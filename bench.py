@@ -1,0 +1,73 @@
+"""Benchmark driver: flagship Transformer training throughput on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The workload is the reference's headline Transformer benchmark
+(reference: examples/cpp/Transformer/transformer.cc — 12 layers, hidden
+1024, 16 heads, seq 512, bs 8/chip, SGD, MSE; prints THROUGHPUT samples/s).
+`vs_baseline` is measured against BASELINE_SAMPLES_PER_SEC, the first
+recorded single-chip data-parallel number of this rebuild (the reference
+repo publishes no figures — BASELINE.md; its story is self-relative).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+# First recorded throughput of this framework's round-1 data-parallel
+# Transformer step on one v5e-lite chip; later rounds must beat it.
+BASELINE_SAMPLES_PER_SEC = 12.0
+
+
+def main():
+    import jax
+
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from examples.transformer import build_transformer, synthetic_batch
+
+    batch_size, seq, hidden, heads, layers = 8, 512, 1024, 16, 12
+    model, _ = build_transformer(
+        batch_size=batch_size,
+        seq_len=seq,
+        hidden=hidden,
+        num_heads=heads,
+        num_layers=layers,
+    )
+    step = model.executor.train_step()
+    batch = model.executor.shard_batch(
+        synthetic_batch(batch_size, seq, hidden)
+    )
+    params, opt_state = model.params, model.opt_state
+    rng = jax.random.PRNGKey(0)
+
+    # warmup / compile
+    for _ in range(2):
+        rng, k = jax.random.split(rng)
+        params, opt_state, loss, _ = step(params, opt_state, batch, k)
+    jax.block_until_ready(loss)
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        rng, k = jax.random.split(rng)
+        params, opt_state, loss, _ = step(params, opt_state, batch, k)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    thpt = batch_size * iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "transformer_12L_1024h_seq512_train_throughput",
+                "value": round(thpt, 2),
+                "unit": "samples/s",
+                "vs_baseline": round(thpt / BASELINE_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Transformer benchmark workload.
+
+Mirror of the reference example (reference: examples/cpp/Transformer/
+transformer.cc:79-85 config — 12 layers, hidden 1024, 16 heads, seq 512;
+encoder layer :33-45 = MHA then two biasless dense layers; final dense(1),
+SGD lr 0.01, MSE loss, THROUGHPUT print :209).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    SGDOptimizer,
+)
+
+
+def build_transformer(
+    config: FFConfig = None,
+    batch_size: int = 8,
+    seq_len: int = 512,
+    hidden: int = 1024,
+    num_heads: int = 16,
+    num_layers: int = 12,
+    compile_now: bool = True,
+    devices=None,
+):
+    cfg = config or FFConfig(batch_size=batch_size, learning_rate=0.01)
+    cfg.batch_size = batch_size
+    model = FFModel(cfg)
+    x = model.create_tensor([batch_size, seq_len, hidden], name="x")
+    t = x
+    for _ in range(num_layers):
+        t = model.multihead_attention(t, t, t, hidden, num_heads)
+        t = model.dense(t, hidden, activation=ActiMode.RELU, use_bias=False)
+        t = model.dense(t, hidden, use_bias=False)
+    t = model.dense(t, 1, use_bias=False)
+    if compile_now:
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.01),
+            loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+            metrics=[],
+            devices=devices,
+        )
+    return model, t
+
+
+def synthetic_batch(batch_size=8, seq_len=512, hidden=1024, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": rng.randn(batch_size, seq_len, hidden).astype(np.float32),
+        "label": rng.randn(batch_size, seq_len, 1).astype(np.float32),
+    }
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    model, _ = build_transformer(cfg, batch_size=cfg.batch_size)
+    num_samples = cfg.batch_size * (cfg.iterations or 32)
+    batch = synthetic_batch(num_samples, 512, 1024)
+    model.fit(batch["x"], batch["label"], epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

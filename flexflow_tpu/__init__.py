@@ -1,0 +1,62 @@
+"""flexflow_tpu: a TPU-native automatic-parallelization training framework.
+
+A from-scratch rebuild of the capabilities of FlexFlow/Unity (reference:
+daiyaanarfeen/FlexFlow; see SURVEY.md) designed for TPU: the model-builder
+API produces a Parallel Computation Graph, `compile()` searches over
+substitutions and per-op mesh placements with a calibrated cost model, and
+the chosen strategy executes as one jitted XLA program with GSPMD shardings
+over an ICI mesh.
+"""
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.machine import MachineResource, MachineSpec, MachineView
+from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
+from flexflow_tpu.core.types import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OperatorType,
+    ParameterSyncType,
+)
+from flexflow_tpu.runtime.executor import MeshConfig
+from flexflow_tpu.runtime.initializer import (
+    ConstantInitializer,
+    GlorotUniform,
+    NormInitializer,
+    UniformInitializer,
+    ZeroInitializer,
+)
+from flexflow_tpu.runtime.model import FFModel, Tensor
+from flexflow_tpu.runtime.optimizer import AdamOptimizer, SGDOptimizer
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "FFConfig",
+    "FFModel",
+    "Tensor",
+    "DataType",
+    "OperatorType",
+    "ActiMode",
+    "AggrMode",
+    "LossType",
+    "MetricsType",
+    "CompMode",
+    "ParameterSyncType",
+    "ParallelDim",
+    "ParallelTensorShape",
+    "MachineView",
+    "MachineResource",
+    "MachineSpec",
+    "MeshConfig",
+    "SGDOptimizer",
+    "AdamOptimizer",
+    "GlorotUniform",
+    "ZeroInitializer",
+    "ConstantInitializer",
+    "UniformInitializer",
+    "NormInitializer",
+]

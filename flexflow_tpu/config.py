@@ -1,0 +1,152 @@
+"""Runtime configuration + CLI flag parsing.
+
+Re-design of FFConfig (reference: include/flexflow/config.h:92-165,
+FFConfig::parse_args src/runtime/model.cc:3541-3697). The Legion `-ll:*`
+resource flags become mesh/topology settings; search and training flags keep
+the reference's spellings so the example scripts read the same.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+# reference: config.h:40-53 compile-time bounds
+MAX_NUM_INPUTS = 256
+MAX_NUM_WEIGHTS = 64
+MAX_NUM_OUTPUTS = 256
+MAX_NUM_WORKERS = 1024
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # training (reference flags -e/-b/--lr/--wd)
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    iterations: Optional[int] = None
+
+    # machine (reference: -ll:gpu/-ll:cpu + numNodes)
+    num_nodes: int = 1
+    workers_per_node: int = 0  # 0 = use all local devices
+    chip: str = "v4"
+
+    # search (reference: --budget/--alpha/--import/--export/…)
+    search_budget: int = 0
+    search_alpha: float = 1.05
+    import_strategy_file: str = ""
+    export_strategy_file: str = ""
+    only_data_parallel: bool = False
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_sample_parallel: bool = False
+    enable_inplace_optimizations: bool = False
+    base_optimize_threshold: int = 10  # reference: config.h:155
+    substitution_json: str = ""
+    # search-without-hardware overrides (reference: model.cc:3673-3680)
+    search_num_nodes: int = -1
+    search_num_workers: int = -1
+
+    # runtime
+    perform_fusion: bool = False  # reference: --fusion
+    profiling: bool = False
+    seed: int = 0
+    # numerics: allow bf16 matmul accumulation paths (reference:
+    # --allow-tensor-op-math-conversion picks TF32/FP16 tensor cores)
+    allow_mixed_precision: bool = True
+
+    # visualization dumps (reference: --compgraph/--taskgraph/--export-strategy)
+    computation_graph_file: str = ""
+    task_graph_file: str = ""
+    include_costs_dot_graph: bool = False
+
+    # per-iteration dynamic config (reference: FFIterationConfig, config.h:160)
+    seq_length: Optional[int] = None
+
+    @property
+    def num_devices(self) -> int:
+        import jax
+
+        if self.workers_per_node <= 0:
+            return len(jax.devices()) * max(1, self.num_nodes) // max(1, self.num_nodes)
+        return self.num_nodes * self.workers_per_node
+
+    def total_workers(self) -> int:
+        if self.workers_per_node > 0:
+            return self.num_nodes * self.workers_per_node
+        import jax
+
+        return len(jax.devices())
+
+    @staticmethod
+    def parse_args(argv: Optional[Sequence[str]] = None) -> "FFConfig":
+        """Parse the reference's CLI spellings (model.cc:3541-3697)."""
+        import sys
+
+        cfg = FFConfig()
+        args = list(sys.argv[1:] if argv is None else argv)
+        i = 0
+
+        def take():
+            nonlocal i
+            i += 1
+            return args[i]
+
+        while i < len(args):
+            a = args[i]
+            if a in ("-e", "--epochs"):
+                cfg.epochs = int(take())
+            elif a in ("-b", "--batch-size"):
+                cfg.batch_size = int(take())
+            elif a == "--lr" or a == "--learning-rate":
+                cfg.learning_rate = float(take())
+            elif a == "--wd" or a == "--weight-decay":
+                cfg.weight_decay = float(take())
+            elif a in ("-i", "--iterations"):
+                cfg.iterations = int(take())
+            elif a == "--budget" or a == "--search-budget":
+                cfg.search_budget = int(take())
+            elif a == "--alpha" or a == "--search-alpha":
+                cfg.search_alpha = float(take())
+            elif a == "--import" or a == "--import-strategy":
+                cfg.import_strategy_file = take()
+            elif a == "--export" or a == "--export-strategy":
+                cfg.export_strategy_file = take()
+            elif a == "--only-data-parallel":
+                cfg.only_data_parallel = True
+            elif a == "--enable-parameter-parallel":
+                cfg.enable_parameter_parallel = True
+            elif a == "--enable-attribute-parallel":
+                cfg.enable_attribute_parallel = True
+            elif a == "--enable-sample-parallel":
+                cfg.enable_sample_parallel = True
+            elif a == "--base-optimize-threshold":
+                cfg.base_optimize_threshold = int(take())
+            elif a == "--substitution-json":
+                cfg.substitution_json = take()
+            elif a == "--search-num-nodes":
+                cfg.search_num_nodes = int(take())
+            elif a == "--search-num-workers":
+                cfg.search_num_workers = int(take())
+            elif a == "--fusion":
+                cfg.perform_fusion = True
+            elif a == "--profiling":
+                cfg.profiling = True
+            elif a == "--seed":
+                cfg.seed = int(take())
+            elif a == "--compgraph":
+                cfg.computation_graph_file = take()
+            elif a == "--taskgraph":
+                cfg.task_graph_file = take()
+            elif a == "--nodes":
+                cfg.num_nodes = int(take())
+            elif a == "-ll:gpu" or a == "-ll:tpu" or a == "--workers-per-node":
+                cfg.workers_per_node = int(take())
+            elif a == "--chip":
+                cfg.chip = take()
+            # silently accept remaining legion-style flags with one value
+            elif a.startswith("-ll:") or a.startswith("-lg:"):
+                take()
+            i += 1
+        return cfg

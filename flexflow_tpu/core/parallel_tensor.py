@@ -1,0 +1,254 @@
+"""Parallel tensor shape model.
+
+The central abstraction of the framework, re-designed for TPU/GSPMD from the
+reference's `ParallelDim {size, degree, parallel_idx, is_replica_dim}`
+(reference: include/flexflow/parallel_tensor.h:36-70).
+
+Key differences from the reference:
+  * dims are stored in numpy order (outermost first), not Legion order;
+  * `parallel_idx` indexes a *mesh axis* of the global `jax.sharding.Mesh`
+    rather than a MachineView dim — the lowering turns a shape directly into
+    a `PartitionSpec`;
+  * replica dims are represented explicitly like in the reference (a dim with
+    `is_replica_dim=True`, size == degree) because the parallel-op rewrite
+    rules (Replicate/Reduction) and the search's dim-mapping solver reason
+    about them; they vanish at lowering time (GSPMD replicates implicitly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.types import DataType
+
+# Mesh axes used by the lowering. The search assigns degrees to tensor dims;
+# the lowering maps each parallel dim to one of these named axes.
+MAX_TENSOR_DIMS = 5  # reference: MAX_TENSOR_DIM in config.h
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDim:
+    """One tensor dimension with its parallel annotation.
+
+    size: global (unpartitioned) extent of this dim.
+    degree: number of shards this dim is split into (1 = not partitioned).
+    parallel_idx: index of the mesh axis this dim's shards map onto
+        (-1 when degree == 1).
+    is_replica_dim: this is a synthetic replication dim (size == degree);
+        used on weights under data parallelism and activations under
+        tensor parallelism (reference: parallel_tensor.h:36-70).
+    """
+
+    size: int
+    degree: int = 1
+    parallel_idx: int = -1
+    is_replica_dim: bool = False
+
+    def __post_init__(self):
+        if self.size <= 0:
+            raise ValueError(f"dim size must be positive, got {self.size}")
+        if self.degree < 1:
+            raise ValueError(f"degree must be >= 1, got {self.degree}")
+        if self.size % self.degree != 0:
+            raise ValueError(
+                f"degree {self.degree} does not divide size {self.size}"
+            )
+        if self.is_replica_dim and self.size != self.degree:
+            raise ValueError("replica dim must have size == degree")
+
+    @property
+    def piece_size(self) -> int:
+        return self.size // self.degree
+
+    def with_degree(self, degree: int, parallel_idx: int = -1) -> "ParallelDim":
+        return dataclasses.replace(
+            self, degree=degree, parallel_idx=parallel_idx if degree > 1 else -1
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelTensorShape:
+    """Shape + dtype + per-dim parallel annotations.
+
+    reference: ParallelTensorShape in parallel_tensor.h; hashing feeds the
+    search memo tables (graph.cc:1531-1543).
+    """
+
+    dims: Tuple[ParallelDim, ...]
+    dtype: DataType = DataType.FLOAT
+
+    @staticmethod
+    def make(
+        sizes: Sequence[int],
+        dtype: DataType = DataType.FLOAT,
+        degrees: Optional[Sequence[int]] = None,
+        parallel_idxs: Optional[Sequence[int]] = None,
+    ) -> "ParallelTensorShape":
+        degrees = list(degrees) if degrees is not None else [1] * len(sizes)
+        pidxs = (
+            list(parallel_idxs)
+            if parallel_idxs is not None
+            else [-1] * len(sizes)
+        )
+        return ParallelTensorShape(
+            tuple(
+                ParallelDim(s, d, p)
+                for s, d, p in zip(sizes, degrees, pidxs)
+            ),
+            dtype,
+        )
+
+    # -- basic views ---------------------------------------------------------
+
+    @property
+    def ndim(self) -> int:
+        return len(self.dims)
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        """Global sizes including replica dims."""
+        return tuple(d.size for d in self.dims)
+
+    @property
+    def logical_sizes(self) -> Tuple[int, ...]:
+        """Global sizes with replica dims dropped — the array shape JAX sees."""
+        return tuple(d.size for d in self.dims if not d.is_replica_dim)
+
+    @property
+    def degrees(self) -> Tuple[int, ...]:
+        return tuple(d.degree for d in self.dims)
+
+    @property
+    def piece_sizes(self) -> Tuple[int, ...]:
+        """Per-shard local sizes (reference: get_input_sub_tensor)."""
+        return tuple(d.piece_size for d in self.dims)
+
+    @property
+    def total_degree(self) -> int:
+        out = 1
+        for d in self.dims:
+            out *= d.degree
+        return out
+
+    @property
+    def num_replica_dims(self) -> int:
+        return sum(1 for d in self.dims if d.is_replica_dim)
+
+    @property
+    def replica_degree(self) -> int:
+        out = 1
+        for d in self.dims:
+            if d.is_replica_dim:
+                out *= d.degree
+        return out
+
+    def volume(self) -> int:
+        """Number of logical elements (replica dims excluded)."""
+        out = 1
+        for d in self.dims:
+            if not d.is_replica_dim:
+                out *= d.size
+        return out
+
+    def piece_volume(self) -> int:
+        """Elements per shard (replica dims contribute 1)."""
+        out = 1
+        for d in self.dims:
+            out *= 1 if d.is_replica_dim else d.piece_size
+        return out
+
+    def size_bytes(self) -> int:
+        return self.volume() * self.dtype.size_bytes
+
+    def piece_bytes(self) -> int:
+        return self.piece_volume() * self.dtype.size_bytes
+
+    # -- transforms ----------------------------------------------------------
+
+    def with_dim(self, idx: int, dim: ParallelDim) -> "ParallelTensorShape":
+        dims = list(self.dims)
+        dims[idx] = dim
+        return dataclasses.replace(self, dims=tuple(dims))
+
+    def with_degree(
+        self, idx: int, degree: int, parallel_idx: int = -1
+    ) -> "ParallelTensorShape":
+        return self.with_dim(idx, self.dims[idx].with_degree(degree, parallel_idx))
+
+    def data_parallel(self, degree: int, axis: int = 0) -> "ParallelTensorShape":
+        """Partition the sample dim (reference: get_data_parallel_config)."""
+        return self.with_degree(axis, degree, 0)
+
+    def replicated_like(self) -> "ParallelTensorShape":
+        """Drop all partitioning (degree 1 everywhere, no replica dims)."""
+        return ParallelTensorShape(
+            tuple(
+                ParallelDim(d.size)
+                for d in self.dims
+                if not d.is_replica_dim
+            ),
+            self.dtype,
+        )
+
+    def append_replica_dim(self, degree: int, parallel_idx: int = -1):
+        """Add a replication dim at position 0 (reference puts replica dims
+        at the outermost position of weights)."""
+        return ParallelTensorShape(
+            (ParallelDim(degree, degree, parallel_idx, True),) + self.dims,
+            self.dtype,
+        )
+
+    # -- lowering ------------------------------------------------------------
+
+    def partition_spec(self, mesh_axis_names: Sequence[str]):
+        """Lower to a jax PartitionSpec over the global mesh.
+
+        Replica dims produce no spec entry (GSPMD replicates across unused
+        axes implicitly). Each partitioned logical dim maps to the mesh axis
+        named by its parallel_idx.
+        """
+        from jax.sharding import PartitionSpec
+
+        entries = []
+        for d in self.dims:
+            if d.is_replica_dim:
+                continue
+            if d.degree == 1:
+                entries.append(None)
+            else:
+                if d.parallel_idx < 0 or d.parallel_idx >= len(mesh_axis_names):
+                    raise ValueError(
+                        f"dim {d} has degree {d.degree} but no valid mesh axis"
+                    )
+                entries.append(mesh_axis_names[d.parallel_idx])
+        # trim trailing Nones for cleanliness
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+    def is_valid_for_mesh(self, mesh_shape: Sequence[int]) -> bool:
+        """Check degrees fit the mesh: each partitioned dim's degree must
+        equal the size of its assigned mesh axis, and no axis is used twice."""
+        used = set()
+        for d in self.dims:
+            if d.degree == 1:
+                continue
+            if d.parallel_idx < 0 or d.parallel_idx >= len(mesh_shape):
+                return False
+            if d.parallel_idx in used:
+                return False
+            if mesh_shape[d.parallel_idx] != d.degree:
+                return False
+            used.add(d.parallel_idx)
+        return True
+
+    def __str__(self):
+        parts = []
+        for d in self.dims:
+            tag = "r" if d.is_replica_dim else ""
+            if d.degree > 1:
+                parts.append(f"{d.size}/{d.degree}@{d.parallel_idx}{tag}")
+            else:
+                parts.append(f"{d.size}{tag}")
+        return f"[{', '.join(parts)}]:{self.dtype.value}"

@@ -1,0 +1,151 @@
+"""Multi-head attention.
+
+Re-design of the reference's MultiHeadAttention op (reference:
+src/ops/attention.cc:926, attention.cu:35-128 — a monolithic
+cudnnMultiHeadAttnForward call). Here attention is expressed in jnp (XLA
+fuses it well on TPU) with an optional Pallas flash-attention path
+(flexflow_tpu.ops.pallas.flash_attention) selected for long sequences.
+
+Head parallelism follows the reference's substitution semantics
+(reference: substitution.cc:1758-1764 create_partition_attention_combine /
+create_replicate_attention_reduce): a replica dim on the query input becomes
+head partitioning of the QKV/output projections; the output-projection
+contraction over partitioned heads yields partial sums, i.e. a replica dim
+on the output that a downstream Reduction folds.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.ops.registry import register_op
+
+
+def _infer_mha(input_shapes, params):
+    q, k, v = input_shapes
+    embed_dim = params["embed_dim"]
+    num_heads = params["num_heads"]
+    kdim = params.get("kdim", embed_dim)
+    vdim = params.get("vdim", embed_dim)
+    dtype = params.get("dtype", q.dtype)
+    head_dim = embed_dim // num_heads
+
+    rep = [d for d in q.dims if d.is_replica_dim]
+    logical = [d for d in q.dims if not d.is_replica_dim]
+    if len(rep) > 1:
+        raise ValueError("mha: at most one replica dim")
+    r_deg = rep[0].degree if rep else 1
+    r_idx = rep[0].parallel_idx if rep else -1
+    if num_heads % r_deg != 0:
+        raise ValueError("mha: replica degree must divide num_heads")
+
+    b, s, _ = logical
+    out_dims = []
+    if r_deg > 1:
+        out_dims.append(ParallelDim(r_deg, r_deg, r_idx, True))
+    out_dims.extend(
+        [
+            ParallelDim(b.size, b.degree, b.parallel_idx),
+            ParallelDim(s.size, s.degree, s.parallel_idx),
+            ParallelDim(embed_dim),
+        ]
+    )
+    out = ParallelTensorShape(tuple(out_dims), dtype)
+
+    head = ParallelDim(num_heads, r_deg, r_idx)
+    wq = ParallelTensorShape((ParallelDim(embed_dim), head, ParallelDim(head_dim)), dtype)
+    wk = ParallelTensorShape((ParallelDim(kdim), head, ParallelDim(head_dim)), dtype)
+    wv = ParallelTensorShape((ParallelDim(vdim), head, ParallelDim(head_dim)), dtype)
+    wo = ParallelTensorShape((head, ParallelDim(head_dim), ParallelDim(embed_dim)), dtype)
+    weights = [wq, wk, wv, wo]
+    if params.get("bias", True):
+        # per-projection biases (reference: cudnnMultiHeadAttn with biases):
+        # q/k/v biases live in head space (shard with the heads), output
+        # bias is a plain embed_dim vector.
+        bqkv = ParallelTensorShape((head, ParallelDim(head_dim)), dtype)
+        bo = ParallelTensorShape((ParallelDim(embed_dim),), dtype)
+        weights += [bqkv, bqkv, bqkv, bo]
+    return (out,), tuple(weights)
+
+
+def scaled_dot_product_attention(
+    q, k, v, causal=False, bias=None, dropout_rate=0.0, dropout_rng=None
+):
+    """q,k,v: [b, s, h, d] — plain XLA attention; fp32 softmax accumulation.
+    dropout is applied to the attention probabilities (reference: cudnn MHA
+    attnDropout)."""
+    d = q.shape[-1]
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), dtype=bool))
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0.0).astype(probs.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _lower_mha(params):
+    causal = params.get("causal", False)
+    use_flash = params.get("use_flash", "auto")
+    use_bias = params.get("bias", True)
+    dropout = params.get("dropout", 0.0)
+
+    def fn(ins, ws, ctx):
+        xq, xk, xv = ins
+        wq, wk, wv, wo = ws[:4]
+        q = jnp.einsum("bse,ehd->bshd", xq, wq)
+        k = jnp.einsum("bse,ehd->bshd", xk, wk)
+        v = jnp.einsum("bse,ehd->bshd", xv, wv)
+        if use_bias:
+            bq, bk, bv = ws[4], ws[5], ws[6]
+            q = q + bq
+            k = k + bk
+            v = v + bv
+        seq = q.shape[1]
+        dropping = dropout > 0.0 and ctx.train and ctx.rng is not None
+        flash = (
+            use_flash is True or (use_flash == "auto" and seq >= 1024)
+        ) and not dropping  # the Pallas kernel has no prob-dropout path
+        if flash:
+            from flexflow_tpu.ops.pallas.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=causal)
+        else:
+            attn = scaled_dot_product_attention(
+                q,
+                k,
+                v,
+                causal=causal,
+                dropout_rate=dropout if dropping else 0.0,
+                dropout_rng=ctx.rng if dropping else None,
+            )
+        y = jnp.einsum("bshd,hde->bse", attn, wo)
+        if use_bias:
+            y = y + ws[7]
+        return [y]
+
+    return fn
+
+
+def _flops_mha(input_shapes, params):
+    q = input_shapes[0]
+    b, s, e = q.logical_sizes[-3:]
+    proj = 4 * 2.0 * b * s * e * e
+    attn = 2 * 2.0 * b * s * s * e
+    return proj + attn
+
+
+register_op(OperatorType.MULTIHEAD_ATTENTION, _infer_mha, _lower_mha, _flops_mha)

@@ -1,0 +1,207 @@
+"""Mixture-of-Experts op family: TopK, GroupBy, Aggregate(Spec), Cache.
+
+Re-design of the reference's MoE ops (reference: src/ops/{topk,group_by,
+aggregate,aggregate_spec,cache}.{cc,cu}; SURVEY §2.2): expert routing is
+topk → group_by (scatter samples per expert) → expert ops → aggregate
+(gather + gate-weighted sum), with a `lambda_bal` load-balancing loss.
+
+TPU-native differences:
+  * group_by/aggregate use fixed `capacity = ceil(alpha * k * batch / n)`
+    slots per expert so shapes stay static under XLA (the reference sizes
+    buffers the same way, group_by.cc), with one-hot-matmul dispatch —
+    MXU-friendly, the GShard/Mesh-TF formulation — instead of scatter
+    kernels;
+  * dropped tokens (over capacity) contribute zeros, matching the
+    reference's capacity-overflow behavior.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
+from flexflow_tpu.core.types import DataType, OperatorType
+from flexflow_tpu.ops.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# TopK (reference: src/ops/topk.cc)
+# ---------------------------------------------------------------------------
+
+
+def _infer_topk(input_shapes, params):
+    (x,) = input_shapes
+    k = params["k"]
+    last = x.dims[-1]
+    if last.degree > 1:
+        raise ValueError("topk: topk dim may not be partitioned")
+    out_dims = x.dims[:-1] + (ParallelDim(k),)
+    values = ParallelTensorShape(out_dims, x.dtype)
+    indices = ParallelTensorShape(out_dims, DataType.INT32)
+    return (values, indices), ()
+
+
+def _lower_topk(params):
+    k = params["k"]
+
+    def fn(ins, ws, ctx):
+        (x,) = ins
+        values, indices = jax.lax.top_k(x, k)
+        return [values, indices.astype(jnp.int32)]
+
+    return fn
+
+
+register_op(OperatorType.TOPK, _infer_topk, _lower_topk)
+
+
+# ---------------------------------------------------------------------------
+# GroupBy (reference: src/ops/group_by.cc) — scatter samples to experts
+# ---------------------------------------------------------------------------
+
+
+def _capacity(batch, k, n_experts, alpha):
+    return max(1, int(math.ceil(alpha * k * batch / n_experts)))
+
+
+def _infer_group_by(input_shapes, params):
+    data, assign = input_shapes  # data [b, d], assign [b, k] int
+    n = params["n"]
+    alpha = params.get("alpha", 1.0)
+    b = data.dims[0].size
+    k = assign.dims[-1].size
+    cap = _capacity(b, k, n, alpha)
+    out = ParallelTensorShape(
+        (ParallelDim(cap), ParallelDim(data.dims[1].size)), data.dtype
+    )
+    return tuple(out for _ in range(n)), ()
+
+
+def dispatch_slots(assign, n_experts, capacity):
+    """Slot assignment shared by group_by and aggregate.
+
+    assign: [b, k] int expert ids. Returns slot_onehot [b*k, n, cap] 0/1
+    float: entry (i*k+j, e, c) == 1 iff sample i's j-th choice is expert e
+    and it got queue slot c. Tokens past capacity are dropped (all-zero
+    row), like the reference's fixed-size expert batches.
+    """
+    flat = assign.reshape(-1)  # [b*k], sample i -> entries i*k..i*k+k-1
+    onehot = jax.nn.one_hot(flat, n_experts, dtype=jnp.int32)  # [b*k, n]
+    # position of each (sample, slot) within its expert queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [b*k, n], -1 where absent
+    keep = (pos >= 0) & (pos < capacity)
+    pos = jnp.where(keep, pos, 0)
+    return jax.nn.one_hot(pos, capacity, dtype=jnp.float32) * keep[..., None]
+
+
+def dispatch_mask(assign, n_experts, capacity):
+    """dispatch [n, cap, b]: dispatch[e, c, i] == 1 iff sample i holds slot
+    c of expert e (summed over the k choices)."""
+    b, k = assign.shape
+    d = dispatch_slots(assign, n_experts, capacity).reshape(
+        b, k, n_experts, capacity
+    )
+    return jnp.transpose(d, (2, 3, 0, 1)).sum(axis=-1)  # [n, cap, b]
+
+
+def _lower_group_by(params):
+    n = params["n"]
+    alpha = params.get("alpha", 1.0)
+
+    def fn(ins, ws, ctx):
+        data, assign = ins
+        b = data.shape[0]
+        k = assign.shape[-1]
+        cap = _capacity(b, k, n, alpha)
+        d = dispatch_mask(assign, n, cap)  # [n, cap, b]
+        outs = jnp.einsum("ncb,bd->ncd", d.astype(data.dtype), data)
+        return [outs[e] for e in range(n)]
+
+    return fn
+
+
+register_op(OperatorType.GROUP_BY, _infer_group_by, _lower_group_by)
+
+
+# ---------------------------------------------------------------------------
+# Aggregate (reference: src/ops/aggregate.cc) — gate-weighted gather
+# ---------------------------------------------------------------------------
+
+
+def _infer_aggregate(input_shapes, params):
+    # inputs: gate_values [b,k], gate_assign [b,k], exp_pred_0..n-1 [cap, d]
+    n = params["n"]
+    gate_values = input_shapes[0]
+    exp0 = input_shapes[2]
+    b = gate_values.dims[0].size
+    d = exp0.dims[-1].size
+    out = ParallelTensorShape((ParallelDim(b), ParallelDim(d)), exp0.dtype)
+    return (out,), ()
+
+
+def _lower_aggregate(params):
+    n = params["n"]
+    alpha = params.get("alpha", 1.0)
+
+    def fn(ins, ws, ctx):
+        gate_values, assign = ins[0], ins[1]
+        exp_preds = jnp.stack(ins[2:], axis=0)  # [n, cap, d]
+        b, k = assign.shape
+        cap = exp_preds.shape[1]
+        # combine weights: gate value of the (sample, slot) that owns each slot
+        slot_onehot = dispatch_slots(assign, n, cap)  # [b*k, n, cap]
+        gates = gate_values.reshape(-1)[:, None, None]  # [b*k,1,1]
+        combine = (slot_onehot * gates).reshape(b, k, n, cap).sum(axis=1)
+        # combine: [b, n, cap]; output = sum over experts/slots
+        y = jnp.einsum("bnc,ncd->bd", combine.astype(exp_preds.dtype), exp_preds)
+        return [y]
+
+    return fn
+
+
+register_op(OperatorType.AGGREGATE, _infer_aggregate, _lower_aggregate)
+
+
+def _infer_aggregate_spec(input_shapes, params):
+    return _infer_aggregate(input_shapes, params)
+
+
+register_op(OperatorType.AGGREGATE_SPEC, _infer_aggregate_spec, _lower_aggregate)
+
+
+# ---------------------------------------------------------------------------
+# load-balancing auxiliary loss (reference: group_by lambda_bal)
+# ---------------------------------------------------------------------------
+
+
+def load_balance_loss(gate_probs, assign, n_experts):
+    """GShard-style aux loss: n * sum_e (fraction_tokens_e * mean_prob_e)."""
+    b = gate_probs.shape[0]
+    counts = jnp.sum(jax.nn.one_hot(assign[:, 0], n_experts), axis=0)
+    frac = counts / b
+    mean_prob = jnp.mean(gate_probs, axis=0)
+    return n_experts * jnp.sum(frac * mean_prob)
+
+
+# ---------------------------------------------------------------------------
+# Cache (reference: src/ops/cache.cc) — activation memoization
+# ---------------------------------------------------------------------------
+
+
+def _infer_cache(input_shapes, params):
+    return (input_shapes[0],), ()
+
+
+def _lower_cache(params):
+    # Under XLA a trained-step cache is a passthrough; the recompile hook
+    # (runtime.recompile) owns cross-iteration memoization decisions.
+    def fn(ins, ws, ctx):
+        return [ins[0]]
+
+    return fn
+
+
+register_op(OperatorType.CACHE, _infer_cache, _lower_cache)

@@ -1,0 +1,81 @@
+"""Flash attention for TPU.
+
+The reference's attention is one cudnnMultiHeadAttnForward call per shard
+(reference: src/ops/attention.cu:35) with no long-context story (SURVEY §5
+"no ring attention, no blockwise"). This module provides the TPU-native
+upgrade: blockwise-tiled attention that never materializes the [s, s] score
+matrix, written with Pallas when running on TPU.
+
+Current status: the jnp blockwise formulation below is numerically exact
+(online-softmax over key blocks via lax.scan, fp32 accumulators) and XLA
+compiles it into a fused streaming loop; a hand-tiled Pallas kernel can
+replace `_blockwise_attention` without changing callers.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _blockwise_attention(q, k, v, causal: bool, block_k: int):
+    """Online-softmax attention over key blocks. q,k,v: [b, s, h, d]."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    nk = (sk + block_k - 1) // block_k
+    pad = nk * block_k - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    scale = 1.0 / math.sqrt(d)
+    q32 = q.astype(jnp.float32) * scale
+    kb = k.reshape(b, nk, block_k, h, d).astype(jnp.float32)
+    vb = v.reshape(b, nk, block_k, h, d).astype(jnp.float32)
+    kpos = jnp.arange(nk * block_k).reshape(nk, block_k)
+    qpos = jnp.arange(sq)
+
+    def body(carry, blk):
+        m, l, acc = carry
+        kblk, vblk, kp = blk
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kblk)
+        mask = kp[None, None, None, :] < sk
+        if causal:
+            mask = mask & (kp[None, None, None, :] <= qpos[None, None, :, None])
+        logits = jnp.where(mask, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # guard fully-masked rows (m_new == -inf)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(mask, p, 0.0)
+        correction = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+        correction = jnp.where(jnp.isfinite(m), correction, 0.0)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vblk
+        )
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        body,
+        (m0, l0, acc0),
+        (
+            jnp.moveaxis(kb, 1, 0),
+            jnp.moveaxis(vb, 1, 0),
+            kpos,
+        ),
+    )
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_k"))
+def flash_attention(q, k, v, causal: bool = False, block_k: int = 512):
+    """q, k, v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim]."""
+    return _blockwise_attention(q, k, v, causal, block_k)

@@ -1,0 +1,205 @@
+"""Parallel operators: Repartition, Combine, Replicate, Reduction, FusedParallel.
+
+Re-design of the reference's parallel-op layer (reference: src/parallel_ops/,
+include/flexflow/parallel_ops/parallel_op.h:17; SURVEY §2.3). These ops change
+only the parallel layout of a tensor, not its math:
+
+  | op          | reference semantics (fwd)         | TPU lowering            |
+  |-------------|-----------------------------------|-------------------------|
+  | Repartition | split a dim `degree×` more ways   | sharding constraint     |
+  | Combine     | merge a dim's partitions          | sharding constraint     |
+  | Replicate   | add replica dim (broadcast)       | sharding constraint     |
+  | Reduction   | sum over replica dim              | sharding constraint     |
+
+In the reference, data movement happens through Legion partitions read by the
+op's index tasks (reference: combine.cc:135-176); grads of Replicate are
+summed (reference: replicate_kernels.cu:35-57). Here every parallel op is an
+*identity on the global logical array* whose output ParallelTensorShape
+carries the new layout; the executor emits
+`jax.lax.with_sharding_constraint` from that shape and GSPMD inserts the
+matching collectives (all-to-all / all-gather / psum / reduce-scatter) over
+ICI — including the transposed ones in the backward pass, which XLA derives
+automatically (Replicate's grad-psum falls out of differentiation).
+
+One real semantic note: a "partial-sums" replica dim (produced by a Linear
+whose contraction dim is partitioned) does not exist at the logical-array
+level — jnp.matmul expresses the full contraction and GSPMD materializes the
+partial sums + psum when the weight is sharded on the contraction dim. The
+Reduction op therefore marks *where* the psum lands, which the cost model
+charges for, but lowers to a layout constraint only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.ops.registry import register_op
+
+
+def _identity_lower(params):
+    def fn(ins, ws, ctx):
+        return [ins[0]]
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Repartition (reference: src/parallel_ops/partition.cc)
+# ---------------------------------------------------------------------------
+
+
+def _infer_repartition(input_shapes, params):
+    (x,) = input_shapes
+    axis = params["axis"]
+    degree = params["degree"]
+    parallel_idx = params.get("parallel_idx", -1)
+    d = x.dims[axis]
+    if d.is_replica_dim:
+        raise ValueError("repartition: use Replicate for replica dims")
+    new_degree = d.degree * degree
+    if d.size % new_degree != 0:
+        raise ValueError(
+            f"repartition: degree {new_degree} does not divide size {d.size}"
+        )
+    out = x.with_dim(axis, ParallelDim(d.size, new_degree, parallel_idx))
+    return (out,), ()
+
+
+register_op(OperatorType.REPARTITION, _infer_repartition, _identity_lower)
+
+
+# ---------------------------------------------------------------------------
+# Combine (reference: src/parallel_ops/combine.cc:88 degree /= combine_degree)
+# ---------------------------------------------------------------------------
+
+
+def _infer_combine(input_shapes, params):
+    (x,) = input_shapes
+    axis = params["axis"]
+    degree = params["degree"]
+    d = x.dims[axis]
+    if d.degree % degree != 0:
+        raise ValueError(
+            f"combine: combine degree {degree} does not divide dim degree {d.degree}"
+        )
+    new_degree = d.degree // degree
+    pidx = d.parallel_idx if new_degree > 1 else params.get("parallel_idx", -1)
+    if new_degree == 1:
+        pidx = -1
+    out = x.with_dim(axis, ParallelDim(d.size, new_degree, pidx))
+    return (out,), ()
+
+
+register_op(OperatorType.COMBINE, _infer_combine, _identity_lower)
+
+
+# ---------------------------------------------------------------------------
+# Replicate (reference: src/parallel_ops/replicate.cc)
+# ---------------------------------------------------------------------------
+
+
+def _infer_replicate(input_shapes, params):
+    (x,) = input_shapes
+    degree = params["degree"]
+    parallel_idx = params.get("parallel_idx", -1)
+    out = x.append_replica_dim(degree, parallel_idx)
+    return (out,), ()
+
+
+register_op(OperatorType.REPLICATE, _infer_replicate, _identity_lower)
+
+
+# ---------------------------------------------------------------------------
+# Reduction (reference: src/parallel_ops/reduction.cc)
+# ---------------------------------------------------------------------------
+
+
+def _infer_reduction(input_shapes, params):
+    (x,) = input_shapes
+    degree = params["degree"]
+    rep_idx = None
+    for i, d in enumerate(x.dims):
+        if d.is_replica_dim:
+            rep_idx = i
+            break
+    if rep_idx is None:
+        raise ValueError("reduction: input has no replica dim")
+    if x.dims[rep_idx].degree != degree:
+        raise ValueError(
+            f"reduction: degree {degree} != replica degree {x.dims[rep_idx].degree}"
+        )
+    out = ParallelTensorShape(
+        x.dims[:rep_idx] + x.dims[rep_idx + 1 :], x.dtype
+    )
+    return (out,), ()
+
+
+register_op(OperatorType.REDUCTION, _infer_reduction, _identity_lower)
+
+
+# ---------------------------------------------------------------------------
+# AllToAll (TPU-native addition: Ulysses-style sequence<->head reshard)
+# ---------------------------------------------------------------------------
+
+
+def _infer_alltoall(input_shapes, params):
+    """Move partitioning from src_axis to dst_axis in one collective."""
+    (x,) = input_shapes
+    src, dst = params["src_axis"], params["dst_axis"]
+    d_src = x.dims[src]
+    if d_src.degree == 1:
+        raise ValueError("alltoall: src axis not partitioned")
+    degree, pidx = d_src.degree, d_src.parallel_idx
+    d_dst = x.dims[dst]
+    if d_dst.degree != 1:
+        raise ValueError("alltoall: dst axis already partitioned")
+    out = x.with_dim(src, ParallelDim(d_src.size)).with_dim(
+        dst, ParallelDim(d_dst.size, degree, pidx)
+    )
+    return (out,), ()
+
+
+register_op(OperatorType.ALLTOALL, _infer_alltoall, _identity_lower)
+
+
+# ---------------------------------------------------------------------------
+# FusedParallelOp (reference: src/parallel_ops/fused_parallel_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelOpInfo:
+    """One step of a fused parallel chain
+    (reference: parallel_op.h ParallelOpInfo)."""
+
+    op_type: OperatorType
+    axis: int
+    degree: int
+    parallel_idx: int = -1
+
+
+def _infer_fused_parallel(input_shapes, params):
+    shape = input_shapes[0]
+    for info in params["chain"]:
+        sub = {
+            "axis": info.axis,
+            "degree": info.degree,
+            "parallel_idx": info.parallel_idx,
+        }
+        if info.op_type == OperatorType.REPARTITION:
+            (shape,), _ = _infer_repartition([shape], sub)
+        elif info.op_type == OperatorType.COMBINE:
+            (shape,), _ = _infer_combine([shape], sub)
+        elif info.op_type == OperatorType.REPLICATE:
+            (shape,), _ = _infer_replicate([shape], sub)
+        elif info.op_type == OperatorType.REDUCTION:
+            (shape,), _ = _infer_reduction([shape], sub)
+        else:
+            raise ValueError(f"fused parallel: bad step {info.op_type}")
+    return (shape,), ()
+
+
+register_op(OperatorType.FUSED_PARALLEL, _infer_fused_parallel, _identity_lower)

@@ -1,0 +1,96 @@
+"""Parallelization strategies: how an un-annotated PCG gets its parallel dims.
+
+A Strategy bundles the global MeshConfig with the per-tensor degree
+annotations. The data-parallel strategy replicates the reference's
+`--only-data-parallel` mode (reference: graph.cc:1588-1613 — a 1-D view over
+all devices partitioning the sample dim). Searched strategies (Unity DP /
+MCMC, flexflow_tpu.search) produce per-op annotations that `apply` writes
+into the graph before shape propagation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Optional, Tuple
+
+from flexflow_tpu.core.parallel_tensor import ParallelTensorShape
+from flexflow_tpu.core.pcg import PCGGraph
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.runtime.executor import MeshConfig
+
+
+@dataclasses.dataclass
+class Strategy:
+    mesh_config: MeshConfig
+    # callable mutating the graph's source annotations / inserting parallel ops
+    _apply: Optional[Callable[[PCGGraph], None]] = None
+    name: str = "custom"
+
+    def apply(self, graph: PCGGraph):
+        if self._apply is not None:
+            self._apply(graph)
+
+
+def effective_dp_degree(graph: PCGGraph, num_devices: int) -> int:
+    """Largest degree <= num_devices dividing every input's batch dim.
+    The mesh is sized to this degree — a PartitionSpec must shard a dim
+    exactly axis-size ways, so degree and mesh axis cannot disagree."""
+    batches = [
+        n.params["shape"].dims[0].size
+        for n in graph.nodes.values()
+        if n.op_type == OperatorType.INPUT and not n.inputs
+    ]
+    if not batches:
+        return 1
+    for d in range(min(num_devices, min(batches)), 0, -1):
+        if all(b % d == 0 for b in batches):
+            return d
+    return 1
+
+
+def data_parallel_strategy(num_devices: int, graph: PCGGraph = None) -> Strategy:
+    """Partition every input's sample (outermost) dim over the data axis
+    (reference: --only-data-parallel, graph.cc:1588-1613)."""
+    dp = (
+        effective_dp_degree(graph, num_devices)
+        if graph is not None
+        else num_devices
+    )
+
+    def apply(g: PCGGraph):
+        degree = effective_dp_degree(g, dp)
+        if degree <= 1:
+            return
+        for node in g.nodes.values():
+            if node.op_type == OperatorType.INPUT and not node.inputs:
+                shape: ParallelTensorShape = node.params["shape"]
+                new_shape = shape.data_parallel(degree)
+                node.params["shape"] = new_shape
+                node.output_shapes = (new_shape,)
+
+    return Strategy(
+        MeshConfig.data_parallel(max(dp, 1)), apply, name="data-parallel"
+    )
+
+
+def choose_strategy(model, num_devices: int) -> Strategy:
+    """Strategy selection at compile() (reference: model.cc:2789 →
+    graph_optimize_task, graph.cc:1545-1613): data-parallel unless a search
+    budget asks for the Unity-style search."""
+    cfg = model.config
+    if cfg.import_strategy_file:
+        from flexflow_tpu.search.strategy_io import load_strategy
+
+        return load_strategy(cfg.import_strategy_file, model.graph, num_devices)
+    if cfg.only_data_parallel or cfg.search_budget <= 0:
+        return data_parallel_strategy(num_devices, model.graph)
+    from flexflow_tpu.search.auto import search_strategy
+
+    return search_strategy(model, num_devices)
+
+
+def export_strategy(strategy: Strategy, path: str):
+    from flexflow_tpu.search.strategy_io import save_strategy
+
+    save_strategy(strategy, path)

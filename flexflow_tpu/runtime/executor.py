@@ -1,0 +1,263 @@
+"""PCG → XLA executor.
+
+This is the TPU-native replacement for the reference's entire execution stack
+(Legion index launches + FFMapper + CUDA kernels; SURVEY §3.3): the annotated
+PCG lowers to ONE pure train-step function, jitted over a
+`jax.sharding.Mesh`. Per-op MachineViews/parallel dims become
+`with_sharding_constraint`s; GSPMD inserts the collectives the reference's
+parallel ops / NCCL allreduce performed explicitly; Legion's begin/end_trace
+iteration replay (reference: transformer.cc:192-198) is subsumed by jit
+compilation caching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from flexflow_tpu.core.parallel_tensor import ParallelTensorShape
+from flexflow_tpu.core.pcg import PCGGraph, TensorRef
+from flexflow_tpu.core.types import LossType, MetricsType, OperatorType
+from flexflow_tpu.ops.registry import LowerCtx, infer_shapes, lower_op
+from flexflow_tpu.runtime.initializer import default_weight_initializer
+from flexflow_tpu.runtime.loss import compute_loss
+from flexflow_tpu.runtime.metrics import compute_metrics
+from flexflow_tpu.runtime.optimizer import Optimizer
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """The global device mesh the strategy is expressed over.
+
+    axis i of this mesh is what ParallelDim.parallel_idx == i refers to.
+    This is the v1 restriction documented in SURVEY §7: every MachineView
+    the search picks must be expressible as sub-axes of one global mesh
+    (the reference allows arbitrary per-op device sets).
+    """
+
+    axis_names: Tuple[str, ...] = ("data",)
+    axis_sizes: Tuple[int, ...] = (1,)
+
+    @property
+    def num_devices(self) -> int:
+        out = 1
+        for s in self.axis_sizes:
+            out *= s
+        return out
+
+    def build_mesh(self, devices=None) -> Mesh:
+        devices = jax.devices() if devices is None else list(devices)
+        n = self.num_devices
+        if len(devices) < n:
+            raise ValueError(
+                f"mesh needs {n} devices, have {len(devices)}"
+            )
+        arr = np.array(devices[:n]).reshape(self.axis_sizes)
+        return Mesh(arr, self.axis_names)
+
+    @staticmethod
+    def data_parallel(num_devices: int) -> "MeshConfig":
+        return MeshConfig(("data",), (num_devices,))
+
+
+def propagate_shapes(graph: PCGGraph):
+    """Re-run parallel-shape inference over the whole graph in topo order.
+
+    Called after a strategy annotates source nodes or inserts parallel ops —
+    the equivalent of the reference's per-op output-dim solve at PCG
+    construction (reference: model.cc:494-647).
+    """
+    for guid in graph.topo_order():
+        node = graph.nodes[guid]
+        if not node.inputs:
+            (outs, weights) = infer_shapes(node.op_type, [], node.params)
+            node.output_shapes = tuple(outs)
+            continue
+        in_shapes = [graph.shape_of(r) for r in node.inputs]
+        outs, weights = infer_shapes(node.op_type, in_shapes, node.params)
+        node.output_shapes = tuple(outs)
+        node.weight_shapes = tuple(weights)
+
+
+class Executor:
+    """Compiles an annotated PCG into jitted step functions."""
+
+    def __init__(
+        self,
+        graph: PCGGraph,
+        mesh_config: MeshConfig,
+        logits_ref: TensorRef,
+        label_shape: Optional[ParallelTensorShape] = None,
+        loss_type: Optional[LossType] = None,
+        metrics: Sequence[MetricsType] = (),
+        optimizer: Optional[Optimizer] = None,
+        devices=None,
+        aux_loss_fns=(),
+        logits_from_logits: bool = True,
+    ):
+        self.graph = graph
+        self.mesh_config = mesh_config
+        self.mesh = mesh_config.build_mesh(devices)
+        self.logits_ref = logits_ref
+        self.label_shape = label_shape
+        self.loss_type = loss_type
+        self.metric_types = tuple(metrics)
+        self.optimizer = optimizer
+        self.aux_loss_fns = tuple(aux_loss_fns)
+        self.logits_from_logits = logits_from_logits
+        self.topo = graph.topo_order()
+        self._lowered = {
+            g: lower_op(graph.nodes[g].op_type, graph.nodes[g].params)
+            for g in self.topo
+        }
+        self._train_step = None
+        self._eval_step = None
+        self._fwd = None
+
+    # -- shardings -----------------------------------------------------------
+
+    def sharding_for(self, shape: ParallelTensorShape) -> NamedSharding:
+        spec = shape.partition_spec(self.mesh_config.axis_names)
+        return NamedSharding(self.mesh, spec)
+
+    def _constrain(self, x, shape: ParallelTensorShape):
+        if shape.total_degree > 1 and any(
+            d.degree > 1 and not d.is_replica_dim for d in shape.dims
+        ):
+            return jax.lax.with_sharding_constraint(x, self.sharding_for(shape))
+        return x
+
+    # -- parameters ----------------------------------------------------------
+
+    def init_params(self, rng) -> Dict[int, List[jnp.ndarray]]:
+        """Initialize + shard all weights (reference: initializer tasks at
+        Op::init, SURVEY §2.1)."""
+        params: Dict[int, List[jnp.ndarray]] = {}
+        for guid in self.topo:
+            node = self.graph.nodes[guid]
+            if not node.weight_shapes:
+                continue
+            ws = []
+            inits = node.params.get("initializers")
+            for i, wshape in enumerate(node.weight_shapes):
+                init = (
+                    inits[i]
+                    if inits is not None and inits[i] is not None
+                    else default_weight_initializer(node.name, i, wshape)
+                )
+                key = jax.random.fold_in(rng, guid * 131 + i)
+                arr = init.create(key, wshape)
+                arr = jax.device_put(arr, self.sharding_for(wshape))
+                ws.append(arr)
+            params[guid] = ws
+        return params
+
+    # -- forward -------------------------------------------------------------
+
+    def forward_values(self, params, batch, rng=None, train=True):
+        """Evaluate the PCG; returns {(guid, out_idx): array}."""
+        values: Dict[Tuple[int, int], jnp.ndarray] = {}
+        for guid in self.topo:
+            node = self.graph.nodes[guid]
+            if node.op_type in (OperatorType.INPUT, OperatorType.NOOP) and not node.inputs:
+                if node.name not in batch:
+                    raise KeyError(f"batch missing input '{node.name}'")
+                x = batch[node.name]
+                x = self._constrain(x, node.output_shapes[0])
+                values[(guid, 0)] = x
+                continue
+            ins = [values[(r.guid, r.out_idx)] for r in node.inputs]
+            ws = params.get(guid, [])
+            ctx = LowerCtx(
+                train=train,
+                rng=None if rng is None else jax.random.fold_in(rng, guid),
+            )
+            outs = self._lowered[guid](ins, ws, ctx)
+            for i, out in enumerate(outs):
+                out = self._constrain(out, node.output_shapes[i])
+                values[(guid, i)] = out
+        return values
+
+    def _loss_and_metrics(self, params, batch, rng, train):
+        values = self.forward_values(params, batch, rng, train)
+        logits = values[(self.logits_ref.guid, self.logits_ref.out_idx)]
+        labels = batch["label"]
+        loss = compute_loss(
+            self.loss_type, logits, labels, from_logits=self.logits_from_logits
+        )
+        for fn in self.aux_loss_fns:
+            loss = loss + fn(values, batch)
+        mets = compute_metrics(
+            self.metric_types, logits, labels, from_logits=self.logits_from_logits
+        )
+        return loss, mets
+
+    # -- compiled entry points ----------------------------------------------
+
+    def train_step_fn(self):
+        """(params, opt_state, batch, rng) -> (params, opt_state, loss, metrics)"""
+
+        def step(params, opt_state, batch, rng):
+            def loss_fn(p):
+                return self._loss_and_metrics(p, batch, rng, train=True)
+
+            (loss, mets), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            new_params, new_state = self.optimizer.update(params, grads, opt_state)
+            return new_params, new_state, loss, mets
+
+        return step
+
+    def train_step(self):
+        if self._train_step is None:
+            self._train_step = jax.jit(self.train_step_fn(), donate_argnums=(0, 1))
+        return self._train_step
+
+    def eval_step(self):
+        if self._eval_step is None:
+
+            def step(params, batch):
+                return self._loss_and_metrics(params, batch, None, train=False)
+
+            self._eval_step = jax.jit(step)
+        return self._eval_step
+
+    def forward_fn(self):
+        """Inference forward: (params, batch) -> logits."""
+        if self._fwd is None:
+
+            def fwd(params, batch):
+                values = self.forward_values(params, batch, None, train=False)
+                return values[(self.logits_ref.guid, self.logits_ref.out_idx)]
+
+            self._fwd = jax.jit(fwd)
+        return self._fwd
+
+    # -- data placement ------------------------------------------------------
+
+    def shard_batch(self, batch: Dict[str, np.ndarray]):
+        """Host→device transfer with each input's searched sharding
+        (the TPU analog of the reference's SingleDataLoader index-launched
+        shard copies, python/flexflow_dataloader.cc)."""
+        out = {}
+        shapes = self.input_shapes()
+        for name, arr in batch.items():
+            if name in shapes:
+                out[name] = jax.device_put(arr, self.sharding_for(shapes[name]))
+            else:
+                out[name] = jax.device_put(arr)
+        return out
+
+    def input_shapes(self) -> Dict[str, ParallelTensorShape]:
+        out = {}
+        for guid in self.topo:
+            node = self.graph.nodes[guid]
+            if node.op_type == OperatorType.INPUT and not node.inputs:
+                out[node.name] = node.output_shapes[0]
+        if self.label_shape is not None:
+            out["label"] = self.label_shape
+        return out

@@ -1,0 +1,101 @@
+"""Weight initializers (reference: src/runtime/initializer.cc,
+initializer_kernel.cu — Glorot-uniform, Zero, Constant, Uniform, Normal as
+device tasks; here each is a pure function of a PRNG key)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.parallel_tensor import ParallelTensorShape
+
+
+@dataclasses.dataclass(frozen=True)
+class Initializer:
+    def create(self, key, shape: ParallelTensorShape):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class GlorotUniform(Initializer):
+    """reference: GlorotUniform in initializer.cc — limit sqrt(6/(fi+fo))."""
+
+    seed: int = 0
+
+    def create(self, key, shape: ParallelTensorShape):
+        sizes = shape.logical_sizes
+        if len(sizes) >= 2:
+            fan_in = math.prod(sizes[:-1])
+            fan_out = sizes[-1]
+        else:
+            fan_in = fan_out = sizes[0]
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(
+            key, sizes, shape.dtype.to_jnp(), minval=-limit, maxval=limit
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroInitializer(Initializer):
+    def create(self, key, shape: ParallelTensorShape):
+        return jnp.zeros(shape.logical_sizes, shape.dtype.to_jnp())
+
+
+@dataclasses.dataclass(frozen=True)
+class ConstantInitializer(Initializer):
+    value: float = 0.0
+
+    def create(self, key, shape: ParallelTensorShape):
+        return jnp.full(shape.logical_sizes, self.value, shape.dtype.to_jnp())
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformInitializer(Initializer):
+    min_val: float = 0.0
+    max_val: float = 1.0
+    seed: int = 0
+
+    def create(self, key, shape: ParallelTensorShape):
+        return jax.random.uniform(
+            key,
+            shape.logical_sizes,
+            shape.dtype.to_jnp(),
+            minval=self.min_val,
+            maxval=self.max_val,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class NormInitializer(Initializer):
+    mean: float = 0.0
+    stddev: float = 1.0
+    seed: int = 0
+
+    def create(self, key, shape: ParallelTensorShape):
+        return (
+            self.mean
+            + self.stddev
+            * jax.random.normal(key, shape.logical_sizes).astype(
+                shape.dtype.to_jnp()
+            )
+        )
+
+
+def default_weight_initializer(
+    op_name: str, idx: int, shape: ParallelTensorShape = None
+) -> Initializer:
+    """Matrix-shaped weights (rank >= 2: kernels, all four MHA projections)
+    get Glorot; vector weights (biases, LN beta) get zeros — matching the
+    reference's per-op defaults (e.g. linear.cc kernel_initializer /
+    bias_initializer). Scale-style vectors (gamma) must be requested
+    explicitly as ConstantInitializer(1.0) by the builder."""
+    if shape is not None:
+        return (
+            GlorotUniform()
+            if len(shape.logical_sizes) >= 2
+            else ZeroInitializer()
+        )
+    return GlorotUniform() if idx == 0 else ZeroInitializer()

@@ -1,0 +1,41 @@
+"""Loss functions (reference: src/loss_functions/loss_functions.cc:39-100 —
+Loss::backward seeds output grads with scale 1/batch for CE, 2/volume for
+MSE; here losses are scalar functions and jax.grad produces those seeds)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.types import LossType
+
+
+def compute_loss(loss_type: LossType, logits, labels, from_logits=True):
+    """`from_logits=False` when the graph's final op is already a Softmax —
+    the reference's CE losses always consume softmax probabilities
+    (loss_functions.cc seeds grads assuming softmax outputs)."""
+    if loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+        if from_logits:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-12, 1.0))
+        ll = jnp.take_along_axis(
+            logp, labels.astype(jnp.int32)[..., None], axis=-1
+        )[..., 0]
+        return -jnp.mean(ll)
+    if loss_type == LossType.CATEGORICAL_CROSSENTROPY:
+        if from_logits:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-12, 1.0))
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+    if loss_type == LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
+        return jnp.mean(jnp.square(logits.astype(jnp.float32) - labels))
+    if loss_type == LossType.MEAN_SQUARED_ERROR_SUM_REDUCE:
+        # reference scales grads by 2/volume but sums over the class dim
+        return jnp.mean(
+            jnp.sum(jnp.square(logits.astype(jnp.float32) - labels), axis=-1)
+        )
+    if loss_type == LossType.IDENTITY:
+        return jnp.mean(logits.astype(jnp.float32))
+    raise ValueError(f"unknown loss {loss_type}")

@@ -1,0 +1,99 @@
+"""Training metrics (reference: src/metrics_functions/ — Metrics::compute
+launches per-shard METRICS_COMP tasks whose PerfMetrics are reduced through a
+Legion future chain, model.cc:741; here metrics are computed inside the jitted
+step and the host accumulates a PerfMetrics counter)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.types import MetricsType
+
+
+def compute_metrics(
+    metric_types: Sequence[MetricsType], logits, labels, from_logits: bool = False
+) -> Dict[str, jnp.ndarray]:
+    """Returns summed (not averaged) per-batch metric values + counts, so the
+    host can accumulate exactly like PerfMetrics (metrics_functions.h:12-28).
+
+    from_logits: the final op emits raw logits (no softmax); CE metrics go
+    through log_softmax instead of log(probs), mirroring compute_loss.
+    """
+    out = {}
+    n = logits.shape[0] if logits.ndim > 0 else 1
+    out["num_samples"] = jnp.asarray(n, jnp.float32)
+
+    def _logp():
+        x = jnp.asarray(logits, jnp.float32)
+        if from_logits:
+            return jax.nn.log_softmax(x, axis=-1)
+        return jnp.log(jnp.clip(x, 1e-12, 1.0))
+
+    for mt in metric_types:
+        if mt == MetricsType.ACCURACY:
+            if labels.ndim == logits.ndim:  # one-hot
+                correct = jnp.argmax(logits, -1) == jnp.argmax(labels, -1)
+            else:
+                correct = jnp.argmax(logits, -1) == labels.astype(jnp.int32)
+            out["accuracy_sum"] = jnp.sum(correct.astype(jnp.float32))
+        elif mt == MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            logp = jnp.take_along_axis(
+                _logp(), labels.astype(jnp.int32)[..., None], axis=-1
+            )
+            out["ce_sum"] = out.get("ce_sum", 0.0) + (-jnp.sum(logp))
+        elif mt == MetricsType.CATEGORICAL_CROSSENTROPY:
+            out["ce_sum"] = out.get("ce_sum", 0.0) + (-jnp.sum(labels * _logp()))
+        elif mt == MetricsType.MEAN_SQUARED_ERROR:
+            out["mse_sum"] = jnp.sum(
+                jnp.square(jnp.asarray(logits, jnp.float32) - labels)
+            )
+        elif mt == MetricsType.ROOT_MEAN_SQUARED_ERROR:
+            out["rmse_sum"] = jnp.sqrt(
+                jnp.mean(jnp.square(jnp.asarray(logits, jnp.float32) - labels))
+            ) * n
+        elif mt == MetricsType.MEAN_ABSOLUTE_ERROR:
+            out["mae_sum"] = jnp.sum(
+                jnp.abs(jnp.asarray(logits, jnp.float32) - labels)
+            )
+    return out
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Host-side accumulator (reference: metrics_functions.h:12-28)."""
+
+    train_all: int = 0
+    train_correct: float = 0.0
+    ce_loss: float = 0.0
+    mse_loss: float = 0.0
+    mae_loss: float = 0.0
+    loss_sum: float = 0.0
+    iterations: int = 0
+
+    def update(self, step_metrics: Dict[str, float], loss: float):
+        n = int(step_metrics.get("num_samples", 0))
+        self.train_all += n
+        self.train_correct += float(step_metrics.get("accuracy_sum", 0.0))
+        self.ce_loss += float(step_metrics.get("ce_sum", 0.0))
+        self.mse_loss += float(step_metrics.get("mse_sum", 0.0))
+        self.mae_loss += float(step_metrics.get("mae_sum", 0.0))
+        self.loss_sum += float(loss) * max(n, 1)
+        self.iterations += 1
+
+    def report(self) -> str:
+        n = max(self.train_all, 1)
+        parts = [f"loss: {self.loss_sum / n:.4f}"]
+        if self.train_correct:
+            parts.append(
+                f"accuracy: {100.0 * self.train_correct / n:.2f}%"
+                f" ({int(self.train_correct)} / {n})"
+            )
+        if self.ce_loss:
+            parts.append(f"ce: {self.ce_loss / n:.4f}")
+        if self.mse_loss:
+            parts.append(f"mse: {self.mse_loss / n:.4f}")
+        return " ".join(parts)

@@ -1,0 +1,27 @@
+"""Auto-parallelization search (SURVEY §2.5 rebuild).
+
+cost_model — roofline op costs + ICI collective formulas
+simulator  — per-candidate step-time estimation
+rewrites   — TP substitution sites (Megatron linear pairs, attention heads)
+auto       — mesh × site search (greedy + MCMC under --budget)
+strategy_io — JSON --export-strategy / --import-strategy
+"""
+
+from flexflow_tpu.search.auto import optimize, result_to_strategy, search_strategy
+from flexflow_tpu.search.cost_model import CostModel, OpCost
+from flexflow_tpu.search.rewrites import find_tp_sites
+from flexflow_tpu.search.simulator import GraphCost, estimate_graph_cost
+from flexflow_tpu.search.strategy_io import load_strategy, save_search_result
+
+__all__ = [
+    "optimize",
+    "result_to_strategy",
+    "search_strategy",
+    "CostModel",
+    "OpCost",
+    "find_tp_sites",
+    "GraphCost",
+    "estimate_graph_cost",
+    "load_strategy",
+    "save_search_result",
+]

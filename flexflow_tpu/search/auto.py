@@ -1,0 +1,221 @@
+"""Automatic parallelization search.
+
+TPU rebuild of the reference's two search engines (SURVEY §2.5):
+
+  * the Unity substitution search (reference: GraphSearchHelper::
+    graph_optimize, src/runtime/substitution.cc:1884-2194 — priority-queue
+    rewrite search ranked by simulated cost) becomes a **mesh × rewrite-site
+    search**: enumerate (dp, tp) factorizations of the chip count, detect TP
+    rewrite sites (rewrites.find_tp_sites), greedily toggle sites by
+    simulated step time, then spend the remaining `--budget` on MCMC
+    perturbations (reference: FFModel::mcmc_optimize, model.cc:3271-3342 —
+    random flip, accept with exp(-alpha·Δ)).
+  * per-candidate cost comes from search.simulator (the reference's
+    Simulator::simulate_runtime role).
+
+The v1 restriction documented in SURVEY §7 applies: every strategy lives on
+ONE global mesh (data × model axes); per-op device subsets
+(start_device_id/strides MachineViews) are not searched.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import List, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.core.pcg import PCGGraph
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.parallel.strategy import Strategy, data_parallel_strategy
+from flexflow_tpu.search.cost_model import CostModel
+from flexflow_tpu.search.rewrites import Site, find_tp_sites
+from flexflow_tpu.search.simulator import GraphCost, estimate_graph_cost
+
+_MODEL_AXIS = 1  # mesh axis index for tensor parallelism ("model")
+
+
+def _annotate_data_parallel(graph: PCGGraph, dp: int):
+    """Shard every input's batch dim exactly dp ways; the mesh data axis is
+    dp wide, so a batch dp does not divide makes the candidate infeasible."""
+    if dp <= 1:
+        return
+    for node in graph.nodes.values():
+        if node.op_type == OperatorType.INPUT and not node.inputs:
+            shape = node.params["shape"]
+            if shape.dims[0].size % dp != 0:
+                raise ValueError(
+                    f"input '{node.name}' batch {shape.dims[0].size} not "
+                    f"divisible by dp={dp}"
+                )
+            new_shape = shape.data_parallel(dp)
+            node.params["shape"] = new_shape
+            node.output_shapes = (new_shape,)
+
+
+def _candidate_graph(
+    base: PCGGraph, dp: int, tp: int, sites: Sequence[Site], on: Sequence[bool]
+) -> Optional[PCGGraph]:
+    from flexflow_tpu.runtime.executor import propagate_shapes
+
+    g = base.copy()
+    try:
+        _annotate_data_parallel(g, dp)
+        for site, enabled in zip(sites, on):
+            if enabled:
+                site.apply(g, tp, _MODEL_AXIS)
+        propagate_shapes(g)
+    except (ValueError, KeyError):
+        return None
+    return g
+
+
+def _mesh_factorizations(num_devices: int) -> List[Tuple[int, int]]:
+    """(dp, tp) pairs with dp*tp == num_devices (reference enumerates
+    divisor-sized machine views, graph.cc:1783-1814)."""
+    out = []
+    for tp in range(1, num_devices + 1):
+        if num_devices % tp == 0:
+            out.append((num_devices // tp, tp))
+    return out
+
+
+class SearchResult:
+    def __init__(self, dp, tp, sites, on, cost: GraphCost):
+        self.dp = dp
+        self.tp = tp
+        self.sites = list(sites)
+        self.on = list(on)
+        self.cost = cost
+
+    def describe(self) -> str:
+        n_on = sum(self.on)
+        return (
+            f"mesh(data={self.dp}, model={self.tp}), {n_on}/{len(self.on)} "
+            f"TP sites, simulated step {self.cost.step_time * 1e3:.3f} ms"
+        )
+
+
+def optimize(
+    graph: PCGGraph,
+    num_devices: int,
+    spec: MachineSpec,
+    budget: int = 10,
+    alpha: float = 1.05,
+    measure: bool = False,
+    seed: int = 0,
+    verbose: bool = False,
+) -> SearchResult:
+    """Run the search on a PCG; returns the best found configuration."""
+    cm = CostModel(spec, measure=measure)
+    rng = random.Random(seed)
+    evals = 0
+    best: Optional[SearchResult] = None
+
+    def evaluate(dp, tp, sites, on) -> Optional[GraphCost]:
+        nonlocal evals
+        evals += 1
+        g = _candidate_graph(graph, dp, tp, sites, on)
+        if g is None:
+            return None
+        mesh_sizes = (dp, tp) if tp > 1 else (dp,)
+        cost = estimate_graph_cost(g, cm, mesh_sizes)
+        if not cost.feasible(spec):
+            return None
+        return cost
+
+    for dp, tp in _mesh_factorizations(num_devices):
+        sites = [
+            s for s in find_tp_sites(graph) if tp == 1 or s.divisible_by(graph, tp)
+        ]
+        if tp > 1 and not sites:
+            continue
+        on = [False] * len(sites)
+        cost = evaluate(dp, tp, sites, on)
+        if cost is None:
+            continue
+        cur = SearchResult(dp, tp, sites, on, cost)
+        if tp > 1:
+            # greedy forward pass over sites in graph order
+            for i in range(len(sites)):
+                trial = list(cur.on)
+                trial[i] = True
+                c = evaluate(dp, tp, sites, trial)
+                if c is not None and c.step_time < cur.cost.step_time:
+                    cur = SearchResult(dp, tp, sites, trial, c)
+        if verbose:
+            print(f"[search] {cur.describe()}")
+        if best is None or cur.cost.step_time < best.cost.step_time:
+            best = cur
+
+    if best is None:
+        raise RuntimeError("search found no feasible strategy")
+
+    # MCMC refinement with the remaining budget (reference: mcmc_optimize)
+    cur = best
+    while evals < budget and cur.sites:
+        i = rng.randrange(len(cur.sites))
+        trial = list(cur.on)
+        trial[i] = not trial[i]
+        c = evaluate(cur.dp, cur.tp, cur.sites, trial)
+        if c is None:
+            continue
+        delta = c.step_time - cur.cost.step_time
+        scale = max(cur.cost.step_time, 1e-9)
+        if delta < 0 or rng.random() < math.exp(-alpha * delta / scale):
+            cur = SearchResult(cur.dp, cur.tp, cur.sites, trial, c)
+        if cur.cost.step_time < best.cost.step_time:
+            best = cur
+
+    return best
+
+
+def result_to_strategy(result: SearchResult) -> Strategy:
+    from flexflow_tpu.runtime.executor import MeshConfig
+
+    if result.tp > 1:
+        mesh = MeshConfig(("data", "model"), (result.dp, result.tp))
+    else:
+        mesh = MeshConfig(("data",), (result.dp,))
+
+    def apply(g: PCGGraph):
+        _annotate_data_parallel(g, result.dp)
+        for site, enabled in zip(result.sites, result.on):
+            if enabled:
+                site.apply(g, result.tp, _MODEL_AXIS)
+
+    return Strategy(mesh, apply, name=f"searched:{result.describe()}")
+
+
+def search_strategy(model, num_devices: int) -> Strategy:
+    """compile()-time entry (reference: graph_optimize_task,
+    graph.cc:1545-1613)."""
+    cfg = model.config
+    # search-without-hardware overrides (reference: model.cc:3673-3680)
+    n = num_devices
+    if cfg.search_num_workers > 0:
+        n = cfg.search_num_workers * max(1, cfg.search_num_nodes)
+    spec = MachineSpec(
+        num_nodes=max(1, cfg.search_num_nodes)
+        if cfg.search_num_nodes > 0
+        else max(1, cfg.num_nodes),
+        chips_per_node=max(1, n // max(1, cfg.num_nodes)),
+        chip=cfg.chip,
+    )
+    if n <= 1:
+        return data_parallel_strategy(num_devices, model.graph)
+    result = optimize(
+        model.graph,
+        n,
+        spec,
+        budget=max(cfg.search_budget, 1),
+        alpha=cfg.search_alpha,
+        seed=cfg.seed,
+        verbose=cfg.profiling,
+    )
+    print(f"[flexflow_tpu] search: best strategy = {result.describe()}")
+    if cfg.export_strategy_file:
+        from flexflow_tpu.search.strategy_io import save_search_result
+
+        save_search_result(result, model.graph, cfg.export_strategy_file)
+    return result_to_strategy(result)

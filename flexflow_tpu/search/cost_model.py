@@ -1,0 +1,196 @@
+"""Per-op and per-collective cost model for the strategy search.
+
+Replaces the reference's Simulator op-cost measurement + analytic xfer cost
+(reference: src/runtime/simulator.cc:532-756, src/runtime/model.cu:38-74 —
+real-kernel timing cached by (OperatorParameters, MachineView)) with a
+TPU-appropriate split:
+
+  * **analytic roofline** per op: time = max(FLOPs / MXU peak, bytes / HBM
+    bandwidth). This is the default so the search runs without hardware
+    (reference's --search-num-workers override, model.cc:3673-3680).
+  * **measured mode**: jit the op's lowered function on its *shard* shapes on
+    the real chip, time it, and cache by (params_hash, shard shapes) — the
+    direct analog of inner_measure_operator_cost. Under XLA an isolated-op
+    time over-counts what fusion removes, so measurement is reserved for the
+    big MXU ops where it is accurate (matmul/conv/attention).
+  * **collective costs** from ring formulas over ICI: all-reduce moves
+    2·(n-1)/n · bytes per link, all-gather/reduce-scatter (n-1)/n · bytes,
+    all-to-all (n-1)/n · bytes with full bisection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.core.parallel_tensor import ParallelTensorShape
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.ops.registry import op_flops
+
+
+@dataclasses.dataclass
+class OpCost:
+    """reference: CostMetrics {forward_time, backward_time, sync_time,
+    memory} (simulator.h:54-79). Times in seconds, memory in bytes/chip."""
+
+    forward_time: float = 0.0
+    backward_time: float = 0.0
+    sync_time: float = 0.0
+    memory: int = 0
+
+    @property
+    def total(self) -> float:
+        return self.forward_time + self.backward_time + self.sync_time
+
+
+# ops whose FLOPs dominate (MXU ops); everything else is bandwidth-bound
+_MXU_OPS = {
+    OperatorType.LINEAR,
+    OperatorType.CONV2D,
+    OperatorType.BATCHMATMUL,
+    OperatorType.MULTIHEAD_ATTENTION,
+}
+
+# collective latency floor per hop (ICI); dominates small messages
+_ICI_LATENCY_S = 1e-6
+_DEFAULT_EFFICIENCY = 0.6  # achievable fraction of peak (MXU and ICI alike)
+
+
+class CostModel:
+    def __init__(
+        self,
+        spec: MachineSpec,
+        measure: bool = False,
+        efficiency: float = _DEFAULT_EFFICIENCY,
+    ):
+        self.spec = spec
+        self.measure = measure
+        self.efficiency = efficiency
+        self._measured: Dict[Tuple[int, Tuple], float] = {}
+
+    # -- collectives --------------------------------------------------------
+
+    def _ici_time(self, bytes_on_wire: float, hops: int = 1) -> float:
+        bw = self.spec.ici_gbps * 1e9 * self.efficiency
+        return bytes_on_wire / bw + hops * _ICI_LATENCY_S
+
+    def all_reduce(self, bytes_per_chip: float, group_size: int) -> float:
+        if group_size <= 1 or bytes_per_chip <= 0:
+            return 0.0
+        wire = 2.0 * (group_size - 1) / group_size * bytes_per_chip
+        return self._ici_time(wire, hops=2 * (group_size - 1))
+
+    def all_gather(self, bytes_per_chip: float, group_size: int) -> float:
+        if group_size <= 1 or bytes_per_chip <= 0:
+            return 0.0
+        wire = (group_size - 1) / group_size * bytes_per_chip * group_size
+        return self._ici_time(wire, hops=group_size - 1)
+
+    def reduce_scatter(self, bytes_per_chip: float, group_size: int) -> float:
+        if group_size <= 1 or bytes_per_chip <= 0:
+            return 0.0
+        wire = (group_size - 1) / group_size * bytes_per_chip
+        return self._ici_time(wire, hops=group_size - 1)
+
+    def all_to_all(self, bytes_per_chip: float, group_size: int) -> float:
+        if group_size <= 1 or bytes_per_chip <= 0:
+            return 0.0
+        wire = (group_size - 1) / group_size * bytes_per_chip
+        return self._ici_time(wire, hops=group_size - 1)
+
+    # -- compute ------------------------------------------------------------
+
+    def _roofline(self, flops: float, bytes_moved: float) -> float:
+        t_flops = flops / (self.spec.peak_tflops * 1e12 * self.efficiency)
+        t_mem = bytes_moved / (self.spec.hbm_gbps * 1e9 * self.efficiency)
+        return max(t_flops, t_mem)
+
+    def op_cost(self, node, input_shapes: Sequence[ParallelTensorShape]) -> OpCost:
+        """Cost of one op on ONE chip's shard, fwd + bwd.
+
+        Shard sizing: global FLOPs / total_degree of the output — per-dim
+        degrees multiply into how many ways the work is split. Parallel ops
+        are costed by the simulator (they are communication, not compute).
+        """
+        out = node.output_shapes[0] if node.output_shapes else None
+        if out is None:
+            return OpCost()
+        degree = max(1, out.total_degree)
+        flops = op_flops(node.op_type, input_shapes, node.params) / degree
+        bytes_moved = sum(s.piece_bytes() for s in input_shapes)
+        bytes_moved += sum(s.piece_bytes() for s in node.output_shapes)
+        bytes_moved += sum(s.piece_bytes() for s in node.weight_shapes)
+        mem = sum(s.piece_bytes() for s in node.output_shapes)
+        mem += sum(s.piece_bytes() for s in node.weight_shapes)
+
+        if self.measure and node.op_type in _MXU_OPS:
+            fwd = self._measure_op(node, input_shapes)
+            if fwd is not None:
+                # bwd of a matmul-family op = two matmuls of the same size
+                return OpCost(fwd, 2.0 * fwd, 0.0, mem)
+
+        fwd = self._roofline(flops, bytes_moved)
+        # backward: dX and dW each cost about one forward for MXU ops;
+        # elementwise backward re-reads the same bytes.
+        bwd = 2.0 * fwd if node.op_type in _MXU_OPS else fwd
+        return OpCost(fwd, bwd, 0.0, mem)
+
+    # -- measured mode ------------------------------------------------------
+
+    def _measure_op(self, node, input_shapes) -> Optional[float]:
+        """Time the real lowered kernel on shard shapes (reference:
+        inner_measure_operator_cost, model.cu:38-74). Cached like the
+        reference's hash_to_op_cost (simulator.cc:532-572)."""
+        key = (
+            node.params_hash(),
+            tuple(s.piece_sizes for s in input_shapes),
+        )
+        if key in self._measured:
+            return self._measured[key]
+        try:
+            import time
+
+            import jax
+            import jax.numpy as jnp
+
+            from flexflow_tpu.ops.registry import LowerCtx, lower_op
+
+            fn = lower_op(node.op_type, node.params)
+            ins = [
+                jnp.zeros(
+                    tuple(
+                        d.piece_size
+                        for d in s.dims
+                        if not d.is_replica_dim
+                    ),
+                    s.dtype.to_jnp(),
+                )
+                for s in input_shapes
+            ]
+            ws = [
+                jnp.zeros(
+                    tuple(
+                        d.piece_size
+                        for d in s.dims
+                        if not d.is_replica_dim
+                    ),
+                    s.dtype.to_jnp(),
+                )
+                for s in node.weight_shapes
+            ]
+            ctx = LowerCtx(train=False, rng=None)
+            jitted = jax.jit(lambda i, w: fn(i, w, ctx))
+            outs = jitted(ins, ws)  # compile + warmup
+            jax.block_until_ready(outs)
+            reps = 5
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                outs = jitted(ins, ws)
+            jax.block_until_ready(outs)
+            t = (time.perf_counter() - t0) / reps
+            self._measured[key] = t
+            return t
+        except Exception:
+            self._measured[key] = None
+            return None

@@ -1,0 +1,241 @@
+"""Parallelization rewrites: the substitution vocabulary of the search.
+
+TPU re-design of the reference's hand-written GraphXfer generators
+(reference: src/runtime/substitution.cc:1721-1862). Each rewrite wraps a
+matched subgraph in parallel ops so the existing shape-inference protocol
+(replica dim -> channel/head sharding; partitioned contraction dim ->
+partial-sum replica dim) expresses the strategy:
+
+  * `LinearChainSite` — Megatron column→row pair
+    (reference: create_replicate_linear_combine + the reduction variant,
+    substitution.cc:1750-1765,1804-1827): Replicate(x) → Linear(out-sharded)
+    → …elementwise… → Linear(partial sums) → Reduction.
+  * `AttentionSite` — head parallelism
+    (reference: create_replicate_attention_reduce, substitution.cc:1758-1764):
+    Replicate(q,k,v) → MHA (heads sharded, output partial) → Reduction.
+  * `SingleLinearSite` — lone Linear: Replicate → Linear → Combine on the
+    feature dim (column-parallel only; reference:
+    create_partition_linear_combine).
+
+A "site" is a detected location; `apply(graph, tp, axis)` mutates the graph.
+Sites are the unit the search toggles on/off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.pcg import PCGGraph, TensorRef
+from flexflow_tpu.core.types import OperatorType
+
+# elementwise ops a sharded feature dim passes through unchanged
+_PASSTHROUGH = {
+    OperatorType.RELU,
+    OperatorType.SIGMOID,
+    OperatorType.TANH,
+    OperatorType.ELU,
+    OperatorType.GELU,
+    OperatorType.IDENTITY,
+    OperatorType.EXP,
+    OperatorType.SIN,
+    OperatorType.COS,
+    OperatorType.POW,
+    OperatorType.RSQRT,
+    OperatorType.SCALAR_MULTIPLY,
+    OperatorType.SCALAR_ADD,
+    OperatorType.SCALAR_SUB,
+    OperatorType.SCALAR_TRUE_DIV,
+    OperatorType.DROPOUT,
+}
+
+
+def _insert_before(
+    graph: PCGGraph,
+    consumer_guid: int,
+    input_ref: TensorRef,
+    op_type: OperatorType,
+    name: str,
+    params: dict,
+) -> TensorRef:
+    """Insert `op_type(input_ref)` and rewire ONLY consumer_guid to it.
+
+    Output shapes are placeholders (the producer's current shape): upstream
+    rewrites may not have re-propagated yet, so real shapes are only
+    computable by the caller's final propagate_shapes pass."""
+    in_shape = graph.shape_of(input_ref)
+    node = graph.add_node(op_type, name, [input_ref], params, [in_shape])
+    new_ref = TensorRef(node.guid, 0)
+    graph.replace_input(consumer_guid, input_ref, new_ref)
+    return new_ref
+
+
+def _insert_after(
+    graph: PCGGraph,
+    producer_guid: int,
+    op_type: OperatorType,
+    name: str,
+    params: dict,
+) -> TensorRef:
+    """Insert `op_type(producer:0)` and rewire ALL other consumers to it.
+    Placeholder output shapes, like _insert_before."""
+    src = TensorRef(producer_guid, 0)
+    consumers = graph.consumers(producer_guid)
+    in_shape = graph.shape_of(src)
+    node = graph.add_node(op_type, name, [src], params, [in_shape])
+    new_ref = TensorRef(node.guid, 0)
+    for c in consumers:
+        graph.replace_input(c, src, new_ref)
+    return new_ref
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    kind: str
+    guids: Tuple[int, ...]  # nodes involved, in chain order
+
+    def divisible_by(self, graph: PCGGraph, tp: int) -> bool:
+        raise NotImplementedError
+
+    def apply(self, graph: PCGGraph, tp: int, axis: int):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearChainSite(Site):
+    """linear → elementwise* → linear, all intermediates single-consumer."""
+
+    def divisible_by(self, graph, tp):
+        a = graph.nodes[self.guids[0]]
+        return a.params["out_features"] % tp == 0
+
+    def apply(self, graph, tp, axis):
+        a_guid, b_guid = self.guids[0], self.guids[-1]
+        a = graph.nodes[a_guid]
+        _insert_before(
+            graph,
+            a_guid,
+            a.inputs[0],
+            OperatorType.REPLICATE,
+            f"{a.name}.replicate",
+            {"degree": tp, "parallel_idx": axis},
+        )
+        b = graph.nodes[b_guid]
+        _insert_after(
+            graph,
+            b_guid,
+            OperatorType.REDUCTION,
+            f"{b.name}.reduction",
+            {"degree": tp},
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class AttentionSite(Site):
+    """One MultiHeadAttention node; q/k/v may be the same tensor."""
+
+    def divisible_by(self, graph, tp):
+        node = graph.nodes[self.guids[0]]
+        return node.params["num_heads"] % tp == 0
+
+    def apply(self, graph, tp, axis):
+        guid = self.guids[0]
+        node = graph.nodes[guid]
+        # one Replicate per unique input; replace_input rewires every
+        # occurrence of a duplicated ref (q=k=v) in one call
+        for i, ref in enumerate(dict.fromkeys(node.inputs)):
+            _insert_before(
+                graph,
+                guid,
+                ref,
+                OperatorType.REPLICATE,
+                f"{node.name}.replicate{i}",
+                {"degree": tp, "parallel_idx": axis},
+            )
+        _insert_after(
+            graph,
+            guid,
+            OperatorType.REDUCTION,
+            f"{node.name}.reduction",
+            {"degree": tp},
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SingleLinearSite(Site):
+    """A lone Linear: column-parallel, gather features after."""
+
+    def divisible_by(self, graph, tp):
+        node = graph.nodes[self.guids[0]]
+        return node.params["out_features"] % tp == 0
+
+    def apply(self, graph, tp, axis):
+        guid = self.guids[0]
+        node = graph.nodes[guid]
+        _insert_before(
+            graph,
+            guid,
+            node.inputs[0],
+            OperatorType.REPLICATE,
+            f"{node.name}.replicate",
+            {"degree": tp, "parallel_idx": axis},
+        )
+        # output feature dim comes out sharded (degree tp); Combine gathers it
+        out_ndim = len(node.output_shapes[0].dims)
+        _insert_after(
+            graph,
+            guid,
+            OperatorType.COMBINE,
+            f"{node.name}.combine",
+            {"axis": out_ndim - 1, "degree": tp},
+        )
+
+
+def find_tp_sites(graph: PCGGraph) -> List[Site]:
+    """Detect tensor-parallel rewrite sites (the search's substitution
+    candidates). Linear pairs are preferred over two singles; attention
+    nodes are always sites."""
+    sites: List[Site] = []
+    claimed = set()
+
+    for guid in graph.topo_order():
+        node = graph.nodes[guid]
+        if node.op_type == OperatorType.MULTIHEAD_ATTENTION:
+            sites.append(AttentionSite("attention", (guid,)))
+            claimed.add(guid)
+
+    # linear→elementwise*→linear chains
+    for guid in graph.topo_order():
+        node = graph.nodes[guid]
+        if node.op_type != OperatorType.LINEAR or guid in claimed:
+            continue
+        chain = [guid]
+        cur = guid
+        ok = False
+        while True:
+            cons = graph.consumers(cur)
+            if len(cons) != 1:
+                break
+            nxt = next(iter(cons))
+            nxt_node = graph.nodes[nxt]
+            if nxt_node.op_type == OperatorType.LINEAR and nxt not in claimed:
+                chain.append(nxt)
+                ok = True
+                break
+            if nxt_node.op_type in _PASSTHROUGH:
+                chain.append(nxt)
+                cur = nxt
+                continue
+            break
+        if ok:
+            sites.append(LinearChainSite("linear_chain", tuple(chain)))
+            claimed.update(chain)
+
+    # leftover lone linears (not the tiny final classifier — searching it
+    # is allowed, the cost model will reject unprofitable ones anyway)
+    for guid in graph.topo_order():
+        node = graph.nodes[guid]
+        if node.op_type == OperatorType.LINEAR and guid not in claimed:
+            sites.append(SingleLinearSite("single_linear", (guid,)))
+            claimed.add(guid)
+    return sites

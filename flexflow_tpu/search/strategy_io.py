@@ -1,0 +1,120 @@
+"""Strategy import/export.
+
+Rebuild of the reference's strategy file I/O (reference:
+src/runtime/strategy.cc:100-197 load/save of per-op ParallelConfig maps,
+exposed as --export-strategy / --import-strategy). The on-disk format is
+JSON instead of the reference's binary protobuf: the global mesh plus the
+enabled rewrite sites, keyed by op *names* (stable across runs of the same
+builder program, like the reference's per-op keys).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from flexflow_tpu.core.pcg import PCGGraph
+from flexflow_tpu.parallel.strategy import Strategy, data_parallel_strategy
+
+_SITE_KINDS = {}
+
+
+def _register_site_kinds():
+    from flexflow_tpu.search.rewrites import (
+        AttentionSite,
+        LinearChainSite,
+        SingleLinearSite,
+    )
+
+    _SITE_KINDS.update(
+        {
+            "attention": AttentionSite,
+            "linear_chain": LinearChainSite,
+            "single_linear": SingleLinearSite,
+        }
+    )
+
+
+def save_search_result(result, graph: PCGGraph, path: str):
+    """Persist a SearchResult (search.auto) for later --import-strategy."""
+    sites = []
+    for site, enabled in zip(result.sites, result.on):
+        if enabled:
+            sites.append(
+                {
+                    "kind": site.kind,
+                    "names": [graph.nodes[g].name for g in site.guids],
+                }
+            )
+    doc = {
+        "version": 1,
+        "dp": result.dp,
+        "tp": result.tp,
+        "simulated_step_ms": result.cost.step_time * 1e3,
+        "sites": sites,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+
+
+def save_strategy(strategy: Strategy, path: str):
+    """Persist a plain Strategy (mesh only; site-level detail requires a
+    SearchResult — use save_search_result from the search path)."""
+    doc = {
+        "version": 1,
+        "mesh_axes": list(strategy.mesh_config.axis_names),
+        "mesh_sizes": list(strategy.mesh_config.axis_sizes),
+        "name": strategy.name,
+        "sites": [],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+
+
+def load_strategy(path: str, graph: PCGGraph, num_devices: int) -> Strategy:
+    """Rebuild a Strategy from JSON against the current graph
+    (reference: load_strategies_from_file + compile-time map lookup)."""
+    _register_site_kinds()
+    with open(path) as f:
+        doc = json.load(f)
+
+    dp = int(doc.get("dp", doc.get("mesh_sizes", [num_devices])[0]))
+    tp = int(doc.get("tp", 1))
+    if dp * tp > num_devices:
+        raise ValueError(
+            f"strategy file wants {dp * tp} devices, have {num_devices}"
+        )
+    if tp <= 1 and not doc.get("sites"):
+        return data_parallel_strategy(num_devices, graph)
+
+    name_to_guid: Dict[str, int] = {
+        n.name: g for g, n in graph.nodes.items()
+    }
+    sites = []
+    for entry in doc.get("sites", []):
+        cls = _SITE_KINDS.get(entry["kind"])
+        if cls is None:
+            raise ValueError(f"unknown site kind {entry['kind']!r}")
+        try:
+            guids = tuple(name_to_guid[nm] for nm in entry["names"])
+        except KeyError as e:
+            raise ValueError(
+                f"strategy file references unknown op {e.args[0]!r}"
+            ) from None
+        sites.append(cls(entry["kind"], guids))
+
+    from flexflow_tpu.runtime.executor import MeshConfig
+    from flexflow_tpu.search.auto import _MODEL_AXIS, _annotate_data_parallel
+
+    mesh = (
+        MeshConfig(("data", "model"), (dp, tp))
+        if tp > 1
+        else MeshConfig(("data",), (dp,))
+    )
+
+    def apply(g: PCGGraph):
+        _annotate_data_parallel(g, dp)
+        for site in sites:
+            site.apply(g, tp, _MODEL_AXIS)
+
+    return Strategy(mesh, apply, name=f"imported:{path}")

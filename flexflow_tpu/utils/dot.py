@@ -1,0 +1,30 @@
+"""DOT export of the PCG (reference: src/utils/dot/, graph.cc print_dot —
+the --compgraph/--taskgraph artifacts, SURVEY §2.1)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.core.pcg import PCGGraph
+
+
+def pcg_to_dot(graph: PCGGraph, include_costs: bool = False) -> str:
+    lines = ["digraph PCG {", "  rankdir=TB;"]
+    for guid in graph.topo_order():
+        node = graph.nodes[guid]
+        shape_str = ", ".join(str(s) for s in node.output_shapes)
+        mv = ""
+        if node.machine_view is not None:
+            mv = f"\\nview={node.machine_view.dims}@{node.machine_view.start_device_id}"
+        color = "lightblue" if node.is_parallel_op else "white"
+        lines.append(
+            f'  n{guid} [label="{node.name}\\n{node.op_type.name}'
+            f'\\n{shape_str}{mv}", style=filled, fillcolor={color}, shape=box];'
+        )
+        for ref in node.inputs:
+            lines.append(f"  n{ref.guid} -> n{guid};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def export_pcg_dot(graph: PCGGraph, path: str, include_costs: bool = False):
+    with open(path, "w") as f:
+        f.write(pcg_to_dot(graph, include_costs))

@@ -1,0 +1,57 @@
+"""Unit tests for MachineView/MachineResource
+(mirrors reference tests/unit/test_machine_view.cc)."""
+
+from flexflow_tpu.core.machine import (
+    MachineResource,
+    MachineSpec,
+    MachineView,
+    enumerate_machine_views,
+)
+
+
+def test_device_ids():
+    v = MachineView(0, (4,), (1,))
+    assert v.device_ids() == [0, 1, 2, 3]
+    v2 = MachineView(2, (3,), (4,))
+    assert v2.device_ids() == [2, 6, 10]
+
+
+def test_2d_view():
+    v = MachineView(0, (2, 2), (4, 1))
+    assert sorted(v.device_ids()) == [0, 1, 4, 5]
+
+
+def test_hash_stable():
+    a = MachineView(0, (4,), (1,))
+    b = MachineView(0, (4,), (1,))
+    c = MachineView(1, (4,), (1,))
+    assert a.hash() == b.hash()
+    assert a.hash() != c.hash()
+
+
+def test_resource_splits():
+    r = MachineResource(num_nodes=4, chips_per_node=4)
+    left, right = r.vertical_split(1)
+    assert left.num_chips == 4 and right.num_chips == 12
+    assert right.start_node_id == 1
+    hl, hr = r.horizontal_split(2)
+    assert hl.num_chips == 8 and hr.num_chips == 8
+    assert hr.start_chip_id == 2
+
+
+def test_enumerate_views():
+    views = enumerate_machine_views(2, 4)
+    # full-machine view present
+    assert any(v.num_devices == 8 for v in views)
+    # single-device views present for every device
+    singles = [v for v in views if v.num_devices == 1]
+    assert len(singles) >= 8
+    # strided cross-node views present
+    assert any(v.strides == (4,) for v in views)
+
+
+def test_machine_spec():
+    ms = MachineSpec(num_nodes=4, chips_per_node=4, chip="v4")
+    assert ms.num_chips == 16
+    assert ms.peak_tflops > 200
+    assert ms.resource().num_chips == 16

@@ -1,0 +1,55 @@
+"""Unit tests for the ParallelDim/ParallelTensorShape data model
+(mirrors reference tests/unit/test_parallel_config.cc in spirit)."""
+
+import pytest
+from jax.sharding import PartitionSpec
+
+from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
+from flexflow_tpu.core.types import DataType
+
+
+def test_basic_shape():
+    s = ParallelTensorShape.make([64, 128], DataType.FLOAT)
+    assert s.sizes == (64, 128)
+    assert s.total_degree == 1
+    assert s.volume() == 64 * 128
+    assert s.size_bytes() == 64 * 128 * 4
+
+
+def test_degree_divides():
+    with pytest.raises(ValueError):
+        ParallelDim(10, 3)
+
+
+def test_data_parallel():
+    s = ParallelTensorShape.make([64, 128]).data_parallel(8)
+    assert s.degrees == (8, 1)
+    assert s.piece_sizes == (8, 128)
+    assert s.total_degree == 8
+    assert s.partition_spec(["data"]) == PartitionSpec("data")
+
+
+def test_replica_dim():
+    s = ParallelTensorShape.make([64, 128]).append_replica_dim(4, 1)
+    assert s.num_replica_dims == 1
+    assert s.replica_degree == 4
+    assert s.logical_sizes == (64, 128)
+    assert s.volume() == 64 * 128  # replicas don't add logical volume
+    # replica dims make no PartitionSpec entry
+    assert s.partition_spec(["data", "model"]) == PartitionSpec()
+
+
+def test_partition_spec_two_axes():
+    s = ParallelTensorShape.make(
+        [64, 512], degrees=[4, 2], parallel_idxs=[0, 1]
+    )
+    assert s.partition_spec(["data", "model"]) == PartitionSpec("data", "model")
+    assert s.is_valid_for_mesh([4, 2])
+    assert not s.is_valid_for_mesh([2, 4])
+
+
+def test_mesh_axis_reuse_invalid():
+    s = ParallelTensorShape.make(
+        [64, 512], degrees=[2, 2], parallel_idxs=[0, 0]
+    )
+    assert not s.is_valid_for_mesh([2, 2])
