@@ -1,0 +1,44 @@
+"""AlexNet on CIFAR-10-sized synthetic data (BASELINE config #1;
+reference: bootcamp_demo/ff_alexnet_cifar10.py + examples/cpp/AlexNet).
+
+    python examples/alexnet.py -b 64 -e 1 [--budget N]
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training, synthetic_images
+
+from flexflow_tpu import (  # noqa: E402
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_alexnet  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    # CIFAR-10 images upscaled to the reference's 229x229 input
+    # (alexnet.cc:58); NHWC layout.
+    x = ff.create_tensor([cfg.batch_size, 229, 229, 3], name="image")
+    build_alexnet(ff, x, num_classes=10)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    n = cfg.batch_size * (cfg.iterations or 8)
+    X, y = synthetic_images(n, 229, 229)
+    run_training(ff, {"image": X}, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Shared example-runner glue: synthetic data + the reference's
+train-and-print-THROUGHPUT loop (reference: every examples/cpp/* prints
+`THROUGHPUT = %.2f samples/s`, e.g. alexnet.cc:135)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_training(model, data: dict, labels, cfg, epochs=None):
+    """fit() with the config's epochs; fit prints THROUGHPUT per epoch."""
+    inputs = {k: v for k, v in data.items()}
+    return model.fit(
+        inputs,
+        labels,
+        epochs=epochs or cfg.epochs,
+        batch_size=cfg.batch_size,
+        verbose=True,
+    )
+
+
+def synthetic_images(num, h, w, c=3, num_classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(num, h, w, c).astype(np.float32)
+    y = rng.randint(0, num_classes, size=num).astype(np.int32)
+    return x, y

@@ -1,0 +1,55 @@
+"""DLRM with sharded embedding tables (BASELINE config #5;
+reference: examples/cpp/DLRM/dlrm.cc default DLRMConfig).
+
+    python examples/dlrm.py -b 64 -e 1 --enable-parameter-parallel
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training
+
+from flexflow_tpu import (  # noqa: E402
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_dlrm  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    # reference defaults (dlrm.cc:27-42); tables shrunk when no TP budget
+    emb_sizes = [1000000] * 4
+    bag = 1
+    ff = FFModel(cfg)
+    dense = ff.create_tensor([cfg.batch_size, 4], name="dense_features")
+    sparse = [
+        ff.create_tensor([cfg.batch_size, bag], dtype=DataType.INT32,
+                         name=f"sparse_{i}")
+        for i in range(len(emb_sizes))
+    ]
+    build_dlrm(ff, dense, sparse, embedding_sizes=emb_sizes)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    n = cfg.batch_size * (cfg.iterations or 8)
+    rng = np.random.RandomState(0)
+    data = {"dense_features": rng.randn(n, 4).astype(np.float32)}
+    for i, v in enumerate(emb_sizes):
+        data[f"sparse_{i}"] = rng.randint(0, v, size=(n, bag)).astype(np.int32)
+    y = rng.rand(n, 2).astype(np.float32)
+    run_training(ff, data, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""MLP_Unify twin-tower MLP (reference: examples/cpp/MLP_Unify/mlp.cc).
+
+    python examples/mlp.py -b 64 -e 1 [--budget N]
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training
+
+from flexflow_tpu import (  # noqa: E402
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_mlp_unify  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x1 = ff.create_tensor([cfg.batch_size, 1024], name="input1")
+    x2 = ff.create_tensor([cfg.batch_size, 1024], name="input2")
+    build_mlp_unify(ff, x1, x2)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    n = cfg.batch_size * (cfg.iterations or 8)
+    rng = np.random.RandomState(0)
+    data = {
+        "input1": rng.randn(n, 1024).astype(np.float32),
+        "input2": rng.randn(n, 1024).astype(np.float32),
+    }
+    y = rng.randint(0, 8192, size=n).astype(np.int32)
+    run_training(ff, data, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

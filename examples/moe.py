@@ -1,0 +1,44 @@
+"""Mixture-of-Experts MNIST classifier (reference:
+examples/cpp/mixture_of_experts/moe.cc — 5 experts, top-2, MNIST dims).
+
+    python examples/moe.py -b 64 -e 1
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training
+
+from flexflow_tpu import (  # noqa: E402
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_moe_mlp  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 784], name="pixels")
+    build_moe_mlp(ff, x)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    n = cfg.batch_size * (cfg.iterations or 8)
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 784).astype(np.float32)
+    y = rng.randint(0, 10, size=n).astype(np.int32)
+    run_training(ff, {"pixels": X}, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,9 +8,14 @@ SGD lr 0.01, MSE loss, THROUGHPUT print :209).
 
 from __future__ import annotations
 
+import os
+import sys
+
 import numpy as np
 
-from flexflow_tpu import (
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu import (  # noqa: E402
     ActiMode,
     FFConfig,
     FFModel,

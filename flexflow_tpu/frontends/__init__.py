@@ -1,0 +1,6 @@
+"""Frontends (reference: SURVEY §2.7 — python/flexflow/{torch,keras,onnx}).
+
+torch_fx   — torch.fx trace -> FFModel replay (+ weight transfer)
+keras_api  — Sequential/functional Model with Keras layer/optimizer names
+onnx_model — ONNX graph replay (import-gated: `onnx` not baked in)
+"""

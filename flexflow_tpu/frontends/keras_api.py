@@ -1,0 +1,349 @@
+"""Keras-style frontend.
+
+Rebuild of the reference's Keras frontend (reference: python/flexflow/keras/
+— Sequential + functional Model over FFModel, BaseModel.fit/evaluate
+keras/models/base_model.py:196-283, layer classes under keras/layers/).
+Layers are lightweight specs; `Model.compile` lowers the layer graph into
+FFModel builder calls, then fit/evaluate delegate to the runtime.
+
+    from flexflow_tpu.frontends import keras_api as keras
+    model = keras.Sequential([
+        keras.Input(shape=(784,)),
+        keras.Dense(512, activation="relu"),
+        keras.Dense(10),
+    ])
+    model.compile(optimizer=keras.SGD(0.01), loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=2, batch_size=64)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.types import ActiMode, DataType, LossType, MetricsType
+from flexflow_tpu.runtime.model import FFModel
+from flexflow_tpu.runtime.optimizer import AdamOptimizer, SGDOptimizer
+
+_ACT = {
+    None: ActiMode.NONE,
+    "relu": ActiMode.RELU,
+    "sigmoid": ActiMode.SIGMOID,
+    "tanh": ActiMode.TANH,
+    "gelu": ActiMode.GELU,
+    "softmax": "softmax",  # handled as a separate op
+}
+
+_LOSS = {
+    "categorical_crossentropy": LossType.CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy": LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "mse": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+}
+
+_METRIC = {
+    "accuracy": MetricsType.ACCURACY,
+    "sparse_categorical_crossentropy": MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "categorical_crossentropy": MetricsType.CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": MetricsType.MEAN_SQUARED_ERROR,
+    "mean_absolute_error": MetricsType.MEAN_ABSOLUTE_ERROR,
+}
+
+
+# -- optimizers (reference: flexflow/keras/optimizers.py) -------------------
+
+
+def SGD(learning_rate=0.01, momentum=0.0, nesterov=False, weight_decay=0.0):
+    return SGDOptimizer(
+        lr=learning_rate,
+        momentum=momentum,
+        nesterov=nesterov,
+        weight_decay=weight_decay,
+    )
+
+
+def Adam(learning_rate=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-8):
+    return AdamOptimizer(
+        alpha=learning_rate, beta1=beta_1, beta2=beta_2, epsilon=epsilon
+    )
+
+
+# -- layer specs ------------------------------------------------------------
+
+
+class Layer:
+    def __init__(self, name=None):
+        self.name = name
+
+    def __call__(self, *inputs):
+        """Functional API: returns a Node wiring this layer after inputs."""
+        return Node(self, [n for n in inputs])
+
+    def build(self, ff: FFModel, tensors):
+        raise NotImplementedError
+
+
+class Node:
+    """Functional-API handle: a layer applied to upstream nodes."""
+
+    def __init__(self, layer: Optional[Layer], inputs: List["Node"], shape=None):
+        self.layer = layer
+        self.inputs = inputs
+        self.shape = shape  # only for Input nodes
+
+
+def Input(shape: Sequence[int], dtype: DataType = DataType.FLOAT, name=None):
+    n = Node(None, [], shape=tuple(shape))
+    n.dtype = dtype
+    n.name = name
+    return n
+
+
+def _resolve_act(name):
+    if name not in _ACT:
+        raise ValueError(
+            f"unknown activation {name!r}; supported: "
+            f"{sorted(k for k in _ACT if k)}"
+        )
+    return _ACT[name]
+
+
+class Dense(Layer):
+    def __init__(self, units, activation=None, use_bias=True, name=None):
+        super().__init__(name)
+        self.units = units
+        self.activation = activation
+        self.use_bias = use_bias
+
+    def build(self, ff, ts):
+        act = _resolve_act(self.activation)
+        if act == "softmax":
+            t = ff.dense(ts[0], self.units, use_bias=self.use_bias, name=self.name)
+            return ff.softmax(t)
+        return ff.dense(
+            ts[0], self.units, activation=act, use_bias=self.use_bias, name=self.name
+        )
+
+
+class Conv2D(Layer):
+    """channels_last (NHWC) — the TPU-native layout."""
+
+    def __init__(self, filters, kernel_size, strides=(1, 1), padding="valid",
+                 activation=None, groups=1, use_bias=True, name=None):
+        super().__init__(name)
+        self.filters = filters
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) else (kernel_size,) * 2
+        s = strides if isinstance(strides, (tuple, list)) else (strides,) * 2
+        self.kernel, self.strides = k, s
+        self.padding = padding
+        self.activation = activation
+        self.groups = groups
+        self.use_bias = use_bias
+
+    def build(self, ff, ts):
+        if self.padding == "same":
+            ph, pw = self.kernel[0] // 2, self.kernel[1] // 2
+        else:
+            ph = pw = 0
+        act = _resolve_act(self.activation)
+        softmax = act == "softmax"
+        t = ff.conv2d(
+            ts[0], self.filters, self.kernel[0], self.kernel[1],
+            self.strides[0], self.strides[1], ph, pw,
+            activation=ActiMode.NONE if softmax else act,
+            groups=self.groups, use_bias=self.use_bias, name=self.name,
+        )
+        return ff.softmax(t) if softmax else t
+
+
+class _Pool2D(Layer):
+    kind = "max"
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding="valid", name=None):
+        super().__init__(name)
+        p = pool_size if isinstance(pool_size, (tuple, list)) else (pool_size,) * 2
+        s = strides if strides is not None else p
+        s = s if isinstance(s, (tuple, list)) else (s,) * 2
+        self.pool, self.strides, self.padding = p, s, padding
+
+    def build(self, ff, ts):
+        ph = self.pool[0] // 2 if self.padding == "same" else 0
+        pw = self.pool[1] // 2 if self.padding == "same" else 0
+        return ff.pool2d(
+            ts[0], self.pool[0], self.pool[1], self.strides[0], self.strides[1],
+            ph, pw, pool_type=self.kind, name=self.name,
+        )
+
+
+class MaxPooling2D(_Pool2D):
+    kind = "max"
+
+
+class AveragePooling2D(_Pool2D):
+    kind = "avg"
+
+
+class Flatten(Layer):
+    def build(self, ff, ts):
+        return ff.flat(ts[0], name=self.name)
+
+
+class Dropout(Layer):
+    def __init__(self, rate, name=None):
+        super().__init__(name)
+        self.rate = rate
+
+    def build(self, ff, ts):
+        return ff.dropout(ts[0], self.rate, name=self.name)
+
+
+class Activation(Layer):
+    def __init__(self, fn, name=None):
+        super().__init__(name)
+        self.fn = fn
+
+    def build(self, ff, ts):
+        if self.fn == "softmax":
+            return ff.softmax(ts[0], name=self.name)
+        return {
+            "relu": ff.relu,
+            "sigmoid": ff.sigmoid,
+            "tanh": ff.tanh,
+            "gelu": ff.gelu,
+        }[self.fn](ts[0], name=self.name)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim, output_dim, name=None):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def build(self, ff, ts):
+        return ff.embedding(ts[0], self.input_dim, self.output_dim, name=self.name)
+
+
+class BatchNormalization(Layer):
+    def build(self, ff, ts):
+        return ff.batch_norm(ts[0], relu=False, name=self.name)
+
+
+class LayerNormalization(Layer):
+    def __init__(self, epsilon=1e-5, name=None):
+        super().__init__(name)
+        self.eps = epsilon
+
+    def build(self, ff, ts):
+        return ff.layer_norm(ts[0], eps=self.eps, name=self.name)
+
+
+class Concatenate(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def build(self, ff, ts):
+        return ff.concat(ts, self.axis, name=self.name)
+
+
+class Add(Layer):
+    def build(self, ff, ts):
+        return ff.add(ts[0], ts[1], name=self.name)
+
+
+class Multiply(Layer):
+    def build(self, ff, ts):
+        return ff.multiply(ts[0], ts[1], name=self.name)
+
+
+# -- models (reference: keras/models/base_model.py) -------------------------
+
+
+class Model:
+    def __init__(self, inputs=None, outputs=None, config: Optional[FFConfig] = None):
+        self._inputs = (
+            [inputs] if isinstance(inputs, Node) else list(inputs or [])
+        )
+        self._outputs = (
+            [outputs] if isinstance(outputs, Node) else list(outputs or [])
+        )
+        self.config = config or FFConfig()
+        self.ffmodel: Optional[FFModel] = None
+
+    # lower the Node graph into FFModel builder calls
+    def _lower(self, batch_size: int) -> FFModel:
+        ff = FFModel(self.config)
+        built = {}
+
+        def visit(node: Node):
+            if id(node) in built:
+                return built[id(node)]
+            if node.layer is None:  # Input
+                t = ff.create_tensor(
+                    (batch_size,) + tuple(node.shape),
+                    dtype=getattr(node, "dtype", DataType.FLOAT),
+                    name=getattr(node, "name", None),
+                )
+            else:
+                ins = [visit(i) for i in node.inputs]
+                t = node.layer.build(ff, ins)
+            built[id(node)] = t
+            return t
+
+        for out in self._outputs:
+            visit(out)
+        return ff
+
+    def compile(self, optimizer=None, loss="sparse_categorical_crossentropy",
+                metrics=("accuracy",), batch_size: Optional[int] = None):
+        if isinstance(optimizer, str):
+            optimizer = {"sgd": SGD(), "adam": Adam()}[optimizer.lower()]
+        bs = batch_size or self.config.batch_size
+        self.ffmodel = self._lower(bs)
+        self.ffmodel.compile(
+            optimizer=optimizer,
+            loss_type=_LOSS[loss] if isinstance(loss, str) else loss,
+            metrics=[
+                _METRIC[m] if isinstance(m, str) else m for m in metrics
+            ],
+        )
+
+    def fit(self, x, y, epochs=1, batch_size: Optional[int] = None, **kw):
+        if self.ffmodel is None:
+            raise RuntimeError("call compile() first")
+        return self.ffmodel.fit(x, y, epochs=epochs, batch_size=batch_size, **kw)
+
+    def evaluate(self, x, y, batch_size: Optional[int] = None):
+        return self.ffmodel.evaluate(x, y, batch_size=batch_size)
+
+    def summary(self):
+        if self.ffmodel is None:
+            raise RuntimeError("call compile() first")
+        return repr(self.ffmodel.graph)
+
+
+class Sequential(Model):
+    def __init__(self, layers=None, config: Optional[FFConfig] = None):
+        super().__init__(config=config)
+        self.layers: List = list(layers or [])
+
+    def add(self, layer):
+        self.layers.append(layer)
+
+    def compile(self, *args, **kw):
+        if not self.layers:
+            raise ValueError("Sequential model has no layers")
+        first = self.layers[0]
+        if isinstance(first, Node):
+            node = first
+            rest = self.layers[1:]
+        else:
+            raise ValueError("first layer must be keras_api.Input(shape=...)")
+        for layer in rest:
+            node = Node(layer, [node])
+        self._inputs = [first]
+        self._outputs = [node]
+        super().compile(*args, **kw)

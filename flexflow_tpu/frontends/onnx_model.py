@@ -1,0 +1,186 @@
+"""ONNX frontend: onnx graph -> FFModel builder calls.
+
+Rebuild of the reference's ONNX importer (reference:
+python/flexflow/onnx/model.py — node-type dispatch building FFModel layers
+for Conv/Gemm/Pool/Concat/Split/Flatten/Add/Relu/...). The `onnx` package is
+not part of this image's baked-in set, so the frontend is import-gated: it
+raises a clear error at use, and everything else in flexflow_tpu works
+without it.
+
+Layout: ONNX convs are NCHW; like the torch frontend, inputs keep the NCHW
+convention at the boundary and a transpose to NHWC is inserted before
+conv-family ops, transposing back at Flatten.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from flexflow_tpu.core.types import DataType
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+
+        return onnx
+    except ImportError:
+        raise ImportError(
+            "the ONNX frontend needs the `onnx` package, which is not "
+            "installed in this environment; use the torch_fx or keras_api "
+            "frontend, or install onnx"
+        ) from None
+
+
+class ONNXModel:
+    """Replays an ONNX graph into FFModel calls
+    (reference: ONNXModel.apply, flexflow/onnx/model.py)."""
+
+    def __init__(self, path_or_proto):
+        onnx = _require_onnx()
+        if isinstance(path_or_proto, (str, bytes)):
+            self.model = onnx.load(path_or_proto)
+        else:
+            self.model = path_or_proto
+        self.inits = {i.name for i in self.model.graph.initializer}
+
+    @staticmethod
+    def _attrs(node) -> Dict:
+        out = {}
+        for a in node.attribute:
+            if a.type == 1:
+                out[a.name] = a.f
+            elif a.type == 2:
+                out[a.name] = a.i
+            elif a.type == 7:
+                out[a.name] = list(a.ints)
+            elif a.type == 3:
+                out[a.name] = a.s.decode()
+        return out
+
+    def apply(self, ffmodel, input_tensors: Dict[str, object]):
+        env = dict(input_tensors)
+        nchw = {k: len(t.dims) == 4 for k, t in input_tensors.items()}
+
+        def to_nhwc(name):
+            t = env[name]
+            if nchw.get(name, False):
+                t = ffmodel.transpose(t, [0, 2, 3, 1])
+                nchw[name] = False
+            return t
+
+        for node in self.model.graph.node:
+            a = self._attrs(node)
+            ins = [i for i in node.input if i not in self.inits]
+            out = node.output[0]
+            op = node.op_type
+            if op == "Conv":
+                x = to_nhwc(ins[0])
+                k = a.get("kernel_shape", [1, 1])
+                s = a.get("strides", [1, 1])
+                p = a.get("pads", [0, 0, 0, 0])
+                # find out_channels from the weight initializer shape
+                wname = node.input[1]
+                w = next(
+                    i for i in self.model.graph.initializer if i.name == wname
+                )
+                env[out] = ffmodel.conv2d(
+                    x, w.dims[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                    groups=a.get("group", 1),
+                    use_bias=len(node.input) > 2,
+                    name=node.name or None,
+                )
+                nchw[out] = False
+            elif op in ("MaxPool", "AveragePool"):
+                x = to_nhwc(ins[0])
+                k = a.get("kernel_shape", [2, 2])
+                s = a.get("strides", k)
+                p = a.get("pads", [0, 0, 0, 0])
+                env[out] = ffmodel.pool2d(
+                    x, k[0], k[1], s[0], s[1], p[0], p[1],
+                    pool_type="max" if op == "MaxPool" else "avg",
+                )
+                nchw[out] = False
+            elif op == "GlobalAveragePool":
+                x = to_nhwc(ins[0])
+                h, w = x.dims[1], x.dims[2]
+                env[out] = ffmodel.pool2d(x, h, w, h, w, 0, 0, pool_type="avg")
+                nchw[out] = False
+            elif op == "Gemm" or op == "MatMul":
+                wname = node.input[1]
+                w = next(
+                    (i for i in self.model.graph.initializer if i.name == wname),
+                    None,
+                )
+                if w is None:
+                    # activation x activation (e.g. attention scores)
+                    env[out] = ffmodel.batch_matmul(env[ins[0]], env[ins[1]])
+                else:
+                    out_dim = w.dims[0] if a.get("transB", 0) else w.dims[-1]
+                    env[out] = ffmodel.dense(
+                        env[ins[0]], out_dim, use_bias=len(node.input) > 2
+                    )
+            elif op == "Relu":
+                env[out] = ffmodel.relu(env[ins[0]])
+                nchw[out] = nchw.get(ins[0], False)
+            elif op == "Sigmoid":
+                env[out] = ffmodel.sigmoid(env[ins[0]])
+            elif op == "Tanh":
+                env[out] = ffmodel.tanh(env[ins[0]])
+            elif op == "Softmax":
+                env[out] = ffmodel.softmax(env[ins[0]], dim=a.get("axis", -1))
+            elif op == "Flatten":
+                x = env[ins[0]]
+                if len(x.dims) == 4 and not nchw.get(ins[0], True):
+                    x = ffmodel.transpose(x, [0, 3, 1, 2])
+                env[out] = ffmodel.flat(x)
+            elif op == "Add":
+                env[out] = ffmodel.add(env[ins[0]], env[ins[1]])
+            elif op == "Sub":
+                env[out] = ffmodel.subtract(env[ins[0]], env[ins[1]])
+            elif op == "Mul":
+                env[out] = ffmodel.multiply(env[ins[0]], env[ins[1]])
+            elif op == "Concat":
+                env[out] = ffmodel.concat([env[i] for i in ins], a.get("axis", 0))
+            elif op == "Split":
+                sizes = a.get("split")
+                outs = ffmodel.split(
+                    env[ins[0]],
+                    sizes if sizes else len(node.output),
+                    a.get("axis", 0),
+                )
+                for o, t in zip(node.output, outs):
+                    env[o] = t
+                continue
+            elif op == "Reshape":
+                # shape comes from an initializer
+                import numpy as np
+                from onnx import numpy_helper
+
+                shape_init = next(
+                    i
+                    for i in self.model.graph.initializer
+                    if i.name == node.input[1]
+                )
+                shape = [int(v) for v in numpy_helper.to_array(shape_init)]
+                x = env[ins[0]]
+                if any(s == -1 for s in shape):
+                    known = int(np.prod([s for s in shape if s != -1]))
+                    total = int(np.prod(x.dims))
+                    shape = [total // known if s == -1 else s for s in shape]
+                env[out] = ffmodel.reshape(x, shape)
+            elif op == "Transpose":
+                env[out] = ffmodel.transpose(env[ins[0]], a["perm"])
+            elif op == "Dropout":
+                env[out] = ffmodel.dropout(env[ins[0]], a.get("ratio", 0.5))
+            elif op == "Identity":
+                env[out] = env[ins[0]]
+            elif op == "BatchNormalization":
+                x = to_nhwc(ins[0])
+                env[out] = ffmodel.batch_norm(x, relu=False)
+                nchw[out] = False
+            else:
+                raise NotImplementedError(f"ONNX frontend: op {op!r}")
+
+        outputs = [env[o.name] for o in self.model.graph.output if o.name in env]
+        return outputs if len(outputs) != 1 else outputs[0]

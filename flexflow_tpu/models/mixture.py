@@ -1,0 +1,44 @@
+"""Mixture-of-Experts workloads (reference: examples/cpp/mixture_of_experts/
+moe.cc)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.core.types import ActiMode
+
+
+def build_moe_mlp(
+    ff,
+    input_tensor,
+    num_classes: int = 10,
+    num_exp: int = 5,
+    num_select: int = 2,
+    hidden_size: int = 784,
+    alpha: float = 2.0,
+    lambda_bal: float = 0.04,
+):
+    """reference: moe.cc:158-166 — ff.moe(input, 5, 2, hidden, 2.0, 0.04)
+    then dense(OUT_DIM=10, relu); MNIST dims (moe.h:23-25,34-42)."""
+    t = ff.moe(input_tensor, num_exp, num_select, hidden_size, alpha, lambda_bal)
+    return ff.dense(t, num_classes, activation=ActiMode.RELU)
+
+
+def build_moe_encoder(
+    ff,
+    input_tensor,
+    num_layers: int = 6,
+    hidden_size: int = 784,
+    num_heads: int = 16,
+    num_exp: int = 5,
+    num_select: int = 2,
+    alpha: float = 2.0,
+    lambda_bal: float = 0.04,
+):
+    """reference: moe.cc:100-130 create_moe_encoder — per layer:
+    LN(x + MHA(x)) then LN(x + moe(x))."""
+    x = input_tensor
+    for _ in range(num_layers):
+        a = ff.multihead_attention(x, x, x, hidden_size, num_heads)
+        x = ff.layer_norm(ff.add(a, x))
+        m = ff.moe(x, num_exp, num_select, hidden_size, alpha, lambda_bal)
+        x = ff.layer_norm(ff.add(m, x))
+    return x

@@ -1,0 +1,24 @@
+"""MLP_Unify workload (reference: examples/cpp/MLP_Unify/mlp.cc)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flexflow_tpu.core.types import ActiMode
+
+
+def build_mlp_unify(
+    ff,
+    input1,
+    input2,
+    hidden_dims: Sequence[int] = (8192,) * 8,
+):
+    """reference: mlp.cc:36-53 — two 1024-dim inputs through twin 8x8192
+    dense stacks (relu except last), added, softmax."""
+    t1, t2 = input1, input2
+    for i, d in enumerate(hidden_dims):
+        act = ActiMode.NONE if i + 1 == len(hidden_dims) else ActiMode.RELU
+        t1 = ff.dense(t1, d, activation=act, use_bias=False)
+        t2 = ff.dense(t2, d, activation=act, use_bias=False)
+    t = ff.add(t1, t2)
+    return ff.softmax(t)

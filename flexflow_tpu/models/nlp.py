@@ -1,0 +1,73 @@
+"""NLP workloads: Transformer encoder (the flagship bench), BERT proxy,
+mT5-style encoder."""
+
+from __future__ import annotations
+
+from flexflow_tpu.core.types import ActiMode, DataType
+
+
+def build_transformer_encoder(
+    ff,
+    input_tensor,
+    hidden: int = 1024,
+    num_heads: int = 16,
+    num_layers: int = 12,
+    dropout: float = 0.0,
+):
+    """reference: examples/cpp/Transformer/transformer.cc:33-45 — per layer:
+    MHA then dense(relu)+dense, no residuals/LN in the reference benchmark;
+    final dense(1)."""
+    t = input_tensor
+    for _ in range(num_layers):
+        t = ff.multihead_attention(t, t, t, hidden, num_heads, dropout=dropout,
+                                   bias=False)
+        t = ff.dense(t, hidden, activation=ActiMode.RELU, use_bias=False)
+        t = ff.dense(t, hidden, use_bias=False)
+    return ff.dense(t, 1, use_bias=False)
+
+
+def build_bert_proxy(
+    ff,
+    input_tensor,
+    hidden: int = 768,
+    num_heads: int = 12,
+    num_layers: int = 12,
+    ff_dim: int = 3072,
+):
+    """reference: examples/python/native/bert_proxy_native.py — BERT-base
+    proxy blocks: pre-built embedding output [b, seq, hidden]; per layer
+    MHA + add&norm + GELU MLP + add&norm."""
+    t = input_tensor
+    for _ in range(num_layers):
+        a = ff.multihead_attention(t, t, t, hidden, num_heads)
+        t = ff.layer_norm(ff.add(a, t))
+        m = ff.dense(t, ff_dim, activation=ActiMode.GELU, use_bias=False)
+        m = ff.dense(m, hidden, use_bias=False)
+        t = ff.layer_norm(ff.add(m, t))
+    return t
+
+
+def build_mt5_encoder(
+    ff,
+    token_ids,
+    vocab_size: int = 32128,
+    hidden: int = 512,
+    num_heads: int = 8,
+    num_layers: int = 8,
+    ff_dim: int = 1024,
+):
+    """reference: align/mt5_encoder/align_mt5_encoder_ff.py — embedding +
+    pre-LN attention/MLP blocks (T5-style: RMS-ish LN approximated by LN,
+    gated GELU feed-forward)."""
+    t = ff.embedding(token_ids, vocab_size, hidden)
+    for _ in range(num_layers):
+        h = ff.layer_norm(t)
+        a = ff.multihead_attention(h, h, h, hidden, num_heads, bias=False)
+        t = ff.add(t, a)
+        h = ff.layer_norm(t)
+        wi0 = ff.dense(h, ff_dim, activation=ActiMode.GELU, use_bias=False)
+        wi1 = ff.dense(h, ff_dim, use_bias=False)
+        m = ff.multiply(wi0, wi1)
+        m = ff.dense(m, hidden, use_bias=False)
+        t = ff.add(t, m)
+    return ff.layer_norm(t)

@@ -1,0 +1,89 @@
+"""Recommender / tabular workloads: DLRM, XDL, CANDLE-Uno."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from flexflow_tpu.core.types import ActiMode, AggrMode, DataType
+
+
+def _mlp(ff, t, dims: Sequence[int], sigmoid_layer: int = -1):
+    """reference: examples/cpp/DLRM/dlrm.cc create_mlp — dense stack,
+    relu except a designated sigmoid layer, no bias."""
+    for i, d in enumerate(dims):
+        act = (
+            ActiMode.SIGMOID if i == sigmoid_layer else ActiMode.RELU
+        )
+        t = ff.dense(t, d, activation=act, use_bias=False)
+    return t
+
+
+def build_dlrm(
+    ff,
+    dense_input,
+    sparse_inputs: Sequence,
+    embedding_sizes: Sequence[int] = (1000000,) * 4,
+    sparse_feature_size: int = 64,
+    mlp_bot: Sequence[int] = (64, 64),
+    mlp_top: Sequence[int] = (64, 64, 2),
+    interaction: str = "cat",
+):
+    """reference: examples/cpp/DLRM/dlrm.cc — default config
+    (DLRMConfig ctor: 4x 1M-row embedding tables, feature 64, bot [4,64,64],
+    top [64,64,2], cat interaction, final sigmoid)."""
+    embs = []
+    for i, (tbl, vocab) in enumerate(zip(sparse_inputs, embedding_sizes)):
+        e = ff.embedding(
+            tbl, vocab, sparse_feature_size, aggr=AggrMode.SUM,
+            name=f"emb_table_{i}",
+        )
+        embs.append(e)
+    x = _mlp(ff, dense_input, mlp_bot)
+    if interaction == "cat":
+        t = ff.concat(embs + [x], axis=-1)
+    else:
+        raise NotImplementedError(f"interaction {interaction!r}")
+    t = _mlp(ff, t, mlp_top, sigmoid_layer=len(mlp_top) - 1)
+    return t
+
+
+def build_xdl(
+    ff,
+    sparse_inputs: Sequence,
+    embedding_size: int = 1000000,
+    sparse_feature_size: int = 64,
+    mlp_dims: Sequence[int] = (4096, 2048, 1024, 2),
+):
+    """reference: examples/cpp/XDL/xdl.cc — embedding-dominated click model:
+    N embedding bags concatenated into a deep MLP."""
+    embs = [
+        ff.embedding(
+            t, embedding_size, sparse_feature_size, aggr=AggrMode.SUM,
+            name=f"xdl_emb_{i}",
+        )
+        for i, t in enumerate(sparse_inputs)
+    ]
+    t = ff.concat(embs, axis=-1)
+    t = _mlp(ff, t, mlp_dims, sigmoid_layer=len(mlp_dims) - 1)
+    return t
+
+
+def build_candle_uno(
+    ff,
+    feature_inputs: Sequence,
+    feature_dims: Sequence[int] = (942, 5270, 2048),
+    tower_dims: Sequence[int] = (1000, 1000, 1000),
+    final_dims: Sequence[int] = (1000, 1000, 1000),
+):
+    """reference: examples/cpp/candle_uno/candle_uno.cc — per-feature dense
+    towers concatenated, shared trunk, dense(1) regression head."""
+    towers = []
+    for x in feature_inputs:
+        t = x
+        for d in tower_dims:
+            t = ff.dense(t, d, activation=ActiMode.RELU, use_bias=False)
+        towers.append(t)
+    t = ff.concat(towers, axis=-1) if len(towers) > 1 else towers[0]
+    for d in final_dims:
+        t = ff.dense(t, d, activation=ActiMode.RELU, use_bias=False)
+    return ff.dense(t, 1, use_bias=False)

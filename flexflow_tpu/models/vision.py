@@ -1,0 +1,134 @@
+"""Vision workloads: AlexNet, ResNet-50, ResNeXt-50, Inception-v3.
+
+Topologies mirror the reference examples (cited per builder); layout is NHWC
+(TPU-native) instead of the reference's NCHW — dims [N, H, W, C].
+"""
+
+from __future__ import annotations
+
+from flexflow_tpu.core.types import ActiMode
+
+
+def build_alexnet(ff, input_tensor, num_classes: int = 10):
+    """reference: examples/cpp/AlexNet/alexnet.cc:69-84 (229x229 input,
+    conv 64/11x11 s4 ... dense 4096x2, dense num_classes, softmax)."""
+    t = ff.conv2d(input_tensor, 64, 11, 11, 4, 4, 2, 2, activation=ActiMode.RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation=ActiMode.RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d(t, 384, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 4096, activation=ActiMode.RELU)
+    t = ff.dense(t, 4096, activation=ActiMode.RELU)
+    t = ff.dense(t, num_classes)
+    return ff.softmax(t)
+
+
+def _bottleneck(ff, t, out_channels: int, stride: int):
+    """reference: examples/cpp/ResNet/resnet.cc:39-57 BottleneckBlock —
+    1x1 -> bn+relu -> 3x3 stride -> bn+relu -> 1x1 4x -> bn, projection
+    shortcut when stride != 1, add, relu via final bn."""
+    inp = t
+    t = ff.conv2d(t, out_channels, 1, 1, 1, 1, 0, 0)
+    t = ff.batch_norm(t)
+    t = ff.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1)
+    t = ff.batch_norm(t)
+    t = ff.conv2d(t, 4 * out_channels, 1, 1, 1, 1, 0, 0)
+    t = ff.batch_norm(t, relu=False)
+    if stride > 1 or inp.dims[-1] != 4 * out_channels:
+        inp = ff.conv2d(inp, 4 * out_channels, 1, 1, stride, stride, 0, 0,
+                        activation=ActiMode.RELU)
+    t = ff.add(t, inp)
+    return ff.relu(t)
+
+
+def build_resnet50(ff, input_tensor, num_classes: int = 10):
+    """reference: examples/cpp/ResNet/resnet.cc:89-112 — conv7x7/64 s2,
+    maxpool3 s2, bottleneck stacks [3,4,6,3] @ 64/128/256/512, avgpool,
+    dense(num_classes)."""
+    t = ff.conv2d(input_tensor, 64, 7, 7, 2, 2, 3, 3)
+    t = ff.batch_norm(t)
+    t = ff.pool2d(t, 3, 3, 2, 2, 1, 1)
+    for _ in range(3):
+        t = _bottleneck(ff, t, 64, 1)
+    for i in range(4):
+        t = _bottleneck(ff, t, 128, 2 if i == 0 else 1)
+    for i in range(6):
+        t = _bottleneck(ff, t, 256, 2 if i == 0 else 1)
+    for i in range(3):
+        t = _bottleneck(ff, t, 512, 2 if i == 0 else 1)
+    h, w = t.dims[1], t.dims[2]
+    t = ff.pool2d(t, h, w, 1, 1, 0, 0, pool_type="avg")
+    t = ff.flat(t)
+    t = ff.dense(t, num_classes)
+    return ff.softmax(t)
+
+
+def _resnext_block(ff, t, out_channels: int, stride: int, groups: int = 32):
+    """reference: examples/cpp/resnext50/resnext.cc — grouped 3x3 conv
+    bottleneck (cardinality 32)."""
+    inp = t
+    t = ff.conv2d(t, out_channels, 1, 1, 1, 1, 0, 0, activation=ActiMode.RELU)
+    t = ff.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1,
+                  activation=ActiMode.RELU, groups=groups)
+    t = ff.conv2d(t, 2 * out_channels, 1, 1, 1, 1, 0, 0)
+    if stride > 1 or inp.dims[-1] != 2 * out_channels:
+        inp = ff.conv2d(inp, 2 * out_channels, 1, 1, stride, stride, 0, 0)
+    t = ff.add(t, inp)
+    return ff.relu(t)
+
+
+def build_resnext50(ff, input_tensor, num_classes: int = 10):
+    """reference: examples/cpp/resnext50/resnext.cc — stacks [3,4,6,3] at
+    128/256/512/1024 with cardinality 32."""
+    t = ff.conv2d(input_tensor, 64, 7, 7, 2, 2, 3, 3, activation=ActiMode.RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 1, 1)
+    for _ in range(3):
+        t = _resnext_block(ff, t, 128, 1)
+    for i in range(4):
+        t = _resnext_block(ff, t, 256, 2 if i == 0 else 1)
+    for i in range(6):
+        t = _resnext_block(ff, t, 512, 2 if i == 0 else 1)
+    for i in range(3):
+        t = _resnext_block(ff, t, 1024, 2 if i == 0 else 1)
+    h, w = t.dims[1], t.dims[2]
+    t = ff.pool2d(t, h, w, 1, 1, 0, 0, pool_type="avg")
+    t = ff.flat(t)
+    t = ff.dense(t, num_classes)
+    return ff.softmax(t)
+
+
+def _inception_a(ff, t, pool_features: int):
+    """reference: examples/cpp/InceptionV3/inception.cc InceptionA."""
+    b1 = ff.conv2d(t, 64, 1, 1, 1, 1, 0, 0, activation=ActiMode.RELU)
+    b2 = ff.conv2d(t, 48, 1, 1, 1, 1, 0, 0, activation=ActiMode.RELU)
+    b2 = ff.conv2d(b2, 64, 5, 5, 1, 1, 2, 2, activation=ActiMode.RELU)
+    b3 = ff.conv2d(t, 64, 1, 1, 1, 1, 0, 0, activation=ActiMode.RELU)
+    b3 = ff.conv2d(b3, 96, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    b3 = ff.conv2d(b3, 96, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    b4 = ff.pool2d(t, 3, 3, 1, 1, 1, 1, pool_type="avg")
+    b4 = ff.conv2d(b4, pool_features, 1, 1, 1, 1, 0, 0, activation=ActiMode.RELU)
+    return ff.concat([b1, b2, b3, b4], axis=3)
+
+
+def build_inception_v3(ff, input_tensor, num_classes: int = 10):
+    """reference: examples/cpp/InceptionV3/inception.cc — stem + InceptionA
+    stack (abridged: the A blocks capture the concat-heavy search shape)."""
+    t = ff.conv2d(input_tensor, 32, 3, 3, 2, 2, 0, 0, activation=ActiMode.RELU)
+    t = ff.conv2d(t, 32, 3, 3, 1, 1, 0, 0, activation=ActiMode.RELU)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d(t, 80, 1, 1, 1, 1, 0, 0, activation=ActiMode.RELU)
+    t = ff.conv2d(t, 192, 3, 3, 1, 1, 0, 0, activation=ActiMode.RELU)
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = _inception_a(ff, t, 32)
+    t = _inception_a(ff, t, 64)
+    t = _inception_a(ff, t, 64)
+    h, w = t.dims[1], t.dims[2]
+    t = ff.pool2d(t, h, w, 1, 1, 0, 0, pool_type="avg")
+    t = ff.flat(t)
+    t = ff.dense(t, num_classes)
+    return ff.softmax(t)

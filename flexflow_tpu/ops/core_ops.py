@@ -51,7 +51,7 @@ def _apply_activation(x, act: ActiMode):
         ActiMode.RELU: jax.nn.relu,
         ActiMode.SIGMOID: jax.nn.sigmoid,
         ActiMode.TANH: jnp.tanh,
-        ActiMode.GELU: jax.nn.gelu,
+        ActiMode.GELU: lambda v: jax.nn.gelu(v, approximate=False),
     }[act](x)
 
 
@@ -452,7 +452,9 @@ _UNARY_FNS = {
     OperatorType.SIGMOID: lambda x, p: jax.nn.sigmoid(x),
     OperatorType.TANH: lambda x, p: jnp.tanh(x),
     OperatorType.ELU: lambda x, p: jax.nn.elu(x),
-    OperatorType.GELU: lambda x, p: jax.nn.gelu(x),
+    # exact (erf) form: matches torch's default and keeps frontend
+    # alignment tests tight; XLA lowers erf natively on TPU
+    OperatorType.GELU: lambda x, p: jax.nn.gelu(x, approximate=False),
     OperatorType.IDENTITY: lambda x, p: x,
     OperatorType.EXP: lambda x, p: jnp.exp(x),
     OperatorType.SIN: lambda x, p: jnp.sin(x),

@@ -68,14 +68,17 @@ def _capacity(batch, k, n_experts, alpha):
 
 
 def _infer_group_by(input_shapes, params):
-    data, assign = input_shapes  # data [b, d], assign [b, k] int
+    # data [*lead, d], assign [*lead, k] int — leading dims are flattened
+    # into one token axis (sequence MoE feeds [b, s, d], moe.cc encoder)
+    data, assign = input_shapes
     n = params["n"]
     alpha = params.get("alpha", 1.0)
-    b = data.dims[0].size
+    d = data.dims[-1].size
+    tokens = data.volume() // d
     k = assign.dims[-1].size
-    cap = _capacity(b, k, n, alpha)
+    cap = _capacity(tokens, k, n, alpha)
     out = ParallelTensorShape(
-        (ParallelDim(cap), ParallelDim(data.dims[1].size)), data.dtype
+        (ParallelDim(cap), ParallelDim(d)), data.dtype
     )
     return tuple(out for _ in range(n)), ()
 
@@ -113,11 +116,14 @@ def _lower_group_by(params):
 
     def fn(ins, ws, ctx):
         data, assign = ins
-        b = data.shape[0]
+        feat = data.shape[-1]
         k = assign.shape[-1]
-        cap = _capacity(b, k, n, alpha)
-        d = dispatch_mask(assign, n, cap)  # [n, cap, b]
-        outs = jnp.einsum("ncb,bd->ncd", d.astype(data.dtype), data)
+        data2 = data.reshape(-1, feat)  # [tokens, d]
+        assign2 = assign.reshape(-1, k)
+        tokens = data2.shape[0]
+        cap = _capacity(tokens, k, n, alpha)
+        d = dispatch_mask(assign2, n, cap)  # [n, cap, tokens]
+        outs = jnp.einsum("ncb,bd->ncd", d.astype(data.dtype), data2)
         return [outs[e] for e in range(n)]
 
     return fn
@@ -132,13 +138,14 @@ register_op(OperatorType.GROUP_BY, _infer_group_by, _lower_group_by)
 
 
 def _infer_aggregate(input_shapes, params):
-    # inputs: gate_values [b,k], gate_assign [b,k], exp_pred_0..n-1 [cap, d]
+    # inputs: gate_values [*lead,k], gate_assign [*lead,k],
+    # exp_pred_0..n-1 [cap, d] -> output [*lead, d]
     n = params["n"]
     gate_values = input_shapes[0]
     exp0 = input_shapes[2]
-    b = gate_values.dims[0].size
     d = exp0.dims[-1].size
-    out = ParallelTensorShape((ParallelDim(b), ParallelDim(d)), exp0.dtype)
+    lead = gate_values.dims[:-1]
+    out = ParallelTensorShape(tuple(lead) + (ParallelDim(d),), exp0.dtype)
     return (out,), ()
 
 
@@ -149,15 +156,18 @@ def _lower_aggregate(params):
     def fn(ins, ws, ctx):
         gate_values, assign = ins[0], ins[1]
         exp_preds = jnp.stack(ins[2:], axis=0)  # [n, cap, d]
-        b, k = assign.shape
+        lead = assign.shape[:-1]
+        k = assign.shape[-1]
+        assign2 = assign.reshape(-1, k)
+        b = assign2.shape[0]
         cap = exp_preds.shape[1]
-        # combine weights: gate value of the (sample, slot) that owns each slot
-        slot_onehot = dispatch_slots(assign, n, cap)  # [b*k, n, cap]
+        # combine weights: gate value of the (token, slot) that owns each slot
+        slot_onehot = dispatch_slots(assign2, n, cap)  # [b*k, n, cap]
         gates = gate_values.reshape(-1)[:, None, None]  # [b*k,1,1]
         combine = (slot_onehot * gates).reshape(b, k, n, cap).sum(axis=1)
         # combine: [b, n, cap]; output = sum over experts/slots
         y = jnp.einsum("bnc,ncd->bd", combine.astype(exp_preds.dtype), exp_preds)
-        return [y]
+        return [y.reshape(lead + (y.shape[-1],))]
 
     return fn
 
@@ -178,11 +188,14 @@ register_op(OperatorType.AGGREGATE_SPEC, _infer_aggregate_spec, _lower_aggregate
 
 
 def load_balance_loss(gate_probs, assign, n_experts):
-    """GShard-style aux loss: n * sum_e (fraction_tokens_e * mean_prob_e)."""
-    b = gate_probs.shape[0]
-    counts = jnp.sum(jax.nn.one_hot(assign[:, 0], n_experts), axis=0)
-    frac = counts / b
-    mean_prob = jnp.mean(gate_probs, axis=0)
+    """GShard-style aux loss: n * sum_e (fraction_tokens_e * mean_prob_e).
+    gate_probs [*lead, n] is the FULL gate distribution; assign [*lead, k]."""
+    gp = gate_probs.reshape(-1, gate_probs.shape[-1])
+    asg = assign.reshape(-1, assign.shape[-1])
+    tokens = gp.shape[0]
+    counts = jnp.sum(jax.nn.one_hot(asg[:, 0], n_experts), axis=0)
+    frac = counts / tokens
+    mean_prob = jnp.mean(gp, axis=0)
     return n_experts * jnp.sum(frac * mean_prob)
 
 

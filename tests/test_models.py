@@ -1,0 +1,170 @@
+"""Model-zoo smoke tests: every workload builds, compiles, and takes one
+training step on the virtual 8-device CPU mesh (reference: SURVEY §4.4's
+integration runs, shrunk to test size)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu import models as zoo
+
+
+def _one_step(ff, data, labels, loss, metrics=(MetricsType.ACCURACY,)):
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=loss,
+        metrics=list(metrics),
+    )
+    hist = ff.fit(data, labels, epochs=1, verbose=False)
+    assert np.isfinite(hist[0]["loss_sum"]), hist[0]
+    return hist[0]
+
+
+BS = 8
+RNG = np.random.RandomState(0)
+
+
+def _images(n, hw, c=3, classes=10):
+    return (
+        RNG.randn(n, hw, hw, c).astype(np.float32),
+        RNG.randint(0, classes, size=n).astype(np.int32),
+    )
+
+
+def test_alexnet_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    x = ff.create_tensor([BS, 67, 67, 3], name="image")
+    zoo.build_alexnet(ff, x)
+    X, y = _images(BS * 2, 67)
+    _one_step(ff, {"image": X}, y, LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+
+
+def test_resnet50_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    x = ff.create_tensor([BS, 64, 64, 3], name="image")
+    zoo.build_resnet50(ff, x)
+    X, y = _images(BS, 64)
+    _one_step(ff, {"image": X}, y, LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+
+
+def test_resnext50_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    x = ff.create_tensor([BS, 64, 64, 3], name="image")
+    zoo.build_resnext50(ff, x)
+    X, y = _images(BS, 64)
+    _one_step(ff, {"image": X}, y, LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+
+
+def test_inception_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    x = ff.create_tensor([BS, 75, 75, 3], name="image")
+    zoo.build_inception_v3(ff, x)
+    X, y = _images(BS, 75)
+    _one_step(ff, {"image": X}, y, LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+
+
+def test_bert_proxy_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    x = ff.create_tensor([BS, 16, 64], name="x")
+    t = zoo.build_bert_proxy(ff, x, hidden=64, num_heads=4, num_layers=2,
+                             ff_dim=128)
+    t = ff.dense(t, 1, use_bias=False)
+    X = RNG.randn(BS, 16, 64).astype(np.float32)
+    y = RNG.randn(BS, 16, 1).astype(np.float32)
+    _one_step(ff, {"x": X}, y, LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, ())
+
+
+def test_mt5_encoder_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    ids = ff.create_tensor([BS, 12], dtype=DataType.INT32, name="tokens")
+    t = zoo.build_mt5_encoder(ff, ids, vocab_size=128, hidden=32,
+                              num_heads=2, num_layers=2, ff_dim=64)
+    t = ff.dense(t, 1, use_bias=False)
+    X = RNG.randint(0, 128, size=(BS, 12)).astype(np.int32)
+    y = RNG.randn(BS, 12, 1).astype(np.float32)
+    _one_step(ff, {"tokens": X}, y, LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, ())
+
+
+def test_dlrm_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    dense = ff.create_tensor([BS, 4], name="dense_features")
+    sparse = [
+        ff.create_tensor([BS, 1], dtype=DataType.INT32, name=f"sparse_{i}")
+        for i in range(4)
+    ]
+    zoo.build_dlrm(ff, dense, sparse, embedding_sizes=(1000,) * 4)
+    data = {"dense_features": RNG.randn(BS, 4).astype(np.float32)}
+    for i in range(4):
+        data[f"sparse_{i}"] = RNG.randint(0, 1000, size=(BS, 1)).astype(np.int32)
+    y = RNG.rand(BS, 2).astype(np.float32)
+    _one_step(ff, data, y, LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, ())
+
+
+def test_xdl_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    sparse = [
+        ff.create_tensor([BS, 1], dtype=DataType.INT32, name=f"s{i}")
+        for i in range(4)
+    ]
+    zoo.build_xdl(ff, sparse, embedding_size=500,
+                  mlp_dims=(64, 32, 2))
+    data = {
+        f"s{i}": RNG.randint(0, 500, size=(BS, 1)).astype(np.int32)
+        for i in range(4)
+    }
+    y = RNG.rand(BS, 2).astype(np.float32)
+    _one_step(ff, data, y, LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, ())
+
+
+def test_candle_uno_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    feats = [
+        ff.create_tensor([BS, d], name=f"feature_{i}")
+        for i, d in enumerate((32, 48, 16))
+    ]
+    zoo.build_candle_uno(ff, feats, tower_dims=(64, 64), final_dims=(64,))
+    data = {
+        f"feature_{i}": RNG.randn(BS, d).astype(np.float32)
+        for i, d in enumerate((32, 48, 16))
+    }
+    y = RNG.rand(BS, 1).astype(np.float32)
+    _one_step(ff, data, y, LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, ())
+
+
+def test_moe_mlp_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    x = ff.create_tensor([BS, 64], name="pixels")
+    zoo.build_moe_mlp(ff, x, hidden_size=64)
+    X = RNG.randn(BS, 64).astype(np.float32)
+    y = RNG.randint(0, 10, size=BS).astype(np.int32)
+    _one_step(ff, {"pixels": X}, y, LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+
+
+def test_moe_encoder_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    x = ff.create_tensor([BS, 8, 32], name="x")
+    t = zoo.build_moe_encoder(ff, x, num_layers=1, hidden_size=32, num_heads=2)
+    t = ff.dense(t, 1, use_bias=False)
+    X = RNG.randn(BS, 8, 32).astype(np.float32)
+    y = RNG.randn(BS, 8, 1).astype(np.float32)
+    _one_step(ff, {"x": X}, y, LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, ())
+
+
+def test_mlp_unify_small():
+    ff = FFModel(FFConfig(batch_size=BS))
+    x1 = ff.create_tensor([BS, 32], name="input1")
+    x2 = ff.create_tensor([BS, 32], name="input2")
+    zoo.build_mlp_unify(ff, x1, x2, hidden_dims=(64, 64))
+    data = {
+        "input1": RNG.randn(BS, 32).astype(np.float32),
+        "input2": RNG.randn(BS, 32).astype(np.float32),
+    }
+    y = RNG.randint(0, 64, size=BS).astype(np.int32)
+    _one_step(ff, data, y, LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
