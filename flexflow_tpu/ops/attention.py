@@ -97,11 +97,75 @@ def scaled_dot_product_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _seq_parallel_axes(ctx):
+    """If the q AND k/v sequence dims are partitioned the same way, return the
+    mesh axis names (seq_axis, batch_axis, head_axis) for the ring/Ulysses
+    paths; else None (the dense path handles mixed layouts via GSPMD). Head
+    sharding comes from a replica dim on q (the head-parallel rewrite)."""
+    if ctx is None or ctx.mesh is None or not ctx.in_shapes:
+        return None
+    qshape = ctx.in_shapes[0]
+    logical = [d for d in qshape.dims if not d.is_replica_dim]
+    rep = [d for d in qshape.dims if d.is_replica_dim]
+    if len(logical) != 3:
+        return None
+    b, s, _ = logical
+    if s.degree <= 1:
+        return None
+    # cross-attention guard: the ring rotates K/V blocks, so the key/value
+    # sequence dims must be sharded on the same axis with the same degree
+    for kv in ctx.in_shapes[1:3]:
+        kv_logical = [d for d in kv.dims if not d.is_replica_dim]
+        if len(kv_logical) != 3:
+            return None
+        s_kv = kv_logical[1]
+        if s_kv.degree != s.degree or s_kv.parallel_idx != s.parallel_idx:
+            return None
+    names = ctx.axis_names
+    seq_ax = names[s.parallel_idx]
+    batch_ax = names[b.parallel_idx] if b.degree > 1 else None
+    head_ax = (
+        names[rep[0].parallel_idx] if rep and rep[0].degree > 1 else None
+    )
+    return seq_ax, batch_ax, head_ax
+
+
 def _lower_mha(params):
     causal = params.get("causal", False)
     use_flash = params.get("use_flash", "auto")
     use_bias = params.get("bias", True)
     dropout = params.get("dropout", 0.0)
+    # "ring" | "ulysses" | "auto" | "none" — how attention runs when the
+    # sequence dim is partitioned (TPU-native addition; the reference cannot
+    # shard the attention sequence dim at all, SURVEY §5)
+    seq_parallel = params.get("seq_parallel", "auto")
+    if seq_parallel not in ("auto", "ring", "ulysses", "none"):
+        raise ValueError(
+            f"seq_parallel must be auto|ring|ulysses|none, got {seq_parallel!r}"
+        )
+
+    def _ulysses(q, k, v, ctx, seq_ax, batch_ax):
+        # Ulysses: all-to-all the seq sharding onto the head dim, attend
+        # locally, all-to-all back — GSPMD emits the all-to-alls from the
+        # layout constraints.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        head_spec = NamedSharding(
+            ctx.mesh, PartitionSpec(batch_ax, None, seq_ax, None)
+        )
+        qh = jax.lax.with_sharding_constraint(q, head_spec)
+        kh = jax.lax.with_sharding_constraint(k, head_spec)
+        vh = jax.lax.with_sharding_constraint(v, head_spec)
+        if use_flash is True or (use_flash == "auto" and q.shape[1] >= 1024):
+            from flexflow_tpu.ops.pallas.flash_attention import flash_attention
+
+            attn = flash_attention(qh, kh, vh, causal=causal)
+        else:
+            attn = scaled_dot_product_attention(qh, kh, vh, causal=causal)
+        seq_spec = NamedSharding(
+            ctx.mesh, PartitionSpec(batch_ax, seq_ax, None, None)
+        )
+        return jax.lax.with_sharding_constraint(attn, seq_spec)
 
     def fn(ins, ws, ctx):
         xq, xk, xv = ins
@@ -116,22 +180,63 @@ def _lower_mha(params):
             v = v + bv
         seq = q.shape[1]
         dropping = dropout > 0.0 and ctx.train and ctx.rng is not None
-        flash = (
-            use_flash is True or (use_flash == "auto" and seq >= 1024)
-        ) and not dropping  # the Pallas kernel has no prob-dropout path
-        if flash:
-            from flexflow_tpu.ops.pallas.flash_attention import flash_attention
-
-            attn = flash_attention(q, k, v, causal=causal)
-        else:
-            attn = scaled_dot_product_attention(
-                q,
-                k,
-                v,
-                causal=causal,
-                dropout_rate=dropout if dropping else 0.0,
-                dropout_rng=ctx.rng if dropping else None,
+        sp = None if seq_parallel == "none" else _seq_parallel_axes(ctx)
+        if sp is not None and dropping:
+            if seq_parallel in ("ring", "ulysses"):
+                # don't silently densify an explicitly requested SP path —
+                # dense attention materializes the [s, s] scores SP avoids
+                raise ValueError(
+                    f"seq_parallel={seq_parallel!r} does not support "
+                    "attention-prob dropout; use dropout=0.0 or "
+                    "seq_parallel='auto' (which falls back to dense)"
+                )
+            sp = None
+        if sp is not None:
+            seq_ax, batch_ax, head_ax = sp
+            mode = "ring" if seq_parallel == "auto" else seq_parallel
+            # Ulysses reshards seq→heads, so it needs the head dim free of
+            # TP sharding and divisible by the seq-axis degree
+            ulysses_ok = (
+                head_ax is None and q.shape[2] % ctx.mesh.shape[seq_ax] == 0
             )
+            if mode == "ulysses" and not ulysses_ok:
+                raise ValueError(
+                    "seq_parallel='ulysses' needs num_heads divisible by the "
+                    f"seq-axis degree ({ctx.mesh.shape[seq_ax]}) and heads "
+                    "free of tensor-parallel sharding; use 'ring'"
+                )
+            if mode == "ulysses":
+                attn = _ulysses(q, k, v, ctx, seq_ax, batch_ax)
+            else:
+                from flexflow_tpu.ops.pallas.ring_attention import ring_attention
+
+                attn = ring_attention(
+                    q,
+                    k,
+                    v,
+                    ctx.mesh,
+                    seq_ax,
+                    causal=causal,
+                    batch_axis=batch_ax,
+                    head_axis=head_ax,
+                )
+        else:
+            flash = (
+                use_flash is True or (use_flash == "auto" and seq >= 1024)
+            ) and not dropping  # the Pallas kernel has no prob-dropout path
+            if flash:
+                from flexflow_tpu.ops.pallas.flash_attention import flash_attention
+
+                attn = flash_attention(q, k, v, causal=causal)
+            else:
+                attn = scaled_dot_product_attention(
+                    q,
+                    k,
+                    v,
+                    causal=causal,
+                    dropout_rate=dropout if dropping else 0.0,
+                    dropout_rng=ctx.rng if dropping else None,
+                )
         y = jnp.einsum("bshd,hde->bse", attn, wo)
         if use_bias:
             y = y + ws[7]

@@ -36,6 +36,12 @@ class LowerCtx:
     train: bool = True
     rng: object = None  # jax PRNG key or None
     seq_length: Optional[int] = None  # reference: FFIterationConfig.seq_length
+    # distribution context: ops whose lowering is sharding-aware (ring
+    # attention under a partitioned sequence dim) read the mesh and the
+    # node's parallel shapes; plain ops ignore these.
+    mesh: object = None  # jax.sharding.Mesh or None
+    axis_names: Tuple[str, ...] = ()
+    in_shapes: Optional[Sequence[ParallelTensorShape]] = None
 
 
 @dataclasses.dataclass

@@ -74,6 +74,38 @@ def data_parallel_strategy(num_devices: int, graph: PCGGraph = None) -> Strategy
     )
 
 
+def sequence_parallel_strategy(
+    dp: int, sp: int, graph: PCGGraph = None, seq_axis: int = 1
+) -> Strategy:
+    """dp × sp mesh: inputs' batch dim on the "data" axis and sequence dim on
+    the "seq" axis. Attention under the partitioned sequence dim runs the
+    ring-attention path (ops/pallas/ring_attention.py) — the long-context
+    capability the reference lacks (SURVEY §5)."""
+
+    def apply(g: PCGGraph):
+        for node in g.nodes.values():
+            if node.op_type == OperatorType.INPUT and not node.inputs:
+                shape: ParallelTensorShape = node.params["shape"]
+                if dp > 1 and shape.dims[0].size % dp == 0:
+                    shape = shape.data_parallel(dp)
+                if (
+                    sp > 1
+                    # a real sequence dim has a trailing feature dim after
+                    # it; plain [b, features] inputs must not be seq-sharded
+                    and shape.ndim > seq_axis + 1
+                    and shape.dims[seq_axis].size % sp == 0
+                ):
+                    shape = shape.with_degree(seq_axis, sp, 1)
+                node.params["shape"] = shape
+                node.output_shapes = (shape,)
+
+    return Strategy(
+        MeshConfig(("data", "seq"), (max(dp, 1), max(sp, 1))),
+        apply,
+        name=f"dp{dp}xsp{sp}",
+    )
+
+
 def choose_strategy(model, num_devices: int) -> Strategy:
     """Strategy selection at compile() (reference: model.cc:2789 →
     graph_optimize_task, graph.cc:1545-1613): data-parallel unless a search

@@ -176,6 +176,9 @@ class Executor:
             ctx = LowerCtx(
                 train=train,
                 rng=None if rng is None else jax.random.fold_in(rng, guid),
+                mesh=self.mesh,
+                axis_names=self.mesh_config.axis_names,
+                in_shapes=[self.graph.shape_of(r) for r in node.inputs],
             )
             outs = self._lowered[guid](ins, ws, ctx)
             for i, out in enumerate(outs):

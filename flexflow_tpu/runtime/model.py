@@ -267,6 +267,7 @@ class FFModel:
         dropout: float = 0.0,
         bias: bool = True,
         causal: bool = False,
+        seq_parallel: str = "auto",
         name: Optional[str] = None,
     ) -> Tensor:
         params = {
@@ -277,6 +278,7 @@ class FFModel:
             "dropout": dropout,
             "bias": bias,
             "causal": causal,
+            "seq_parallel": seq_parallel,
             # 4 projection kernels (Glorot default) + optional 4 zero biases
             "initializers": [None] * 4
             + ([ZeroInitializer()] * 4 if bias else []),
