@@ -128,6 +128,14 @@ class Dense(Layer):
         )
 
 
+def _same_pad(in_size, kernel, stride):
+    """TF/keras 'same' padding: out = ceil(in/stride), extra pad on the
+    bottom/right side."""
+    out = -(-in_size // stride)
+    total = max((out - 1) * stride + kernel - in_size, 0)
+    return (total // 2, total - total // 2)
+
+
 class Conv2D(Layer):
     """channels_last (NHWC) — the TPU-native layout."""
 
@@ -145,7 +153,9 @@ class Conv2D(Layer):
 
     def build(self, ff, ts):
         if self.padding == "same":
-            ph, pw = self.kernel[0] // 2, self.kernel[1] // 2
+            _, h, w, _ = ts[0].dims  # NHWC
+            ph = _same_pad(h, self.kernel[0], self.strides[0])
+            pw = _same_pad(w, self.kernel[1], self.strides[1])
         else:
             ph = pw = 0
         act = _resolve_act(self.activation)
@@ -170,8 +180,12 @@ class _Pool2D(Layer):
         self.pool, self.strides, self.padding = p, s, padding
 
     def build(self, ff, ts):
-        ph = self.pool[0] // 2 if self.padding == "same" else 0
-        pw = self.pool[1] // 2 if self.padding == "same" else 0
+        if self.padding == "same":
+            _, h, w, _ = ts[0].dims  # NHWC
+            ph = _same_pad(h, self.pool[0], self.strides[0])
+            pw = _same_pad(w, self.pool[1], self.strides[1])
+        else:
+            ph = pw = 0
         return ff.pool2d(
             ts[0], self.pool[0], self.pool[1], self.strides[0], self.strides[1],
             ph, pw, pool_type=self.kind, name=self.name,

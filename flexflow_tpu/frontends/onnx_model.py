@@ -84,8 +84,10 @@ class ONNXModel:
                 w = next(
                     i for i in self.model.graph.initializer if i.name == wname
                 )
+                # ONNX pads are [top, left, bottom, right]
                 env[out] = ffmodel.conv2d(
-                    x, w.dims[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                    x, w.dims[0], k[0], k[1], s[0], s[1],
+                    (p[0], p[2]), (p[1], p[3]),
                     groups=a.get("group", 1),
                     use_bias=len(node.input) > 2,
                     name=node.name or None,
@@ -97,7 +99,7 @@ class ONNXModel:
                 s = a.get("strides", k)
                 p = a.get("pads", [0, 0, 0, 0])
                 env[out] = ffmodel.pool2d(
-                    x, k[0], k[1], s[0], s[1], p[0], p[1],
+                    x, k[0], k[1], s[0], s[1], (p[0], p[2]), (p[1], p[3]),
                     pool_type="max" if op == "MaxPool" else "avg",
                 )
                 nchw[out] = False

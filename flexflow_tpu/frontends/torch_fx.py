@@ -60,9 +60,12 @@ def trace_module(module, concrete_args=None) -> List[dict]:
             emit(node.name, "input", [])
         elif node.op == "output":
             arg = node.args[0]
-            if isinstance(arg, (tuple, list)):
-                arg = arg[0]
-            emit(node.name, "output", [arg.name])
+            args = list(arg) if isinstance(arg, (tuple, list)) else [arg]
+            emit(
+                node.name,
+                "output",
+                [a.name for a in args if isinstance(a, fx.Node)],
+            )
         elif node.op == "call_module":
             m = modules[node.target]
             if isinstance(m, nn.Linear):
@@ -137,6 +140,9 @@ def trace_module(module, concrete_args=None) -> List[dict]:
                     embed_dim=m.embed_dim,
                     num_heads=m.num_heads,
                     dropout=m.dropout,
+                    # torch default is batch_first=False ([s, b, e]); the
+                    # replay inserts the transposes to our [b, s, e]
+                    batch_first=bool(m.batch_first),
                     module=node.target,
                 )
             elif isinstance(m, nn.Dropout):
@@ -315,7 +321,7 @@ class PyTorchModel:
                 is_channels_first[name] = len(t.dims) == 4
                 continue
             if op == "output":
-                outputs.append(env[ins[0]])
+                outputs.extend(env[i] for i in ins)
                 continue
 
             if op == "linear":
@@ -392,10 +398,22 @@ class PyTorchModel:
                 )
             elif op == "multihead_attention":
                 q, k, v = (env[i] for i in (ins + ins[:1] * 3)[:3])
-                env[name] = ffmodel.multihead_attention(
+                # batch_first=False (torch's default) means [s, b, e] inputs
+                if not p.get("batch_first", False):
+                    q, k, v = (
+                        ffmodel.transpose(t, [1, 0, 2], name=f"{name}_bf{i}")
+                        for i, t in enumerate((q, k, v))
+                    )
+                out = ffmodel.multihead_attention(
                     q, k, v, p["embed_dim"], p["num_heads"],
                     dropout=p.get("dropout", 0.0), name=name,
                 )
+                # weight transfer targets the attention node, not the
+                # layout transpose appended below
+                self.node_map[name] = out.ref.guid
+                if not p.get("batch_first", False):
+                    out = ffmodel.transpose(out, [1, 0, 2], name=f"{name}_sf")
+                env[name] = out
             elif op == "dropout":
                 env[name] = ffmodel.dropout(env[ins[0]], p.get("rate", 0.5), name=name)
             elif op == "activation":
@@ -520,8 +538,10 @@ class PyTorchModel:
                     )
             else:
                 raise NotImplementedError(f"torch frontend replay: {op!r}")
-            if not isinstance(env[name], _UnsupportedAux) and hasattr(
-                env[name], "ref"
+            if (
+                name not in self.node_map
+                and not isinstance(env[name], _UnsupportedAux)
+                and hasattr(env[name], "ref")
             ):
                 self.node_map[name] = env[name].ref.guid
             inherit_layout(name, ins)

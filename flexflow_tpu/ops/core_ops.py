@@ -149,8 +149,17 @@ register_op(OperatorType.LINEAR, _infer_linear, _lower_linear, _flops_linear)
 # ---------------------------------------------------------------------------
 
 
+def _pad2(pad):
+    """Normalize a padding param: int (symmetric) or (lo, hi) tuple."""
+    if isinstance(pad, (tuple, list)):
+        lo, hi = pad
+        return int(lo), int(hi)
+    return int(pad), int(pad)
+
+
 def _conv_out_size(in_size, kernel, stride, pad):
-    return (in_size + 2 * pad - kernel) // stride + 1
+    lo, hi = _pad2(pad)
+    return (in_size + lo + hi - kernel) // stride + 1
 
 
 def _infer_conv2d(input_shapes, params):
@@ -202,7 +211,7 @@ def _infer_conv2d(input_shapes, params):
 
 def _lower_conv2d(params):
     sh, sw = params["stride_h"], params["stride_w"]
-    ph, pw = params["padding_h"], params["padding_w"]
+    ph, pw = _pad2(params["padding_h"]), _pad2(params["padding_w"])
     groups = params.get("groups", 1)
     act = params.get("activation", ActiMode.NONE)
     use_bias = params.get("use_bias", True)
@@ -214,7 +223,7 @@ def _lower_conv2d(params):
             x,
             kernel,
             window_strides=(sh, sw),
-            padding=[(ph, ph), (pw, pw)],
+            padding=[ph, pw],
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=groups,
             preferred_element_type=jnp.float32,
@@ -270,26 +279,33 @@ def _lower_pool2d(pool_type):
     def lower(params):
         kh, kw = params["kernel_h"], params["kernel_w"]
         sh, sw = params["stride_h"], params["stride_w"]
-        ph, pw = params["padding_h"], params["padding_w"]
+        ph, pw = _pad2(params["padding_h"]), _pad2(params["padding_w"])
         act = params.get("activation", ActiMode.NONE)
 
         def fn(ins, ws, ctx):
             (x,) = ins
-            pad = [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+            pad = [(0, 0), ph, pw, (0, 0)]
             window = (1, kh, kw, 1)
             strides = (1, sh, sw, 1)
             if pool_type == PoolType.MAX:
                 init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
                 y = jax.lax.reduce_window(
-                    x, init, jax.lax.max, window, strides,
-                    [(lo, hi) for lo, hi in pad],
+                    x, init, jax.lax.max, window, strides, pad,
                 )
             else:
                 s = jax.lax.reduce_window(
-                    x, 0.0, jax.lax.add, window, strides,
-                    [(lo, hi) for lo, hi in pad],
+                    x, 0.0, jax.lax.add, window, strides, pad,
                 )
-                y = s / (kh * kw)
+                if any(p != (0, 0) for p in (ph, pw)):
+                    # padded windows divide by the in-bounds count only
+                    # (keras/TF 'same' avg-pool semantics)
+                    ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+                    cnt = jax.lax.reduce_window(
+                        ones, 0.0, jax.lax.add, window, strides, pad,
+                    )
+                    y = s / cnt
+                else:
+                    y = s / (kh * kw)
             return [_apply_activation(y, act)]
 
         return fn

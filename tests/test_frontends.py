@@ -232,3 +232,77 @@ def test_torch_residual_cnn_flatten_layout():
     got = np.asarray(ff.forward({"x": xin}))
     want = tm(torch.from_numpy(xin)).detach().numpy()
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_torch_mha_batch_first_false_alignment():
+    """torch's nn.MultiheadAttention default layout is [s, b, e]; the
+    importer must insert the layout transposes (review finding)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+    class SelfAttn(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.mha = nn.MultiheadAttention(16, 4)  # batch_first=False
+
+        def forward(self, x):
+            out, _ = self.mha(x, x, x)
+            return out
+
+    tm = SelfAttn().eval()
+    pm = PyTorchModel(tm)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor([8, 4, 16], name="x")  # [s, b, e]
+    out = pm.apply(ff, [x])
+    ff.compile(loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, metrics=[],
+               logits=out)
+    pm.copy_weights(ff)
+
+    xin = np.random.RandomState(1).randn(8, 4, 16).astype(np.float32)
+    got = np.asarray(ff.forward({"x": xin}))
+    want = tm(torch.from_numpy(xin)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_torch_tuple_output():
+    """Modules returning (a, b) must expose both outputs (review finding)."""
+    pytest.importorskip("torch")
+    import torch.nn as nn
+
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+    class TwoHead(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(8, 4)
+            self.b = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    pm = PyTorchModel(TwoHead().eval())
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor([4, 8], name="x")
+    outs = pm.apply(ff, [x])
+    assert isinstance(outs, list) and len(outs) == 2
+    assert outs[0].dims == (4, 4) and outs[1].dims == (4, 2)
+
+
+def test_keras_same_padding_shapes():
+    """'same' must reproduce TF's ceil(in/stride) output sizes, including
+    the even-kernel/pool cases the old kernel//2 approximation broke."""
+    from flexflow_tpu.frontends import keras_api as keras
+
+    m = keras.Sequential(
+        [
+            keras.Input(shape=(32, 32, 3)),
+            keras.Conv2D(8, 4, strides=2, padding="same"),  # -> 16x16
+            keras.MaxPooling2D(2, padding="same"),  # -> 8x8
+            keras.Conv2D(4, 3, strides=1, padding="same"),  # -> 8x8
+        ]
+    )
+    m.compile(optimizer="sgd", loss="mse", metrics=[], batch_size=4)
+    sink = m.ffmodel.graph.nodes[m.ffmodel.graph.sinks()[0]]
+    assert sink.output_shapes[0].logical_sizes == (4, 8, 8, 4)
