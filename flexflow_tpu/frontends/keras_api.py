@@ -188,7 +188,8 @@ class _Pool2D(Layer):
             ph = pw = 0
         return ff.pool2d(
             ts[0], self.pool[0], self.pool[1], self.strides[0], self.strides[1],
-            ph, pw, pool_type=self.kind, name=self.name,
+            ph, pw, pool_type=self.kind, count_include_pad=False,
+            name=self.name,
         )
 
 

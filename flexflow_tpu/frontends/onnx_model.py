@@ -96,11 +96,13 @@ class ONNXModel:
             elif op in ("MaxPool", "AveragePool"):
                 x = to_nhwc(ins[0])
                 k = a.get("kernel_shape", [2, 2])
-                s = a.get("strides", k)
+                s = a.get("strides", [1, 1])  # ONNX default: stride 1
                 p = a.get("pads", [0, 0, 0, 0])
                 env[out] = ffmodel.pool2d(
                     x, k[0], k[1], s[0], s[1], (p[0], p[2]), (p[1], p[3]),
                     pool_type="max" if op == "MaxPool" else "avg",
+                    # ONNX AveragePool default: exclude padding from divisor
+                    count_include_pad=bool(a.get("count_include_pad", 0)),
                 )
                 nchw[out] = False
             elif op == "GlobalAveragePool":

@@ -105,6 +105,7 @@ def trace_module(module, concrete_args=None) -> List[dict]:
                     stride=to2(s),
                     padding=to2(p),
                     pool_type="max" if isinstance(m, nn.MaxPool2d) else "avg",
+                    count_include_pad=getattr(m, "count_include_pad", True),
                 )
             elif isinstance(m, nn.AdaptiveAvgPool2d):
                 emit(node.name, "adaptive_avg_pool2d", ins,
@@ -288,6 +289,9 @@ class PyTorchModel:
     def apply(self, ffmodel, input_tensors: Sequence):
         """input_tensors: FFModel Tensors matching placeholder order (image
         inputs in torch NCHW layout)."""
+        # Guids are per-FFModel; a fresh apply() must not keep the previous
+        # graph's entries (copy_weights would target stale guids).
+        self.node_map = {}
         env: Dict[str, object] = {}
         is_channels_first: Dict[str, bool] = {}
         it = iter(input_tensors)
@@ -358,6 +362,7 @@ class PyTorchModel:
                     p["padding"][0],
                     p["padding"][1],
                     pool_type=p.get("pool_type", "max"),
+                    count_include_pad=p.get("count_include_pad", True),
                     name=name,
                 )
                 is_channels_first[name] = False

@@ -1,0 +1,428 @@
+"""ctypes bindings to the native C++ core (libffnative.so).
+
+The reference keeps its search-critical machinery in C++ (graph toolkit
+include/flexflow/dominators.h, event simulator src/runtime/simulator.cc,
+data loader python/flexflow_dataloader.cc); this package is the TPU
+rebuild's equivalent native layer. The library is built on demand with the
+checked-in Makefile (native/Makefile); every entry point has a pure-Python
+fallback so the framework works where no C++ toolchain exists
+(set FFTPU_NO_NATIVE=1 to force the fallbacks).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libffnative.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _sources_newer_than_lib() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    src_dir = os.path.join(_NATIVE_DIR, "src")
+    for f in os.listdir(src_dir):
+        if os.path.getmtime(os.path.join(src_dir, f)) > lib_mtime:
+            return True
+    return False
+
+
+def _declare(lib: ctypes.CDLL):
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ffn_topo_sort.restype = ctypes.c_int
+    lib.ffn_topo_sort.argtypes = [ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p]
+    lib.ffn_imm_dominators.restype = ctypes.c_int
+    lib.ffn_imm_dominators.argtypes = [ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p]
+    lib.ffn_imm_post_dominators.restype = ctypes.c_int
+    lib.ffn_imm_post_dominators.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p,
+    ]
+    lib.ffn_transitive_reduction.restype = ctypes.c_int
+    lib.ffn_transitive_reduction.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, u8p,
+    ]
+    lib.ffn_simulate.restype = ctypes.c_double
+    lib.ffn_simulate.argtypes = [
+        ctypes.c_int32, i32p, f64p, ctypes.c_int32, i32p, i32p,
+        ctypes.c_int32, f64p, f64p,
+    ]
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.ffn_loader_create.restype = ctypes.c_void_p
+    lib.ffn_loader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), i64p,
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, i64p,
+        ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.ffn_loader_num_batches.restype = ctypes.c_int64
+    lib.ffn_loader_num_batches.argtypes = [ctypes.c_void_p]
+    lib.ffn_loader_next.restype = ctypes.c_int64
+    lib.ffn_loader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.ffn_loader_reset.restype = None
+    lib.ffn_loader_reset.argtypes = [ctypes.c_void_p, i64p]
+    lib.ffn_loader_destroy.restype = None
+    lib.ffn_loader_destroy.argtypes = [ctypes.c_void_p]
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    if os.environ.get("FFTPU_NO_NATIVE"):
+        _lib_failed = True
+        return None
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if _sources_newer_than_lib():
+                import sys
+
+                print(
+                    "[flexflow_tpu] building native core (libffnative.so)…",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                subprocess.run(
+                    ["make", "-s", "-j4"],
+                    cwd=_NATIVE_DIR,
+                    check=True,
+                    capture_output=True,
+                    timeout=300,
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+    return _lib
+
+
+def _as_i32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+# -- graph algorithms ---------------------------------------------------------
+
+
+def topo_sort(n: int, edges: Sequence[Tuple[int, int]]) -> Optional[List[int]]:
+    """Deterministic topological order of nodes 0..n-1; None on cycle."""
+    lib = get_lib()
+    src = _as_i32([e[0] for e in edges])
+    dst = _as_i32([e[1] for e in edges])
+    if lib is not None:
+        out = np.empty(n, dtype=np.int32)
+        rc = lib.ffn_topo_sort(n, len(edges), _i32p(src), _i32p(dst), _i32p(out))
+        return None if rc != 0 else out.tolist()
+    # fallback: Kahn with sorted ready set
+    indeg = [0] * n
+    adj = [[] for _ in range(n)]
+    for s, d in edges:
+        adj[s].append(d)
+        indeg[d] += 1
+    import heapq
+
+    ready = [v for v in range(n) if indeg[v] == 0]
+    heapq.heapify(ready)
+    order = []
+    while ready:
+        v = heapq.heappop(ready)
+        order.append(v)
+        for w in adj[v]:
+            indeg[w] -= 1
+            if indeg[w] == 0:
+                heapq.heappush(ready, w)
+    return order if len(order) == n else None
+
+
+def imm_post_dominators(
+    n: int, edges: Sequence[Tuple[int, int]]
+) -> Optional[List[int]]:
+    """ipdom[v] (or -1 when only the virtual sink post-dominates v).
+
+    The search's find_split_node uses this to locate sequence-split
+    bottlenecks (reference: dominators.h:377, substitution.cc:1984).
+    """
+    lib = get_lib()
+    if lib is not None:
+        src = _as_i32([e[0] for e in edges])
+        dst = _as_i32([e[1] for e in edges])
+        out = np.empty(n, dtype=np.int32)
+        rc = lib.ffn_imm_post_dominators(
+            n, len(edges), _i32p(src), _i32p(dst), _i32p(out)
+        )
+        return None if rc != 0 else out.tolist()
+    return _py_imm_post_dominators(n, edges)
+
+
+def _py_imm_post_dominators(n, edges):
+    """Pure-Python fallback: post-dominator sets by reverse-topo dataflow,
+    then ipdom = the nearest strict post-dominator."""
+    order = topo_sort(n, edges)
+    if order is None:
+        return None
+    succ = [[] for _ in range(n)]
+    for s, d in edges:
+        succ[s].append(d)
+    full = frozenset(range(n))
+    pdom = [full] * n
+    for v in reversed(order):
+        if not succ[v]:
+            pdom[v] = frozenset([v])
+        else:
+            inter = frozenset.intersection(*[pdom[s] for s in succ[v]])
+            pdom[v] = inter | {v}
+    index = {v: i for i, v in enumerate(order)}
+    out = []
+    for v in range(n):
+        strict = [d for d in pdom[v] if d != v]
+        # nearest = the one earliest in topo order among strict post-doms
+        out.append(min(strict, key=lambda d: index[d]) if strict else -1)
+    return out
+
+
+def transitive_reduction(
+    n: int, edges: Sequence[Tuple[int, int]]
+) -> Optional[List[bool]]:
+    """keep[i] per edge; False when implied by a longer path."""
+    lib = get_lib()
+    src = [e[0] for e in edges]
+    dst = [e[1] for e in edges]
+    if lib is not None:
+        out = np.empty(len(edges), dtype=np.uint8)
+        rc = lib.ffn_transitive_reduction(
+            n, len(edges), _i32p(_as_i32(src)), _i32p(_as_i32(dst)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return None if rc != 0 else [bool(x) for x in out]
+    adj = [[] for _ in range(n)]
+    for s, d in edges:
+        adj[s].append(d)
+    keep = []
+    for s, d in edges:
+        seen = set()
+        stack = [w for w in adj[s] if w != d]
+        found = False
+        while stack:
+            v = stack.pop()
+            if v == d:
+                found = True
+                break
+            for w in adj[v]:
+                if w not in seen:
+                    seen.add(w)
+                    stack.append(w)
+        keep.append(not found)
+    return keep
+
+
+# -- event-driven simulator ---------------------------------------------------
+
+
+def simulate(
+    resource_of: Sequence[int],
+    duration: Sequence[float],
+    edges: Sequence[Tuple[int, int]],
+    num_resources: int,
+) -> Optional[Tuple[float, np.ndarray]]:
+    """Replay a task DAG; returns (makespan, per-resource busy time).
+
+    Native path is ffn_simulate (reference: simulate_runtime,
+    simulator.cc:810-1240); fallback is an equivalent Python event loop.
+    """
+    n = len(resource_of)
+    lib = get_lib()
+    if lib is not None:
+        res = _as_i32(resource_of)
+        dur = np.ascontiguousarray(duration, dtype=np.float64)
+        src = _as_i32([e[0] for e in edges])
+        dst = _as_i32([e[1] for e in edges])
+        busy = np.zeros(num_resources, dtype=np.float64)
+        ms = lib.ffn_simulate(
+            n, _i32p(res), dur.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(edges), _i32p(src), _i32p(dst), num_resources,
+            busy.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), None,
+        )
+        return None if ms < 0 else (float(ms), busy)
+    return _py_simulate(resource_of, duration, edges, num_resources)
+
+
+def _py_simulate(resource_of, duration, edges, num_resources):
+    import heapq
+
+    n = len(resource_of)
+    out_edges = [[] for _ in range(n)]
+    unmet = [0] * n
+    for s, d in edges:
+        out_edges[s].append(d)
+        unmet[d] += 1
+    ready = [[] for _ in range(num_resources)]  # heaps of (ready_t, task)
+    running = [False] * num_resources
+    busy = np.zeros(num_resources)
+    done_heap = []
+    completed = 0
+    makespan = 0.0
+
+    def try_start(r, now):
+        if running[r] or not ready[r]:
+            return
+        _, t = heapq.heappop(ready[r])
+        end = now + duration[t]
+        running[r] = True
+        busy[r] += duration[t]
+        heapq.heappush(done_heap, (end, t))
+
+    for i in range(n):
+        if unmet[i] == 0:
+            heapq.heappush(ready[resource_of[i]], (0.0, i))
+    for r in range(num_resources):
+        try_start(r, 0.0)
+    while done_heap:
+        now, t = heapq.heappop(done_heap)
+        makespan = max(makespan, now)
+        completed += 1
+        r = resource_of[t]
+        running[r] = False
+        for s in out_edges[t]:
+            unmet[s] -= 1
+            if unmet[s] == 0:
+                heapq.heappush(ready[resource_of[s]], (now, s))
+        try_start(r, now)
+        for s in out_edges[t]:
+            rs = resource_of[s]
+            if not running[rs]:
+                try_start(rs, now)
+    if completed != n:
+        return None
+    return makespan, busy
+
+
+# -- data loader --------------------------------------------------------------
+
+
+class NativeLoader:
+    """Background-threaded shuffle/batch/prefetch loader (reference:
+    SingleDataLoader, python/flexflow_dataloader.h:34). Falls back to
+    synchronous numpy batching without the native library.
+
+    The epoch permutation is always drawn from numpy's seeded RNG here in
+    Python and handed to the C++ side, so the batch stream for a given seed
+    is identical whether or not the native library loaded."""
+
+    def __init__(
+        self,
+        arrays: Sequence[np.ndarray],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        prefetch_depth: int = 2,
+    ):
+        self.arrays = [np.ascontiguousarray(a) for a in arrays]
+        n = self.arrays[0].shape[0]
+        for a in self.arrays:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must share the sample dimension")
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._handle = None
+        self._lib = get_lib()
+        self._perm = self._make_perm(seed)
+        if self._lib is not None:
+            ptrs = (ctypes.c_void_p * len(self.arrays))(
+                *[a.ctypes.data_as(ctypes.c_void_p).value for a in self.arrays]
+            )
+            row_bytes = (ctypes.c_int64 * len(self.arrays))(
+                *[a.nbytes // n for a in self.arrays]
+            )
+            self._handle = self._lib.ffn_loader_create(
+                ptrs, row_bytes, len(self.arrays), n, batch_size,
+                self._perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                1 if drop_last else 0, prefetch_depth,
+            )
+        self._pos = 0
+
+    def _make_perm(self, seed) -> np.ndarray:
+        idx = np.arange(self.arrays[0].shape[0], dtype=np.int64)
+        if self.shuffle:
+            np.random.RandomState(seed).shuffle(idx)
+        return np.ascontiguousarray(idx)
+
+    @property
+    def num_batches(self) -> int:
+        n = self.arrays[0].shape[0]
+        if self._handle is not None:
+            return int(self._lib.ffn_loader_num_batches(self._handle))
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def next_batch(self) -> Optional[List[np.ndarray]]:
+        """Returns per-array [batch_size, ...] copies, or None at epoch end."""
+        if self._handle is not None:
+            ptrs = (ctypes.c_void_p * len(self.arrays))()
+            idx = self._lib.ffn_loader_next(self._handle, ptrs)
+            if idx < 0:
+                return None
+            out = []
+            for a, p in zip(self.arrays, ptrs):
+                shape = (self.batch_size,) + a.shape[1:]
+                buf = np.ctypeslib.as_array(
+                    ctypes.cast(p, ctypes.POINTER(ctypes.c_uint8)),
+                    shape=(int(np.prod(shape)) * a.itemsize,),
+                )
+                out.append(buf.view(a.dtype).reshape(shape).copy())
+            return out
+        if self._pos >= self.num_batches:
+            return None
+        b = self._pos
+        self._pos += 1
+        rows = self._perm[b * self.batch_size : (b + 1) * self.batch_size]
+        if len(rows) < self.batch_size:  # pad short final batch
+            rows = np.concatenate(
+                [rows, np.repeat(rows[:1], self.batch_size - len(rows))]
+            )
+        return [a[rows] for a in self.arrays]
+
+    def reset(self, seed: Optional[int] = None):
+        seed = self.seed if seed is None else seed
+        self.reset_perm(self._make_perm(seed))
+
+    def reset_perm(self, perm: np.ndarray):
+        """New epoch with an explicit sample order (len == num_samples)."""
+        self._perm = np.ascontiguousarray(perm, dtype=np.int64)
+        self._pos = 0
+        if self._handle is not None:
+            self._lib.ffn_loader_reset(
+                self._handle,
+                self._perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            )
+
+    def __del__(self):
+        if getattr(self, "_handle", None) is not None and self._lib is not None:
+            self._lib.ffn_loader_destroy(self._handle)
+            self._handle = None
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -296,15 +296,17 @@ def _lower_pool2d(pool_type):
                 s = jax.lax.reduce_window(
                     x, 0.0, jax.lax.add, window, strides, pad,
                 )
-                if any(p != (0, 0) for p in (ph, pw)):
-                    # padded windows divide by the in-bounds count only
-                    # (keras/TF 'same' avg-pool semantics)
+                include_pad = params.get("count_include_pad", True)
+                if not include_pad and any(p != (0, 0) for p in (ph, pw)):
+                    # divide by the in-bounds count only (keras/TF 'same'
+                    # and ONNX default avg-pool semantics)
                     ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
                     cnt = jax.lax.reduce_window(
                         ones, 0.0, jax.lax.add, window, strides, pad,
                     )
                     y = s / cnt
                 else:
+                    # full-kernel-area divisor (torch AvgPool2d default)
                     y = s / (kh * kw)
             return [_apply_activation(y, act)]
 

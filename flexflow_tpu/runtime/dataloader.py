@@ -5,7 +5,12 @@ index-launches per-shard batch copies; SURVEY §2.7).
 TPU-native version: the dataset lives in host RAM as numpy arrays; each
 `next_batch` slices a global batch and `jax.device_put`s it with the input's
 NamedSharding, so each chip receives exactly its shard (the same
-host→device movement pattern, without the Legion tasks)."""
+host→device movement pattern, without the Legion tasks). Batch assembly
+(shuffle + row gather) runs on the native threaded loader
+(native/src/dataloader.cc via flexflow_tpu.native.NativeLoader) when the
+C++ core is available, so the next batch is prefetched while the chip is
+still executing the current step — the role the reference's background
+CPU load tasks played."""
 
 from __future__ import annotations
 
@@ -25,6 +30,7 @@ class SingleDataLoader:
         shuffle: bool = False,
         seed: int = 0,
         drop_last: bool = True,
+        use_native: bool = True,
     ):
         sizes = {k: len(v) for k, v in arrays.items()}
         if len(set(sizes.values())) != 1:
@@ -37,6 +43,29 @@ class SingleDataLoader:
         self._rng = np.random.RandomState(seed)
         self._order = np.arange(self.num_samples)
         self._pos = 0
+        self._native = None
+        # Native prefetch path: only for full-batch epochs (drop_last) so
+        # both paths produce identical batch shapes, and only when at least
+        # one full batch exists. The permutation always comes from this
+        # object's numpy RNG, so batches are bit-identical with or without
+        # the native library.
+        if (
+            use_native
+            and drop_last
+            and self.num_samples >= batch_size
+        ):
+            from flexflow_tpu import native as _native_mod
+
+            if _native_mod.available():
+                self._keys = list(arrays.keys())
+                self._native = _native_mod.NativeLoader(
+                    [arrays[k] for k in self._keys],
+                    batch_size,
+                    shuffle=False,  # order supplied via reset_perm below
+                    seed=seed,
+                    drop_last=drop_last,
+                )
+                self._native.reset_perm(self._order)
 
     @property
     def num_batches(self) -> int:
@@ -48,8 +77,16 @@ class SingleDataLoader:
         self._pos = 0
         if self.shuffle:
             self._rng.shuffle(self._order)
+        if self._native is not None:
+            self._native.reset_perm(self._order)
 
     def next_batch(self) -> Dict[str, np.ndarray]:
+        if self._native is not None:
+            bufs = self._native.next_batch()
+            if bufs is None:  # epoch rollover
+                self.reset()
+                bufs = self._native.next_batch()
+            return dict(zip(self._keys, bufs))
         remaining = self.num_samples - self._pos
         if remaining < self.batch_size and (self.drop_last or remaining == 0):
             self.reset()

@@ -188,8 +188,12 @@ class FFModel:
         padding_w: int = 0,
         pool_type: str = "max",
         activation: ActiMode = ActiMode.NONE,
+        count_include_pad: bool = True,
         name: Optional[str] = None,
     ) -> Tensor:
+        """count_include_pad: avg-pool divisor semantics — True divides by
+        the full kernel area (torch AvgPool2d default), False by the
+        in-bounds window count (keras/TF 'same', ONNX default)."""
         params = {
             "kernel_h": kernel_h,
             "kernel_w": kernel_w,
@@ -198,6 +202,7 @@ class FFModel:
             "padding_h": padding_h,
             "padding_w": padding_w,
             "activation": activation,
+            "count_include_pad": count_include_pad,
         }
         op = (
             OperatorType.POOL2D_MAX

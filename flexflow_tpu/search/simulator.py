@@ -2,15 +2,21 @@
 
 The TPU rebuild of the reference's task-graph simulation
 (reference: Simulator::simulate_runtime, src/runtime/simulator.cc:810-1240).
-The reference replays an event-driven SimTask DAG over a machine model; under
-XLA one jitted step has no per-task launch overheads and collectives are the
-only explicit communication, so v1 models a step as
+Two modes:
 
-    sum over ops(max(roofline compute)) + sum(collective times) + grad sync
+  * **taskgraph** (default): lower the annotated PCG into a SimTask DAG —
+    forward/backward compute on a representative chip (one XLA stream;
+    SPMD makes all chips symmetric), collectives and per-weight gradient
+    all-reduces on an ICI link resource — and replay it event-driven
+    through the native simulator (native/src/simulator.cc, pure-Python
+    fallback inside flexflow_tpu.native). This captures what the analytic
+    sum cannot: gradient syncs overlapping with the remaining backward
+    compute, exactly the overlap XLA's async collectives give a real step.
+  * **analytic**: the reference's `LogicalTaskgraphBasedSimulator` style
+    closed-form sum (simulator.h:776-818) — compute + comm + sync.
 
-i.e. the reference's `LogicalTaskgraphBasedSimulator` analytic mode
-(simulator.h:776-818) rather than the full event replay. Costs come from
-`CostModel`; parallel ops map to collectives per the §2.3 table:
+Costs come from `CostModel`; parallel ops map to collectives per the
+SURVEY §2.3 table:
 
   Replicate  fwd broadcast(free: GSPMD keeps unsharded axes replicated),
              bwd all-reduce of the grad over the replica group
@@ -24,7 +30,7 @@ i.e. the reference's `LogicalTaskgraphBasedSimulator` analytic mode
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from flexflow_tpu.core.machine import MachineSpec
 from flexflow_tpu.core.pcg import PCGGraph
@@ -57,78 +63,166 @@ def _group_size(shape, mesh_sizes) -> int:
     return group
 
 
+def _parallel_op_comm(node, in_shapes, cm: CostModel) -> Tuple[float, float]:
+    """(fwd, bwd) collective seconds for one parallel op (SURVEY §2.3)."""
+    x = in_shapes[0]
+    y = node.output_shapes[0]
+    fwd = bwd = 0.0
+    if node.op_type == OperatorType.REPLICATE:
+        deg = node.params["degree"]
+        bwd = cm.all_reduce(x.piece_bytes(), deg)
+    elif node.op_type == OperatorType.REDUCTION:
+        deg = node.params["degree"]
+        fwd = cm.all_reduce(y.piece_bytes(), deg)
+    elif node.op_type == OperatorType.REPARTITION:
+        deg = node.params["degree"]
+        fwd = cm.all_to_all(x.piece_bytes(), deg)
+        bwd = cm.all_gather(y.piece_bytes(), deg)
+    elif node.op_type == OperatorType.COMBINE:
+        deg = node.params["degree"]
+        fwd = cm.all_gather(x.piece_bytes(), deg)
+        bwd = cm.all_to_all(y.piece_bytes(), deg)
+    elif node.op_type in (OperatorType.ALLTOALL, OperatorType.FUSED_PARALLEL):
+        deg = max(x.total_degree, y.total_degree)
+        fwd = cm.all_to_all(x.piece_bytes(), deg)
+        bwd = cm.all_to_all(y.piece_bytes(), deg)
+    return fwd, bwd
+
+
+_CHIP = 0  # compute resource id (one XLA stream per chip; SPMD-symmetric)
+
+
+def _collective_axis(node, mesh_sizes) -> int:
+    """Mesh axis a parallel op's collective rides. Collectives over
+    different mesh axes use disjoint ICI torus dimensions and may overlap;
+    same-axis collectives serialize on their link resource."""
+    idx = node.params.get("parallel_idx", -1)
+    if isinstance(idx, int) and 0 <= idx < len(mesh_sizes):
+        return idx
+    return len(mesh_sizes) - 1  # model axis by convention
+
+
 def estimate_graph_cost(
     graph: PCGGraph,
     cost_model: CostModel,
     mesh_sizes,
     include_backward: bool = True,
     optimizer_state_factor: float = 3.0,
+    mode: str = "taskgraph",
 ) -> GraphCost:
     """Estimate one training-iteration time for an annotated PCG.
 
     optimizer_state_factor: weights + grads + momentum ≈ 3× weight bytes
     (Adam: 4×) — feeds the HBM feasibility check.
     """
+    cm = cost_model
     total = GraphCost()
     weight_bytes = 0
     act_bytes = 0
-    cm = cost_model
+    taskgraph = mode != "analytic"
+    # resource ids: chip 0, then one ICI link resource per mesh axis
+    num_resources = 1 + max(1, len(mesh_sizes))
 
-    for guid in graph.topo_order():
+    def link(axis: int) -> int:
+        return 1 + min(axis, num_resources - 2)
+
+    # SimTask arrays (taskgraph mode)
+    resource_of: List[int] = []
+    duration: List[float] = []
+    edges: List[Tuple[int, int]] = []
+    fwd_task: Dict[int, int] = {}
+    bwd_task: Dict[int, int] = {}
+    bwd_comm: Dict[int, float] = {}
+
+    def add_task(resource: int, dur: float) -> int:
+        if not taskgraph:
+            return -1
+        resource_of.append(resource)
+        duration.append(dur)
+        return len(resource_of) - 1
+
+    def add_edge(src: int, dst: int):
+        if taskgraph:
+            edges.append((src, dst))
+
+    topo = graph.topo_order()
+
+    # ---- forward pass -------------------------------------------------------
+    per_node_cost: Dict[int, OpCost] = {}
+    for guid in topo:
         node = graph.nodes[guid]
         in_shapes = [graph.shape_of(r) for r in node.inputs]
 
         if node.op_type == OperatorType.INPUT:
             act_bytes += sum(s.piece_bytes() for s in node.output_shapes)
+            t = add_task(_CHIP, 0.0)
+        elif node.is_parallel_op:
+            f, b = _parallel_op_comm(node, in_shapes, cm)
+            total.comm_time += f + (b if include_backward else 0.0)
+            per_node_cost[guid] = OpCost(0.0, 0.0, 0.0, 0)
+            t = add_task(link(_collective_axis(node, mesh_sizes)), f)
+            bwd_comm[guid] = b
+        else:
+            cost = cm.op_cost(node, in_shapes)
+            per_node_cost[guid] = cost
+            total.compute_time += cost.forward_time
+            if include_backward:
+                total.compute_time += cost.backward_time
+            act_bytes += sum(s.piece_bytes() for s in node.output_shapes)
+            t = add_task(_CHIP, cost.forward_time)
+        fwd_task[guid] = t
+        for r in node.inputs:
+            if r.guid in fwd_task:
+                add_edge(fwd_task[r.guid], t)
+
+    # ---- backward pass ------------------------------------------------------
+    if include_backward:
+        for guid in reversed(topo):
+            node = graph.nodes[guid]
+            if node.op_type == OperatorType.INPUT:
+                continue
+            if node.is_parallel_op:
+                t = add_task(
+                    link(_collective_axis(node, mesh_sizes)),
+                    bwd_comm.get(guid, 0.0),
+                )
+            else:
+                t = add_task(_CHIP, per_node_cost[guid].backward_time)
+            bwd_task[guid] = t
+            add_edge(fwd_task[guid], t)  # bwd after own fwd
+            for c in graph.consumers(guid):
+                if c in bwd_task:
+                    add_edge(bwd_task[c], t)
+
+    # ---- gradient sync (per-weight all-reduce over replication group) -------
+    # Grad all-reduces ride the data axis (axis 0): TP-sharded weights are
+    # replicated over "data", DP-replicated weights reduce over it.
+    for guid in topo:
+        node = graph.nodes[guid]
+        if not node.weight_shapes:
             continue
-
-        if node.is_parallel_op:
-            x = in_shapes[0]
-            y = node.output_shapes[0]
-            t = 0.0
-            if node.op_type == OperatorType.REPLICATE:
-                deg = node.params["degree"]
-                if include_backward:
-                    t += cm.all_reduce(x.piece_bytes(), deg)
-            elif node.op_type == OperatorType.REDUCTION:
-                deg = node.params["degree"]
-                t += cm.all_reduce(y.piece_bytes(), deg)
-            elif node.op_type == OperatorType.REPARTITION:
-                deg = node.params["degree"]
-                t += cm.all_to_all(x.piece_bytes(), deg)
-                if include_backward:
-                    t += cm.all_gather(y.piece_bytes(), deg)
-            elif node.op_type == OperatorType.COMBINE:
-                deg = node.params["degree"]
-                t += cm.all_gather(x.piece_bytes(), deg)
-                if include_backward:
-                    t += cm.all_to_all(y.piece_bytes(), deg)
-            elif node.op_type in (
-                OperatorType.ALLTOALL,
-                OperatorType.FUSED_PARALLEL,
-            ):
-                deg = max(x.total_degree, y.total_degree)
-                t += cm.all_to_all(x.piece_bytes(), deg)
-                if include_backward:
-                    t += cm.all_to_all(y.piece_bytes(), deg)
-            total.comm_time += t
-            continue
-
-        cost = cm.op_cost(node, in_shapes)
-        total.compute_time += cost.forward_time
-        if include_backward:
-            total.compute_time += cost.backward_time
-        act_bytes += sum(s.piece_bytes() for s in node.output_shapes)
-
-        # gradient sync per weight (reference: per-parameter NCCL allreduce)
+        t_sync = 0.0
         for w in node.weight_shapes:
             weight_bytes += w.piece_bytes()
             if include_backward:
                 g = _group_size(w, mesh_sizes)
-                total.sync_time += cm.all_reduce(w.piece_bytes(), g)
+                t_sync += cm.all_reduce(w.piece_bytes(), g)
+        if include_backward and t_sync > 0:
+            total.sync_time += t_sync
+            t = add_task(link(0), t_sync)
+            add_edge(bwd_task.get(guid, fwd_task[guid]), t)
 
-    total.memory_per_chip = int(
-        weight_bytes * optimizer_state_factor + act_bytes
-    )
-    total.step_time = total.compute_time + total.comm_time + total.sync_time
+    total.memory_per_chip = int(weight_bytes * optimizer_state_factor + act_bytes)
+
+    if not taskgraph:
+        total.step_time = total.compute_time + total.comm_time + total.sync_time
+        return total
+
+    from flexflow_tpu import native
+
+    sim = native.simulate(resource_of, duration, edges, num_resources)
+    if sim is None:  # malformed candidate graph — treat as analytic
+        total.step_time = total.compute_time + total.comm_time + total.sync_time
+    else:
+        total.step_time = sim[0]
     return total

@@ -1,0 +1,188 @@
+// Threaded host-side data loader.
+//
+// Native rebuild of the reference's C++ SingleDataLoader
+// (reference: python/flexflow_dataloader.{h,cc} — full dataset resident in
+// host memory, next_batch copies per-shard slices toward the device). On
+// TPU the device transfer is JAX's job; the native layer owns what the
+// reference's CPU tasks owned: epoch shuffling, row gather into contiguous
+// batch buffers, and background prefetch so the accelerator never waits on
+// Python-side batch assembly.
+//
+// Ownership: the caller keeps the source arrays alive for the loader's
+// lifetime. Batch buffers are owned by the loader and reused; a slot
+// returned by ffn_loader_next stays valid until the next
+// ffn_loader_next/reset call. The Python wrapper copies the slot into a
+// caller-owned array (its public API makes no lifetime promise); the
+// prefetch win is that the row gather ran on this thread while the
+// accelerator executed the previous step.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <numeric>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<std::vector<uint8_t>> buffers;  // one per array
+  int64_t index = -1;   // batch index within the epoch
+  bool ready = false;
+};
+
+struct Loader {
+  std::vector<const uint8_t*> arrays;
+  std::vector<int64_t> row_bytes;  // bytes per sample, per array
+  int64_t num_samples = 0;
+  int64_t batch_size = 0;
+  bool drop_last = true;
+
+  // Sample order for the epoch. Always supplied by the caller (the Python
+  // wrapper shuffles with numpy's seeded RNG) so that the batch stream is
+  // bit-identical with and without the native library.
+  std::vector<int64_t> perm;
+  int64_t num_batches = 0;
+
+  std::vector<Batch> slots;
+  int64_t produced = 0;  // next batch index the worker will fill
+  int64_t consumed = 0;  // next batch index the caller will take
+  bool handed_out = false;  // caller still owns the last returned slot
+  bool filling = false;     // worker is copying outside the lock
+  bool stop = false;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+
+  void set_perm(const int64_t* p) {
+    perm.resize(num_samples);
+    if (p)
+      std::memcpy(perm.data(), p, sizeof(int64_t) * num_samples);
+    else
+      std::iota(perm.begin(), perm.end(), 0);
+  }
+
+  void fill(Batch* b, int64_t batch_idx) {
+    int64_t begin = batch_idx * batch_size;
+    int64_t rows = std::min(batch_size, num_samples - begin);
+    for (size_t a = 0; a < arrays.size(); ++a) {
+      int64_t rb = row_bytes[a];
+      b->buffers[a].resize((size_t)(batch_size * rb));
+      uint8_t* dst = b->buffers[a].data();
+      for (int64_t r = 0; r < rows; ++r)
+        std::memcpy(dst + r * rb, arrays[a] + perm[begin + r] * rb,
+                    (size_t)rb);
+      // pad a short final batch by repeating row 0 (static shapes for XLA)
+      for (int64_t r = rows; r < batch_size; ++r)
+        std::memcpy(dst + r * rb, arrays[a] + perm[begin] * rb, (size_t)rb);
+    }
+    b->index = batch_idx;
+    b->ready = true;
+  }
+
+  void run() {
+    for (;;) {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_produce.wait(lk, [&] {
+        return stop || (produced < num_batches &&
+                        produced - consumed < (int64_t)slots.size());
+      });
+      if (stop) return;
+      int64_t idx = produced;
+      Batch* slot = &slots[idx % slots.size()];
+      filling = true;
+      lk.unlock();
+      fill(slot, idx);
+      lk.lock();
+      filling = false;
+      // A reset may have rewound `produced` while we copied; only publish
+      // if this fill still corresponds to the expected next batch.
+      if (produced == idx) produced++;
+      cv_consume.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// arrays[i] points at num_samples rows of row_bytes[i] bytes each.
+// perm (nullable -> identity) gives the epoch's sample order.
+void* ffn_loader_create(const void** arrays, const int64_t* row_bytes,
+                        int32_t num_arrays, int64_t num_samples,
+                        int64_t batch_size, const int64_t* perm,
+                        int32_t drop_last, int32_t prefetch_depth) {
+  if (num_arrays <= 0 || num_samples <= 0 || batch_size <= 0) return nullptr;
+  Loader* L = new Loader();
+  for (int32_t i = 0; i < num_arrays; ++i) {
+    L->arrays.push_back((const uint8_t*)arrays[i]);
+    L->row_bytes.push_back(row_bytes[i]);
+  }
+  L->num_samples = num_samples;
+  L->batch_size = batch_size;
+  L->drop_last = drop_last != 0;
+  L->num_batches = drop_last ? num_samples / batch_size
+                             : (num_samples + batch_size - 1) / batch_size;
+  L->set_perm(perm);
+  int32_t depth = prefetch_depth < 1 ? 1 : prefetch_depth;
+  L->slots.resize((size_t)depth);
+  for (auto& s : L->slots) s.buffers.resize((size_t)num_arrays);
+  L->worker = std::thread([L] { L->run(); });
+  return L;
+}
+
+int64_t ffn_loader_num_batches(void* loader) {
+  return ((Loader*)loader)->num_batches;
+}
+
+// Blocks until the next batch is prefetched; writes per-array buffer
+// pointers into out_ptrs. Returns the batch index, or -1 at epoch end.
+// The returned buffers stay valid until the NEXT ffn_loader_next/reset
+// call — the slot is only recycled once the caller asks for more.
+int64_t ffn_loader_next(void* loader, void** out_ptrs) {
+  Loader* L = (Loader*)loader;
+  std::unique_lock<std::mutex> lk(L->mu);
+  if (L->handed_out) {  // release the previously returned slot
+    L->handed_out = false;
+    L->consumed++;
+    L->cv_produce.notify_all();
+  }
+  if (L->consumed >= L->num_batches) return -1;
+  int64_t idx = L->consumed;
+  L->cv_consume.wait(lk, [&] { return L->produced > idx; });
+  Batch& b = L->slots[idx % L->slots.size()];
+  for (size_t a = 0; a < L->arrays.size(); ++a)
+    out_ptrs[a] = b.buffers[a].data();
+  L->handed_out = true;
+  return idx;
+}
+
+// New epoch: install the caller's new sample order and restart prefetching.
+void ffn_loader_reset(void* loader, const int64_t* perm) {
+  Loader* L = (Loader*)loader;
+  std::unique_lock<std::mutex> lk(L->mu);
+  // Wait until the worker is parked on the condition variable (not copying
+  // outside the lock) before touching the permutation or counters.
+  L->cv_consume.wait(lk, [&] { return !L->filling; });
+  L->set_perm(perm);
+  L->produced = 0;
+  L->consumed = 0;
+  L->handed_out = false;
+  for (auto& s : L->slots) s.ready = false;
+  L->cv_produce.notify_all();
+}
+
+void ffn_loader_destroy(void* loader) {
+  Loader* L = (Loader*)loader;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->stop = true;
+    L->cv_produce.notify_all();
+  }
+  L->worker.join();
+  delete L;
+}
+
+}  // extern "C"

@@ -1,0 +1,185 @@
+// Graph algorithms for the auto-parallelization search.
+//
+// Native rebuild of the reference's header-only graph toolkit
+// (reference: include/flexflow/dominators.h — topo_sort :156, dominators
+// :205, post_dominators :243, imm_post_dominators :377, transitive_reduction
+// :382), exposed through a flat C ABI consumed from Python via ctypes
+// (flexflow_tpu/native). The search uses immediate post-dominators to find
+// sequence-split bottleneck nodes (reference: substitution.cc:1984
+// find_split_node) and topological order everywhere.
+//
+// Graphs cross the boundary as edge lists: n nodes labelled 0..n-1 and m
+// edges (src[i] -> dst[i]).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+namespace {
+
+struct Adj {
+  std::vector<std::vector<int32_t>> out;
+  std::vector<std::vector<int32_t>> in;
+  Adj(int32_t n, int32_t m, const int32_t* src, const int32_t* dst)
+      : out(n), in(n) {
+    for (int32_t e = 0; e < m; ++e) {
+      out[src[e]].push_back(dst[e]);
+      in[dst[e]].push_back(src[e]);
+    }
+  }
+};
+
+// Kahn's algorithm with a min-heap so the order is deterministic for equal
+// in-degree (matches the Python PCG topo_order contract).
+bool topo_sort_impl(int32_t n, const Adj& adj, std::vector<int32_t>* order) {
+  std::vector<int32_t> indeg(n, 0);
+  for (int32_t v = 0; v < n; ++v) indeg[v] = (int32_t)adj.in[v].size();
+  std::priority_queue<int32_t, std::vector<int32_t>, std::greater<int32_t>> q;
+  for (int32_t v = 0; v < n; ++v)
+    if (indeg[v] == 0) q.push(v);
+  order->clear();
+  order->reserve(n);
+  while (!q.empty()) {
+    int32_t v = q.top();
+    q.pop();
+    order->push_back(v);
+    for (int32_t w : adj.out[v])
+      if (--indeg[w] == 0) q.push(w);
+  }
+  return (int32_t)order->size() == n;
+}
+
+// Iterative dataflow dominators (Cooper–Harvey–Kennedy "A Simple, Fast
+// Dominance Algorithm"): intersect along the dominator tree in reverse
+// postorder until fixpoint.
+void idom_impl(int32_t n, const std::vector<std::vector<int32_t>>& preds,
+               const std::vector<int32_t>& rpo, int32_t root,
+               std::vector<int32_t>* idom) {
+  std::vector<int32_t> rpo_index(n, -1);
+  for (size_t i = 0; i < rpo.size(); ++i) rpo_index[rpo[i]] = (int32_t)i;
+  idom->assign(n, -1);
+  (*idom)[root] = root;
+  auto intersect = [&](int32_t a, int32_t b) {
+    while (a != b) {
+      while (rpo_index[a] > rpo_index[b]) a = (*idom)[a];
+      while (rpo_index[b] > rpo_index[a]) b = (*idom)[b];
+    }
+    return a;
+  };
+  bool changed = true;
+  while (changed) {
+    changed = false;
+    for (int32_t v : rpo) {
+      if (v == root) continue;
+      int32_t new_idom = -1;
+      for (int32_t p : preds[v]) {
+        if ((*idom)[p] == -1) continue;
+        new_idom = (new_idom == -1) ? p : intersect(new_idom, p);
+      }
+      if (new_idom != -1 && (*idom)[v] != new_idom) {
+        (*idom)[v] = new_idom;
+        changed = true;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out_order[n]; returns 0 on success, -1 if the graph has a cycle.
+int ffn_topo_sort(int32_t n, int32_t m, const int32_t* src,
+                  const int32_t* dst, int32_t* out_order) {
+  if (n < 0 || m < 0) return -1;
+  Adj adj(n, m, src, dst);
+  std::vector<int32_t> order;
+  if (!topo_sort_impl(n, adj, &order)) return -1;
+  std::memcpy(out_order, order.data(), sizeof(int32_t) * n);
+  return 0;
+}
+
+// Immediate dominators from a virtual root connected to every source node.
+// out_idom[v] = immediate dominator (-1 for sources themselves: their idom
+// is the virtual root, which has no real id). Returns 0 ok / -1 cyclic.
+int ffn_imm_dominators(int32_t n, int32_t m, const int32_t* src,
+                       const int32_t* dst, int32_t* out_idom) {
+  if (n <= 0) return -1;
+  Adj adj(n, m, src, dst);
+  std::vector<int32_t> order;
+  if (!topo_sort_impl(n, adj, &order)) return -1;
+
+  // Virtual root = node n, preceding every zero-in-degree node.
+  int32_t vn = n + 1;
+  std::vector<std::vector<int32_t>> preds(vn);
+  for (int32_t v = 0; v < n; ++v) {
+    preds[v] = adj.in[v];
+    if (preds[v].empty()) preds[v].push_back(n);
+  }
+  std::vector<int32_t> rpo;
+  rpo.push_back(n);
+  for (int32_t v : order) rpo.push_back(v);
+  std::vector<int32_t> idom;
+  idom_impl(vn, preds, rpo, n, &idom);
+  for (int32_t v = 0; v < n; ++v)
+    out_idom[v] = (idom[v] == n || idom[v] == -1) ? -1 : idom[v];
+  return 0;
+}
+
+// Immediate post-dominators (reference: dominators.h:377) — run idom on the
+// reversed graph with a virtual sink. out_ipdom[v] = -1 when v's immediate
+// post-dominator is the virtual sink (i.e. v is a sink or no single real
+// node post-dominates it).
+int ffn_imm_post_dominators(int32_t n, int32_t m, const int32_t* src,
+                            const int32_t* dst, int32_t* out_ipdom) {
+  if (n <= 0) return -1;
+  std::vector<int32_t> rsrc(m), rdst(m);
+  for (int32_t e = 0; e < m; ++e) {
+    rsrc[e] = dst[e];
+    rdst[e] = src[e];
+  }
+  return ffn_imm_dominators(n, m, rsrc.data(), rdst.data(), out_ipdom);
+}
+
+// Transitive reduction: keep[e] = 0 when edge e is implied by a longer
+// path (reference: dominators.h:382). O(m * reachable) DFS — search graphs
+// are small (hundreds of nodes).
+int ffn_transitive_reduction(int32_t n, int32_t m, const int32_t* src,
+                             const int32_t* dst, uint8_t* keep) {
+  if (n < 0 || m < 0) return -1;
+  Adj adj(n, m, src, dst);
+  std::vector<int32_t> order;
+  if (!topo_sort_impl(n, adj, &order)) return -1;
+  std::vector<uint8_t> reach(n, 0);
+  for (int32_t e = 0; e < m; ++e) {
+    keep[e] = 1;
+    // is there a path src->dst avoiding the direct edge?
+    std::fill(reach.begin(), reach.end(), 0);
+    std::vector<int32_t> stack;
+    for (int32_t w : adj.out[src[e]]) {
+      if (w == dst[e]) continue;  // skip one copy of the direct edge
+      if (!reach[w]) {
+        reach[w] = 1;
+        stack.push_back(w);
+      }
+    }
+    while (!stack.empty()) {
+      int32_t v = stack.back();
+      stack.pop_back();
+      if (v == dst[e]) {
+        keep[e] = 0;
+        break;
+      }
+      for (int32_t w : adj.out[v])
+        if (!reach[w]) {
+          reach[w] = 1;
+          stack.push_back(w);
+        }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,128 @@
+// Event-driven task-graph simulator.
+//
+// Native rebuild of the reference's Simulator::simulate_runtime
+// (reference: src/runtime/simulator.cc:810-1240): build a DAG of SimTasks
+// (forward/backward/update work pinned to devices, communication on links),
+// replay it with per-resource FIFO queues and a global event heap, and
+// return the makespan. The Python side (flexflow_tpu/search/simulator.py)
+// lowers an annotated PCG + strategy into the flat task arrays; this file
+// only knows about tasks, devices, and links.
+//
+// Differences from the reference, by design for TPU:
+//  * compute resources are chips (one stream each — XLA serializes a step's
+//    ops per chip), not CUDA streams per GPU;
+//  * communication occupies LINK resources assigned by the Python lowering
+//    — one per mesh axis, since collectives over different mesh axes ride
+//    disjoint ICI torus dimensions and can overlap, while collectives on
+//    the same axis serialize. This replaces the reference's MachineModel
+//    comm-path devices (reference: simulator.h:133-157, get_comm_path);
+//  * no per-task launch overhead parameter (Legion's is gone under XLA),
+//    but a fixed per-collective latency can be folded into task durations.
+
+#include <cstdint>
+#include <queue>
+#include <vector>
+
+namespace {
+
+struct Event {
+  double time;
+  int32_t task;
+  bool operator>(const Event& o) const {
+    if (time != o.time) return time > o.time;
+    return task > o.task;  // deterministic tie-break
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Simulate a task DAG.
+//   n               number of tasks
+//   resource_of[i]  resource (chip or link) executing task i, in [0, R)
+//   duration[i]     execution time of task i (seconds)
+//   m, esrc, edst   dependency edges: edst ready only after esrc completes
+//   R               total number of resources (chips + links)
+//   out_busy[R]     (optional, may be null) per-resource busy time
+//   out_finish[n]   (optional, may be null) per-task completion time
+// Returns makespan in seconds, or -1.0 on error (cycle / bad input).
+//
+// Scheduling: a task becomes READY when all predecessors finished; each
+// resource runs one task at a time, picking the ready task that became
+// ready earliest (FIFO by ready time, task id tie-break) — the reference's
+// ready-queue replay (simulator.cc:810+).
+double ffn_simulate(int32_t n, const int32_t* resource_of,
+                    const double* duration, int32_t m, const int32_t* esrc,
+                    const int32_t* edst, int32_t R, double* out_busy,
+                    double* out_finish) {
+  if (n < 0 || m < 0 || R <= 0) return -1.0;
+  std::vector<std::vector<int32_t>> out_edges(n);
+  std::vector<int32_t> unmet(n, 0);
+  for (int32_t e = 0; e < m; ++e) {
+    if (esrc[e] < 0 || esrc[e] >= n || edst[e] < 0 || edst[e] >= n)
+      return -1.0;
+    out_edges[esrc[e]].push_back(edst[e]);
+    unmet[edst[e]]++;
+  }
+  for (int32_t i = 0; i < n; ++i)
+    if (resource_of[i] < 0 || resource_of[i] >= R) return -1.0;
+
+  // Per-resource queue of ready tasks ordered by (ready_time, id).
+  using RQ = std::priority_queue<Event, std::vector<Event>, std::greater<Event>>;
+  std::vector<RQ> ready(R);
+  std::vector<double> free_at(R, 0.0);
+  std::vector<char> running(R, 0);
+  std::vector<double> busy(R, 0.0);
+  std::vector<double> finish(n, 0.0);
+
+  std::priority_queue<Event, std::vector<Event>, std::greater<Event>> done;
+  int32_t completed = 0;
+  double makespan = 0.0;
+
+  auto try_start = [&](int32_t r, double now) {
+    if (running[r] || ready[r].empty()) return;
+    Event ev = ready[r].top();
+    ready[r].pop();
+    double start = std::max(now, free_at[r]);
+    double end = start + duration[ev.task];
+    running[r] = 1;
+    free_at[r] = end;
+    busy[r] += duration[ev.task];
+    finish[ev.task] = end;
+    done.push({end, ev.task});
+  };
+
+  for (int32_t i = 0; i < n; ++i)
+    if (unmet[i] == 0) ready[resource_of[i]].push({0.0, i});
+  for (int32_t r = 0; r < R; ++r) try_start(r, 0.0);
+
+  while (!done.empty()) {
+    Event ev = done.top();
+    done.pop();
+    double now = ev.time;
+    makespan = std::max(makespan, now);
+    completed++;
+    int32_t r = resource_of[ev.task];
+    running[r] = 0;
+    for (int32_t succ : out_edges[ev.task]) {
+      if (--unmet[succ] == 0) ready[resource_of[succ]].push({now, succ});
+    }
+    // The finishing resource can start its next task; successors may also
+    // unblock idle resources.
+    try_start(r, now);
+    for (int32_t succ : out_edges[ev.task]) {
+      int32_t rs = resource_of[succ];
+      if (!running[rs]) try_start(rs, now);
+    }
+  }
+
+  if (completed != n) return -1.0;  // cycle: some tasks never became ready
+  if (out_busy)
+    for (int32_t r = 0; r < R; ++r) out_busy[r] = busy[r];
+  if (out_finish)
+    for (int32_t i = 0; i < n; ++i) out_finish[i] = finish[i];
+  return makespan;
+}
+
+}  // extern "C"
