@@ -1,0 +1,135 @@
+"""Checkpoint / resume.
+
+The reference has NO model checkpointing subsystem (SURVEY §5: weights can
+only be pulled/pushed from Python via Tensor.get_tensor/set_tensor, and only
+*strategies* are serializable via --export-strategy). This module is the
+"TPU build should do better" item: step-tagged training checkpoints of
+params + optimizer state + RNG through orbax when available (multi-host-safe
+and async-capable), with a pickle fallback so the subsystem works anywhere.
+
+Layout: `<dir>/step_<N>/` per checkpoint, newest retained up to
+`max_to_keep` (oldest deleted on save, like orbax's manager).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_STEP_PREFIX = "step_"
+_INT_KEY = "i~"  # marks dict keys that were ints (guids) before saving
+
+
+def _stringify(tree):
+    """Recursively make dict keys orbax/JSON-safe (int guid -> 'i~<guid>')."""
+    if isinstance(tree, dict):
+        return {
+            (_INT_KEY + str(k)) if isinstance(k, int) else k: _stringify(v)
+            for k, v in tree.items()
+        }
+    if isinstance(tree, (list, tuple)):
+        return [_stringify(v) for v in tree]
+    return tree
+
+
+def _unstringify(tree):
+    if isinstance(tree, dict):
+        return {
+            int(k[len(_INT_KEY):]) if isinstance(k, str) and k.startswith(_INT_KEY)
+            else k: _unstringify(v)
+            for k, v in tree.items()
+        }
+    if isinstance(tree, (list, tuple)):
+        return [_unstringify(v) for v in tree]
+    return tree
+
+
+def _to_host(tree):
+    """Device arrays -> numpy (gathers sharded arrays to host)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+class CheckpointManager:
+    """Save/restore training state under a directory.
+
+    State is any pytree; FFModel passes {params, opt_state, rng, meta}.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+        self._orbax = None
+        try:
+            import orbax.checkpoint as ocp
+
+            self._orbax = ocp
+        except Exception:
+            pass
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    steps.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    pass
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+
+    def _prune(self):
+        steps = self.all_steps()
+        while len(steps) > self.max_to_keep:
+            victim = steps.pop(0)
+            shutil.rmtree(self._path(victim), ignore_errors=True)
+
+    # -- save / restore ------------------------------------------------------
+
+    def save(self, step: int, state: Dict[str, Any]):
+        """Write one checkpoint; prunes beyond max_to_keep."""
+        tree = _stringify(_to_host(state))
+        path = self._path(step)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        if self._orbax is not None:
+            ckptr = self._orbax.StandardCheckpointer()
+            ckptr.save(os.path.join(path, "state"), tree)
+            ckptr.wait_until_finished()
+        else:
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "state.pkl"), "wb") as f:
+                pickle.dump(tree, f)
+        self._prune()
+
+    def restore(self, step: Optional[int] = None) -> Tuple[int, Dict[str, Any]]:
+        """Load a checkpoint (latest by default); returns (step, state)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        path = self._path(step)
+        orbax_path = os.path.join(path, "state")
+        pkl_path = os.path.join(path, "state.pkl")
+        if self._orbax is not None and os.path.isdir(orbax_path):
+            ckptr = self._orbax.StandardCheckpointer()
+            tree = ckptr.restore(orbax_path)
+        elif os.path.exists(pkl_path):
+            with open(pkl_path, "rb") as f:
+                tree = pickle.load(f)
+        else:
+            raise FileNotFoundError(f"checkpoint {path} has no payload")
+        return step, _unstringify(tree)

@@ -157,6 +157,31 @@ class Executor:
             params[guid] = ws
         return params
 
+    def place_params(
+        self, host_params: Dict[int, List[np.ndarray]]
+    ) -> Dict[int, List[jnp.ndarray]]:
+        """Re-shard host weights onto the mesh (checkpoint restore path)."""
+        params: Dict[int, List[jnp.ndarray]] = {}
+        for guid in self.topo:
+            node = self.graph.nodes[guid]
+            if not node.weight_shapes:
+                continue
+            if guid not in host_params:
+                raise KeyError(
+                    f"checkpoint missing weights for node {guid} ({node.name})"
+                )
+            ws = []
+            for wshape, arr in zip(node.weight_shapes, host_params[guid]):
+                expect = tuple(d.size for d in wshape.dims if not d.is_replica_dim)
+                if tuple(arr.shape) != expect:
+                    raise ValueError(
+                        f"checkpoint weight for {node.name} has shape "
+                        f"{tuple(arr.shape)}, model expects {expect}"
+                    )
+                ws.append(jax.device_put(jnp.asarray(arr), self.sharding_for(wshape)))
+            params[guid] = ws
+        return params
+
     # -- forward -------------------------------------------------------------
 
     def forward_values(self, params, batch, rng=None, train=True):

@@ -540,9 +540,14 @@ class FFModel:
         comp_mode: CompMode = CompMode.TRAINING,
         logits: Optional[Tensor] = None,
         devices=None,
+        strategy=None,
     ):
         """Pick a strategy, propagate parallel shapes, build the executor
-        (reference: FFModel::compile, model.cc:2789-3154; SURVEY §3.2)."""
+        (reference: FFModel::compile, model.cc:2789-3154; SURVEY §3.2).
+
+        strategy: an explicit parallel.strategy.Strategy to use instead of
+        the config-driven choice (the reference's --import-strategy path).
+        """
         from flexflow_tpu.parallel.strategy import choose_strategy
 
         self.optimizer = optimizer or SGDOptimizer(
@@ -562,7 +567,7 @@ class FFModel:
         self._logits = logits
 
         devices = jax.devices() if devices is None else list(devices)
-        self.strategy = choose_strategy(self, len(devices))
+        self.strategy = strategy or choose_strategy(self, len(devices))
         self.strategy.apply(self.graph)
         propagate_shapes(self.graph)
 
@@ -646,6 +651,8 @@ class FFModel:
         batch_size: Optional[int] = None,
         shuffle: bool = False,
         verbose: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1,
     ):
         """Training loop (reference: flexflow_cffi.py:1916-1958 fit —
         per-iter begin_trace; next_batch; forward; zero_gradients; backward;
@@ -693,6 +700,8 @@ class FFModel:
             if verbose:
                 print(f"epoch {epoch}: {perf.report()}")
                 print(f"THROUGHPUT = {thpt:.2f} samples/s")
+            if checkpoint_dir and (epoch + 1) % max(1, checkpoint_every) == 0:
+                self.save_checkpoint(checkpoint_dir, step=epoch)
         return history
 
     def evaluate(self, x, y, batch_size: Optional[int] = None):
@@ -739,3 +748,44 @@ class FFModel:
         self.params[guid][idx] = jax.device_put(
             jnp.asarray(value, node.weight_shapes[idx].dtype.to_jnp()), sharding
         )
+
+    # --------------------------------------------------------- checkpointing
+    # The reference has no model checkpointing (SURVEY §5); this is the
+    # orbax-backed upgrade: params + optimizer state + RNG, step-tagged.
+
+    def save_checkpoint(self, directory: str, step: int, max_to_keep: int = 3):
+        """Persist training state under `directory/step_<step>/`."""
+        from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+        if self.executor is None:
+            raise RuntimeError("call compile() before save_checkpoint()")
+        mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
+        mgr.save(
+            step,
+            {
+                "params": self.params,
+                "opt_state": self.opt_state,
+                "rng": self._rng,
+            },
+        )
+
+    def restore_checkpoint(self, directory: str, step: Optional[int] = None) -> int:
+        """Load training state (latest step by default); returns the step.
+
+        Weights are re-placed with the compiled strategy's shardings, so a
+        checkpoint written under one mesh restores correctly under another
+        (e.g. train data-parallel, resume with a searched dp×tp strategy).
+        """
+        from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+        if self.executor is None:
+            raise RuntimeError("call compile() before restore_checkpoint()")
+        mgr = CheckpointManager(directory)
+        step, state = mgr.restore(step)
+        self.params = self.executor.place_params(state["params"])
+        self.opt_state = jax.tree_util.tree_map(
+            jnp.asarray, state["opt_state"]
+        )
+        if "rng" in state:
+            self._rng = jnp.asarray(state["rng"])
+        return step

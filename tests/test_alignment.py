@@ -1,0 +1,423 @@
+"""Per-op forward + backward alignment vs PyTorch.
+
+TPU rebuild of the reference's align/ harness (reference:
+align/align_utils.py:87-103 — per-op fwd+bwd gradient comparison via
+torch.testing.assert_close; one op per directory, gen_tensors.sh +
+align_<op>_ff.py / align_<op>_torch.py). Here each test builds a one-op
+FFModel, injects torch-initialized weights via set_tensor, evaluates a
+fixed-cotangent scalar through jax.value_and_grad, and compares the
+output, input gradients, and weight gradients elementwise against torch
+autograd on CPU.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    SGDOptimizer,
+)
+
+RTOL, ATOL = 2e-4, 2e-5  # float32 CPU vs torch (matmul precision 'highest')
+
+
+def build(batch):
+    return FFModel(FFConfig(batch_size=batch))
+
+
+def compile_fwd(model):
+    model.compile(optimizer=SGDOptimizer(lr=0.1))
+    return model
+
+
+def ff_run(model, feeds, cotangent, wrt_inputs=True):
+    """Returns (output, {input: grad}, {(guid, idx): weight grad}) for
+    loss = sum(output * cotangent). wrt_inputs=False skips input grads
+    (required for integer inputs, e.g. embedding indices)."""
+    ex = model.executor
+    ref = ex.logits_ref
+    batch = ex.shard_batch(feeds)
+    cot = jnp.asarray(cotangent)
+
+    def f(params, batch):
+        vals = ex.forward_values(params, batch, rng=None, train=True)
+        out = vals[(ref.guid, ref.out_idx)]
+        return (out.astype(jnp.float32) * cot).sum(), out
+
+    argnums = (0, 1) if wrt_inputs else (0,)
+    (_, out), grads = jax.value_and_grad(f, argnums=argnums, has_aux=True)(
+        model.params, batch
+    )
+    dparams = grads[0]
+    dbatch = grads[1] if wrt_inputs else {}
+    dw = {
+        (g, i): np.asarray(w)
+        for g, ws in dparams.items()
+        for i, w in enumerate(ws)
+    }
+    return np.asarray(out), {k: np.asarray(v) for k, v in dbatch.items()}, dw
+
+
+def t_run(t_out, tensors):
+    """Backprop sum(t_out * cot) through torch; returns cot plus grads."""
+    cot = torch.randn_like(t_out)
+    (t_out * cot).sum().backward()
+    return cot.numpy(), [t.grad.numpy() for t in tensors]
+
+
+def close(a, b, rtol=RTOL, atol=ATOL):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------- linear
+
+
+def test_linear_alignment():
+    torch.manual_seed(0)
+    b, din, dout = 16, 24, 12
+    lin = torch.nn.Linear(din, dout)
+    x_t = torch.randn(b, din, requires_grad=True)
+    out_t = lin(x_t)
+    cot, (dx_t,) = t_run(out_t, [x_t])
+
+    model = build(b)
+    x = model.create_tensor([b, din], name="x")
+    y = model.dense(x, dout)
+    compile_fwd(model)
+    guid = y.ref.guid
+    model.set_tensor(guid, 0, lin.weight.detach().numpy().T)  # [in, out]
+    model.set_tensor(guid, 1, lin.bias.detach().numpy())
+
+    out, dx, dw = ff_run(model, {"x": x_t.detach().numpy()}, cot)
+    close(out, out_t.detach().numpy())
+    close(dx["x"], dx_t)
+    close(dw[(guid, 0)], lin.weight.grad.numpy().T)
+    close(dw[(guid, 1)], lin.bias.grad.numpy())
+
+
+def test_linear_relu_alignment():
+    torch.manual_seed(1)
+    b, din, dout = 8, 10, 6
+    lin = torch.nn.Linear(din, dout)
+    x_t = torch.randn(b, din, requires_grad=True)
+    out_t = torch.relu(lin(x_t))
+    cot, (dx_t,) = t_run(out_t, [x_t])
+
+    model = build(b)
+    x = model.create_tensor([b, din], name="x")
+    y = model.dense(x, dout, activation=ActiMode.RELU)
+    compile_fwd(model)
+    model.set_tensor(y.ref.guid, 0, lin.weight.detach().numpy().T)
+    model.set_tensor(y.ref.guid, 1, lin.bias.detach().numpy())
+    out, dx, dw = ff_run(model, {"x": x_t.detach().numpy()}, cot)
+    close(out, out_t.detach().numpy())
+    close(dx["x"], dx_t)
+
+
+# ---------------------------------------------------------------- conv2d
+
+
+def test_conv2d_alignment():
+    torch.manual_seed(2)
+    b, cin, cout, hw = 8, 3, 5, 10
+    conv = torch.nn.Conv2d(cin, cout, 3, stride=1, padding=1)
+    x_t = torch.randn(b, cin, hw, hw, requires_grad=True)
+    out_t = conv(x_t)
+    cot, (dx_t,) = t_run(out_t, [x_t])
+
+    model = build(b)
+    x = model.create_tensor([b, hw, hw, cin], name="x")  # NHWC
+    y = model.conv2d(x, cout, 3, 3, 1, 1, 1, 1)
+    compile_fwd(model)
+    guid = y.ref.guid
+    # torch OIHW -> HWIO
+    model.set_tensor(guid, 0, conv.weight.detach().numpy().transpose(2, 3, 1, 0))
+    model.set_tensor(guid, 1, conv.bias.detach().numpy())
+
+    feeds = {"x": x_t.detach().numpy().transpose(0, 2, 3, 1)}
+    out, dx, dw = ff_run(model, feeds, cot.transpose(0, 2, 3, 1))
+    close(out, out_t.detach().numpy().transpose(0, 2, 3, 1))
+    close(dx["x"], dx_t.transpose(0, 2, 3, 1))
+    close(dw[(guid, 0)], conv.weight.grad.numpy().transpose(2, 3, 1, 0))
+    close(dw[(guid, 1)], conv.bias.grad.numpy())
+
+
+def test_pool2d_alignment():
+    torch.manual_seed(3)
+    b, c, hw = 8, 4, 8
+    for pool_t, tmod in [
+        ("max", torch.nn.MaxPool2d(2, 2)),
+        ("avg", torch.nn.AvgPool2d(3, 2, padding=1)),
+    ]:
+        x_t = torch.randn(b, c, hw, hw, requires_grad=True)
+        out_t = tmod(x_t)
+        cot, (dx_t,) = t_run(out_t, [x_t])
+
+        model = build(b)
+        x = model.create_tensor([b, hw, hw, c], name="x")
+        if pool_t == "max":
+            model.pool2d(x, 2, 2, 2, 2, 0, 0, pool_type="max")
+        else:
+            model.pool2d(x, 3, 3, 2, 2, 1, 1, pool_type="avg")
+        compile_fwd(model)
+        feeds = {"x": x_t.detach().numpy().transpose(0, 2, 3, 1)}
+        out, dx, _ = ff_run(model, feeds, cot.transpose(0, 2, 3, 1))
+        close(out, out_t.detach().numpy().transpose(0, 2, 3, 1))
+        close(dx["x"], dx_t.transpose(0, 2, 3, 1))
+
+
+# ------------------------------------------------------------- embedding
+
+
+def test_embedding_alignment():
+    torch.manual_seed(4)
+    b, seq, vocab, dim = 8, 6, 50, 16
+    emb = torch.nn.Embedding(vocab, dim)
+    idx = torch.randint(0, vocab, (b, seq))
+    out_t = emb(idx)
+    cot, _ = t_run(out_t, [])
+
+    model = build(b)
+    x = model.create_tensor([b, seq], dtype=DataType.INT32, name="x")
+    y = model.embedding(x, vocab, dim)
+    compile_fwd(model)
+    guid = y.ref.guid
+    model.set_tensor(guid, 0, emb.weight.detach().numpy())
+    out, _, dw = ff_run(
+        model, {"x": idx.numpy().astype(np.int32)}, cot, wrt_inputs=False
+    )
+    close(out, out_t.detach().numpy())
+    close(dw[(guid, 0)], emb.weight.grad.numpy())
+
+
+# ------------------------------------------------------------- layer_norm
+
+
+def test_layer_norm_alignment():
+    torch.manual_seed(5)
+    b, seq, dim = 8, 5, 12
+    ln = torch.nn.LayerNorm(dim)
+    with torch.no_grad():  # non-trivial affine params
+        ln.weight.mul_(1.7).add_(0.1)
+        ln.bias.add_(0.3)
+    x_t = torch.randn(b, seq, dim, requires_grad=True)
+    out_t = ln(x_t)
+    cot, (dx_t,) = t_run(out_t, [x_t])
+
+    model = build(b)
+    x = model.create_tensor([b, seq, dim], name="x")
+    y = model.layer_norm(x)
+    compile_fwd(model)
+    guid = y.ref.guid
+    model.set_tensor(guid, 0, ln.weight.detach().numpy())
+    model.set_tensor(guid, 1, ln.bias.detach().numpy())
+    out, dx, dw = ff_run(model, {"x": x_t.detach().numpy()}, cot)
+    close(out, out_t.detach().numpy())
+    close(dx["x"], dx_t)
+    close(dw[(guid, 0)], ln.weight.grad.numpy())
+    close(dw[(guid, 1)], ln.bias.grad.numpy())
+
+
+# ------------------------------------------------------------ batch_norm
+
+
+def test_batch_norm_alignment():
+    torch.manual_seed(6)
+    b, c, hw = 16, 4, 6
+    bn = torch.nn.BatchNorm2d(c)
+    with torch.no_grad():
+        bn.weight.mul_(1.3).add_(0.2)
+        bn.bias.add_(0.1)
+    x_t = torch.randn(b, c, hw, hw, requires_grad=True)
+    out_t = bn(x_t)  # training mode: batch statistics
+    cot, (dx_t,) = t_run(out_t, [x_t])
+
+    model = build(b)
+    x = model.create_tensor([b, hw, hw, c], name="x")
+    y = model.batch_norm(x, relu=False)
+    compile_fwd(model)
+    guid = y.ref.guid
+    model.set_tensor(guid, 0, bn.weight.detach().numpy())
+    model.set_tensor(guid, 1, bn.bias.detach().numpy())
+    feeds = {"x": x_t.detach().numpy().transpose(0, 2, 3, 1)}
+    out, dx, dw = ff_run(model, feeds, cot.transpose(0, 2, 3, 1))
+    close(out, out_t.detach().numpy().transpose(0, 2, 3, 1), rtol=1e-3, atol=1e-4)
+    close(dx["x"], dx_t.transpose(0, 2, 3, 1), rtol=1e-3, atol=1e-4)
+    close(dw[(guid, 0)], bn.weight.grad.numpy(), rtol=1e-3, atol=1e-4)
+    close(dw[(guid, 1)], bn.bias.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+# ------------------------------------------------- multi-head attention
+
+
+def test_multihead_attention_alignment():
+    torch.manual_seed(7)
+    b, seq, embed, heads = 8, 6, 16, 4
+    head_dim = embed // heads
+    mha = torch.nn.MultiheadAttention(embed, heads, batch_first=True)
+    x_t = torch.randn(b, seq, embed, requires_grad=True)
+    out_t, _ = mha(x_t, x_t, x_t, need_weights=False)
+    cot, (dx_t,) = t_run(out_t, [x_t])
+
+    model = build(b)
+    x = model.create_tensor([b, seq, embed], name="x")
+    y = model.multihead_attention(x, x, x, embed, heads)
+    compile_fwd(model)
+    guid = y.ref.guid
+
+    w_in = mha.in_proj_weight.detach().numpy()  # [3E, E], out = x @ W.T
+    b_in = mha.in_proj_bias.detach().numpy()
+    for i in range(3):
+        w = w_in[i * embed : (i + 1) * embed]  # [E, E]
+        model.set_tensor(guid, i, w.T.reshape(embed, heads, head_dim))
+        model.set_tensor(
+            guid, 4 + i, b_in[i * embed : (i + 1) * embed].reshape(heads, head_dim)
+        )
+    w_out = mha.out_proj.weight.detach().numpy()  # [E, E]
+    model.set_tensor(guid, 3, w_out.T.reshape(heads, head_dim, embed))
+    model.set_tensor(guid, 7, mha.out_proj.bias.detach().numpy())
+
+    out, dx, dw = ff_run(model, {"x": x_t.detach().numpy()}, cot)
+    close(out, out_t.detach().numpy(), rtol=1e-3, atol=1e-4)
+    close(dx["x"], dx_t, rtol=1e-3, atol=1e-4)
+    # projection weight grads
+    dw_in = mha.in_proj_weight.grad.numpy()
+    for i in range(3):
+        close(
+            dw[(guid, i)],
+            dw_in[i * embed : (i + 1) * embed].T.reshape(embed, heads, head_dim),
+            rtol=1e-3,
+            atol=1e-4,
+        )
+    close(
+        dw[(guid, 3)],
+        mha.out_proj.weight.grad.numpy().T.reshape(heads, head_dim, embed),
+        rtol=1e-3,
+        atol=1e-4,
+    )
+
+
+# ------------------------------------------------------------ elementwise
+
+
+@pytest.mark.parametrize(
+    "ff_name,torch_fn",
+    [
+        ("add", torch.add),
+        ("subtract", torch.sub),
+        ("multiply", torch.mul),
+        ("divide", torch.div),
+    ],
+)
+def test_binary_alignment(ff_name, torch_fn):
+    torch.manual_seed(8)
+    b, d = 8, 10
+    a_t = torch.randn(b, d, requires_grad=True)
+    b_t = (torch.randn(b, d) + 2.0).requires_grad_()  # away from 0 for div
+    out_t = torch_fn(a_t, b_t)
+    cot, (da_t, db_t) = t_run(out_t, [a_t, b_t])
+
+    model = build(b)
+    xa = model.create_tensor([b, d], name="a")
+    xb = model.create_tensor([b, d], name="b")
+    getattr(model, ff_name)(xa, xb)
+    compile_fwd(model)
+    out, dx, _ = ff_run(
+        model, {"a": a_t.detach().numpy(), "b": b_t.detach().numpy()}, cot
+    )
+    close(out, out_t.detach().numpy())
+    close(dx["a"], da_t)
+    close(dx["b"], db_t)
+
+
+@pytest.mark.parametrize(
+    "ff_name,torch_fn",
+    [
+        ("relu", torch.relu),
+        ("sigmoid", torch.sigmoid),
+        ("tanh", torch.tanh),
+        ("gelu", torch.nn.functional.gelu),
+        ("exp", torch.exp),
+    ],
+)
+def test_unary_alignment(ff_name, torch_fn):
+    torch.manual_seed(9)
+    b, d = 8, 12
+    x_t = torch.randn(b, d, requires_grad=True)
+    out_t = torch_fn(x_t)
+    cot, (dx_t,) = t_run(out_t, [x_t])
+
+    model = build(b)
+    x = model.create_tensor([b, d], name="x")
+    getattr(model, ff_name)(x)
+    compile_fwd(model)
+    out, dx, _ = ff_run(model, {"x": x_t.detach().numpy()}, cot)
+    close(out, out_t.detach().numpy(), rtol=1e-3, atol=1e-5)
+    close(dx["x"], dx_t, rtol=1e-3, atol=1e-5)
+
+
+def test_softmax_alignment():
+    torch.manual_seed(10)
+    b, d = 8, 10
+    x_t = torch.randn(b, d, requires_grad=True)
+    out_t = torch.softmax(x_t, dim=-1)
+    cot, (dx_t,) = t_run(out_t, [x_t])
+
+    model = build(b)
+    x = model.create_tensor([b, d], name="x")
+    model.softmax(x)
+    compile_fwd(model)
+    out, dx, _ = ff_run(model, {"x": x_t.detach().numpy()}, cot)
+    close(out, out_t.detach().numpy())
+    close(dx["x"], dx_t)
+
+
+def test_batch_matmul_alignment():
+    torch.manual_seed(11)
+    b, m, k, n = 8, 5, 7, 6
+    a_t = torch.randn(b, m, k, requires_grad=True)
+    b_t = torch.randn(b, k, n, requires_grad=True)
+    out_t = torch.bmm(a_t, b_t)
+    cot, (da_t, db_t) = t_run(out_t, [a_t, b_t])
+
+    model = build(b)
+    xa = model.create_tensor([b, m, k], name="a")
+    xb = model.create_tensor([b, k, n], name="b")
+    model.batch_matmul(xa, xb)
+    compile_fwd(model)
+    out, dx, _ = ff_run(
+        model, {"a": a_t.detach().numpy(), "b": b_t.detach().numpy()}, cot
+    )
+    close(out, out_t.detach().numpy())
+    close(dx["a"], da_t)
+    close(dx["b"], db_t)
+
+
+def test_concat_transpose_reshape_alignment():
+    torch.manual_seed(12)
+    b, d = 8, 6
+    a_t = torch.randn(b, d, requires_grad=True)
+    b_t = torch.randn(b, d, requires_grad=True)
+    out_t = torch.cat([a_t, b_t], dim=1).reshape(b, 2, d).permute(0, 2, 1)
+    cot, (da_t, db_t) = t_run(out_t, [a_t, b_t])
+
+    model = build(b)
+    xa = model.create_tensor([b, d], name="a")
+    xb = model.create_tensor([b, d], name="b")
+    t = model.concat([xa, xb], axis=1)
+    t = model.reshape(t, [b, 2, d])
+    model.transpose(t, [0, 2, 1])
+    compile_fwd(model)
+    out, dx, _ = ff_run(
+        model, {"a": a_t.detach().numpy(), "b": b_t.detach().numpy()}, cot
+    )
+    close(out, out_t.detach().numpy())
+    close(dx["a"], da_t)
+    close(dx["b"], db_t)
