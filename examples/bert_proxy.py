@@ -1,0 +1,48 @@
+"""BERT-base proxy blocks (reference:
+examples/python/native/bert_proxy_native.py; OSDI22 AE bert.sh runs this
+shape with --budget 30 on 4 devices).
+
+    python examples/bert_proxy.py -b 8 -e 1 --budget 30
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training
+
+from flexflow_tpu import (  # noqa: E402
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_bert_proxy  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    seq, hidden, heads, layers = 512, 768, 12, 12
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, seq, hidden], name="hidden_states")
+    t = build_bert_proxy(ff, x, hidden=hidden, num_heads=heads,
+                         num_layers=layers)
+    ff.dense(t, 1, use_bias=False)  # regression head for the proxy loss
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.0001),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    n = cfg.batch_size * (cfg.iterations or 4)
+    rng = np.random.RandomState(0)
+    data = {"hidden_states": rng.randn(n, seq, hidden).astype(np.float32)}
+    y = rng.randn(n, seq, 1).astype(np.float32)
+    run_training(ff, data, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""CANDLE-Uno drug-response regression
+(reference: examples/cpp/candle_uno/candle_uno.cc; OSDI22 AE candle_uno.sh).
+
+    python examples/candle_uno.py -b 64 -e 1 [--budget N]
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training
+
+from flexflow_tpu import (  # noqa: E402
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_candle_uno  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    # reference feature shapes (candle_uno.cc input_shapes)
+    feature_dims = (942, 5270, 2048)
+    ff = FFModel(cfg)
+    feats = [
+        ff.create_tensor([cfg.batch_size, d], name=f"feature_{i}")
+        for i, d in enumerate(feature_dims)
+    ]
+    build_candle_uno(ff, feats, feature_dims=feature_dims)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    n = cfg.batch_size * (cfg.iterations or 4)
+    rng = np.random.RandomState(0)
+    data = {
+        f"feature_{i}": rng.randn(n, d).astype(np.float32)
+        for i, d in enumerate(feature_dims)
+    }
+    y = rng.rand(n, 1).astype(np.float32)
+    run_training(ff, data, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

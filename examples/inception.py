@@ -1,0 +1,41 @@
+"""Inception-v3 on synthetic ImageNet-sized data
+(reference: examples/cpp/InceptionV3/inception.cc; OSDI22 AE inception.sh).
+
+    python examples/inception.py -b 32 -e 1 [--budget N]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training, synthetic_images
+
+from flexflow_tpu import (  # noqa: E402
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_inception_v3  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    # reference input 299x299x3 (inception.cc top_level_task), NHWC here
+    x = ff.create_tensor([cfg.batch_size, 299, 299, 3], name="image")
+    build_inception_v3(ff, x, num_classes=10)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    n = cfg.batch_size * (cfg.iterations or 4)
+    X, y = synthetic_images(n, 299, 299)
+    run_training(ff, {"image": X}, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""mT5-small encoder (BASELINE config #4; reference: align/mt5_encoder —
+embedding + layernorm + attention under parallel rewrites).
+
+    python examples/mt5_encoder.py -b 8 -e 1 [--budget N]
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training
+
+from flexflow_tpu import (  # noqa: E402
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    AdamOptimizer,
+)
+from flexflow_tpu.models import build_mt5_encoder  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    vocab, seq, hidden, heads, layers = 32128, 128, 512, 8, 8
+    ff = FFModel(cfg)
+    ids = ff.create_tensor([cfg.batch_size, seq], dtype=DataType.INT32,
+                           name="input_ids")
+    t = build_mt5_encoder(ff, ids, vocab_size=vocab, hidden=hidden,
+                          num_heads=heads, num_layers=layers)
+    ff.dense(t, 1, use_bias=False)
+    ff.compile(
+        optimizer=AdamOptimizer(lr=0.0001),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    n = cfg.batch_size * (cfg.iterations or 4)
+    rng = np.random.RandomState(0)
+    data = {"input_ids": rng.randint(0, vocab, size=(n, seq)).astype(np.int32)}
+    y = rng.randn(n, seq, 1).astype(np.float32)
+    run_training(ff, data, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""ResNeXt-50 (32x4d) on synthetic data
+(reference: examples/cpp/resnext50/resnext.cc; OSDI22 AE resnext-50.sh).
+
+    python examples/resnext.py -b 32 -e 1 [--budget N]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training, synthetic_images
+
+from flexflow_tpu import (  # noqa: E402
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_resnext50  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 224, 224, 3], name="image")
+    build_resnext50(ff, x, num_classes=10)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    n = cfg.batch_size * (cfg.iterations or 4)
+    X, y = synthetic_images(n, 224, 224)
+    run_training(ff, {"image": X}, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""split_test: exercises the Split op inside a trained graph
+(reference: examples/cpp/split_test/split_test.cc and split_test_2 —
+a dense stack whose hidden tensor is split and re-concatenated).
+
+    python examples/split_test.py -b 16 -e 1
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training
+
+from flexflow_tpu import (  # noqa: E402
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 64], name="x")
+    t = ff.dense(x, 64, activation=ActiMode.RELU)
+    a, b = ff.split(t, 2, axis=1)
+    a = ff.dense(a, 32, activation=ActiMode.RELU)
+    b = ff.dense(b, 32, activation=ActiMode.RELU)
+    t = ff.concat([a, b], axis=1)
+    t = ff.dense(t, 10)
+    ff.softmax(t)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    n = cfg.batch_size * (cfg.iterations or 8)
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 64).astype(np.float32)
+    y = rng.randint(0, 10, size=n).astype(np.int32)
+    run_training(ff, {"x": X}, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

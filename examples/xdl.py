@@ -1,0 +1,55 @@
+"""XDL click-through model: embedding-dominated wide model
+(reference: examples/cpp/XDL/xdl.cc; OSDI22 AE xdl.sh).
+
+    python examples/xdl.py -b 64 -e 1 --enable-parameter-parallel
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training
+
+from flexflow_tpu import (  # noqa: E402
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_xdl  # noqa: E402
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    num_tables = 8  # reference xdl.cc embeddings count
+    vocab = 100000
+    ff = FFModel(cfg)
+    sparse = [
+        ff.create_tensor([cfg.batch_size, 1], dtype=DataType.INT32,
+                         name=f"sparse_{i}")
+        for i in range(num_tables)
+    ]
+    build_xdl(ff, sparse, embedding_size=vocab,
+              mlp_dims=(1024, 512, 2))
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    n = cfg.batch_size * (cfg.iterations or 8)
+    rng = np.random.RandomState(0)
+    data = {
+        f"sparse_{i}": rng.randint(0, vocab, size=(n, 1)).astype(np.int32)
+        for i in range(num_tables)
+    }
+    y = rng.rand(n, 2).astype(np.float32)
+    run_training(ff, data, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

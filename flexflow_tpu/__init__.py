@@ -31,6 +31,7 @@ from flexflow_tpu.runtime.initializer import (
 )
 from flexflow_tpu.runtime.model import FFModel, Tensor
 from flexflow_tpu.runtime.optimizer import AdamOptimizer, SGDOptimizer
+from flexflow_tpu.runtime.recompile import RecompileState
 
 __version__ = "0.1.0"
 
@@ -54,6 +55,7 @@ __all__ = [
     "MeshConfig",
     "SGDOptimizer",
     "AdamOptimizer",
+    "RecompileState",
     "GlorotUniform",
     "ZeroInitializer",
     "ConstantInitializer",

@@ -567,9 +567,27 @@ class FFModel:
         self._logits = logits
 
         devices = jax.devices() if devices is None else list(devices)
+        # pristine builder graph + the caller's compile arguments, restored
+        # by the recompile hook so a recompile keeps the user's explicit
+        # strategy/devices (reference: RecompileState, recompile.h:26-41)
+        self._prestrategy_graph = self.graph.copy()
+        self._compile_devices = devices
+        self._compile_strategy = strategy
         self.strategy = strategy or choose_strategy(self, len(devices))
         self.strategy.apply(self.graph)
         propagate_shapes(self.graph)
+
+        # substitution optimization pass (reference: base_optimize inside
+        # GraphSearchHelper::graph_optimize; enabled by --substitution-json
+        # or --fusion, SURVEY §2.5)
+        if self.config.substitution_json or self.config.perform_fusion:
+            from flexflow_tpu.search.substitution import apply_substitution_pass
+
+            self.graph, new_ref = apply_substitution_pass(
+                self.graph, logits.ref, self.config, self.strategy.mesh_config
+            )
+            logits = Tensor(self, new_ref)
+            self._logits = logits
 
         # label tensor matching the final op's batch partitioning
         # (reference: model.cc:3072-3110)
@@ -737,6 +755,14 @@ class FFModel:
 
     def zero_gradients(self):
         pass  # gradients are functional; nothing to zero
+
+    def recompile_on_condition(self, state) -> bool:
+        """Mid-training model mutation + recompile (reference:
+        FFModel::recompile_on_condition, model.cc:2416-2420; MoE expert
+        rebalancing, moe.cc:65-99). See runtime.recompile.RecompileState."""
+        from flexflow_tpu.runtime.recompile import recompile_on_condition
+
+        return recompile_on_condition(self, state)
 
     def get_tensor(self, guid: int, idx: int = 0) -> np.ndarray:
         """Pull a weight to host (reference: ParallelTensor get_tensor)."""

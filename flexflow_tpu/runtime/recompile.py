@@ -1,0 +1,95 @@
+"""Dynamic-reconfiguration hook: RecompileState.
+
+TPU rebuild of the reference's recompile subsystem (reference:
+src/recompile/recompile_state.cc:1-40, include/flexflow/recompile.h:26-41;
+used by the MoE example to rebalance experts mid-training,
+examples/cpp/mixture_of_experts/moe.cc:65-99). A `RecompileState` pairs a
+trigger predicate with a model-mutating alter function;
+`FFModel.recompile_on_condition(state)` checks the trigger each time it is
+called from the training loop and, when it fires, mutates the model and
+recompiles — preserving weights of every surviving layer whose shape is
+unchanged, re-initializing the rest, and resetting optimizer state.
+
+Differences from the reference: the reference alters the live Legion op
+graph and re-runs compile() in place; here the builder graph is restored to
+its pre-strategy form before `alter_func` runs (strategy annotations and
+inserted parallel ops are compile artifacts, not user model structure), so
+the alter function sees the same graph shape the user built.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RecompileState:
+    """reference: RecompileState {trigger_func, alter_func} (recompile.h)."""
+
+    trigger_func: Callable[["FFModel"], bool]
+    alter_func: Callable[["FFModel"], None]
+    recompiled: int = 0
+
+    def trigger(self, model) -> bool:
+        return bool(self.trigger_func(model))
+
+    def alter(self, model) -> None:
+        self.alter_func(model)
+        self.recompiled += 1
+
+
+def recompile_on_condition(model, state: RecompileState) -> bool:
+    """Check the trigger; on fire, alter + recompile the model
+    (reference: FFModel::recompile_on_condition, model.cc:2416-2420).
+
+    Returns True when a recompile happened.
+    """
+    if model.executor is None:
+        raise RuntimeError("call compile() before recompile_on_condition()")
+    if not state.trigger(model):
+        return False
+
+    # weights to host, keyed by node guid
+    host = {
+        guid: [np.asarray(w) for w in ws] for guid, ws in model.params.items()
+    }
+
+    # restore the user-built graph (pre-strategy), then let alter mutate it.
+    # Carry the live guid counter forward: strategy/substitution allocated
+    # guids past the pristine copy's counter, and reusing them would alias
+    # alter-added nodes with stale refs (logits, host-weight keys).
+    live_next_guid = model.graph._next_guid
+    model.graph = model._prestrategy_graph.copy()
+    model.graph._next_guid = max(model.graph._next_guid, live_next_guid)
+    state.alter(model)
+
+    logits = model._logits
+    model.compile(
+        optimizer=model.optimizer,
+        loss_type=model.loss_type,
+        metrics=model.metric_types,
+        logits=logits if logits.ref.guid in model.graph.nodes else None,
+        devices=model._compile_devices,
+        strategy=model._compile_strategy,
+    )
+
+    # carry over weights whose node + shape survived the alteration
+    for guid, ws in host.items():
+        node = model.graph.nodes.get(guid)
+        if node is None or len(node.weight_shapes) != len(ws):
+            continue
+        ok = all(
+            tuple(arr.shape)
+            == tuple(d.size for d in shape.dims if not d.is_replica_dim)
+            for arr, shape in zip(ws, node.weight_shapes)
+        )
+        if ok:
+            for i, arr in enumerate(ws):
+                model.set_tensor(guid, i, arr)
+    # opt_state from compile() stays valid: set_tensor preserves shapes,
+    # and a recompile resets momenta by design (the reference re-inits
+    # optimizer tasks after recompile too)
+    return True

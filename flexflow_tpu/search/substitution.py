@@ -1,0 +1,673 @@
+"""TASO-style graph-substitution engine with JSON rule loading.
+
+TPU rebuild of the reference's substitution subsystem (reference:
+src/runtime/substitution.cc — `GraphXfer` with pattern (`srcOps`) and
+replacement (`dstOps`) `OpX` nodes, backtracking match, `create_new_graph`;
+include/flexflow/substitution_loader.h + src/runtime/substitution_loader.cc —
+JSON rule files like substitutions/graph_subst_3_v2.json, loaded via
+`create_xfers` at substitution.cc:1587-1664).
+
+A rule is a pair of small op graphs over shared symbolic input tensors:
+
+    srcOps  — the pattern to match in the PCG (with parameter constraints),
+    dstOps  — the replacement subgraph, built over the same symbolic inputs,
+    mapped_outputs — which src outputs are re-routed to which dst outputs.
+
+Loading semantics kept from the reference (create_xfer,
+substitution.cc:1587-1614):
+
+  * `input` entries with opId >= 0 refer to output tsId of the rule-op at
+    that index; opId < 0 names an external input, shared between src and dst
+    sides by (opId, tsId).
+  * generated rules always carry `PM_PARALLEL_DEGREE == 2`; the loader
+    generalizes this to the requested `parallel_degree`
+    (reference: "Assume the generator only consider a parallel degree of 2",
+    substitution.cc:1486-1488).
+  * a dst compute op (Linear/Concat/…) inherits its full parameters from the
+    unique src op of the same type (reference: find_opx_with_type,
+    substitution.cc:1520-1531).
+
+Dim-numbering translation: rule files index tensor dims in the reference's
+Legion order (dim 0 = innermost/fastest-varying; the replica dim sits past
+the outermost dim). Our shapes are numpy-ordered with replica dims
+prepended, so ff-dim d on a tensor with n non-replica dims maps to numpy
+axis (n-1-d), and d == n denotes the replica dim.
+
+PM_ACTI uses the TASO generator's activation encoding (0 = none, 2 = relu),
+not ffconst's AC_MODE_* values; we decode accordingly (the reference passes
+the raw value through, substitution.cc:1511-1513, so its generated linear
+rules compare 0/2 against AC_MODE_* and can never fire — a latent bug we do
+not reproduce).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import json
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.pcg import PCGGraph, PCGNode, TensorRef
+from flexflow_tpu.core.types import ActiMode, OperatorType
+
+# ---------------------------------------------------------------------------
+# Pattern IR: TensorX / OpX / GraphXfer
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorX:
+    """A symbolic tensor in a rule: either output `idx` of rule op `op`
+    (internal), or external input `idx` when op is None."""
+
+    op: Optional["OpX"]
+    idx: int
+
+    @property
+    def is_external(self) -> bool:
+        return self.op is None
+
+
+@dataclasses.dataclass(frozen=True)
+class Constraint:
+    """An equality constraint on a matched op's parameter, in the rule-file
+    vocabulary (PM_* keys; reference: OpX::add_pm_constraint)."""
+
+    key: str
+    value: int
+
+
+class OpX:
+    """One pattern/replacement operator (reference: OpX, substitution.h)."""
+
+    def __init__(
+        self,
+        op_type: OperatorType,
+        inputs: Sequence[TensorX],
+        constraints: Sequence[Constraint] = (),
+        num_outputs: int = 1,
+    ):
+        self.op_type = op_type
+        self.inputs = tuple(inputs)
+        self.constraints = tuple(constraints)
+        self.num_outputs = num_outputs
+
+    def out(self, idx: int = 0) -> TensorX:
+        return TensorX(self, idx)
+
+    def constraint_value(self, key: str) -> Optional[int]:
+        for c in self.constraints:
+            if c.key == key:
+                return c.value
+        return None
+
+    def __repr__(self):
+        return f"OpX({self.op_type.name}, {len(self.inputs)} in)"
+
+
+class GraphXfer:
+    """A substitution rule: match src_ops in a PCG, replace with dst_ops."""
+
+    def __init__(
+        self,
+        name: str,
+        src_ops: Sequence[OpX],
+        dst_ops: Sequence[OpX],
+        mapped_outputs: Sequence[Tuple[TensorX, TensorX]],
+        model_axis: int = 1,
+    ):
+        self.name = name
+        self.src_ops = list(src_ops)
+        self.dst_ops = list(dst_ops)
+        self.mapped_outputs = list(mapped_outputs)
+        self.model_axis = model_axis
+
+    # -- matching -----------------------------------------------------------
+
+    def find_matches(
+        self, graph: PCGGraph, limit: int = 64
+    ) -> List[Tuple[Dict[OpX, int], Dict[TensorX, TensorRef]]]:
+        """Backtracking search for pattern embeddings
+        (reference: GraphXfer::run's DFS over srcOps).
+
+        Returns up to `limit` (op mapping, external-tensor binding) pairs.
+        """
+        matches: List[Tuple[Dict[OpX, int], Dict[TensorX, TensorRef]]] = []
+        mapping: Dict[OpX, int] = {}
+        binding: Dict[TensorX, TensorRef] = {}
+
+        candidates_by_type: Dict[OperatorType, List[int]] = {}
+        for guid in graph.topo_order():
+            candidates_by_type.setdefault(
+                graph.nodes[guid].op_type, []
+            ).append(guid)
+
+        def try_op(i: int):
+            if len(matches) >= limit:
+                return
+            if i == len(self.src_ops):
+                if self._check_match_closure(graph, mapping):
+                    matches.append((dict(mapping), dict(binding)))
+                return
+            opx = self.src_ops[i]
+            for guid in candidates_by_type.get(opx.op_type, ()):
+                if guid in mapping.values():
+                    continue
+                node = graph.nodes[guid]
+                if len(node.inputs) != len(opx.inputs):
+                    continue
+                if not self._constraints_ok(graph, node, opx):
+                    continue
+                new_bindings = []
+                ok = True
+                for tx, ref in zip(opx.inputs, node.inputs):
+                    if tx.is_external:
+                        if tx in binding:
+                            if binding[tx] != ref:
+                                ok = False
+                                break
+                        else:
+                            binding[tx] = ref
+                            new_bindings.append(tx)
+                    else:
+                        src_opx = tx.op
+                        if src_opx not in mapping:
+                            # pattern inputs always reference earlier ops
+                            ok = False
+                            break
+                        if ref != TensorRef(mapping[src_opx], tx.idx):
+                            ok = False
+                            break
+                if ok:
+                    mapping[opx] = guid
+                    try_op(i + 1)
+                    del mapping[opx]
+                for tx in new_bindings:
+                    del binding[tx]
+                if len(matches) >= limit:
+                    return
+
+        try_op(0)
+        return matches
+
+    def _check_match_closure(
+        self, graph: PCGGraph, mapping: Dict[OpX, int]
+    ) -> bool:
+        """Every output of a matched node consumed outside the match must be
+        a mapped output; otherwise the rewrite would orphan a live tensor
+        (reference: create_new_graph's external-edge check)."""
+        matched = set(mapping.values())
+        mapped_src = set()
+        for src_tx, _ in self.mapped_outputs:
+            mapped_src.add((mapping[src_tx.op], src_tx.idx))
+        for opx, guid in mapping.items():
+            for c in graph.consumers(guid):
+                if c in matched:
+                    continue
+                consumer = graph.nodes[c]
+                for ref in consumer.inputs:
+                    if ref.guid == guid and (guid, ref.out_idx) not in mapped_src:
+                        return False
+        return True
+
+    def _constraints_ok(
+        self, graph: PCGGraph, node: PCGNode, opx: OpX
+    ) -> bool:
+        for c in opx.constraints:
+            actual = _node_pm(graph, node, c.key)
+            if actual is None or actual != c.value:
+                return False
+        return True
+
+    # -- application ---------------------------------------------------------
+
+    def apply(
+        self,
+        graph: PCGGraph,
+        mapping: Dict[OpX, int],
+        binding: Dict[TensorX, TensorRef],
+    ) -> Tuple[PCGGraph, Dict[TensorRef, TensorRef]]:
+        """Build the rewritten graph (reference: GraphXfer::create_new_graph).
+
+        Returns (new graph, {old ref → new ref} for mapped outputs). Raises
+        ValueError if the result is invalid (cycle / shape mismatch) —
+        callers treat that as "rule does not apply here".
+        """
+        from flexflow_tpu.ops.registry import infer_shapes
+        from flexflow_tpu.runtime.executor import propagate_shapes
+
+        g = graph.copy()
+        dst_nodes: Dict[OpX, PCGNode] = {}
+
+        def resolve(tx: TensorX) -> TensorRef:
+            if tx.is_external:
+                return binding[tx]
+            if tx.op in dst_nodes:
+                return TensorRef(dst_nodes[tx.op].guid, tx.idx)
+            # a dst input referencing a src op's output directly
+            if tx.op in mapping:
+                return TensorRef(mapping[tx.op], tx.idx)
+            raise ValueError("unresolvable rule tensor")
+
+        for opx in self.dst_ops:
+            in_refs = [resolve(tx) for tx in opx.inputs]
+            params = self._dst_params(g, opx, mapping, graph, in_refs)
+            # infer real output shapes immediately so later dst ops in the
+            # chain translate ff dims against correct ranks (a placeholder
+            # here would feed _ff_dim_to_axis the pre-op shape)
+            in_shapes = [g.shape_of(r) for r in in_refs]
+            outs, weights = infer_shapes(opx.op_type, in_shapes, params)
+            node = g.add_node(
+                opx.op_type,
+                f"{self.name}.{opx.op_type.name.lower()}",
+                in_refs,
+                params,
+                outs,
+                weights,
+            )
+            dst_nodes[opx] = node
+
+        ref_map: Dict[TensorRef, TensorRef] = {}
+        matched = set(mapping.values())
+        for src_tx, dst_tx in self.mapped_outputs:
+            old = TensorRef(mapping[src_tx.op], src_tx.idx)
+            new = TensorRef(dst_nodes[dst_tx.op].guid, dst_tx.idx)
+            ref_map[old] = new
+            for c in list(g.consumers(old.guid)):
+                if c not in matched and c not in {
+                    n.guid for n in dst_nodes.values()
+                }:
+                    g.replace_input(c, old, new)
+
+        for guid in matched:
+            g.remove_node(guid)
+
+        propagate_shapes(g)  # validates: raises on cycle or shape break
+        return g, ref_map
+
+    def _dst_params(
+        self,
+        g: PCGGraph,
+        opx: OpX,
+        mapping: Dict[OpX, int],
+        old_graph: PCGGraph,
+        in_refs: Sequence[TensorRef],
+    ) -> Dict[str, object]:
+        """Parameters for an instantiated dst op: parallel ops from the rule's
+        constraints; compute ops copied from the unique matched src op of the
+        same type (reference: find_opx_with_type), overlaid with any
+        constraint-pinned values."""
+        ot = opx.op_type
+        if ot in (
+            OperatorType.REPARTITION,
+            OperatorType.COMBINE,
+            OperatorType.REPLICATE,
+            OperatorType.REDUCTION,
+        ):
+            degree = opx.constraint_value("PM_PARALLEL_DEGREE")
+            ff_dim = opx.constraint_value("PM_PARALLEL_DIM")
+            if degree is None:
+                raise ValueError(f"{self.name}: dst {ot} missing degree")
+            params: Dict[str, object] = {"degree": degree}
+            in_shape = g.shape_of(in_refs[0])
+            if ot in (OperatorType.REPARTITION, OperatorType.COMBINE):
+                axis = _ff_dim_to_axis(in_shape, ff_dim)
+                if axis is None:
+                    raise ValueError(f"{self.name}: bad dim {ff_dim}")
+                params["axis"] = axis
+            if ot == OperatorType.REPARTITION:
+                # batch-dim partitions ride the data axis; everything else
+                # (feature/channel dims) rides the model axis
+                batch_axis = _nonreplica_axes(in_shape)[0]
+                params["parallel_idx"] = (
+                    0 if params["axis"] == batch_axis else self.model_axis
+                )
+            elif ot == OperatorType.REPLICATE:
+                params["parallel_idx"] = self.model_axis
+            return params
+
+        # compute op: copy the matched same-type src op's params
+        src_match = None
+        for s_opx, guid in mapping.items():
+            if s_opx.op_type == ot:
+                if src_match is not None:
+                    raise ValueError(
+                        f"{self.name}: ambiguous param source for {ot}"
+                    )
+                src_match = old_graph.nodes[guid]
+        params = dict(src_match.params) if src_match is not None else {}
+        acti = opx.constraint_value("PM_ACTI")
+        if acti is not None:
+            params["activation"] = _TASO_ACTI[acti]
+        ff_axis = opx.constraint_value("PM_AXIS")
+        if ff_axis is not None and in_refs:
+            axis = _ff_dim_to_axis(g.shape_of(in_refs[0]), ff_axis)
+            if axis is None:
+                raise ValueError(f"{self.name}: bad axis {ff_axis}")
+            params["axis"] = axis
+        return params
+
+    # -- one-shot driver ------------------------------------------------------
+
+    def run(
+        self, graph: PCGGraph, limit: int = 16
+    ) -> Iterator[PCGGraph]:
+        """Yield every valid single application of this rule to `graph`."""
+        for mapping, binding in self.find_matches(graph, limit=limit):
+            try:
+                g, _ = self.apply(graph, mapping, binding)
+            except (ValueError, KeyError):
+                continue
+            yield g
+
+    def __repr__(self):
+        return (
+            f"GraphXfer('{self.name}', {len(self.src_ops)}→"
+            f"{len(self.dst_ops)} ops)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# PM-parameter extraction from PCG nodes (match-time constraint evaluation)
+# ---------------------------------------------------------------------------
+
+# TASO generator activation encoding (see module docstring)
+_TASO_ACTI = {0: ActiMode.NONE, 2: ActiMode.RELU}
+_TASO_ACTI_REV = {v: k for k, v in _TASO_ACTI.items()}
+
+
+def _nonreplica_axes(shape) -> List[int]:
+    return [i for i, d in enumerate(shape.dims) if not d.is_replica_dim]
+
+
+def _ff_dim_to_axis(shape, ff_dim: Optional[int]) -> Optional[int]:
+    """ff-dim (innermost-first, replica past outermost) → numpy dims index."""
+    if ff_dim is None:
+        return None
+    nr = _nonreplica_axes(shape)
+    n = len(nr)
+    if 0 <= ff_dim < n:
+        return nr[n - 1 - ff_dim]
+    return None  # ff_dim == n denotes the replica dim: no numpy axis
+
+
+def _axis_to_ff_dim(shape, axis: int) -> Optional[int]:
+    nr = _nonreplica_axes(shape)
+    n = len(nr)
+    if axis in nr:
+        return n - 1 - nr.index(axis)
+    return None
+
+
+def _node_pm(graph: PCGGraph, node: PCGNode, key: str) -> Optional[int]:
+    """Evaluate a PM_* key on a PCG node, in the rule file's conventions
+    (the analog of Op::get_int_parameter on the reference side)."""
+    ot = node.op_type
+    in_shape = graph.shape_of(node.inputs[0]) if node.inputs else None
+
+    if key == "PM_PARALLEL_DEGREE":
+        if ot in (
+            OperatorType.REPARTITION,
+            OperatorType.COMBINE,
+            OperatorType.REPLICATE,
+            OperatorType.REDUCTION,
+        ):
+            return node.params.get("degree")
+        return None
+    if key == "PM_PARALLEL_DIM":
+        if in_shape is None:
+            return None
+        if ot in (OperatorType.REPARTITION, OperatorType.COMBINE):
+            return _axis_to_ff_dim(in_shape, node.params.get("axis"))
+        if ot in (OperatorType.REPLICATE, OperatorType.REDUCTION):
+            # replica dim position in ff convention = #non-replica dims
+            return len(_nonreplica_axes(in_shape))
+        return None
+    if key == "PM_ACTI":
+        acti = node.params.get("activation", ActiMode.NONE)
+        return _TASO_ACTI_REV.get(acti)
+    if key == "PM_AXIS":
+        if in_shape is None:
+            return None
+        return _axis_to_ff_dim(in_shape, node.params.get("axis"))
+    if key == "PM_NUM_INPUTS":
+        return len(node.inputs)
+    if key == "PM_NUM_OUTPUTS":
+        return node.num_outputs
+    if key == "PM_NUMDIM":
+        out_shape = node.output_shapes[0] if node.output_shapes else None
+        if out_shape is None:
+            return None
+        return len(_nonreplica_axes(out_shape))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# JSON rule loading (reference: substitution_loader.cc + create_xfers)
+# ---------------------------------------------------------------------------
+
+_JSON_OP_TYPES = {
+    "OP_PARTITION": OperatorType.REPARTITION,
+    "OP_COMBINE": OperatorType.COMBINE,
+    "OP_REPLICATE": OperatorType.REPLICATE,
+    "OP_REDUCE": OperatorType.REDUCTION,
+    "OP_LINEAR": OperatorType.LINEAR,
+    "OP_CONCAT": OperatorType.CONCAT,
+    "OP_RELU": OperatorType.RELU,
+    "OP_EW_ADD": OperatorType.EW_ADD,
+    "OP_EW_MUL": OperatorType.EW_MUL,
+    "OP_SPLIT": OperatorType.SPLIT,
+    "OP_CONV2D": OperatorType.CONV2D,
+    "OP_SOFTMAX": OperatorType.SOFTMAX,
+    "OP_RESHAPE": OperatorType.RESHAPE,
+    "OP_TRANSPOSE": OperatorType.TRANSPOSE,
+}
+
+
+def _rule_to_xfer(
+    rule: dict, parallel_degree: int, model_axis: int
+) -> GraphXfer:
+    """Convert one JSON Rule to a GraphXfer
+    (reference: create_xfer, substitution.cc:1587-1614)."""
+    externals: Dict[Tuple[int, int], TensorX] = {}
+    ext_counter = itertools.count()
+
+    def external(op_id: int, ts_id: int) -> TensorX:
+        key = (op_id, ts_id)
+        if key not in externals:
+            externals[key] = TensorX(None, next(ext_counter))
+        return externals[key]
+
+    def build(ops_json: List[dict]) -> List[OpX]:
+        built: List[OpX] = []
+        for op in ops_json:
+            ot = _JSON_OP_TYPES.get(op["type"])
+            if ot is None:
+                raise ValueError(f"unsupported rule op type {op['type']}")
+            inputs = []
+            for t in op["input"]:
+                if t["opId"] < 0:
+                    inputs.append(external(t["opId"], t["tsId"]))
+                else:
+                    inputs.append(built[t["opId"]].out(t["tsId"]))
+            constraints = []
+            num_outputs = 1
+            for p in op.get("para", []):
+                key, value = p["key"], p["value"]
+                if key == "PM_PARALLEL_DEGREEE":  # typo-proofing
+                    key = "PM_PARALLEL_DEGREE"
+                if key == "PM_PARALLEL_DEGREE":
+                    # generated rules hardcode degree 2; generalize
+                    # (reference: substitution.cc:1486-1488)
+                    if value == 2:
+                        value = parallel_degree
+                if key == "PM_NUM_OUTPUTS":
+                    num_outputs = value
+                constraints.append(Constraint(key, value))
+            built.append(OpX(ot, inputs, constraints, num_outputs))
+        return built
+
+    src_ops = build(rule["srcOp"])
+    dst_ops = build(rule["dstOp"])
+    mapped = [
+        (
+            src_ops[m["srcOpId"]].out(m["srcTsId"]),
+            dst_ops[m["dstOpId"]].out(m["dstTsId"]),
+        )
+        for m in rule["mappedOutput"]
+    ]
+    return GraphXfer(rule["name"], src_ops, dst_ops, mapped, model_axis)
+
+
+def load_substitution_rules(
+    path: str, parallel_degree: int = 2, model_axis: int = 1
+) -> List[GraphXfer]:
+    """Load a TASO-generated rule collection JSON
+    (reference: load_rule_collection_from_path + create_xfers; the file
+    format of substitutions/graph_subst_3_v2.json)."""
+    with open(path) as f:
+        data = json.load(f)
+    xfers = []
+    for rule in data["rule"]:
+        try:
+            xfers.append(_rule_to_xfer(rule, parallel_degree, model_axis))
+        except ValueError:
+            continue  # rule uses an op outside our vocabulary
+    return xfers
+
+
+# ---------------------------------------------------------------------------
+# Built-in hand-written xfers (reference: substitution.cc:1721-1862)
+# ---------------------------------------------------------------------------
+
+
+def create_linear_relu_merge(model_axis: int = 1) -> GraphXfer:
+    """Linear(acti=none) → Relu  ⇒  Linear(acti=relu)
+    (reference: create_linear_relu_merge, substitution.cc:3064-3090)."""
+    x = TensorX(None, 0)
+    lin = OpX(OperatorType.LINEAR, [x], [Constraint("PM_ACTI", 0)])
+    relu = OpX(OperatorType.RELU, [lin.out()])
+    fused = OpX(OperatorType.LINEAR, [x], [Constraint("PM_ACTI", 2)])
+    return GraphXfer(
+        "linear_relu_merge",
+        [lin, relu],
+        [fused],
+        [(relu.out(), fused.out())],
+        model_axis,
+    )
+
+
+def default_xfers(parallel_degree: int, model_axis: int = 1) -> List[GraphXfer]:
+    """The built-in rewrite set used when no JSON file is given."""
+    return [create_linear_relu_merge(model_axis)]
+
+
+# ---------------------------------------------------------------------------
+# Cost-bounded substitution search (reference: base_optimize,
+# substitution.cc:2112-2194 — priority-queue rewrite search)
+# ---------------------------------------------------------------------------
+
+
+def apply_substitution_pass(
+    graph: PCGGraph,
+    logits_ref: TensorRef,
+    cfg,
+    mesh_config,
+) -> Tuple[PCGGraph, TensorRef]:
+    """compile()-time substitution optimization
+    (reference: GraphSearchHelper::graph_optimize's base_optimize loop over
+    GraphXfers, substitution.cc:2112-2194; invoked when --substitution-json
+    or --fusion is set — under XLA the fusion payoff is folded into the
+    rewrite set since the compiler already fuses elementwise chains).
+
+    Tracks the logits tensor across rewrites by pinning it with a sentinel
+    IDENTITY consumer (rewired by mapped-output routing like any consumer),
+    and returns (optimized graph, surviving logits ref).
+    """
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.simulator import estimate_graph_cost
+
+    mesh_sizes = tuple(mesh_config.axis_sizes)
+    model_degree = mesh_sizes[1] if len(mesh_sizes) > 1 else 2
+    model_axis = 1 if len(mesh_sizes) > 1 else 0
+
+    xfers = default_xfers(model_degree, model_axis)
+    if cfg.substitution_json:
+        xfers += load_substitution_rules(
+            cfg.substitution_json, model_degree, model_axis
+        )
+
+    g = graph.copy()
+    sentinel = g.add_node(
+        OperatorType.IDENTITY, "__logits_sentinel__", [logits_ref], {},
+        [g.shape_of(logits_ref)],
+    )
+
+    spec = MachineSpec(
+        num_nodes=max(1, cfg.num_nodes),
+        chips_per_node=max(
+            1, mesh_config.num_devices // max(1, cfg.num_nodes)
+        ),
+        chip=cfg.chip,
+    )
+    cm = CostModel(spec, measure=False)
+
+    def cost_fn(gr: PCGGraph) -> float:
+        try:
+            return estimate_graph_cost(gr, cm, mesh_sizes).step_time
+        except (ValueError, KeyError):
+            return float("inf")
+
+    budget = cfg.search_budget if cfg.search_budget > 0 else 50
+    best, _ = base_optimize(
+        g, xfers, cost_fn, budget=budget, alpha=cfg.search_alpha
+    )
+
+    snode = best.nodes[sentinel.guid]
+    new_logits = snode.inputs[0]
+    best.remove_node(sentinel.guid)
+    return best, new_logits
+
+
+def base_optimize(
+    graph: PCGGraph,
+    xfers: Sequence[GraphXfer],
+    cost_fn: Callable[[PCGGraph], float],
+    budget: int = 100,
+    alpha: float = 1.05,
+    max_matches_per_xfer: int = 8,
+) -> Tuple[PCGGraph, float]:
+    """Best-first search over rule applications.
+
+    Pops the cheapest graph, applies every rule at every match site, keeps
+    candidates whose cost is within `alpha ×` the best seen (the reference's
+    pruning factor), stops after `budget` cost evaluations.
+    """
+    best = graph
+    best_cost = cost_fn(graph)
+    seen = {graph.hash()}
+    counter = itertools.count()
+    pq: List[Tuple[float, int, PCGGraph]] = [(best_cost, next(counter), graph)]
+    evals = 0
+
+    while pq and evals < budget:
+        cost, _, g = heapq.heappop(pq)
+        if cost > alpha * best_cost:
+            continue
+        for xfer in xfers:
+            for new_g in xfer.run(g, limit=max_matches_per_xfer):
+                h = new_g.hash()
+                if h in seen:
+                    continue
+                seen.add(h)
+                c = cost_fn(new_g)
+                evals += 1
+                if c < best_cost:
+                    best, best_cost = new_g, c
+                if c <= alpha * best_cost:
+                    heapq.heappush(pq, (c, next(counter), new_g))
+                if evals >= budget:
+                    return best, best_cost
+    return best, best_cost

@@ -1,0 +1,111 @@
+"""Recompile-hook tests (reference: recompile_state.cc + moe.cc:65-99)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    RecompileState,
+    SGDOptimizer,
+)
+
+
+def _mlp(hidden=16, out=4, batch=8):
+    cfg = FFConfig(batch_size=batch)
+    model = FFModel(cfg)
+    x = model.create_tensor([batch, hidden], name="x")
+    t = model.dense(x, hidden, activation=ActiMode.RELU, name="h")
+    t = model.dense(t, out, name="head")
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=(MetricsType.ACCURACY,),
+    )
+    return model
+
+
+class TestRecompile:
+    def test_trigger_false_is_noop(self):
+        model = _mlp()
+        state = RecompileState(lambda m: False, lambda m: None)
+        before = model.graph.hash()
+        assert model.recompile_on_condition(state) is False
+        assert state.recompiled == 0
+        assert model.graph.hash() == before
+
+    def test_alter_params_and_preserve_weights(self):
+        """Alter one layer's width: its weights re-init, others survive."""
+        model = _mlp()
+        h_guid = next(
+            g for g, n in model.graph.nodes.items() if n.name == "h"
+        )
+        head_guid = next(
+            g for g, n in model.graph.nodes.items() if n.name == "head"
+        )
+        w_h_before = model.get_tensor(h_guid, 0).copy()
+
+        def alter(m):
+            # widen the head (reference MoE alter re-shards experts; here we
+            # mutate a layer param, the same class of graph surgery)
+            m.graph.nodes[head_guid].params["out_features"] = 8
+
+        state = RecompileState(lambda m: True, alter)
+        assert model.recompile_on_condition(state) is True
+        assert state.recompiled == 1
+        # surviving layer kept its weights
+        np.testing.assert_array_equal(model.get_tensor(h_guid, 0), w_h_before)
+        # altered layer got fresh, reshaped weights
+        assert model.get_tensor(head_guid, 0).shape[-1] == 8
+        # model still trains
+        xs = np.random.RandomState(0).randn(16, 16).astype("float32")
+        ys = np.random.RandomState(1).randint(0, 8, (16,)).astype("int32")
+        hist = model.fit(xs, ys, epochs=1, verbose=False)
+        assert np.isfinite(hist[-1]["loss_sum"])
+
+    def test_moe_rebalance_loop(self):
+        """Training-loop usage mirroring moe.cc:65-99: every K iterations
+        the trigger fires and the alter bumps the MoE balance weight."""
+        from flexflow_tpu.models.mixture import build_moe_mlp
+
+        cfg = FFConfig(batch_size=8)
+        model = FFModel(cfg)
+        x = model.create_tensor([8, 12], name="x")
+        build_moe_mlp(
+            model, x, num_classes=4, num_exp=4, num_select=2, hidden_size=16
+        )
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.01),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=(MetricsType.ACCURACY,),
+        )
+        agg = [
+            n
+            for n in model.graph.nodes.values()
+            if n.op_type.name == "AGGREGATE"
+        ]
+        assert agg
+
+        iters = {"n": 0}
+
+        def trigger(m):
+            iters["n"] += 1
+            return iters["n"] % 2 == 0
+
+        def alter(m):
+            for n in m.graph.nodes.values():
+                if n.op_type.name == "AGGREGATE":
+                    n.params["lambda_bal"] = (
+                        float(n.params.get("lambda_bal", 0.0)) + 0.01
+                    )
+
+        state = RecompileState(trigger, alter)
+        xs = np.random.RandomState(0).randn(16, 12).astype("float32")
+        ys = np.random.RandomState(1).randint(0, 4, (16,)).astype("int32")
+        for _ in range(4):
+            model.fit(xs, ys, epochs=1, verbose=False)
+            model.recompile_on_condition(state)
+        assert state.recompiled == 2
