@@ -52,9 +52,10 @@ class FFConfig:
     perform_fusion: bool = False  # reference: --fusion
     profiling: bool = False
     seed: int = 0
-    # numerics: allow bf16 matmul accumulation paths (reference:
-    # --allow-tensor-op-math-conversion picks TF32/FP16 tensor cores)
-    allow_mixed_precision: bool = True
+    # numerics: bf16 matmul operands with f32 accumulation (reference:
+    # --allow-tensor-op-math-conversion picks TF32/FP16 tensor cores,
+    # model.cc:3668 — off by default there too)
+    allow_mixed_precision: bool = False
 
     # visualization dumps (reference: --compgraph/--taskgraph/--export-strategy)
     computation_graph_file: str = ""
@@ -131,6 +132,8 @@ class FFConfig:
                 cfg.search_num_workers = int(take())
             elif a == "--fusion":
                 cfg.perform_fusion = True
+            elif a == "--allow-tensor-op-math-conversion":
+                cfg.allow_mixed_precision = True
             elif a == "--profiling":
                 cfg.profiling = True
             elif a == "--seed":

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
 from flexflow_tpu.core.types import OperatorType
-from flexflow_tpu.ops.registry import register_op
+from flexflow_tpu.ops.registry import mm_operands, register_op
 
 
 def _infer_mha(input_shapes, params):
@@ -170,14 +170,20 @@ def _lower_mha(params):
     def fn(ins, ws, ctx):
         xq, xk, xv = ins
         wq, wk, wv, wo = ws[:4]
-        q = jnp.einsum("bse,ehd->bshd", xq, wq)
-        k = jnp.einsum("bse,ehd->bshd", xk, wk)
-        v = jnp.einsum("bse,ehd->bshd", xv, wv)
+        dt = xq.dtype
+        xq, xk, xv, wq, wk, wv = mm_operands(ctx, xq, xk, xv, wq, wk, wv)
+        # compute dtype: bf16 under mixed precision (softmax/accumulation
+        # stays f32 inside the attention core), else the input dtype
+        cdt = xq.dtype
+        mm = dict(preferred_element_type=jnp.float32)
+        q = jnp.einsum("bse,ehd->bshd", xq, wq, **mm).astype(cdt)
+        k = jnp.einsum("bse,ehd->bshd", xk, wk, **mm).astype(cdt)
+        v = jnp.einsum("bse,ehd->bshd", xv, wv, **mm).astype(cdt)
         if use_bias:
             bq, bk, bv = ws[4], ws[5], ws[6]
-            q = q + bq
-            k = k + bk
-            v = v + bv
+            q = q + bq.astype(cdt)
+            k = k + bk.astype(cdt)
+            v = v + bv.astype(cdt)
         seq = q.shape[1]
         dropping = dropout > 0.0 and ctx.train and ctx.rng is not None
         sp = None if seq_parallel == "none" else _seq_parallel_axes(ctx)
@@ -237,7 +243,8 @@ def _lower_mha(params):
                     dropout_rate=dropout if dropping else 0.0,
                     dropout_rng=ctx.rng if dropping else None,
                 )
-        y = jnp.einsum("bshd,hde->bse", attn, wo)
+        attn_m, wo_m = mm_operands(ctx, attn, wo)
+        y = jnp.einsum("bshd,hde->bse", attn_m, wo_m, **mm).astype(dt)
         if use_bias:
             y = y + ws[7]
         return [y]

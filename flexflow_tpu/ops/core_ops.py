@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
 from flexflow_tpu.core.types import ActiMode, AggrMode, DataType, OperatorType, PoolType
-from flexflow_tpu.ops.registry import register_op
+from flexflow_tpu.ops.registry import mm_operands, register_op
 
 
 # ---------------------------------------------------------------------------
@@ -126,7 +126,8 @@ def _lower_linear(params):
     def fn(ins, ws, ctx):
         (x,) = ins
         kernel = ws[0]
-        y = jnp.matmul(x, kernel, preferred_element_type=jnp.float32)
+        xm, km = mm_operands(ctx, x, kernel)
+        y = jnp.matmul(xm, km, preferred_element_type=jnp.float32)
         y = y.astype(kernel.dtype)
         if use_bias:
             y = y + ws[1]
@@ -219,9 +220,10 @@ def _lower_conv2d(params):
     def fn(ins, ws, ctx):
         (x,) = ins
         kernel = ws[0]
+        xm, km = mm_operands(ctx, x, kernel)
         y = jax.lax.conv_general_dilated(
-            x,
-            kernel,
+            xm,
+            km,
             window_strides=(sh, sw),
             padding=[ph, pw],
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
@@ -584,9 +586,27 @@ def _infer_batchmatmul(input_shapes, params):
 
 
 def _lower_batchmatmul(params):
+    # per-iteration dynamic sequence truncation (reference: BatchMatmul's
+    # a_seq_length_dim/b_seq_length_dim + FFIterationConfig.seq_length,
+    # model.h:461-465; a static slice at trace time — each distinct
+    # seq_length is one XLA recompile, the analog of a new Legion trace)
+    a_seq_dim = params.get("a_seq_length_dim", -1)
+    b_seq_dim = params.get("b_seq_length_dim", -1)
+
+    def _truncate(x, dim, length):
+        if dim < 0 or length is None or length >= x.shape[dim]:
+            return x
+        idx = [slice(None)] * x.ndim
+        idx[dim] = slice(0, length)
+        return x[tuple(idx)]
+
     def fn(ins, ws, ctx):
         a, b = ins
-        y = jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        if ctx is not None and ctx.seq_length is not None:
+            a = _truncate(a, a_seq_dim, ctx.seq_length)
+            b = _truncate(b, b_seq_dim, ctx.seq_length)
+        am, bm = mm_operands(ctx, a, b)
+        y = jnp.matmul(am, bm, preferred_element_type=jnp.float32)
         return [y.astype(a.dtype)]
 
     return fn

@@ -42,6 +42,26 @@ class LowerCtx:
     mesh: object = None  # jax.sharding.Mesh or None
     axis_names: Tuple[str, ...] = ()
     in_shapes: Optional[Sequence[ParallelTensorShape]] = None
+    # bf16 matmul operands with f32 accumulation — the MXU-native analog of
+    # the reference's --allow-tensor-op-math-conversion (TF32/FP16 tensor
+    # cores, model.cc:3668); set from FFConfig.allow_mixed_precision.
+    bf16_matmul: bool = False
+
+
+def mm_operands(ctx, *arrays):
+    """Cast f32 matmul operands to bf16 when mixed precision is on.
+
+    Accumulation stays f32 (every call site passes
+    preferred_element_type=f32), so this trades mantissa bits on the
+    operands for the MXU's native bf16 throughput."""
+    if ctx is not None and getattr(ctx, "bf16_matmul", False):
+        import jax.numpy as jnp
+
+        return tuple(
+            a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a
+            for a in arrays
+        )
+    return arrays
 
 
 @dataclasses.dataclass

@@ -99,6 +99,8 @@ class Executor:
         devices=None,
         aux_loss_fns=(),
         logits_from_logits: bool = True,
+        mixed_precision: bool = False,
+        seq_length: Optional[int] = None,
     ):
         self.graph = graph
         self.mesh_config = mesh_config
@@ -110,6 +112,8 @@ class Executor:
         self.optimizer = optimizer
         self.aux_loss_fns = tuple(aux_loss_fns)
         self.logits_from_logits = logits_from_logits
+        self.mixed_precision = mixed_precision
+        self.seq_length = seq_length
         self.topo = graph.topo_order()
         self._lowered = {
             g: lower_op(graph.nodes[g].op_type, graph.nodes[g].params)
@@ -204,6 +208,8 @@ class Executor:
                 mesh=self.mesh,
                 axis_names=self.mesh_config.axis_names,
                 in_shapes=[self.graph.shape_of(r) for r in node.inputs],
+                bf16_matmul=self.mixed_precision,
+                seq_length=self.seq_length,
             )
             outs = self._lowered[guid](ins, ws, ctx)
             for i, out in enumerate(outs):
@@ -239,6 +245,17 @@ class Executor:
             return new_params, new_state, loss, mets
 
         return step
+
+    def set_seq_length(self, seq_length: Optional[int]):
+        """Per-iteration dynamic sequence truncation (reference:
+        FFIterationConfig.seq_length, config.h:160-165; threaded into
+        BatchMatmul). Changing it invalidates the compiled steps — each
+        distinct length is one XLA recompile, like a new Legion trace."""
+        if seq_length != self.seq_length:
+            self.seq_length = seq_length
+            self._train_step = None
+            self._eval_step = None
+            self._fwd = None
 
     def train_step(self):
         if self._train_step is None:

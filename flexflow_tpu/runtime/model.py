@@ -571,6 +571,7 @@ class FFModel:
         # by the recompile hook so a recompile keeps the user's explicit
         # strategy/devices (reference: RecompileState, recompile.h:26-41)
         self._prestrategy_graph = self.graph.copy()
+        self._builder_logits_ref = logits.ref  # pre-substitution identity
         self._compile_devices = devices
         self._compile_strategy = strategy
         self.strategy = strategy or choose_strategy(self, len(devices))
@@ -649,6 +650,8 @@ class FFModel:
             devices=devices,
             aux_loss_fns=aux,
             logits_from_logits=from_logits,
+            mixed_precision=self.config.allow_mixed_precision,
+            seq_length=self.config.seq_length,
         )
         self._rng, init_key = jax.random.split(self._rng)
         self.params = self.executor.init_params(init_key)
@@ -749,7 +752,14 @@ class FFModel:
 
     # compat verbs (reference training loop: forward/zero_gradients/backward/
     # update — subsumed by the fused jitted step; provided for ported scripts)
-    def forward(self, batch: Dict[str, np.ndarray]):
+    def forward(self, batch: Dict[str, np.ndarray], seq_length: Optional[int] = None):
+        """reference: FFModel::forward(seq_length), model.cc:2409 — the
+        optional per-iteration sequence truncation reaches BatchMatmul.
+        Like the reference (default -1 = full), the truncation applies to
+        THIS call only; omitting seq_length restores the config default."""
+        self.executor.set_seq_length(
+            seq_length if seq_length is not None else self.config.seq_length
+        )
         b = self.executor.shard_batch(batch)
         return self.executor.forward_fn()(self.params, b)
 

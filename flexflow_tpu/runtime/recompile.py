@@ -52,10 +52,24 @@ def recompile_on_condition(model, state: RecompileState) -> bool:
     if not state.trigger(model):
         return False
 
-    # weights to host, keyed by node guid
-    host = {
-        guid: [np.asarray(w) for w in ws] for guid, ws in model.params.items()
-    }
+    # weights to host, keyed by stable node identity — builder name, or the
+    # weight_key a substitution stamped on its replacement node (guids are
+    # fresh every compile, so they cannot key weights across recompiles)
+    def stable_key(node):
+        return node.params.get("weight_key", node.name)
+
+    host = {}
+    ambiguous = set()
+    for guid, ws in model.params.items():
+        node = model.graph.nodes.get(guid)
+        if node is None:
+            continue
+        key = stable_key(node)
+        if key in host:
+            ambiguous.add(key)
+        host[key] = [np.asarray(w) for w in ws]
+    for key in ambiguous:
+        host.pop(key, None)
 
     # restore the user-built graph (pre-strategy), then let alter mutate it.
     # Carry the live guid counter forward: strategy/substitution allocated
@@ -66,20 +80,35 @@ def recompile_on_condition(model, state: RecompileState) -> bool:
     model.graph._next_guid = max(model.graph._next_guid, live_next_guid)
     state.alter(model)
 
-    logits = model._logits
+    # the builder-graph logits ref (pre-substitution) survives the restore
+    # because graph copies preserve guids; a substituted _logits ref would not
+    from flexflow_tpu.runtime.model import Tensor
+
+    logits_ref = getattr(model, "_builder_logits_ref", model._logits.ref)
     model.compile(
         optimizer=model.optimizer,
         loss_type=model.loss_type,
         metrics=model.metric_types,
-        logits=logits if logits.ref.guid in model.graph.nodes else None,
+        logits=Tensor(model, logits_ref)
+        if logits_ref.guid in model.graph.nodes
+        else None,
         devices=model._compile_devices,
         strategy=model._compile_strategy,
     )
 
-    # carry over weights whose node + shape survived the alteration
-    for guid, ws in host.items():
-        node = model.graph.nodes.get(guid)
-        if node is None or len(node.weight_shapes) != len(ws):
+    # carry over weights whose stable identity + shape survived the alteration
+    new_by_key = {}
+    for guid, node in model.graph.nodes.items():
+        if not node.weight_shapes:
+            continue
+        key = stable_key(node)
+        new_by_key[key] = None if key in new_by_key else guid
+    for key, ws in host.items():
+        guid = new_by_key.get(key)
+        if guid is None:
+            continue
+        node = model.graph.nodes[guid]
+        if len(node.weight_shapes) != len(ws):
             continue
         ok = all(
             tuple(arr.shape)

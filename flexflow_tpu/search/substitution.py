@@ -337,6 +337,14 @@ class GraphXfer:
                     )
                 src_match = old_graph.nodes[guid]
         params = dict(src_match.params) if src_match is not None else {}
+        if src_match is not None:
+            # stable identity for weight carry-over across recompiles: the
+            # replacement node answers for the builder node whose params
+            # (and so whose weights) it inherited, however many rewrites
+            # deep (recompile_on_condition restores weights by this key)
+            params["weight_key"] = src_match.params.get(
+                "weight_key", src_match.name
+            )
         acti = opx.constraint_value("PM_ACTI")
         if acti is not None:
             params["activation"] = _TASO_ACTI[acti]

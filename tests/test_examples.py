@@ -31,7 +31,7 @@ EXAMPLES = [
 @pytest.mark.parametrize("name", EXAMPLES)
 def test_example_imports(name):
     mod = importlib.import_module(f"examples.{name}")
-    assert hasattr(mod, "main") or name in ("common",)
+    assert hasattr(mod, "main")
 
 
 def _run_main(mod_name, argv):

@@ -1,0 +1,66 @@
+"""Mixed-precision matmul mode (FFConfig.allow_mixed_precision — the TPU
+analog of the reference's --allow-tensor-op-math-conversion, model.cc:3668)
+and BatchMatmul's per-iteration seq_length truncation (reference:
+model.h:461-465, FFIterationConfig config.h:160-165)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.ops.registry import LowerCtx, lower_op, mm_operands
+from flexflow_tpu.core.types import OperatorType
+
+
+def test_mm_operands_casts_only_when_enabled():
+    x = jnp.ones((4, 4), jnp.float32)
+    i = jnp.ones((4,), jnp.int32)
+    assert mm_operands(LowerCtx(bf16_matmul=False), x)[0].dtype == jnp.float32
+    assert mm_operands(None, x)[0].dtype == jnp.float32
+    a, b = mm_operands(LowerCtx(bf16_matmul=True), x, i)
+    assert a.dtype == jnp.bfloat16
+    assert b.dtype == jnp.int32  # non-f32 left alone
+
+
+def test_mixed_precision_model_trains_close_to_f32():
+    def build(mixed):
+        cfg = FFConfig(batch_size=16, learning_rate=0.05)
+        cfg.allow_mixed_precision = mixed
+        model = FFModel(cfg)
+        x = model.create_tensor([16, 8], name="x")
+        t = model.dense(x, 32, activation=ActiMode.RELU)
+        t = model.dense(t, 1, use_bias=False)
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+            metrics=[],
+        )
+        return model
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (x @ rng.randn(8, 1)).astype(np.float32)
+    losses = {}
+    for mixed in (False, True):
+        model = build(mixed)
+        hist = model.fit(x, y, epochs=3, verbose=False)
+        losses[mixed] = hist[-1]["loss_sum"] / hist[-1]["train_all"]
+    # bf16 operands lose mantissa, not trainability
+    assert np.isfinite(losses[True])
+    assert abs(losses[True] - losses[False]) < 0.25 * abs(losses[False]) + 0.05
+
+
+def test_batch_matmul_seq_truncation():
+    fn = lower_op(
+        OperatorType.BATCHMATMUL,
+        {"a_seq_length_dim": 1, "b_seq_length_dim": -1},
+    )
+    a = jnp.asarray(np.random.RandomState(0).randn(2, 6, 3).astype(np.float32))
+    b = jnp.asarray(np.random.RandomState(1).randn(2, 3, 5).astype(np.float32))
+    full = fn([a, b], [], LowerCtx())[0]
+    assert full.shape == (2, 6, 5)
+    trunc = fn([a, b], [], LowerCtx(seq_length=4))[0]
+    assert trunc.shape == (2, 4, 5)
+    np.testing.assert_allclose(trunc, full[:, :4, :], rtol=1e-6)
+    # seq_length beyond the dim is a no-op
+    same = fn([a, b], [], LowerCtx(seq_length=99))[0]
+    assert same.shape == (2, 6, 5)

@@ -66,6 +66,42 @@ class TestRecompile:
         hist = model.fit(xs, ys, epochs=1, verbose=False)
         assert np.isfinite(hist[-1]["loss_sum"])
 
+    def test_fusion_recompile_preserves_weights(self):
+        """Substituted (fused) nodes get fresh guids every compile; weights
+        must still survive a recompile via their stable weight_key."""
+        cfg = FFConfig(batch_size=8)
+        cfg.perform_fusion = True
+        model = FFModel(cfg)
+        x = model.create_tensor([8, 16], name="x")
+        t = model.dense(x, 16, activation=ActiMode.RELU, name="h")
+        t = model.dense(t, 4, name="head")
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.01),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=(MetricsType.ACCURACY,),
+        )
+        # train a little so weights differ from a fresh init
+        xs = np.random.RandomState(0).randn(16, 16).astype("float32")
+        ys = np.random.RandomState(1).randint(0, 4, (16,)).astype("int32")
+        model.fit(xs, ys, epochs=1, verbose=False)
+        weights_before = {
+            node.params.get("weight_key", node.name): model.get_tensor(g, 0)
+            for g, node in model.graph.nodes.items()
+            if node.weight_shapes
+        }
+        assert weights_before
+
+        state = RecompileState(lambda m: True, lambda m: None)
+        assert model.recompile_on_condition(state) is True
+        weights_after = {
+            node.params.get("weight_key", node.name): model.get_tensor(g, 0)
+            for g, node in model.graph.nodes.items()
+            if node.weight_shapes
+        }
+        assert set(weights_after) == set(weights_before)
+        for key, w in weights_before.items():
+            np.testing.assert_array_equal(weights_after[key], w)
+
     def test_moe_rebalance_loop(self):
         """Training-loop usage mirroring moe.cc:65-99: every K iterations
         the trigger fires and the alter bumps the MoE balance weight."""
