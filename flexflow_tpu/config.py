@@ -47,6 +47,11 @@ class FFConfig:
     # search-without-hardware overrides (reference: model.cc:3673-3680)
     search_num_nodes: int = -1
     search_num_workers: int = -1
+    # which engine a nonzero --budget runs: "mesh" (mesh × rewrite-site
+    # search, search.auto), "unity" (per-op-view DP, search.unity — the
+    # reference's Unity path, graph.cc:1346), or "mcmc" (simulated
+    # annealing, search.mcmc — the reference's legacy path, model.cc:3271)
+    search_engine: str = "mesh"
 
     # runtime
     perform_fusion: bool = False  # reference: --fusion
@@ -130,6 +135,8 @@ class FFConfig:
                 cfg.search_num_nodes = int(take())
             elif a == "--search-num-workers":
                 cfg.search_num_workers = int(take())
+            elif a == "--search-engine":
+                cfg.search_engine = take()
             elif a == "--fusion":
                 cfg.perform_fusion = True
             elif a == "--allow-tensor-op-math-conversion":

@@ -106,6 +106,44 @@ def sequence_parallel_strategy(
     )
 
 
+def site_strategy(
+    graph: PCGGraph,
+    num_devices: int,
+    tp: int,
+    sites,
+    name_prefix: str = "searched",
+) -> Strategy:
+    """Shared lowering for searched strategies: a (data × model) mesh plus
+    TP rewrite sites. dp is clamped to the largest feasible batch divisor
+    (an infeasible dp would make _annotate_data_parallel raise at compile)."""
+    tp = max(1, tp)
+    dp = effective_dp_degree(graph, max(1, num_devices // tp))
+
+    def apply(g: PCGGraph):
+        if dp > 1:
+            for node in g.nodes.values():
+                if node.op_type == OperatorType.INPUT and not node.inputs:
+                    shape: ParallelTensorShape = node.params["shape"]
+                    node.params["shape"] = shape.data_parallel(dp)
+                    node.output_shapes = (node.params["shape"],)
+        for site in sites:
+            site.apply(g, tp, 1)  # model axis = 1
+
+    mesh = (
+        MeshConfig(("data", "model"), (dp, tp))
+        if tp > 1
+        else MeshConfig(("data",), (max(dp, 1),))
+    )
+    return Strategy(
+        mesh,
+        apply,
+        name=(
+            f"{name_prefix}: mesh(data={dp}, model={tp}), "
+            f"{len(list(sites))} TP sites"
+        ),
+    )
+
+
 def choose_strategy(model, num_devices: int) -> Strategy:
     """Strategy selection at compile() (reference: model.cc:2789 →
     graph_optimize_task, graph.cc:1545-1613): data-parallel unless a search

@@ -170,21 +170,20 @@ def optimize(
     return best
 
 
-def result_to_strategy(result: SearchResult) -> Strategy:
-    from flexflow_tpu.runtime.executor import MeshConfig
+def result_to_strategy(result: SearchResult, graph: PCGGraph) -> Strategy:
+    """Lower via the shared searched-strategy builder; the search already
+    validated dp feasibility through _candidate_graph, so site_strategy's
+    effective-dp clamp resolves to result.dp."""
+    from flexflow_tpu.parallel.strategy import site_strategy
 
-    if result.tp > 1:
-        mesh = MeshConfig(("data", "model"), (result.dp, result.tp))
-    else:
-        mesh = MeshConfig(("data",), (result.dp,))
-
-    def apply(g: PCGGraph):
-        _annotate_data_parallel(g, result.dp)
-        for site, enabled in zip(result.sites, result.on):
-            if enabled:
-                site.apply(g, result.tp, _MODEL_AXIS)
-
-    return Strategy(mesh, apply, name=f"searched:{result.describe()}")
+    sites = [s for s, enabled in zip(result.sites, result.on) if enabled]
+    return site_strategy(
+        graph,
+        result.dp * result.tp,
+        result.tp,
+        sites,
+        name_prefix=f"searched({result.cost.step_time * 1e3:.3f} ms)",
+    )
 
 
 def search_strategy(model, num_devices: int) -> Strategy:
@@ -204,6 +203,35 @@ def search_strategy(model, num_devices: int) -> Strategy:
     )
     if n <= 1:
         return data_parallel_strategy(num_devices, model.graph)
+
+    if cfg.search_engine not in ("mesh", "unity", "mcmc"):
+        raise ValueError(
+            f"unknown --search-engine {cfg.search_engine!r}; "
+            "expected mesh | unity | mcmc"
+        )
+    if cfg.search_engine in ("unity", "mcmc"):
+        from flexflow_tpu.search import unity as unity_mod
+
+        if cfg.search_engine == "unity":
+            result = unity_mod.UnitySearch(model.graph, spec).optimize()
+        else:
+            from flexflow_tpu.search.mcmc import mcmc_optimize
+
+            result = mcmc_optimize(
+                model.graph,
+                spec,
+                budget=max(cfg.search_budget, 1),
+                alpha=cfg.search_alpha,
+                seed=cfg.seed,
+                verbose=cfg.profiling,
+            )
+        # reference prints exactly this at the end of its search
+        # (substitution.cc:1909, model.cc:3298)
+        print(f"Optimal cost: {result.cost * 1e3:.6f}")
+        if cfg.export_strategy_file:
+            unity_mod.save_views(result, model.graph, cfg.export_strategy_file)
+        return unity_mod.result_to_strategy(result, model.graph, num_devices)
+
     result = optimize(
         model.graph,
         n,
@@ -218,4 +246,4 @@ def search_strategy(model, num_devices: int) -> Strategy:
         from flexflow_tpu.search.strategy_io import save_search_result
 
         save_search_result(result, model.graph, cfg.export_strategy_file)
-    return result_to_strategy(result)
+    return result_to_strategy(result, model.graph)

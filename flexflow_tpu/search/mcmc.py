@@ -1,0 +1,129 @@
+"""MCMC strategy search (the legacy engine).
+
+Rebuild of the reference's simulated-annealing search over per-op
+ParallelConfigs (reference: FFModel::mcmc_optimize, model.cc:3271-3342,
+driven by Simulator::strategy_search_task): start from the data-parallel
+config, repeatedly pick a random op and a random valid machine view
+(reference: rewrite(), model.cc:3246), score the whole config with the
+simulator, accept improvements always and regressions with probability
+exp(-alpha * delta), periodically resetting to the best-so-far.
+
+The view vocabulary, per-(op, view) costs and transfer estimates are shared
+with the Unity DP engine (search.unity.UnitySearch); the full-config score
+is the analytic sum the reference's LogicalTaskgraphBasedSimulator computes
+(simulator.h:776-818).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, Optional
+
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.core.pcg import PCGGraph
+from flexflow_tpu.search.unity import UnityResult, UnitySearch, ViewOption
+
+
+def simulate_config(
+    search: UnitySearch, views: Dict[int, ViewOption]
+) -> float:
+    """Step-time of one full per-op view assignment: op costs + transfer
+    cost on every producer→consumer edge whose views differ."""
+    g = search.graph
+    total = 0.0
+    for guid, view in views.items():
+        total += search.op_cost(guid, view)
+        for ref in g.nodes[guid].inputs:
+            if ref.guid in views:
+                total += search.xfer_cost(ref, views[ref.guid], view)
+    return total
+
+
+def config_delta(
+    search: UnitySearch,
+    views: Dict[int, ViewOption],
+    guid: int,
+    new_view: ViewOption,
+) -> float:
+    """Cost change from flipping one node's view: only its op cost and the
+    transfers on its incident edges move (a full re-simulation per proposal
+    would make the budget loop O(V+E) per step for no gain)."""
+    g = search.graph
+    old = views[guid]
+    d = search.op_cost(guid, new_view) - search.op_cost(guid, old)
+    for ref in g.nodes[guid].inputs:
+        if ref.guid in views:
+            d += search.xfer_cost(ref, views[ref.guid], new_view)
+            d -= search.xfer_cost(ref, views[ref.guid], old)
+    for c in g.consumers(guid):
+        if c in views:
+            for ref in g.nodes[c].inputs:
+                if ref.guid == guid:
+                    d += search.xfer_cost(ref, new_view, views[c])
+                    d -= search.xfer_cost(ref, old, views[c])
+    return d
+
+
+def mcmc_optimize(
+    graph: PCGGraph,
+    spec: MachineSpec,
+    budget: int = 100,
+    alpha: float = 1.05,
+    seed: int = 0,
+    verbose: bool = False,
+) -> UnityResult:
+    """reference: mcmc_optimize (model.cc:3271) — budget proposals, periodic
+    reset to best every budget/10 non-improving steps."""
+    search = UnitySearch(graph, spec)
+    resource = search.resource
+    rng = random.Random(seed)
+    guids = [
+        g
+        for g in graph.topo_order()
+        if graph.nodes[g].op_type.name != "INPUT"
+    ]
+
+    # start from data-parallel-over-all-chips where valid (reference seeds
+    # MCMC with the data-parallel strategy too)
+    def default_view(g):
+        cands = search.valid_views(g, resource)
+        full = [
+            v
+            for v in cands
+            if v.ch == 1 and v.num_devices == resource.num_chips
+        ]
+        return full[0] if full else cands[0]
+
+    cur = {g: default_view(g) for g in guids}
+    cur_cost = simulate_config(search, cur)
+    best, best_cost = dict(cur), cur_cost
+    since_best = 0
+    reset_every = max(budget // 10, 10)
+
+    for it in range(budget):
+        g = rng.choice(guids)
+        cands = search.valid_views(g, resource)
+        nxt_view = rng.choice(cands)
+        if nxt_view.key() == cur[g].key():
+            continue
+        delta = config_delta(search, cur, g, nxt_view)
+        scale = max(cur_cost, 1e-9)
+        if delta < 0 or rng.random() < math.exp(-alpha * delta / scale):
+            cur = dict(cur)
+            cur[g] = nxt_view
+            cur_cost += delta
+        if cur_cost < best_cost:
+            best, best_cost = dict(cur), cur_cost
+            since_best = 0
+        else:
+            since_best += 1
+            if since_best >= reset_every:  # reference: periodic reset to best
+                cur, cur_cost = dict(best), best_cost
+                since_best = 0
+        if verbose and it % max(budget // 10, 1) == 0:
+            print(
+                f"[mcmc] iter {it}: current {cur_cost * 1e3:.3f} ms, "
+                f"best {best_cost * 1e3:.3f} ms"
+            )
+    return UnityResult(best_cost, best)

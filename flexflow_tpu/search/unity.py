@@ -1,0 +1,510 @@
+"""Unity-style DP search over per-op MachineViews.
+
+TPU rebuild of the reference's Unity dynamic-programming search
+(reference: SearchHelper::graph_cost, src/runtime/graph.cc:1346-1431;
+sequence/nonsequence splits graph.cc:93-306; machine-view enumeration
+graph.cc:1783-1814; memoization by dp_state_hash graph.cc:1531-1543):
+
+  * **sequence split**: find a bottleneck node (a node on every path from
+    the subgraph's sources to its sink, located via immediate
+    post-dominators like the reference's find_split_node,
+    substitution.cc:1984); enumerate its valid machine views; recurse on
+    the two halves with the bottleneck's view fixed at the boundary.
+  * **nonsequence split**: no bottleneck ⇒ the subgraph is parallel
+    branches; try running the branches concurrently on vertical /
+    horizontal resource splits (reference: MachineResource::vertical(i)/
+    horizontal(i), graph.cc:252-306) or sequentially on the full
+    resources; take the min.
+  * **leaf**: one node — roofline op cost on the view's shard + transfer
+    cost for re-laying the producer's output onto this view + gradient
+    all-reduce over the view's data replicas (the reference's NCCL
+    allreduce term, optimizer_kernel.cu:88).
+  * memoized by (subgraph, boundary views, resource block).
+
+Views live on the abstract chip grid the way the reference's do
+({start, dims, strides}); lowering restricts to mesh-expressible
+assignments (SURVEY §7's documented v1 restriction): the per-node views
+are reduced to one global (data × model) mesh and the tensor-parallel
+rewrite sites whose ops the search gave a 2-D view. The full per-op view
+map is still exported via --export-strategy for inspection, mirroring the
+reference's per-op ParallelConfig strategy files (strategy.cc:100-197).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.machine import MachineResource, MachineSpec, MachineView
+from flexflow_tpu.core.pcg import PCGGraph
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.ops.registry import op_flops
+from flexflow_tpu.search.cost_model import CostModel
+
+# ops that may take a 2-D (data × channel) view: the second view dim
+# partitions output channels / heads (reference: Linear::
+# get_random_parallel_config explores exactly these grids, linear.cc:707-744)
+_CHANNEL_OPS = {
+    OperatorType.LINEAR,
+    OperatorType.MULTIHEAD_ATTENTION,
+}
+
+
+def _node_channel_size(node) -> Optional[int]:
+    if node.op_type == OperatorType.LINEAR:
+        return node.params.get("out_features")
+    if node.op_type == OperatorType.MULTIHEAD_ATTENTION:
+        return node.params.get("num_heads")
+    return None
+
+
+def _batch_size(node) -> int:
+    shape = node.output_shapes[0] if node.output_shapes else None
+    if shape is None:
+        return 1
+    logical = [d for d in shape.dims if not d.is_replica_dim]
+    return logical[0].size if logical else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ViewOption:
+    """A machine view plus its logical factorization: `dp` devices partition
+    the sample dim, `ch` partition channels/heads (dp * ch == devices).
+    The reference encodes this positionally in ParallelConfig.dim[]
+    (machine_view.h:62-96); keeping it explicit avoids conflating the
+    device geometry (node-major grid) with the tensor mapping."""
+
+    view: MachineView
+    dp: int
+    ch: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.view.num_devices
+
+    def key(self) -> Tuple[int, int, int]:
+        return (self.view.hash(), self.dp, self.ch)
+
+
+@dataclasses.dataclass
+class UnityResult:
+    cost: float
+    views: Dict[int, ViewOption]  # guid -> chosen option
+
+    def describe(self) -> str:
+        grids = Counter((v.dp, v.ch) for v in self.views.values())
+        return (
+            f"unity: simulated step {self.cost * 1e3:.3f} ms, "
+            f"(dp, ch) grids {dict(grids)}"
+        )
+
+
+class UnitySearch:
+    """One search instance per (graph, machine). Graph must have inferred
+    output shapes (propagate_shapes) with NO strategy applied — views carry
+    the parallelism."""
+
+    def __init__(
+        self,
+        graph: PCGGraph,
+        spec: MachineSpec,
+        resource: Optional[MachineResource] = None,
+        include_backward: bool = True,
+    ):
+        self.graph = graph
+        self.spec = spec
+        self.cm = CostModel(spec)
+        self.resource = resource or spec.resource()
+        self.include_backward = include_backward
+        self._memo: Dict[Tuple, Tuple[float, Dict[int, ViewOption]]] = {}
+        self._views_cache: Dict[Tuple[int, Tuple], List[ViewOption]] = {}
+        self.memo_hits = 0
+
+    # -- view enumeration ----------------------------------------------------
+
+    def _block_view(
+        self, resource: MachineResource, n: int
+    ) -> Optional[MachineView]:
+        """n devices of the resource block in node-major order; None when n
+        does not tile the block. Views never spill outside their block —
+        MachineResource.is_valid_view holds by construction (the reference
+        checks it per view, machine_view.h:51-60)."""
+        cpn = resource.chips_per_node
+        start = (
+            resource.start_node_id * self.spec.chips_per_node
+            + resource.start_chip_id
+        )
+        if n <= cpn:
+            return MachineView(start, (n,), (1,))
+        if n % cpn == 0 and n // cpn <= resource.num_nodes:
+            return MachineView(
+                start, (n // cpn, cpn), (self.spec.chips_per_node, 1)
+            )
+        return None
+
+    def valid_views(
+        self, guid: int, resource: MachineResource
+    ) -> List[ViewOption]:
+        """reference: get_valid_machine_views (graph.cc:503+) filtering
+        register_all_machine_views; starts are canonicalized to the resource
+        block's origin — TPU slices are symmetric, so shifted views cost the
+        same and would only bloat the memo."""
+        key = (
+            guid,
+            (resource.num_nodes, resource.chips_per_node, resource.start_chip_id,
+             resource.start_node_id),
+        )
+        if key in self._views_cache:
+            return self._views_cache[key]
+        node = self.graph.nodes[guid]
+        total = resource.num_chips
+        batch = _batch_size(node)
+        chan = _node_channel_size(node)
+        views: List[ViewOption] = []
+        for n in range(1, total + 1):
+            if total % n != 0:
+                continue
+            mv = self._block_view(resource, n)
+            if mv is None:
+                continue
+            if batch % n == 0:
+                views.append(ViewOption(mv, dp=n, ch=1))
+            if chan is not None and node.op_type in _CHANNEL_OPS:
+                for dp in range(1, n + 1):
+                    if n % dp != 0:
+                        continue
+                    ch = n // dp
+                    if ch > 1 and batch % dp == 0 and chan % ch == 0:
+                        views.append(ViewOption(mv, dp=dp, ch=ch))
+        if not views:
+            views.append(ViewOption(self._block_view(resource, 1), dp=1, ch=1))
+        self._views_cache[key] = views
+        return views
+
+    # -- per-(node, view) costs ---------------------------------------------
+
+    def op_cost(self, guid: int, opt: ViewOption) -> float:
+        """Roofline fwd(+bwd) seconds of the node's shard under `opt`
+        (the reference measures the real kernel here, simulator.cc:532;
+        our analytic default mirrors CostModel.op_cost)."""
+        node = self.graph.nodes[guid]
+        if node.op_type == OperatorType.INPUT or node.is_parallel_op:
+            return 0.0
+        n = opt.num_devices
+        in_shapes = [self.graph.shape_of(r) for r in node.inputs]
+        flops = op_flops(node.op_type, in_shapes, node.params) / n
+        data = sum(s.volume() * 4 for s in in_shapes)
+        data += sum(s.volume() * 4 for s in node.output_shapes)
+        data += sum(s.volume() * 4 for s in node.weight_shapes)
+        t = self.cm._roofline(flops, data / n)
+        if self.include_backward:
+            mxu = node.op_type in _CHANNEL_OPS or node.op_type in (
+                OperatorType.CONV2D,
+                OperatorType.BATCHMATMUL,
+            )
+            t *= 3.0 if mxu else 2.0
+        # gradient sync: weights are sharded ch ways and replicated across
+        # the dp data replicas; all-reduce the shards over them
+        if self.include_backward and node.weight_shapes:
+            w_bytes = sum(s.volume() * 4 for s in node.weight_shapes) / opt.ch
+            t += self.cm.all_reduce(w_bytes, opt.dp)
+        return t
+
+    def xfer_cost(self, ref, src: ViewOption, dst: ViewOption) -> float:
+        """Re-layout cost of one tensor between views (reference:
+        estimate_xfer_cost, graph.cc:1291 → simulator.cc:617)."""
+        if src.key() == dst.key():
+            return 0.0
+        bytes_total = self.graph.shape_of(ref).volume() * 4
+        n = max(src.num_devices, dst.num_devices)
+        return self.cm.all_to_all(bytes_total / dst.num_devices, n)
+
+    # -- the DP ---------------------------------------------------------------
+
+    def optimize(self) -> UnityResult:
+        """Full-graph entry: enumerate sink views, run the DP
+        (reference: Graph::optimal_cost, graph.cc:1433)."""
+        sinks = self.graph.sinks()
+        if len(sinks) != 1:
+            # multiple sinks: cost each independently (rare; metrics heads)
+            views: Dict[int, MachineView] = {}
+            total = 0.0
+            for s in sinks:
+                r = self._best_for_sink(s)
+                total += r.cost
+                views.update(r.views)
+            return UnityResult(total, views)
+        return self._best_for_sink(sinks[0])
+
+    def _best_for_sink(self, sink: int) -> UnityResult:
+        sub = frozenset(self.graph.ancestors_of([sink])) | {sink}
+        best: Optional[Tuple[float, Dict[int, ViewOption]]] = None
+        for view in self.valid_views(sink, self.resource):
+            c, v = self._graph_cost(sub, None, sink, view, self.resource)
+            if best is None or c < best[0]:
+                best = (c, {**v, sink: view})
+        assert best is not None
+        return UnityResult(best[0], best[1])
+
+    def _res_key(self, r: MachineResource):
+        return (r.num_nodes, r.chips_per_node, r.start_node_id, r.start_chip_id)
+
+    def _graph_cost(
+        self,
+        sub: FrozenSet[int],
+        src_pair: Optional[Tuple[int, ViewOption]],
+        sink: int,
+        sink_view: ViewOption,
+        resource: MachineResource,
+    ) -> Tuple[float, Dict[int, ViewOption]]:
+        """Cost of executing `sub` (sink included, its view fixed) given the
+        producer boundary `src_pair`; returns (seconds, views of sub\\{sink}).
+
+        reference: SearchHelper::graph_cost (graph.cc:1346-1431), memoized
+        by the analog of dp_state_hash (graph.cc:1531-1543)."""
+        key = (
+            sub,
+            src_pair[0] if src_pair else -1,
+            src_pair[1].key() if src_pair else 0,
+            sink,
+            sink_view.key(),
+            self._res_key(resource),
+        )
+        if key in self._memo:
+            self.memo_hits += 1
+            return self._memo[key]
+
+        interior = sub - {sink}
+        if not interior:
+            cost = self.op_cost(sink, sink_view)
+            node = self.graph.nodes[sink]
+            for r in node.inputs:
+                if src_pair is not None and r.guid == src_pair[0]:
+                    cost += self.xfer_cost(r, src_pair[1], sink_view)
+            out = (cost, {})
+            self._memo[key] = out
+            return out
+
+        b = self._find_bottleneck(sub, sink, src_pair)
+        if b is not None:
+            pre = (
+                frozenset(g for g in self.graph.ancestors_of([b]) if g in sub)
+                | {b}
+            )
+            post = sub - pre
+            best: Optional[Tuple[float, Dict[int, ViewOption]]] = None
+            for view in self.valid_views(b, resource):
+                c1, v1 = self._graph_cost(pre, src_pair, b, view, resource)
+                c2, v2 = self._graph_cost(
+                    post | {sink}, (b, view), sink, sink_view, resource
+                )
+                c = c1 + c2
+                if best is None or c < best[0]:
+                    best = (c, {**v1, **v2, b: view})
+            self._memo[key] = best
+            return best
+
+        out = self._nonsequence_cost(sub, src_pair, sink, sink_view, resource)
+        self._memo[key] = out
+        return out
+
+    def _find_bottleneck(
+        self, sub, sink, src_pair
+    ) -> Optional[int]:
+        """An interior node on every source→sink path within `sub`
+        (reference: find_split_node via imm post-dominators,
+        substitution.cc:1984)."""
+        from flexflow_tpu import native
+
+        nodes = sorted(sub)
+        index = {g: i for i, g in enumerate(nodes)}
+        edges = []
+        for g in nodes:
+            for r in self.graph.nodes[g].inputs:
+                if r.guid in index:
+                    edges.append((index[r.guid], index[g]))
+        # virtual source feeding all sub-sources keeps ipdom rooted
+        n = len(nodes)
+        srcs = [
+            i
+            for i, g in enumerate(nodes)
+            if not any(r.guid in index for r in self.graph.nodes[g].inputs)
+        ]
+        vs = n
+        for i in srcs:
+            edges.append((vs, i))
+        ipdom = native.imm_post_dominators(n + 1, edges)
+        if ipdom is None:
+            return None
+        # walk the ipdom chain from the virtual source toward the sink; the
+        # first interior node on it post-dominates every source
+        cur = ipdom[vs]
+        while cur is not None and cur >= 0 and cur < n:
+            g = nodes[cur]
+            if g != sink:
+                return g
+            cur = ipdom[cur] if ipdom[cur] != cur else -1
+        return None
+
+    def _branches(self, sub, sink) -> List[FrozenSet[int]]:
+        """Weakly-connected components of sub\\{sink}."""
+        rest = set(sub) - {sink}
+        comps = []
+        while rest:
+            seed = next(iter(rest))
+            comp = {seed}
+            frontier = [seed]
+            while frontier:
+                g = frontier.pop()
+                nbrs = [
+                    r.guid
+                    for r in self.graph.nodes[g].inputs
+                    if r.guid in rest
+                ]
+                nbrs += [c for c in self.graph.consumers(g) if c in rest]
+                for nb in nbrs:
+                    if nb not in comp:
+                        comp.add(nb)
+                        frontier.append(nb)
+            comps.append(frozenset(comp))
+            rest -= comp
+        return comps
+
+    def _branch_cost(
+        self, branch: FrozenSet[int], src_pair, sink, sink_view, resource
+    ) -> Tuple[float, Dict[int, ViewOption]]:
+        """Cost of one parallel branch: its terminal's view is enumerated,
+        with the transfer onto the (already fixed) sink view charged here."""
+        terms = [
+            g
+            for g in branch
+            if not any(c in branch for c in self.graph.consumers(g))
+        ]
+        if len(terms) != 1:
+            # multi-terminal branch: independent per-node minima (analytic
+            # fallback; the reference bounds this case with its own heuristic
+            # splits). Transfers within the branch are not charged.
+            views = {}
+            total = 0.0
+            for g in sorted(branch):
+                cands = self.valid_views(g, resource)
+                costs = [(self.op_cost(g, v), v) for v in cands]
+                c, v = min(costs, key=lambda t: t[0])
+                total += c
+                views[g] = v
+            return total, views
+        term = terms[0]
+        best: Optional[Tuple[float, Dict[int, ViewOption]]] = None
+        for view in self.valid_views(term, resource):
+            c, v = self._graph_cost(branch, src_pair, term, view, resource)
+            for r in self.graph.nodes[sink].inputs:
+                if r.guid == term:
+                    c += self.xfer_cost(r, view, sink_view)
+            if best is None or c < best[0]:
+                best = (c, {**v, term: view})
+        return best
+
+    def _nonsequence_cost(
+        self, sub, src_pair, sink, sink_view, resource
+    ) -> Tuple[float, Dict[int, ViewOption]]:
+        """No bottleneck ⇒ parallel branches. Try concurrent execution on
+        vertical/horizontal resource splits and sequential on full resources
+        (reference: find_optimal_nonsequence_graph_time, graph.cc:252-306)."""
+        branches = self._branches(sub, sink)
+        sink_cost = self.op_cost(sink, sink_view)
+        if src_pair is not None:
+            for r in self.graph.nodes[sink].inputs:
+                if r.guid == src_pair[0]:
+                    sink_cost += self.xfer_cost(r, src_pair[1], sink_view)
+
+        # sequential: every branch gets the full resource block, times add
+        seq_total = sink_cost
+        seq_views: Dict[int, MachineView] = {}
+        per_branch = []
+        for br in branches:
+            c, v = self._branch_cost(br, src_pair, sink, sink_view, resource)
+            per_branch.append((br, c, v))
+            seq_total += c
+            seq_views.update(v)
+        best = (seq_total, seq_views)
+
+        # concurrent two-way: branches bundled into {first} vs {rest} on a
+        # resource split (the reference enumerates subset splits the same
+        # greedy way)
+        if len(branches) >= 2:
+            first = per_branch[0][0]
+            rest = [b for b, _, _ in per_branch[1:]]
+            splits: List[Tuple[MachineResource, MachineResource]] = []
+            for i in range(1, resource.num_nodes):
+                splits.append(resource.vertical_split(i))
+            for i in range(1, resource.chips_per_node):
+                splits.append(resource.horizontal_split(i))
+            for r1, r2 in splits:
+                c1, v1 = self._branch_cost(first, src_pair, sink, sink_view, r1)
+                c2 = 0.0
+                v2: Dict[int, ViewOption] = {}
+                for br in rest:
+                    c, v = self._branch_cost(br, src_pair, sink, sink_view, r2)
+                    c2 += c
+                    v2.update(v)
+                c = max(c1, c2) + sink_cost
+                if c < best[0]:
+                    best = (c, {**v1, **v2})
+        return best
+
+
+# -- lowering to an executable Strategy --------------------------------------
+
+
+def result_to_strategy(result: UnityResult, graph: PCGGraph, num_devices: int):
+    """Reduce the per-op view map to one global mesh + TP rewrite sites
+    (SURVEY §7's v1 restriction — per-op device subsets beyond one mesh are
+    exported but not lowered)."""
+    from flexflow_tpu.parallel.strategy import site_strategy
+    from flexflow_tpu.search.rewrites import find_tp_sites
+
+    channel = [v for v in result.views.values() if v.ch > 1]
+    tp = Counter(v.ch for v in channel).most_common(1)[0][0] if channel else 1
+    tp = max(1, min(tp, num_devices))
+    while num_devices % tp != 0:
+        tp -= 1
+
+    tp_guids = {g for g, v in result.views.items() if v.ch == tp and v.ch > 1}
+    sites = [
+        s
+        for s in find_tp_sites(graph)
+        if (set(s.guids) & tp_guids) and s.divisible_by(graph, tp)
+    ] if tp > 1 else []
+    return site_strategy(
+        graph,
+        num_devices,
+        tp,
+        sites,
+        name_prefix=f"unity(step {result.cost * 1e3:.3f} ms)",
+    )
+
+
+def save_views(result: UnityResult, graph: PCGGraph, path: str):
+    """Per-op view export (reference: save_strategies_to_file,
+    strategy.cc:156 — per-op ParallelConfig maps)."""
+    import json
+
+    doc = {
+        "version": 1,
+        "engine": "unity",
+        "simulated_step_ms": result.cost * 1e3,
+        "ops": {
+            graph.nodes[g].name: {
+                "start_device_id": v.view.start_device_id,
+                "dims": list(v.view.dims),
+                "strides": list(v.view.strides),
+                "dp": v.dp,
+                "ch": v.ch,
+            }
+            for g, v in sorted(result.views.items())
+            if g in graph.nodes
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)

@@ -87,7 +87,7 @@ def test_optimize_returns_feasible_strategy():
     assert result.dp * result.tp == 8
     assert result.cost.step_time > 0
     # strategy must be applicable to the real graph
-    strat = result_to_strategy(result)
+    strat = result_to_strategy(result, m.graph)
     strat.apply(m.graph)
     propagate_shapes(m.graph)
 
@@ -104,7 +104,7 @@ def test_search_end_to_end_compile_and_step():
     t = m.dense(t, 10)
     m.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
               metrics=[MetricsType.ACCURACY])
-    assert m.strategy.name.startswith("searched:")
+    assert m.strategy.name.startswith("searched(")
     rng = np.random.RandomState(0)
     X = rng.randn(64, 128).astype(np.float32)
     y = rng.randint(0, 10, size=64).astype(np.int32)

@@ -1,0 +1,227 @@
+"""Unity DP search + MCMC engine tests (reference: SearchHelper::graph_cost
+graph.cc:1346-1431, mcmc_optimize model.cc:3271-3342). Pure-logic tests in
+the spirit of the reference's tests/unit/ search tests, plus end-to-end
+compile() integration on the 8-device CPU mesh."""
+
+import json
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.search.mcmc import mcmc_optimize, simulate_config
+from flexflow_tpu.search.unity import UnitySearch, result_to_strategy, save_views
+
+
+def chain_model(batch=32, hidden=64, layers=3):
+    model = FFModel(FFConfig(batch_size=batch))
+    x = model.create_tensor([batch, hidden], name="x")
+    t = x
+    for i in range(layers):
+        t = model.dense(t, hidden, activation=ActiMode.RELU, name=f"d{i}")
+    t = model.dense(t, 8, name="head")
+    return model
+
+
+def diamond_model(batch=32, hidden=64):
+    model = FFModel(FFConfig(batch_size=batch))
+    x = model.create_tensor([batch, hidden], name="x")
+    a = model.dense(x, hidden, name="left")
+    b = model.dense(x, hidden, name="right")
+    t = model.add(a, b)
+    t = model.dense(t, 8, name="head")
+    return model
+
+
+SPEC = MachineSpec(num_nodes=2, chips_per_node=4, chip="v4")
+
+
+class TestUnityDP:
+    def test_chain_assigns_views_to_all_compute_nodes(self):
+        model = chain_model()
+        search = UnitySearch(model.graph, SPEC)
+        result = search.optimize()
+        assert result.cost > 0
+        compute = [
+            g
+            for g, n in model.graph.nodes.items()
+            if n.op_type.name != "INPUT"
+        ]
+        for g in compute:
+            assert g in result.views
+        # all views fit the machine
+        for v in result.views.values():
+            assert v.num_devices <= SPEC.num_chips
+
+    def test_memoization_fires(self):
+        model = chain_model(layers=4)
+        search = UnitySearch(model.graph, SPEC)
+        search.optimize()
+        assert search.memo_hits > 0
+
+    def test_bottleneck_on_chain(self):
+        model = chain_model(layers=2)
+        search = UnitySearch(model.graph, SPEC)
+        g = model.graph
+        sink = g.sinks()[0]
+        sub = frozenset(g.ancestors_of([sink]))
+        b = search._find_bottleneck(sub, sink, None)
+        assert b is not None and b != sink
+        # the bottleneck dominates: removing it separates sources from sink
+        pre = set(g.ancestors_of([b]))
+        assert sink not in pre
+
+    def test_diamond_explores_nonsequence_split(self):
+        model = diamond_model()
+        search = UnitySearch(model.graph, SPEC)
+        result = search.optimize()
+        assert result.cost > 0 and np.isfinite(result.cost)
+        left = next(g for g, n in model.graph.nodes.items() if n.name == "left")
+        right = next(
+            g for g, n in model.graph.nodes.items() if n.name == "right"
+        )
+        assert left in result.views and right in result.views
+
+    def test_more_chips_never_worse(self):
+        model = chain_model(batch=64, hidden=256)
+        small = UnitySearch(
+            model.graph, MachineSpec(num_nodes=1, chips_per_node=2, chip="v4")
+        ).optimize()
+        big = UnitySearch(
+            model.graph, MachineSpec(num_nodes=2, chips_per_node=4, chip="v4")
+        ).optimize()
+        assert big.cost <= small.cost * 1.001
+
+    def test_channel_views_offered_for_linear(self):
+        model = chain_model(batch=8, hidden=64)
+        search = UnitySearch(model.graph, SPEC)
+        lin = next(
+            g for g, n in model.graph.nodes.items() if n.name == "d0"
+        )
+        views = search.valid_views(lin, search.resource)
+        assert any(v.ch > 1 for v in views)
+        # batch 8 on 8 chips: pure dp view present too
+        assert any(v.ch == 1 and v.num_devices == 8 for v in views)
+
+    def test_views_stay_inside_resource_blocks(self):
+        """Horizontal/vertical sub-blocks must not spill device ids into the
+        sibling block (reference: MachineResource::is_valid_view)."""
+        model = chain_model(batch=64)
+        search = UnitySearch(model.graph, SPEC)
+        lin = next(g for g, n in model.graph.nodes.items() if n.name == "d0")
+        left, right = search.resource.horizontal_split(2)
+        cpn = SPEC.chips_per_node
+        for res in (left, right):
+            allowed = {
+                node * cpn + chip
+                for node in range(
+                    res.start_node_id, res.start_node_id + res.num_nodes
+                )
+                for chip in range(
+                    res.start_chip_id, res.start_chip_id + res.chips_per_node
+                )
+            }
+            for opt in search.valid_views(lin, res):
+                assert set(opt.view.device_ids()) <= allowed
+
+    def test_infeasible_batch_clamps_dp(self):
+        """batch=12 on 8 devices: dp must clamp to a batch divisor instead
+        of raising at compile."""
+        model = chain_model(batch=12, hidden=64)
+        result = UnitySearch(model.graph, SPEC).optimize()
+        strategy = result_to_strategy(result, model.graph, 8)
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[],
+            strategy=strategy,
+        )
+        x = np.random.RandomState(0).randn(12, 64).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 8, (12,)).astype(np.int32)
+        hist = model.fit(x, y, epochs=1, verbose=False)
+        assert np.isfinite(hist[-1]["loss_sum"])
+
+    def test_save_views_roundtrip(self, tmp_path):
+        model = chain_model()
+        result = UnitySearch(model.graph, SPEC).optimize()
+        path = tmp_path / "views.json"
+        save_views(result, model.graph, str(path))
+        doc = json.loads(path.read_text())
+        assert doc["engine"] == "unity"
+        assert "d0" in doc["ops"]
+        assert doc["simulated_step_ms"] == pytest.approx(result.cost * 1e3)
+
+    def test_result_lowers_to_runnable_strategy(self):
+        model = chain_model(batch=32, hidden=64)
+        result = UnitySearch(model.graph, SPEC).optimize()
+        strategy = result_to_strategy(result, model.graph, 8)
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[],
+            strategy=strategy,
+        )
+        x = np.random.RandomState(0).randn(32, 64).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 8, (32,)).astype(np.int32)
+        hist = model.fit(x, y, epochs=1, verbose=False)
+        assert np.isfinite(hist[-1]["loss_sum"])
+
+
+class TestMCMC:
+    def test_never_worse_than_data_parallel_seed(self):
+        model = chain_model(batch=64, hidden=128)
+        search = UnitySearch(model.graph, SPEC)
+        guids = [
+            g
+            for g in model.graph.topo_order()
+            if model.graph.nodes[g].op_type.name != "INPUT"
+        ]
+        dp_views = {}
+        for g in guids:
+            full = [
+                v
+                for v in search.valid_views(g, search.resource)
+                if v.ch == 1 and v.num_devices == SPEC.num_chips
+            ]
+            dp_views[g] = full[0] if full else search.valid_views(g, search.resource)[0]
+        dp_cost = simulate_config(search, dp_views)
+        result = mcmc_optimize(model.graph, SPEC, budget=60, seed=0)
+        assert result.cost <= dp_cost * 1.001
+
+    def test_compile_with_mcmc_engine(self):
+        cfg = FFConfig(batch_size=32)
+        cfg.search_budget = 30
+        cfg.search_engine = "mcmc"
+        model = FFModel(cfg)
+        x = model.create_tensor([32, 64], name="x")
+        t = model.dense(x, 64, activation=ActiMode.RELU)
+        t = model.dense(t, 4)
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[],
+        )
+        xs = np.random.RandomState(0).randn(32, 64).astype(np.float32)
+        ys = np.random.RandomState(1).randint(0, 4, (32,)).astype(np.int32)
+        hist = model.fit(xs, ys, epochs=1, verbose=False)
+        assert np.isfinite(hist[-1]["loss_sum"])
+
+    def test_compile_with_unity_engine(self):
+        cfg = FFConfig(batch_size=32)
+        cfg.search_budget = 1
+        cfg.search_engine = "unity"
+        model = FFModel(cfg)
+        x = model.create_tensor([32, 48], name="x")
+        t = model.dense(x, 96, activation=ActiMode.RELU)
+        t = model.dense(t, 96)
+        t = model.dense(t, 4)
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[],
+        )
+        xs = np.random.RandomState(0).randn(32, 48).astype(np.float32)
+        ys = np.random.RandomState(1).randint(0, 4, (32,)).astype(np.int32)
+        hist = model.fit(xs, ys, epochs=1, verbose=False)
+        assert np.isfinite(hist[-1]["loss_sum"])
