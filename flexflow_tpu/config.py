@@ -52,6 +52,11 @@ class FFConfig:
     # reference's Unity path, graph.cc:1346), or "mcmc" (simulated
     # annealing, search.mcmc — the reference's legacy path, model.cc:3271)
     search_engine: str = "mesh"
+    # machine model for the search's comm costs (reference:
+    # --machine-model-version/-file, model.cc:3650+; graph.cc:1566-1581):
+    # 0 = simple ring formulas, 1 = Enhanced from file, 2 = Networked torus
+    machine_model_version: int = 0
+    machine_model_file: str = ""
 
     # runtime
     perform_fusion: bool = False  # reference: --fusion
@@ -137,6 +142,10 @@ class FFConfig:
                 cfg.search_num_workers = int(take())
             elif a == "--search-engine":
                 cfg.search_engine = take()
+            elif a == "--machine-model-version":
+                cfg.machine_model_version = int(take())
+            elif a == "--machine-model-file":
+                cfg.machine_model_file = take()
             elif a == "--fusion":
                 cfg.perform_fusion = True
             elif a == "--allow-tensor-op-math-conversion":

@@ -32,6 +32,27 @@ class Strategy:
             self._apply(graph)
 
 
+def annotate_input_batch(graph: PCGGraph, dp: int, strict: bool = False):
+    """Shard every source INPUT's batch (outermost) dim `dp` ways — the one
+    place this annotation is written (data-parallel, searched, and imported
+    strategies all route here). strict=True raises on a non-dividing batch;
+    otherwise the caller is expected to have clamped dp already."""
+    if dp <= 1:
+        return
+    for node in graph.nodes.values():
+        if node.op_type == OperatorType.INPUT and not node.inputs:
+            shape: ParallelTensorShape = node.params["shape"]
+            if shape.dims[0].size % dp != 0:
+                if strict:
+                    raise ValueError(
+                        f"input '{node.name}' batch {shape.dims[0].size} "
+                        f"not divisible by dp={dp}"
+                    )
+                continue
+            node.params["shape"] = shape.data_parallel(dp)
+            node.output_shapes = (node.params["shape"],)
+
+
 def effective_dp_degree(graph: PCGGraph, num_devices: int) -> int:
     """Largest degree <= num_devices dividing every input's batch dim.
     The mesh is sized to this degree — a PartitionSpec must shard a dim
@@ -59,15 +80,7 @@ def data_parallel_strategy(num_devices: int, graph: PCGGraph = None) -> Strategy
     )
 
     def apply(g: PCGGraph):
-        degree = effective_dp_degree(g, dp)
-        if degree <= 1:
-            return
-        for node in g.nodes.values():
-            if node.op_type == OperatorType.INPUT and not node.inputs:
-                shape: ParallelTensorShape = node.params["shape"]
-                new_shape = shape.data_parallel(degree)
-                node.params["shape"] = new_shape
-                node.output_shapes = (new_shape,)
+        annotate_input_batch(g, effective_dp_degree(g, dp))
 
     return Strategy(
         MeshConfig.data_parallel(max(dp, 1)), apply, name="data-parallel"
@@ -83,11 +96,10 @@ def sequence_parallel_strategy(
     capability the reference lacks (SURVEY §5)."""
 
     def apply(g: PCGGraph):
+        annotate_input_batch(g, dp)
         for node in g.nodes.values():
             if node.op_type == OperatorType.INPUT and not node.inputs:
                 shape: ParallelTensorShape = node.params["shape"]
-                if dp > 1 and shape.dims[0].size % dp == 0:
-                    shape = shape.data_parallel(dp)
                 if (
                     sp > 1
                     # a real sequence dim has a trailing feature dim after
@@ -120,12 +132,7 @@ def site_strategy(
     dp = effective_dp_degree(graph, max(1, num_devices // tp))
 
     def apply(g: PCGGraph):
-        if dp > 1:
-            for node in g.nodes.values():
-                if node.op_type == OperatorType.INPUT and not node.inputs:
-                    shape: ParallelTensorShape = node.params["shape"]
-                    node.params["shape"] = shape.data_parallel(dp)
-                    node.output_shapes = (node.params["shape"],)
+        annotate_input_batch(g, dp)
         for site in sites:
             site.apply(g, tp, 1)  # model axis = 1
 

@@ -38,19 +38,9 @@ _MODEL_AXIS = 1  # mesh axis index for tensor parallelism ("model")
 def _annotate_data_parallel(graph: PCGGraph, dp: int):
     """Shard every input's batch dim exactly dp ways; the mesh data axis is
     dp wide, so a batch dp does not divide makes the candidate infeasible."""
-    if dp <= 1:
-        return
-    for node in graph.nodes.values():
-        if node.op_type == OperatorType.INPUT and not node.inputs:
-            shape = node.params["shape"]
-            if shape.dims[0].size % dp != 0:
-                raise ValueError(
-                    f"input '{node.name}' batch {shape.dims[0].size} not "
-                    f"divisible by dp={dp}"
-                )
-            new_shape = shape.data_parallel(dp)
-            node.params["shape"] = new_shape
-            node.output_shapes = (new_shape,)
+    from flexflow_tpu.parallel.strategy import annotate_input_batch
+
+    annotate_input_batch(graph, dp, strict=True)
 
 
 def _candidate_graph(
@@ -105,9 +95,10 @@ def optimize(
     measure: bool = False,
     seed: int = 0,
     verbose: bool = False,
+    machine_model=None,
 ) -> SearchResult:
     """Run the search on a PCG; returns the best found configuration."""
-    cm = CostModel(spec, measure=measure)
+    cm = CostModel(spec, measure=measure, machine_model=machine_model)
     rng = random.Random(seed)
     evals = 0
     best: Optional[SearchResult] = None
@@ -209,11 +200,16 @@ def search_strategy(model, num_devices: int) -> Strategy:
             f"unknown --search-engine {cfg.search_engine!r}; "
             "expected mesh | unity | mcmc"
         )
+    from flexflow_tpu.search.machine_model import build_machine_model
+
+    mm = build_machine_model(cfg, spec)
     if cfg.search_engine in ("unity", "mcmc"):
         from flexflow_tpu.search import unity as unity_mod
 
         if cfg.search_engine == "unity":
-            result = unity_mod.UnitySearch(model.graph, spec).optimize()
+            result = unity_mod.UnitySearch(
+                model.graph, spec, machine_model=mm
+            ).optimize()
         else:
             from flexflow_tpu.search.mcmc import mcmc_optimize
 
@@ -224,13 +220,21 @@ def search_strategy(model, num_devices: int) -> Strategy:
                 alpha=cfg.search_alpha,
                 seed=cfg.seed,
                 verbose=cfg.profiling,
+                machine_model=mm,
             )
         # reference prints exactly this at the end of its search
         # (substitution.cc:1909, model.cc:3298)
         print(f"Optimal cost: {result.cost * 1e3:.6f}")
         if cfg.export_strategy_file:
-            unity_mod.save_views(result, model.graph, cfg.export_strategy_file)
-        return unity_mod.result_to_strategy(result, model.graph, num_devices)
+            unity_mod.save_views(
+                result,
+                model.graph,
+                cfg.export_strategy_file,
+                engine=cfg.search_engine,
+            )
+        return unity_mod.result_to_strategy(
+            result, model.graph, num_devices, engine=cfg.search_engine
+        )
 
     result = optimize(
         model.graph,
@@ -240,6 +244,7 @@ def search_strategy(model, num_devices: int) -> Strategy:
         alpha=cfg.search_alpha,
         seed=cfg.seed,
         verbose=cfg.profiling,
+        machine_model=mm,
     )
     print(f"[flexflow_tpu] search: best strategy = {result.describe()}")
     if cfg.export_strategy_file:

@@ -63,10 +63,17 @@ class CostModel:
         spec: MachineSpec,
         measure: bool = False,
         efficiency: float = _DEFAULT_EFFICIENCY,
+        machine_model=None,
     ):
+        """machine_model: an optional search.machine_model.MachineModel
+        (Enhanced / Networked); when given, collectives are costed as ring
+        steps over its actual comm paths instead of the flat ICI formulas
+        (reference: the simulator routes messages over
+        MachineModel::get_comm_path, simulator.cc:810+)."""
         self.spec = spec
         self.measure = measure
         self.efficiency = efficiency
+        self.machine_model = machine_model
         self._measured: Dict[Tuple[int, Tuple], float] = {}
 
     # -- collectives --------------------------------------------------------
@@ -75,27 +82,72 @@ class CostModel:
         bw = self.spec.ici_gbps * 1e9 * self.efficiency
         return bytes_on_wire / bw + hops * _ICI_LATENCY_S
 
-    def all_reduce(self, bytes_per_chip: float, group_size: int) -> float:
+    def _ring_step(
+        self,
+        bytes_per_step: float,
+        group_size: int,
+        chips: Optional[Sequence[int]] = None,
+    ) -> float:
+        """One ring step over the machine model's paths: ring neighbors
+        exchange concurrently, so the step takes as long as the slowest
+        pair. `chips` are the group's actual device ids — a cross-node or
+        strided group rings over its real (possibly DCN) paths; without it
+        the group is assumed contiguous at the machine origin."""
+        mm = self.machine_model
+        if chips is None:
+            chips = range(min(group_size, mm.num_chips()))
+        ids = [c % mm.num_chips() for c in chips]
+        worst = 0.0
+        for i, src in enumerate(ids):
+            dst = ids[(i + 1) % len(ids)]
+            worst = max(worst, mm.transfer_time(src, dst, bytes_per_step))
+        return worst
+
+    def all_reduce(
+        self, bytes_per_chip: float, group_size: int, chips=None
+    ) -> float:
         if group_size <= 1 or bytes_per_chip <= 0:
             return 0.0
+        if self.machine_model is not None:
+            return 2 * (group_size - 1) * self._ring_step(
+                bytes_per_chip / group_size, group_size, chips
+            )
         wire = 2.0 * (group_size - 1) / group_size * bytes_per_chip
         return self._ici_time(wire, hops=2 * (group_size - 1))
 
-    def all_gather(self, bytes_per_chip: float, group_size: int) -> float:
+    def all_gather(
+        self, bytes_per_chip: float, group_size: int, chips=None
+    ) -> float:
         if group_size <= 1 or bytes_per_chip <= 0:
             return 0.0
+        if self.machine_model is not None:
+            return (group_size - 1) * self._ring_step(
+                bytes_per_chip, group_size, chips
+            )
         wire = (group_size - 1) / group_size * bytes_per_chip * group_size
         return self._ici_time(wire, hops=group_size - 1)
 
-    def reduce_scatter(self, bytes_per_chip: float, group_size: int) -> float:
+    def reduce_scatter(
+        self, bytes_per_chip: float, group_size: int, chips=None
+    ) -> float:
         if group_size <= 1 or bytes_per_chip <= 0:
             return 0.0
+        if self.machine_model is not None:
+            return (group_size - 1) * self._ring_step(
+                bytes_per_chip / group_size, group_size, chips
+            )
         wire = (group_size - 1) / group_size * bytes_per_chip
         return self._ici_time(wire, hops=group_size - 1)
 
-    def all_to_all(self, bytes_per_chip: float, group_size: int) -> float:
+    def all_to_all(
+        self, bytes_per_chip: float, group_size: int, chips=None
+    ) -> float:
         if group_size <= 1 or bytes_per_chip <= 0:
             return 0.0
+        if self.machine_model is not None:
+            return (group_size - 1) * self._ring_step(
+                bytes_per_chip / group_size, group_size, chips
+            )
         wire = (group_size - 1) / group_size * bytes_per_chip
         return self._ici_time(wire, hops=group_size - 1)
 

@@ -72,10 +72,11 @@ def mcmc_optimize(
     alpha: float = 1.05,
     seed: int = 0,
     verbose: bool = False,
+    machine_model=None,
 ) -> UnityResult:
     """reference: mcmc_optimize (model.cc:3271) — budget proposals, periodic
     reset to best every budget/10 non-improving steps."""
-    search = UnitySearch(graph, spec)
+    search = UnitySearch(graph, spec, machine_model=machine_model)
     resource = search.resource
     rng = random.Random(seed)
     guids = [

@@ -63,29 +63,50 @@ def _group_size(shape, mesh_sizes) -> int:
     return group
 
 
-def _parallel_op_comm(node, in_shapes, cm: CostModel) -> Tuple[float, float]:
+def _axis_group_chips(axis: int, degree: int, mesh_sizes) -> range:
+    """Device ids of one collective group on a mesh axis. Devices are laid
+    out row-major over the mesh, so an axis-i group strides by the product
+    of the trailing axis sizes — the geometry a topology-aware machine
+    model needs to price cross-node rings correctly."""
+    stride = 1
+    for s in mesh_sizes[axis + 1:]:
+        stride *= s
+    return range(0, degree * stride, stride)
+
+
+def _parallel_op_comm(
+    node, in_shapes, cm: CostModel, mesh_sizes=()
+) -> Tuple[float, float]:
     """(fwd, bwd) collective seconds for one parallel op (SURVEY §2.3)."""
     x = in_shapes[0]
     y = node.output_shapes[0]
+    axis = _collective_axis(node, mesh_sizes)
     fwd = bwd = 0.0
     if node.op_type == OperatorType.REPLICATE:
         deg = node.params["degree"]
-        bwd = cm.all_reduce(x.piece_bytes(), deg)
+        bwd = cm.all_reduce(
+            x.piece_bytes(), deg, chips=_axis_group_chips(axis, deg, mesh_sizes)
+        )
     elif node.op_type == OperatorType.REDUCTION:
         deg = node.params["degree"]
-        fwd = cm.all_reduce(y.piece_bytes(), deg)
+        fwd = cm.all_reduce(
+            y.piece_bytes(), deg, chips=_axis_group_chips(axis, deg, mesh_sizes)
+        )
     elif node.op_type == OperatorType.REPARTITION:
         deg = node.params["degree"]
-        fwd = cm.all_to_all(x.piece_bytes(), deg)
-        bwd = cm.all_gather(y.piece_bytes(), deg)
+        chips = _axis_group_chips(axis, deg, mesh_sizes)
+        fwd = cm.all_to_all(x.piece_bytes(), deg, chips=chips)
+        bwd = cm.all_gather(y.piece_bytes(), deg, chips=chips)
     elif node.op_type == OperatorType.COMBINE:
         deg = node.params["degree"]
-        fwd = cm.all_gather(x.piece_bytes(), deg)
-        bwd = cm.all_to_all(y.piece_bytes(), deg)
+        chips = _axis_group_chips(axis, deg, mesh_sizes)
+        fwd = cm.all_gather(x.piece_bytes(), deg, chips=chips)
+        bwd = cm.all_to_all(y.piece_bytes(), deg, chips=chips)
     elif node.op_type in (OperatorType.ALLTOALL, OperatorType.FUSED_PARALLEL):
         deg = max(x.total_degree, y.total_degree)
-        fwd = cm.all_to_all(x.piece_bytes(), deg)
-        bwd = cm.all_to_all(y.piece_bytes(), deg)
+        chips = _axis_group_chips(axis, deg, mesh_sizes)
+        fwd = cm.all_to_all(x.piece_bytes(), deg, chips=chips)
+        bwd = cm.all_to_all(y.piece_bytes(), deg, chips=chips)
     return fwd, bwd
 
 
@@ -157,7 +178,7 @@ def estimate_graph_cost(
             act_bytes += sum(s.piece_bytes() for s in node.output_shapes)
             t = add_task(_CHIP, 0.0)
         elif node.is_parallel_op:
-            f, b = _parallel_op_comm(node, in_shapes, cm)
+            f, b = _parallel_op_comm(node, in_shapes, cm, mesh_sizes)
             total.comm_time += f + (b if include_backward else 0.0)
             per_node_cost[guid] = OpCost(0.0, 0.0, 0.0, 0)
             t = add_task(link(_collective_axis(node, mesh_sizes)), f)
@@ -202,11 +223,19 @@ def estimate_graph_cost(
         if not node.weight_shapes:
             continue
         t_sync = 0.0
+        total_chips = 1
+        for s in mesh_sizes:
+            total_chips *= s
         for w in node.weight_shapes:
             weight_bytes += w.piece_bytes()
             if include_backward:
                 g = _group_size(w, mesh_sizes)
-                t_sync += cm.all_reduce(w.piece_bytes(), g)
+                chips = (
+                    range(total_chips)
+                    if g >= total_chips
+                    else _axis_group_chips(0, g, mesh_sizes)
+                )
+                t_sync += cm.all_reduce(w.piece_bytes(), g, chips=chips)
         if include_backward and t_sync > 0:
             total.sync_time += t_sync
             t = add_task(link(0), t_sync)

@@ -111,10 +111,11 @@ class UnitySearch:
         spec: MachineSpec,
         resource: Optional[MachineResource] = None,
         include_backward: bool = True,
+        machine_model=None,
     ):
         self.graph = graph
         self.spec = spec
-        self.cm = CostModel(spec)
+        self.cm = CostModel(spec, machine_model=machine_model)
         self.resource = resource or spec.resource()
         self.include_backward = include_backward
         self._memo: Dict[Tuple, Tuple[float, Dict[int, ViewOption]]] = {}
@@ -205,10 +206,13 @@ class UnitySearch:
             )
             t *= 3.0 if mxu else 2.0
         # gradient sync: weights are sharded ch ways and replicated across
-        # the dp data replicas; all-reduce the shards over them
+        # the dp data replicas; all-reduce the shards over the actual device
+        # ids of one replica group (ids are laid out (dp, ch) row-major, so
+        # a group is every ch-th device — possibly crossing nodes)
         if self.include_backward and node.weight_shapes:
             w_bytes = sum(s.volume() * 4 for s in node.weight_shapes) / opt.ch
-            t += self.cm.all_reduce(w_bytes, opt.dp)
+            group = opt.view.device_ids()[:: opt.ch]
+            t += self.cm.all_reduce(w_bytes, opt.dp, chips=group)
         return t
 
     def xfer_cost(self, ref, src: ViewOption, dst: ViewOption) -> float:
@@ -227,13 +231,33 @@ class UnitySearch:
         (reference: Graph::optimal_cost, graph.cc:1433)."""
         sinks = self.graph.sinks()
         if len(sinks) != 1:
-            # multiple sinks: cost each independently (rare; metrics heads)
-            views: Dict[int, MachineView] = {}
+            # multiple sinks (rare; metrics heads): cost the largest
+            # subgraph first, then only each later sink's EXCLUSIVE nodes —
+            # shared-trunk nodes keep their first assignment and are not
+            # double-counted. Boundary transfers from the trunk into the
+            # exclusive tail are not charged (documented approximation).
+            order = sorted(
+                sinks,
+                key=lambda s: len(self.graph.ancestors_of([s])),
+                reverse=True,
+            )
+            views: Dict[int, ViewOption] = {}
             total = 0.0
-            for s in sinks:
-                r = self._best_for_sink(s)
-                total += r.cost
-                views.update(r.views)
+            covered: set = set()
+            for s in order:
+                anc = set(self.graph.ancestors_of([s])) | {s}
+                exclusive = frozenset(anc - covered) | {s}
+                best = None
+                for view in self.valid_views(s, self.resource):
+                    c, v = self._graph_cost(
+                        exclusive, None, s, view, self.resource
+                    )
+                    if best is None or c < best[0]:
+                        best = (c, {**v, s: view})
+                total += best[0]
+                for g, v in best[1].items():
+                    views.setdefault(g, v)
+                covered |= anc
             return UnityResult(total, views)
         return self._best_for_sink(sinks[0])
 
@@ -457,7 +481,9 @@ class UnitySearch:
 # -- lowering to an executable Strategy --------------------------------------
 
 
-def result_to_strategy(result: UnityResult, graph: PCGGraph, num_devices: int):
+def result_to_strategy(
+    result: UnityResult, graph: PCGGraph, num_devices: int, engine: str = "unity"
+):
     """Reduce the per-op view map to one global mesh + TP rewrite sites
     (SURVEY §7's v1 restriction — per-op device subsets beyond one mesh are
     exported but not lowered)."""
@@ -481,18 +507,20 @@ def result_to_strategy(result: UnityResult, graph: PCGGraph, num_devices: int):
         num_devices,
         tp,
         sites,
-        name_prefix=f"unity(step {result.cost * 1e3:.3f} ms)",
+        name_prefix=f"{engine}(step {result.cost * 1e3:.3f} ms)",
     )
 
 
-def save_views(result: UnityResult, graph: PCGGraph, path: str):
+def save_views(
+    result: UnityResult, graph: PCGGraph, path: str, engine: str = "unity"
+):
     """Per-op view export (reference: save_strategies_to_file,
     strategy.cc:156 — per-op ParallelConfig maps)."""
     import json
 
     doc = {
         "version": 1,
-        "engine": "unity",
+        "engine": engine,
         "simulated_step_ms": result.cost * 1e3,
         "ops": {
             graph.nodes[g].name: {

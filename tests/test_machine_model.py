@@ -1,0 +1,206 @@
+"""Machine-model tests (reference: machine_model.cc, network.cc; the
+reference unit-tests the adjacent pure logic in tests/unit/)."""
+
+import pytest
+
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.search.cost_model import CostModel
+from flexflow_tpu.search.machine_model import (
+    ConnectionMatrix,
+    EnhancedMachineModel,
+    NetworkedMachineModel,
+    ShortestPathRouting,
+    SimpleMachineModel,
+    WeightedShortestPathRouting,
+    big_switch_topology,
+    build_machine_model,
+    fat_tree_topology,
+    fully_connected_topology,
+    torus_topology,
+)
+
+CONFIG = """
+num_nodes = 2
+chips_per_node = 4
+ici_bandwidth_gbps = 45
+ici_latency_us = 1
+ici_dims = 1
+pcie_bandwidth_gbps = 32
+dcn_bandwidth_gbps = 25
+dcn_latency_us = 10
+segment_size_mb = 4
+inter_slice = host
+"""
+
+
+class TestSimple:
+    def test_paths(self):
+        m = SimpleMachineModel(2, 4)
+        assert m.get_comm_path(0, 0) == []
+        assert [d.kind for d in m.get_comm_path(0, 1)] == ["ici"]
+        assert [d.kind for d in m.get_comm_path(0, 4)] == ["dcn"]
+        assert m.transfer_time(0, 4, 1 << 20) > m.transfer_time(0, 1, 1 << 20)
+
+
+class TestEnhanced:
+    def test_parse_and_paths(self):
+        m = EnhancedMachineModel(CONFIG)
+        assert m.num_chips() == 8
+        assert [d.kind for d in m.get_comm_path(0, 1)] == ["ici"]
+        assert [d.kind for d in m.get_comm_path(1, 5)] == [
+            "pcie",
+            "dcn",
+            "pcie",
+        ]
+
+    def test_segmented_pipelining_beats_store_and_forward(self):
+        m = EnhancedMachineModel(CONFIG)
+        nbytes = 64 << 20  # 16 segments of 4MB
+        piped = m.transfer_time(0, 5, nbytes)
+        store_fwd = sum(d.time(nbytes) for d in m.get_comm_path(0, 5))
+        assert piped < store_fwd
+        # monotone in message size
+        assert m.transfer_time(0, 5, nbytes) > m.transfer_time(0, 5, nbytes // 4)
+
+    def test_ici_dims_sets_intra_slice_hops(self):
+        m = EnhancedMachineModel(CONFIG.replace("ici_dims = 1", "ici_dims = 2"))
+        assert [d.kind for d in m.get_comm_path(0, 1)] == ["ici", "ici"]
+
+    def test_direct_inter_slice(self):
+        m = EnhancedMachineModel(CONFIG.replace("host", "direct"))
+        assert all(d.kind == "ici" for d in m.get_comm_path(0, 5))
+
+    def test_bad_config_raises(self):
+        with pytest.raises(ValueError):
+            EnhancedMachineModel("num_nodes 2")
+        with pytest.raises(ValueError):
+            EnhancedMachineModel("inter_slice = quantum")
+
+
+class TestTopologies:
+    def test_torus_degrees(self):
+        t = torus_topology((4, 4))
+        assert t.num_nodes == 16 and t.num_switches == 0
+        for v in range(16):
+            assert t.degree(v) == 4  # 2 axes x 2 directions
+        # symmetric
+        for i in range(16):
+            for j in range(16):
+                assert t.conn[i][j] == t.conn[j][i]
+
+    def test_torus_2ring_collapses_to_double_link(self):
+        t = torus_topology((2,))
+        assert t.conn[0][1] == 2  # both directions of the 2-ring
+
+    def test_big_switch(self):
+        t = big_switch_topology(4)
+        assert t.num_switches == 1
+        for i in range(4):
+            assert t.degree(i) == 1
+
+    def test_fat_tree_connected(self):
+        t = fat_tree_topology(8, pods=2)
+        r = ShortestPathRouting()
+        for i in range(8):
+            for j in range(8):
+                assert r.route(t, i, j) is not None
+
+    def test_fully_connected(self):
+        t = fully_connected_topology(4)
+        assert all(
+            t.conn[i][j] == 1 for i in range(4) for j in range(4) if i != j
+        )
+
+
+class TestRouting:
+    def test_shortest_path_length(self):
+        t = torus_topology((4,))
+        r = ShortestPathRouting()
+        # ring of 4: opposite node is 2 hops
+        assert len(r.route(t, 0, 2)) == 3
+        assert len(r.route(t, 0, 1)) == 2
+
+    def test_weighted_prefers_fat_links(self):
+        # 0 -> 1 (thin direct), 0 -> 2 -> 1 (fat): weighted routing detours
+        conn = [[0, 1, 4], [1, 0, 4], [4, 4, 0]]
+        t = ConnectionMatrix(3, 0, conn)
+        route = WeightedShortestPathRouting().route(t, 0, 1)
+        assert route == [0, 2, 1]
+        assert ShortestPathRouting().route(t, 0, 1) == [0, 1]
+
+
+class TestNetworked:
+    def test_transfer_routes_over_topology(self):
+        m = NetworkedMachineModel(4, 2, torus_topology((4,)), link_gbps=25)
+        near = m.transfer_time(0, 2, 1 << 20)  # nodes 0->1: 1 hop
+        far = m.transfer_time(0, 4, 1 << 20)  # nodes 0->2: 2 hops
+        assert far > near
+        intra = m.transfer_time(0, 1, 1 << 20)
+        assert intra < near
+
+    def test_topology_size_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            NetworkedMachineModel(3, 2, torus_topology((4,)))
+
+
+class TestCostModelIntegration:
+    def test_collectives_with_machine_model_finite_and_ordered(self):
+        spec = MachineSpec(num_nodes=2, chips_per_node=4, chip="v4")
+        mm = EnhancedMachineModel(CONFIG)
+        cm = CostModel(spec, machine_model=mm)
+        t2 = cm.all_reduce(1 << 20, 2)
+        t8 = cm.all_reduce(1 << 20, 8)
+        assert 0 < t2 < t8
+        assert cm.all_gather(1 << 20, 4) > 0
+        assert cm.reduce_scatter(1 << 20, 4) > 0
+        assert cm.all_to_all(1 << 20, 4) > 0
+
+    def test_build_machine_model_dispatch(self, tmp_path):
+        spec = MachineSpec(num_nodes=2, chips_per_node=4, chip="v4")
+
+        class Cfg:
+            machine_model_version = 0
+            machine_model_file = ""
+
+        assert build_machine_model(Cfg(), spec) is None
+        cfg = Cfg()
+        cfg.machine_model_version = 1
+        with pytest.raises(ValueError):
+            build_machine_model(cfg, spec)
+        p = tmp_path / "mc"
+        p.write_text(CONFIG)
+        cfg.machine_model_file = str(p)
+        assert isinstance(build_machine_model(cfg, spec), EnhancedMachineModel)
+        cfg.machine_model_version = 2
+        assert isinstance(
+            build_machine_model(cfg, spec), NetworkedMachineModel
+        )
+
+    def test_search_with_machine_model_end_to_end(self):
+        import numpy as np
+
+        from flexflow_tpu import (
+            ActiMode,
+            FFConfig,
+            FFModel,
+            LossType,
+            SGDOptimizer,
+        )
+
+        cfg = FFConfig(batch_size=16)
+        cfg.search_budget = 10
+        cfg.search_engine = "unity"
+        cfg.machine_model_version = 2
+        model = FFModel(cfg)
+        x = model.create_tensor([16, 64], name="x")
+        t = model.dense(x, 64, activation=ActiMode.RELU)
+        t = model.dense(t, 4)
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[],
+        )
+        xs = np.random.RandomState(0).randn(16, 64).astype(np.float32)
+        ys = np.random.RandomState(1).randint(0, 4, (16,)).astype(np.int32)
+        hist = model.fit(xs, ys, epochs=1, verbose=False)
+        assert np.isfinite(hist[-1]["loss_sum"])
