@@ -203,3 +203,19 @@ def _infer_fused_parallel(input_shapes, params):
 
 
 register_op(OperatorType.FUSED_PARALLEL, _infer_fused_parallel, _identity_lower)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline (OP_PIPELINE) — declared but UNIMPLEMENTED in the reference
+# (ffconst.h:151, PIPELINE_*_TASK_ID model.h:186-188 with no operator);
+# here it is a stage-boundary marker: the pipeline scheduler
+# (flexflow_tpu.parallel.pipeline) runs GPipe over the `pipe` mesh axis,
+# and this node records where stages cut the graph.
+# ---------------------------------------------------------------------------
+
+
+def _infer_pipeline(input_shapes, params):
+    return (input_shapes[0],), ()
+
+
+register_op(OperatorType.PIPELINE, _infer_pipeline, _identity_lower)

@@ -469,6 +469,26 @@ class FFModel:
             OperatorType.REDUCTION, "reduction", [input], {"degree": degree}, name
         )[0]
 
+    def pipeline(
+        self,
+        input: Tensor,
+        num_stages: int,
+        num_microbatches: int = 4,
+        name=None,
+    ):
+        """Stage-boundary MARKER, pass-through in the PCG executor (the
+        reference declares OP_PIPELINE but never implements it either,
+        ffconst.h:151). Pipelined execution lives in
+        flexflow_tpu.parallel.pipeline.pipeline_apply; compile() warns when
+        markers are present so the inert path is never silent."""
+        return self._add(
+            OperatorType.PIPELINE,
+            "pipeline",
+            [input],
+            {"num_stages": num_stages, "num_microbatches": num_microbatches},
+            name,
+        )[0]
+
     def all_to_all(self, input: Tensor, src_axis: int, dst_axis: int, name=None):
         return self._add(
             OperatorType.ALLTOALL,
@@ -550,6 +570,17 @@ class FFModel:
         """
         from flexflow_tpu.parallel.strategy import choose_strategy
 
+        if any(
+            n.op_type == OperatorType.PIPELINE for n in self.graph.nodes.values()
+        ):
+            import warnings
+
+            warnings.warn(
+                "PIPELINE markers are pass-through in the PCG executor; for "
+                "pipelined execution use flexflow_tpu.parallel.pipeline."
+                "pipeline_apply (GPipe over a 'pipe' mesh axis).",
+                stacklevel=2,
+            )
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate,
             weight_decay=self.config.weight_decay,

@@ -116,6 +116,22 @@ class EnhancedMachineModel(MachineModel):
                                      # or "direct" (ici-extended slices)
     """
 
+    _KEYS = frozenset(
+        {
+            "num_nodes",
+            "chips_per_node",
+            "ici_bandwidth_gbps",
+            "ici_latency_us",
+            "ici_dims",
+            "pcie_bandwidth_gbps",
+            "pcie_latency_us",
+            "dcn_bandwidth_gbps",
+            "dcn_latency_us",
+            "segment_size_mb",
+            "inter_slice",
+        }
+    )
+
     def __init__(self, text: str):
         kv: Dict[str, str] = {}
         for line in text.splitlines():
@@ -125,6 +141,11 @@ class EnhancedMachineModel(MachineModel):
             if "=" not in line:
                 raise ValueError(f"bad machine-config line: {line!r}")
             k, v = (s.strip() for s in line.split("=", 1))
+            if k not in self._KEYS:
+                raise ValueError(
+                    f"unknown machine-config key {k!r}; known keys: "
+                    f"{sorted(self._KEYS)}"
+                )
             kv[k] = v
 
         def f(key, default):
@@ -353,6 +374,8 @@ class NetworkedMachineModel(MachineModel):
         self.intra_node_gbps = intra_node_gbps
         self.routing = routing or WeightedShortestPathRouting()
         self._path_cache: Dict[Tuple[int, int], Optional[List[int]]] = {}
+        self._device_cache: Dict[Tuple[int, int], List[CommDevice]] = {}
+        self._ici_dev = CommDevice("ici", "ici", 1e-6, intra_node_gbps * 1e9)
 
     def num_chips(self) -> int:
         return self.num_nodes * self.chips_per_node
@@ -369,9 +392,11 @@ class NetworkedMachineModel(MachineModel):
         a = src_chip // self.chips_per_node
         b = dst_chip // self.chips_per_node
         if a == b:
-            return [
-                CommDevice("ici", "ici", 1e-6, self.intra_node_gbps * 1e9)
-            ]
+            return [self._ici_dev]
+        key = (a, b)
+        cached = self._device_cache.get(key)
+        if cached is not None:
+            return cached
         route = self._node_route(a, b)
         if route is None:
             raise ValueError(f"no route between nodes {a} and {b}")
@@ -386,6 +411,7 @@ class NetworkedMachineModel(MachineModel):
                     self.link_gbps * 1e9 * mult,
                 )
             )
+        self._device_cache[key] = devices
         return devices
 
 

@@ -236,17 +236,17 @@ class UnitySearch:
             # shared-trunk nodes keep their first assignment and are not
             # double-counted. Boundary transfers from the trunk into the
             # exclusive tail are not charged (documented approximation).
-            order = sorted(
-                sinks,
-                key=lambda s: len(self.graph.ancestors_of([s])),
-                reverse=True,
-            )
+            anc_of = {
+                s: set(self.graph.ancestors_of([s])) for s in sinks
+            }  # ancestors_of includes the start node itself
+            order = sorted(sinks, key=lambda s: len(anc_of[s]), reverse=True)
             views: Dict[int, ViewOption] = {}
             total = 0.0
             covered: set = set()
             for s in order:
-                anc = set(self.graph.ancestors_of([s])) | {s}
-                exclusive = frozenset(anc - covered) | {s}
+                anc = anc_of[s]
+                # a sink is nobody's ancestor, so s is always in `exclusive`
+                exclusive = frozenset(anc - covered)
                 best = None
                 for view in self.valid_views(s, self.resource):
                     c, v = self._graph_cost(
