@@ -862,3 +862,68 @@ def _lower_gather(params):
 
 
 register_op(OperatorType.GATHER, _infer_gather, _lower_gather)
+
+
+# ---------------------------------------------------------------------------
+# FusedOp (reference: src/ops/fused.cc:437 + fused.cu:918 — one task
+# dispatching many inner kernels through indirection tables). Here the
+# sub-op list lives in params["sub_ops"]; infer/lower chain the inner
+# OpDefs, slicing the flattened weight list per sub-op.
+# ---------------------------------------------------------------------------
+
+
+def _infer_fused(input_shapes, params):
+    from flexflow_tpu.ops.registry import infer_shapes as _infer
+
+    shapes = list(input_shapes)
+    weights = []
+    for sub in params["sub_ops"]:
+        outs, ws = _infer(sub["op_type"], shapes, sub["params"])
+        if len(outs) != 1:
+            raise ValueError("fused sub-ops must be single-output")
+        shapes = [outs[0]]
+        weights.extend(ws)
+    return (shapes[0],), tuple(weights)
+
+
+def _lower_fused(params):
+    import dataclasses as _dc
+
+    from flexflow_tpu.ops.registry import lower_op as _lower
+
+    subs = [
+        (_lower(sub["op_type"], sub["params"]), sub["num_weights"])
+        for sub in params["sub_ops"]
+    ]
+
+    def fn(ins, ws, ctx):
+        x = ins[0]
+        off = 0
+        for i, (sub_fn, nw) in enumerate(subs):
+            sub_ctx = ctx
+            if ctx is not None and ctx.rng is not None:
+                # each sub-op gets an independent stream — the executor
+                # folds rng per NODE, and fusion must not make two dropouts
+                # in one chain draw identical masks
+                sub_ctx = _dc.replace(ctx, rng=jax.random.fold_in(ctx.rng, i))
+            (x,) = sub_fn([x], ws[off : off + nw], sub_ctx)
+            off += nw
+        return [x]
+
+    return fn
+
+
+def _flops_fused(input_shapes, params):
+    from flexflow_tpu.ops.registry import infer_shapes as _infer
+    from flexflow_tpu.ops.registry import op_flops as _flops
+
+    shapes = list(input_shapes)
+    total = 0.0
+    for sub in params["sub_ops"]:
+        total += _flops(sub["op_type"], shapes, sub["params"])
+        outs, _ = _infer(sub["op_type"], shapes, sub["params"])
+        shapes = [outs[0]]
+    return total
+
+
+register_op(OperatorType.FUSED, _infer_fused, _lower_fused, _flops_fused)

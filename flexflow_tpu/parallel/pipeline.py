@@ -62,7 +62,6 @@ def gpipe(
         )
     mb = batch // num_microbatches
     xs = x.reshape((num_microbatches, mb) + x.shape[1:])
-    steps = num_microbatches + num_stages - 1
     # pad the microbatch stream with zeros for the drain phase
     pad = jnp.zeros((num_stages - 1, mb) + x.shape[1:], x.dtype)
     stream = jnp.concatenate([xs, pad], axis=0)
@@ -119,26 +118,25 @@ def pipeline_apply(
         lambda _: PartitionSpec(axis_name), stacked_params
     )
     x_spec = PartitionSpec(data_axis) if data_axis else PartitionSpec()
+    import inspect
+
     try:  # jax >= 0.8
         from jax import shard_map
-
-        mapped = shard_map(
-            inner,
-            mesh=mesh,
-            in_specs=(p_spec, x_spec),
-            out_specs=x_spec,
-            check_vma=False,
-        )
-    except (ImportError, TypeError):
-        from jax.experimental.shard_map import shard_map as old_shard_map
-
-        mapped = old_shard_map(
-            inner,
-            mesh=mesh,
-            in_specs=(p_spec, x_spec),
-            out_specs=x_spec,
-            check_rep=False,
-        )
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    sig = inspect.signature(shard_map)
+    check = (
+        {"check_vma": False}
+        if "check_vma" in sig.parameters
+        else {"check_rep": False}
+    )
+    mapped = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(p_spec, x_spec),
+        out_specs=x_spec,
+        **check,
+    )
     return mapped(stacked_params, x)
 
 

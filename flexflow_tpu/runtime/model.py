@@ -621,6 +621,19 @@ class FFModel:
             logits = Tensor(self, new_ref)
             self._logits = logits
 
+        # FusedOp pass (reference: apply_fusion, model.cc:2489-2597): fold
+        # fusible chains into FUSED nodes; the logits node stays unfused so
+        # downstream references (loss, from_logits check) hold.
+        if self.config.perform_fusion:
+            from flexflow_tpu.runtime.fusion import apply_fusion
+
+            self.graph, fref_map = apply_fusion(
+                self.graph, protected={logits.ref.guid}
+            )
+            if logits.ref in fref_map:
+                logits = Tensor(self, fref_map[logits.ref])
+                self._logits = logits
+
         # label tensor matching the final op's batch partitioning
         # (reference: model.cc:3072-3110)
         logits_shape = self.graph.shape_of(logits.ref)
@@ -667,9 +680,14 @@ class FFModel:
 
             aux.append(moe_aux)
 
-        from_logits = (
-            self.graph.nodes[logits.ref.guid].op_type != OperatorType.SOFTMAX
-        )
+        logits_node = self.graph.nodes[logits.ref.guid]
+        if logits_node.op_type == OperatorType.FUSED:
+            from_logits = (
+                logits_node.params["sub_ops"][-1]["op_type"]
+                != OperatorType.SOFTMAX
+            )
+        else:
+            from_logits = logits_node.op_type != OperatorType.SOFTMAX
         self.executor = Executor(
             self.graph,
             self.strategy.mesh_config,

@@ -1,0 +1,126 @@
+"""FusedOp / apply_fusion tests (reference: model.cc:2489-2597, fused.cc)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.runtime.executor import propagate_shapes
+from flexflow_tpu.runtime.fusion import apply_fusion
+
+
+def _mlp(batch=16, hidden=32, classes=4, bias=True):
+    model = FFModel(FFConfig(batch_size=batch))
+    x = model.create_tensor([batch, hidden], name="x")
+    t = model.dense(x, hidden, activation=ActiMode.RELU, name="d0")
+    t = model.tanh(t, name="act")
+    t = model.dense(t, classes, use_bias=bias, name="head")
+    t = model.softmax(t, name="sm")
+    return model, t
+
+
+class TestApplyFusion:
+    def test_chain_folds_to_one_node(self):
+        model, logits = _mlp()
+        g, ref_map = apply_fusion(model.graph, protected={logits.ref.guid})
+        fused = [n for n in g.nodes.values() if n.op_type == OperatorType.FUSED]
+        # d0+act+head+sm fuse into one node (sm, protected, ends the chain)
+        assert len(fused) == 1
+        assert fused[0].name == "d0+act+head+sm"
+        subs = [s["op_type"] for s in fused[0].params["sub_ops"]]
+        assert subs == [
+            OperatorType.LINEAR,
+            OperatorType.TANH,
+            OperatorType.LINEAR,
+            OperatorType.SOFTMAX,
+        ]
+        # flattened weights: d0 kernel+bias, head kernel+bias
+        assert len(fused[0].weight_shapes) == 4
+        propagate_shapes(g)  # fused infer chain must be consistent
+
+    def test_protected_node_may_only_end_a_chain(self):
+        model, logits = _mlp()
+        g, ref_map = apply_fusion(model.graph, protected={logits.ref.guid})
+        if logits.ref.guid not in g.nodes:
+            # absorbed as the LAST sub-op: the ref must be remapped and the
+            # fused node must end with the softmax (value preserved)
+            assert logits.ref in ref_map
+            fused = g.nodes[ref_map[logits.ref].guid]
+            assert fused.params["sub_ops"][-1]["op_type"] == OperatorType.SOFTMAX
+
+    def test_branch_points_block_fusion(self):
+        model = FFModel(FFConfig(batch_size=8))
+        x = model.create_tensor([8, 16], name="x")
+        t = model.dense(x, 16, name="d0")
+        a = model.relu(t, name="ra")
+        b = model.tanh(t, name="rb")  # two consumers of d0
+        model.add(a, b, name="sum")
+        g, _ = apply_fusion(model.graph)
+        fused = [n for n in g.nodes.values() if n.op_type == OperatorType.FUSED]
+        assert not fused  # chains of length 1 only
+
+    def test_fused_model_matches_unfused_numerically(self):
+        def build(fusion):
+            cfg = FFConfig(batch_size=16)
+            cfg.perform_fusion = fusion
+            cfg.substitution_json = ""  # isolate the FusedOp pass
+            model = FFModel(cfg)
+            x = model.create_tensor([16, 32], name="x")
+            t = model.dense(x, 32, activation=ActiMode.RELU, name="d0")
+            t = model.dense(t, 4, name="head")
+            model.compile(
+                optimizer=SGDOptimizer(lr=0.05),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics=[MetricsType.ACCURACY],
+            )
+            return model
+
+        model_f = build(True)
+        model_n = build(False)
+        fused = [
+            n
+            for n in model_f.graph.nodes.values()
+            if n.op_type == OperatorType.FUSED
+        ]
+        assert fused  # the pass actually fired in the compiled model
+
+        # copy the unfused weights into the fused model (chain order ==
+        # topo order, so the flattened lists line up)
+        flat = [
+            np.asarray(w)
+            for guid in model_n.executor.topo
+            for w in model_n.params.get(guid, [])
+        ]
+        off = 0
+        for guid in model_f.executor.topo:
+            node = model_f.graph.nodes[guid]
+            for i in range(len(node.weight_shapes)):
+                model_f.set_tensor(guid, i, flat[off])
+                off += 1
+        assert off == len(flat)
+
+        rng = np.random.RandomState(0)
+        xs = rng.randn(16, 32).astype(np.float32)
+        y_f = np.asarray(model_f.forward({"x": xs}))
+        y_n = np.asarray(model_n.forward({"x": xs}))
+        np.testing.assert_allclose(y_f, y_n, rtol=1e-5, atol=1e-6)
+
+    def test_fused_flops_sum(self):
+        from flexflow_tpu.ops.registry import op_flops
+
+        model, logits = _mlp()
+        g, _ = apply_fusion(model.graph, protected={logits.ref.guid})
+        fused = next(
+            n for n in g.nodes.values() if n.op_type == OperatorType.FUSED
+        )
+        in_shapes = [g.shape_of(r) for r in fused.inputs]
+        f = op_flops(OperatorType.FUSED, in_shapes, fused.params)
+        # two 32x32-ish matmuls dominate; must be > 0 and finite
+        assert f > 0 and np.isfinite(f)
