@@ -1,0 +1,140 @@
+"""Seq2seq NMT workload — the reference's legacy standalone RNN/LSTM
+mini-framework as an example (reference: nmt/ — 3,980 LoC with its own
+RnnModel, rnn_mapper, and CUDA kernels nmt/lstm.cu, embed.cu, linear.cu;
+SURVEY §1 treats it as an example workload, not core).
+
+TPU re-design: the LSTM recurrence is a `lax.scan` (XLA unrolls it onto
+the MXU), embedding/projection are plain jnp ops, the whole train step is
+one jitted function, and the update reuses the framework's SGDOptimizer.
+
+    python examples/nmt.py -b 32 -i 4 -e 1
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from flexflow_tpu import FFConfig, SGDOptimizer  # noqa: E402
+
+VOCAB = 256
+EMBED = 64
+HIDDEN = 128
+SEQ = 16
+
+
+def init_params(key, vocab=VOCAB, embed=EMBED, hidden=HIDDEN):
+    """Encoder LSTM + decoder LSTM + shared embedding + output projection
+    (reference: nmt/rnn.h's LSTM/Embed/Linear node zoo)."""
+    ks = jax.random.split(key, 8)
+    g = jax.nn.initializers.glorot_uniform()
+
+    def lstm(k):
+        k1, k2 = jax.random.split(k)
+        return {
+            "wx": g(k1, (embed, 4 * hidden)),
+            "wh": g(k2, (hidden, 4 * hidden)),
+            "b": jnp.zeros((4 * hidden,)),
+        }
+
+    return {
+        "embed_src": g(ks[0], (vocab, embed)),
+        "embed_dst": g(ks[3], (vocab, embed)),
+        "enc": lstm(ks[1]),
+        "dec": lstm(ks[2]),
+        "proj_w": g(ks[4], (hidden, vocab)),
+        "proj_b": jnp.zeros((vocab,)),
+    }
+
+
+def lstm_scan(cell, xs, h0, c0):
+    """xs: [seq, batch, embed] → hs: [seq, batch, hidden]
+    (reference kernel: nmt/lstm.cu — cuDNN-style fused gates)."""
+
+    def step(carry, x):
+        h, c = carry
+        gates = x @ cell["wx"] + h @ cell["wh"] + cell["b"]
+        i, f, gq, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gq)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    (h, c), hs = jax.lax.scan(step, (h0, c0), xs)
+    return hs, h, c
+
+
+def forward(params, src, dst_in):
+    """src, dst_in: [batch, seq] int32 → logits [batch, seq, vocab]."""
+    batch = src.shape[0]
+    h0 = jnp.zeros((batch, HIDDEN))
+    c0 = jnp.zeros((batch, HIDDEN))
+    x_src = params["embed_src"][src].transpose(1, 0, 2)  # [seq, b, e]
+    _, h, c = lstm_scan(params["enc"], x_src, h0, c0)
+    x_dst = params["embed_dst"][dst_in].transpose(1, 0, 2)
+    hs, _, _ = lstm_scan(params["dec"], x_dst, h, c)  # teacher forcing
+    logits = hs.transpose(1, 0, 2) @ params["proj_w"] + params["proj_b"]
+    return logits
+
+
+def loss_fn(params, batch):
+    logits = forward(params, batch["src"], batch["dst_in"])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, batch["dst_out"][..., None], axis=-1
+    ).squeeze(-1)
+    return nll.mean()
+
+
+def synthetic_batch(rng, batch, seq=SEQ):
+    """Copy task: target = source reversed (a learnable seq2seq toy)."""
+    src = rng.randint(1, VOCAB, size=(batch, seq)).astype(np.int32)
+    tgt = src[:, ::-1].copy()
+    dst_in = np.concatenate([np.zeros((batch, 1), np.int32), tgt[:, :-1]], 1)
+    return {
+        "src": src,
+        "dst_in": dst_in,
+        "dst_out": tgt,
+    }
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    batch = cfg.batch_size
+    iters = cfg.iterations or 8
+    opt = SGDOptimizer(lr=cfg.learning_rate or 0.1)
+
+    params = init_params(jax.random.PRNGKey(cfg.seed))
+    opt_state = opt.init_state(params)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        params, opt_state = opt.update(params, grads, opt_state)
+        return params, opt_state, loss
+
+    rng = np.random.RandomState(cfg.seed)
+    loss = None
+    t0 = time.perf_counter()
+    for epoch in range(cfg.epochs):
+        for _ in range(iters):
+            b = {k: jnp.asarray(v) for k, v in synthetic_batch(rng, batch).items()}
+            params, opt_state, loss = train_step(params, opt_state, b)
+    loss = float(np.asarray(loss))
+    elapsed = time.perf_counter() - t0
+    n = batch * iters * cfg.epochs
+    # reference examples print exactly this (e.g. transformer.cc:209)
+    print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {n / elapsed:.2f} samples/s")
+    print(f"final loss {loss:.4f}")
+    assert np.isfinite(loss)
+
+
+if __name__ == "__main__":
+    main()

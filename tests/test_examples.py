@@ -20,6 +20,7 @@ EXAMPLES = [
     "mlp",
     "moe",
     "mt5_encoder",
+    "nmt",
     "resnet",
     "resnext",
     "split_test",
@@ -49,3 +50,34 @@ def test_split_test_runs():
 
 def test_candle_uno_runs():
     _run_main("candle_uno", ["-b", "8", "-i", "2", "-e", "1"])
+
+
+def test_nmt_runs_and_learns():
+    # 30 iterations of the copy task must beat the uniform-vocab loss
+    import examples.nmt as nmt
+
+    _run_main("nmt", ["-b", "16", "-i", "2", "-e", "1"])
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    params = nmt.init_params(jax.random.PRNGKey(0))
+    from flexflow_tpu import SGDOptimizer
+
+    opt = SGDOptimizer(lr=0.5)
+    state = opt.init_state(params)
+
+    @jax.jit
+    def step(params, state, b):
+        loss, grads = jax.value_and_grad(nmt.loss_fn)(params, b)
+        params, state = opt.update(params, grads, state)
+        return params, state, loss
+
+    rng = np.random.RandomState(0)
+    first = None
+    for i in range(30):
+        b = {k: jnp.asarray(v) for k, v in nmt.synthetic_batch(rng, 16).items()}
+        params, state, loss = step(params, state, b)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
