@@ -109,7 +109,7 @@ def main():
     cfg = FFConfig.parse_args()
     batch = cfg.batch_size
     iters = cfg.iterations or 8
-    opt = SGDOptimizer(lr=cfg.learning_rate or 0.1)
+    opt = SGDOptimizer(lr=cfg.learning_rate)
 
     params = init_params(jax.random.PRNGKey(cfg.seed))
     opt_state = opt.init_state(params)

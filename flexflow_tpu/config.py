@@ -156,6 +156,8 @@ class FFConfig:
                 cfg.seed = int(take())
             elif a == "--compgraph":
                 cfg.computation_graph_file = take()
+            elif a == "--include-costs-dot-graph":
+                cfg.include_costs_dot_graph = True
             elif a == "--taskgraph":
                 cfg.task_graph_file = take()
             elif a == "--nodes":

@@ -706,10 +706,40 @@ class FFModel:
         self.params = self.executor.init_params(init_key)
         self.opt_state = self.optimizer.init_state(self.params)
 
+        if self.config.computation_graph_file or self.config.task_graph_file:
+            # cost the artifacts with the SAME machine description the
+            # search uses (--chip / --machine-model-*), not defaults
+            from flexflow_tpu.core.machine import MachineSpec
+            from flexflow_tpu.search.machine_model import build_machine_model
+
+            spec = MachineSpec(
+                num_nodes=max(1, self.config.num_nodes),
+                chips_per_node=max(
+                    1, len(devices) // max(1, self.config.num_nodes)
+                ),
+                chip=self.config.chip,
+            )
+            mm = build_machine_model(self.config, spec)
         if self.config.computation_graph_file:
             from flexflow_tpu.utils.dot import export_pcg_dot
 
-            export_pcg_dot(self.graph, self.config.computation_graph_file)
+            export_pcg_dot(
+                self.graph,
+                self.config.computation_graph_file,
+                include_costs=self.config.include_costs_dot_graph,
+                spec=spec,
+                machine_model=mm,
+            )
+        if self.config.task_graph_file:
+            from flexflow_tpu.utils.dot import export_task_graph_dot
+
+            export_task_graph_dot(
+                self.graph,
+                self.config.task_graph_file,
+                self.strategy.mesh_config.axis_sizes,
+                spec=spec,
+                machine_model=mm,
+            )
 
     # ------------------------------------------------------------- training
 
@@ -814,6 +844,49 @@ class FFModel:
 
     def zero_gradients(self):
         pass  # gradients are functional; nothing to zero
+
+    def backward(self):
+        """reference: FFModel::backward (model.cc:2432). Subsumed: the
+        jitted train step computes grads via jax.value_and_grad."""
+
+    def update(self):
+        """reference: FFModel::update (model.cc:2463). Subsumed: the jitted
+        train step applies the optimizer in the same program."""
+
+    def init_operators(self):
+        """reference: FFModel::init_operators (model.cc:2403 — per-op INIT
+        index tasks allocating OpMeta). Here it AOT-compiles the train step
+        on zero-filled example shapes (jit is lazy, so merely building the
+        jitted callable would compile nothing) — the first fit() iteration
+        then hits the compile cache instead of stalling."""
+        if self.executor is None:
+            raise RuntimeError("call compile() before init_operators()")
+        step = self.executor.train_step()
+        zeros = {
+            name: np.zeros(
+                tuple(d.size for d in shape.dims if not d.is_replica_dim),
+                shape.dtype.to_jnp(),  # jnp scalar types are np-compatible
+            )
+            for name, shape in self.executor.input_shapes().items()
+        }
+        sharded = self.executor.shard_batch(zeros)
+        step.lower(
+            self.params, self.opt_state, sharded, jax.random.PRNGKey(0)
+        ).compile()
+
+    def begin_trace(self, trace_id: int = 0):
+        """reference: runtime->begin_trace (transformer.cc:192 — Legion
+        capture-and-replay). Subsumed by jit compilation caching."""
+
+    def end_trace(self, trace_id: int = 0):
+        """See begin_trace."""
+
+    def profile_operators(self, batch, iters: int = 5, verbose: bool = True):
+        """Per-op forward timing table (reference: --profiling per-kernel
+        cudaEvent prints, kernels/linear_kernels.cu:95-117)."""
+        from flexflow_tpu.utils.profiling import profile_operators
+
+        return profile_operators(self, batch, iters=iters, verbose=verbose)
 
     def recompile_on_condition(self, state) -> bool:
         """Mid-training model mutation + recompile (reference:

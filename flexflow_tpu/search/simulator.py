@@ -130,6 +130,7 @@ def estimate_graph_cost(
     include_backward: bool = True,
     optimizer_state_factor: float = 3.0,
     mode: str = "taskgraph",
+    export: Optional[Dict] = None,
 ) -> GraphCost:
     """Estimate one training-iteration time for an annotated PCG.
 
@@ -150,16 +151,18 @@ def estimate_graph_cost(
     # SimTask arrays (taskgraph mode)
     resource_of: List[int] = []
     duration: List[float] = []
+    names: List[str] = []
     edges: List[Tuple[int, int]] = []
     fwd_task: Dict[int, int] = {}
     bwd_task: Dict[int, int] = {}
     bwd_comm: Dict[int, float] = {}
 
-    def add_task(resource: int, dur: float) -> int:
+    def add_task(resource: int, dur: float, name: str = "") -> int:
         if not taskgraph:
             return -1
         resource_of.append(resource)
         duration.append(dur)
+        names.append(name)
         return len(resource_of) - 1
 
     def add_edge(src: int, dst: int):
@@ -176,12 +179,14 @@ def estimate_graph_cost(
 
         if node.op_type == OperatorType.INPUT:
             act_bytes += sum(s.piece_bytes() for s in node.output_shapes)
-            t = add_task(_CHIP, 0.0)
+            t = add_task(_CHIP, 0.0, f"{node.name}.in")
         elif node.is_parallel_op:
             f, b = _parallel_op_comm(node, in_shapes, cm, mesh_sizes)
             total.comm_time += f + (b if include_backward else 0.0)
             per_node_cost[guid] = OpCost(0.0, 0.0, 0.0, 0)
-            t = add_task(link(_collective_axis(node, mesh_sizes)), f)
+            t = add_task(
+                link(_collective_axis(node, mesh_sizes)), f, f"{node.name}.fwd"
+            )
             bwd_comm[guid] = b
         else:
             cost = cm.op_cost(node, in_shapes)
@@ -190,7 +195,7 @@ def estimate_graph_cost(
             if include_backward:
                 total.compute_time += cost.backward_time
             act_bytes += sum(s.piece_bytes() for s in node.output_shapes)
-            t = add_task(_CHIP, cost.forward_time)
+            t = add_task(_CHIP, cost.forward_time, f"{node.name}.fwd")
         fwd_task[guid] = t
         for r in node.inputs:
             if r.guid in fwd_task:
@@ -206,9 +211,12 @@ def estimate_graph_cost(
                 t = add_task(
                     link(_collective_axis(node, mesh_sizes)),
                     bwd_comm.get(guid, 0.0),
+                    f"{node.name}.bwd",
                 )
             else:
-                t = add_task(_CHIP, per_node_cost[guid].backward_time)
+                t = add_task(
+                    _CHIP, per_node_cost[guid].backward_time, f"{node.name}.bwd"
+                )
             bwd_task[guid] = t
             add_edge(fwd_task[guid], t)  # bwd after own fwd
             for c in graph.consumers(guid):
@@ -238,7 +246,7 @@ def estimate_graph_cost(
                 t_sync += cm.all_reduce(w.piece_bytes(), g, chips=chips)
         if include_backward and t_sync > 0:
             total.sync_time += t_sync
-            t = add_task(link(0), t_sync)
+            t = add_task(link(0), t_sync, f"{node.name}.sync")
             add_edge(bwd_task.get(guid, fwd_task[guid]), t)
 
     total.memory_per_chip = int(weight_bytes * optimizer_state_factor + act_bytes)
@@ -246,6 +254,15 @@ def estimate_graph_cost(
     if not taskgraph:
         total.step_time = total.compute_time + total.comm_time + total.sync_time
         return total
+
+    if export is not None:
+        export.update(
+            resource_of=list(resource_of),
+            duration=list(duration),
+            names=list(names),
+            edges=list(edges),
+            num_resources=num_resources,
+        )
 
     from flexflow_tpu import native
 

@@ -1,12 +1,40 @@
-"""DOT export of the PCG (reference: src/utils/dot/, graph.cc print_dot —
-the --compgraph/--taskgraph artifacts, SURVEY §2.1)."""
+"""DOT export of the PCG and the simulated task graph (reference:
+src/utils/dot/, graph.cc export_strategy_computation_graph — the
+--compgraph / --taskgraph / --include-costs-dot-graph artifacts,
+SURVEY §2.1/§5)."""
 
 from __future__ import annotations
+
+from typing import Dict, Optional
 
 from flexflow_tpu.core.pcg import PCGGraph
 
 
-def pcg_to_dot(graph: PCGGraph, include_costs: bool = False) -> str:
+def pcg_to_dot(
+    graph: PCGGraph,
+    include_costs: bool = False,
+    spec=None,
+    machine_model=None,
+) -> str:
+    """include_costs annotates each node with the analytic roofline cost
+    (reference: --include-costs-dot-graph) — costed with the caller's
+    machine description so the artifact matches what the search saw."""
+    cost_of = {}
+    if include_costs:
+        from flexflow_tpu.core.machine import MachineSpec
+        from flexflow_tpu.search.cost_model import CostModel
+
+        cm = CostModel(spec or MachineSpec(), machine_model=machine_model)
+        for guid in graph.topo_order():
+            node = graph.nodes[guid]
+            if node.inputs and not node.is_parallel_op:
+                in_shapes = [graph.shape_of(r) for r in node.inputs]
+                try:
+                    c = cm.op_cost(node, in_shapes)
+                    cost_of[guid] = c.forward_time + c.backward_time
+                except Exception:
+                    pass
+
     lines = ["digraph PCG {", "  rankdir=TB;"]
     for guid in graph.topo_order():
         node = graph.nodes[guid]
@@ -14,10 +42,13 @@ def pcg_to_dot(graph: PCGGraph, include_costs: bool = False) -> str:
         mv = ""
         if node.machine_view is not None:
             mv = f"\\nview={node.machine_view.dims}@{node.machine_view.start_device_id}"
+        cost = ""
+        if guid in cost_of:
+            cost = f"\\ncost={cost_of[guid] * 1e6:.1f}us"
         color = "lightblue" if node.is_parallel_op else "white"
         lines.append(
             f'  n{guid} [label="{node.name}\\n{node.op_type.name}'
-            f'\\n{shape_str}{mv}", style=filled, fillcolor={color}, shape=box];'
+            f'\\n{shape_str}{mv}{cost}", style=filled, fillcolor={color}, shape=box];'
         )
         for ref in node.inputs:
             lines.append(f"  n{ref.guid} -> n{guid};")
@@ -25,6 +56,50 @@ def pcg_to_dot(graph: PCGGraph, include_costs: bool = False) -> str:
     return "\n".join(lines)
 
 
-def export_pcg_dot(graph: PCGGraph, path: str, include_costs: bool = False):
+def export_pcg_dot(
+    graph: PCGGraph,
+    path: str,
+    include_costs: bool = False,
+    spec=None,
+    machine_model=None,
+):
     with open(path, "w") as f:
-        f.write(pcg_to_dot(graph, include_costs))
+        f.write(pcg_to_dot(graph, include_costs, spec, machine_model))
+
+
+def task_graph_to_dot(export: Dict) -> str:
+    """Render the simulator's SimTask DAG (reference: the --taskgraph dump
+    of simulate_runtime, simulator.h:715). `export` is the dict filled by
+    estimate_graph_cost(..., export=...): resource_of / duration / names /
+    edges / num_resources."""
+    res_color = ["white", "lightyellow", "lightpink", "lightcyan"]
+    lines = ["digraph TaskGraph {", "  rankdir=LR;"]
+    for i, (r, d, name) in enumerate(
+        zip(export["resource_of"], export["duration"], export["names"])
+    ):
+        kind = "chip" if r == 0 else f"link{r - 1}"
+        color = res_color[min(r, len(res_color) - 1)]
+        lines.append(
+            f'  t{i} [label="{name}\\n{kind} {d * 1e6:.1f}us", '
+            f"style=filled, fillcolor={color}, shape=box];"
+        )
+    for s, d in export["edges"]:
+        lines.append(f"  t{s} -> t{d};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def export_task_graph_dot(
+    graph: PCGGraph, path: str, mesh_sizes, spec=None, machine_model=None
+):
+    """Build the simulated task graph for the CURRENT annotated PCG and
+    write it as DOT (the --taskgraph artifact)."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.simulator import estimate_graph_cost
+
+    cm = CostModel(spec or MachineSpec(), machine_model=machine_model)
+    export: Dict = {}
+    estimate_graph_cost(graph, cm, mesh_sizes, export=export)
+    with open(path, "w") as f:
+        f.write(task_graph_to_dot(export))

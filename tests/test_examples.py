@@ -53,7 +53,6 @@ def test_candle_uno_runs():
 
 
 def test_nmt_runs_and_learns():
-    # 30 iterations of the copy task must beat the uniform-vocab loss
     import examples.nmt as nmt
 
     _run_main("nmt", ["-b", "16", "-i", "2", "-e", "1"])
@@ -61,10 +60,10 @@ def test_nmt_runs_and_learns():
     import jax.numpy as jnp
     import numpy as np
 
-    params = nmt.init_params(jax.random.PRNGKey(0))
-    from flexflow_tpu import SGDOptimizer
+    from flexflow_tpu import AdamOptimizer
 
-    opt = SGDOptimizer(lr=0.5)
+    params = nmt.init_params(jax.random.PRNGKey(0))
+    opt = AdamOptimizer(alpha=0.01)
     state = opt.init_state(params)
 
     @jax.jit
@@ -73,11 +72,10 @@ def test_nmt_runs_and_learns():
         params, state = opt.update(params, grads, state)
         return params, state, loss
 
+    # memorize one fixed batch: must crush the uniform-vocab baseline
+    # ln(VOCAB) ≈ 5.55 — catches any break in the LSTM recurrence/grads
     rng = np.random.RandomState(0)
-    first = None
-    for i in range(30):
-        b = {k: jnp.asarray(v) for k, v in nmt.synthetic_batch(rng, 16).items()}
+    b = {k: jnp.asarray(v) for k, v in nmt.synthetic_batch(rng, 16).items()}
+    for _ in range(60):
         params, state, loss = step(params, state, b)
-        if first is None:
-            first = float(loss)
-    assert float(loss) < first
+    assert float(loss) < 2.0

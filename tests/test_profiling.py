@@ -1,0 +1,69 @@
+"""Profiling + artifact-export tests (reference: --profiling per-kernel
+timing, --taskgraph/--compgraph dumps with costs; SURVEY §5)."""
+
+import numpy as np
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+
+
+def _model(tmp_path=None, **cfg_kw):
+    cfg = FFConfig(batch_size=16)
+    for k, v in cfg_kw.items():
+        setattr(cfg, k, v)
+    model = FFModel(cfg)
+    x = model.create_tensor([16, 32], name="x")
+    t = model.dense(x, 32, activation=ActiMode.RELU, name="d0")
+    t = model.dense(t, 4, name="head")
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    return model
+
+
+def test_profile_operators_returns_rows():
+    model = _model()
+    batch = {"x": np.random.RandomState(0).randn(16, 32).astype(np.float32)}
+    rows = model.profile_operators(batch, iters=2, verbose=False)
+    names = {n for n, _ in rows}
+    assert {"d0", "head"} <= names
+    assert all(t >= 0 for _, t in rows)
+    # sorted slowest-first
+    times = [t for _, t in rows]
+    assert times == sorted(times, reverse=True)
+
+
+def test_compgraph_with_costs_and_taskgraph_export(tmp_path):
+    comp = tmp_path / "comp.dot"
+    task = tmp_path / "task.dot"
+    _model(
+        computation_graph_file=str(comp),
+        task_graph_file=str(task),
+        include_costs_dot_graph=True,
+    )
+    comp_text = comp.read_text()
+    assert "digraph PCG" in comp_text
+    assert "cost=" in comp_text  # --include-costs-dot-graph
+    task_text = task.read_text()
+    assert "digraph TaskGraph" in task_text
+    assert ".fwd" in task_text and ".bwd" in task_text and ".sync" in task_text
+
+
+def test_compat_verbs():
+    model = _model()
+    model.init_operators()  # pre-compiles the step
+    model.begin_trace(111)
+    model.zero_gradients()
+    model.backward()
+    model.update()
+    model.end_trace(111)
+
+
+def test_trace_context_manager(tmp_path):
+    from flexflow_tpu.utils import profiling
+
+    model = _model()
+    batch = {"x": np.random.RandomState(0).randn(16, 32).astype(np.float32)}
+    with profiling.trace(str(tmp_path / "trace")):
+        model.forward(batch)
