@@ -1,0 +1,71 @@
+/* C-API demo: build and train an MLP from C (reference: the C++ examples
+ * linking the FlexFlow C++ API, e.g. examples/cpp/MLP_Unify/mlp.cc).
+ *
+ * Build (after `make -C native capi`):
+ *   gcc examples/capi_mlp.c -Inative/include -Lnative/build -lflexflow_c \
+ *       -Wl,-rpath,native/build -o /tmp/capi_mlp
+ *   FF_CAPI_PLATFORM=cpu /tmp/capi_mlp
+ */
+
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "flexflow_c.h"
+
+int main(int argc, char **argv) {
+  if (flexflow_init(argc, argv) != 0) return 1;
+
+  char *cfg_argv[] = {(char *)"-b", (char *)"16"};
+  flexflow_config_t cfg = flexflow_config_create(2, cfg_argv);
+  flexflow_model_t model = flexflow_model_create(cfg);
+
+  int dims[2] = {16, 32};
+  flexflow_tensor_t x = flexflow_tensor_create(model, 2, dims, "x");
+  flexflow_tensor_t t =
+      flexflow_model_add_dense(model, x, 32, /*relu=*/1, /*bias=*/1);
+  t = flexflow_model_add_dense(model, t, 4, /*none=*/0, /*bias=*/1);
+  if (t == NULL) return 1;
+
+  if (flexflow_model_compile(model, "sparse_categorical_crossentropy",
+                             "accuracy", 0.1) != 0)
+    return 1;
+
+  /* synthetic learnable data: label = argmax of 4 fixed feature sums */
+  enum { N = 64, D = 32, C = 4 };
+  static float xs[N * D];
+  static int32_t ys[N];
+  unsigned seed = 7;
+  for (int i = 0; i < N; ++i) {
+    float best = -1e9f;
+    int cls = 0;
+    for (int j = 0; j < D; ++j) {
+      seed = seed * 1103515245u + 12345u;
+      xs[i * D + j] = ((float)(seed >> 16 & 0x7fff) / 16384.0f) - 1.0f;
+    }
+    for (int c = 0; c < C; ++c) {
+      float s = 0.f;
+      for (int j = c; j < D; j += C) s += xs[i * D + j];
+      if (s > best) {
+        best = s;
+        cls = c;
+      }
+    }
+    ys[i] = cls;
+  }
+  int64_t x_shape[2] = {N, D};
+  int64_t y_shape[1] = {N};
+  double loss = flexflow_model_fit(model, xs, x_shape, 2, ys, y_shape, 1,
+                                   /*y_is_int=*/1, /*epochs=*/4);
+  if (isnan(loss)) return 1;
+  printf("final loss %.4f\n", loss);
+
+  flexflow_handle_destroy(t);
+  flexflow_handle_destroy(x);
+  flexflow_handle_destroy(model);
+  flexflow_handle_destroy(cfg);
+  flexflow_finalize();
+  printf("capi_mlp ok\n");
+  return 0;
+}

@@ -1,0 +1,158 @@
+"""Python side of the C API (native/src/flexflow_c.cc).
+
+The C layer embeds CPython and calls these flat helpers with primitive
+arguments only (ints, floats, strings, raw addresses) — all object
+plumbing stays here. Mirrors the role of the reference's flexflow_c.cc
+body (reference: python/flexflow_c.cc:1884 LoC of handle unwrapping).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Sequence
+
+import numpy as np
+
+
+def _maybe_force_platform():
+    """The embedded interpreter cannot rely on conftest: honor
+    FF_CAPI_PLATFORM (e.g. "cpu") before any backend touch."""
+    plat = os.environ.get("FF_CAPI_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+_maybe_force_platform()
+
+from flexflow_tpu import (  # noqa: E402
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.core.types import PoolType  # noqa: E402
+
+_ACTI = {
+    0: ActiMode.NONE,
+    1: ActiMode.RELU,
+    2: ActiMode.SIGMOID,
+    3: ActiMode.TANH,
+    4: ActiMode.GELU,
+}
+_LOSS = {
+    "sparse_categorical_crossentropy": LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "categorical_crossentropy": LossType.CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+}
+_METRIC = {
+    "accuracy": MetricsType.ACCURACY,
+    "sparse_categorical_crossentropy": MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": MetricsType.MEAN_SQUARED_ERROR,
+}
+
+
+def config_create(argv: Sequence[str]) -> FFConfig:
+    return FFConfig.parse_args(list(argv))
+
+
+def model_create(config: FFConfig) -> FFModel:
+    return FFModel(config)
+
+
+def tensor_create(model: FFModel, dims: Sequence[int], name: str):
+    return model.create_tensor(list(dims), name=name or None)
+
+
+def add_dense(model, t, out_features, activation, use_bias):
+    return model.dense(
+        t, out_features, activation=_ACTI[activation], use_bias=bool(use_bias)
+    )
+
+
+def add_conv2d(model, t, oc, kh, kw, sh, sw, ph, pw, activation):
+    return model.conv2d(
+        t, oc, kh, kw, sh, sw, ph, pw, activation=_ACTI[activation]
+    )
+
+
+def add_pool2d(model, t, kh, kw, sh, sw, ph, pw, pool_type):
+    return model.pool2d(
+        t, kh, kw, sh, sw, ph, pw,
+        pool_type=PoolType.MAX if pool_type == 0 else PoolType.AVG,
+    )
+
+
+def add_flat(model, t):
+    return model.flat(t)
+
+
+def add_embedding(model, t, num_entries, out_dim):
+    return model.embedding(t, num_entries, out_dim)
+
+
+def add_multihead_attention(model, q, k, v, embed_dim, num_heads):
+    return model.multihead_attention(q, k, v, embed_dim, num_heads)
+
+
+def add_unary(model, op: str, t):
+    return getattr(model, op)(t)
+
+
+def add_binary(model, op: str, a, b):
+    return getattr(model, op)(a, b)
+
+
+def add_softmax(model, t):
+    return model.softmax(t)
+
+
+def add_dropout(model, t, rate):
+    return model.dropout(t, rate=float(rate))
+
+
+def compile_model(model, loss: str, metrics: str, learning_rate: float):
+    if loss not in _LOSS:
+        raise ValueError(f"unknown loss {loss!r}; one of {sorted(_LOSS)}")
+    mets = []
+    for m in (metrics or "").split(","):
+        m = m.strip()
+        if m:
+            if m not in _METRIC:
+                raise ValueError(f"unknown metric {m!r}")
+            mets.append(_METRIC[m])
+    model.compile(
+        optimizer=SGDOptimizer(lr=learning_rate),
+        loss_type=_LOSS[loss],
+        metrics=mets,
+    )
+
+
+def _array_from_ptr(addr: int, shape, dtype) -> np.ndarray:
+    n = int(np.prod(shape))
+    itemsize = np.dtype(dtype).itemsize
+    buf = (ctypes.c_char * (n * itemsize)).from_address(addr)
+    # copy: the caller's buffer lifetime ends when the C call returns
+    return np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+
+
+def fit_ptr(
+    model,
+    x_addr: int,
+    x_shape,
+    y_addr: int,
+    y_shape,
+    y_is_int: int,
+    epochs: int,
+) -> float:
+    x = _array_from_ptr(x_addr, tuple(x_shape), np.float32)
+    y = _array_from_ptr(
+        y_addr, tuple(y_shape), np.int32 if y_is_int else np.float32
+    )
+    hist = model.fit(x, y, epochs=int(epochs), verbose=False)
+    last = hist[-1]
+    return float(last["loss_sum"] / max(last["train_all"], 1))

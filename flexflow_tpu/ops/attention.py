@@ -130,6 +130,29 @@ def _seq_parallel_axes(ctx):
     return seq_ax, batch_ax, head_ax
 
 
+# "auto" flash selection: dense attention on TPU beats the blockwise path
+# until the [b, h, sq, sk] f32 score tensor threatens HBM (measured on v5e:
+# dense fwd+bwd is ~4-5x faster than blockwise at seq 512-2048), so the
+# switch is on PER-DEVICE score-tensor BYTES, not sequence length.
+_FLASH_SCORE_BYTES = 2 << 30
+
+
+def _auto_flash(batch, heads, sq, sk, ctx=None) -> bool:
+    # under jit the array shapes are GLOBAL; divide out the sharding so a
+    # data-parallel pod doesn't get blockwise where its per-chip slice is
+    # tiny (degrees come from the q input's parallel shape)
+    if ctx is not None and ctx.in_shapes:
+        qshape = ctx.in_shapes[0]
+        logical = [d for d in qshape.dims if not d.is_replica_dim]
+        rep = [d for d in qshape.dims if d.is_replica_dim]
+        if len(logical) == 3:
+            batch //= max(1, logical[0].degree)
+            sq //= max(1, logical[1].degree)
+            if rep:  # head-parallel replica degree shards the heads
+                heads //= max(1, rep[0].degree)
+    return batch * heads * sq * sk * 4 > _FLASH_SCORE_BYTES
+
+
 def _lower_mha(params):
     causal = params.get("causal", False)
     use_flash = params.get("use_flash", "auto")
@@ -156,7 +179,15 @@ def _lower_mha(params):
         qh = jax.lax.with_sharding_constraint(q, head_spec)
         kh = jax.lax.with_sharding_constraint(k, head_spec)
         vh = jax.lax.with_sharding_constraint(v, head_spec)
-        if use_flash is True or (use_flash == "auto" and q.shape[1] >= 1024):
+        # per-device geometry after the seq→head reshard: full sequence,
+        # heads divided by the seq-axis degree, batch by the data axis
+        b, s, h, _ = qh.shape
+        sp_deg = ctx.mesh.shape[seq_ax]
+        b_local = b // (ctx.mesh.shape[batch_ax] if batch_ax else 1)
+        if use_flash is True or (
+            use_flash == "auto"
+            and _auto_flash(b_local, h // sp_deg, s, s)
+        ):
             from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
             attn = flash_attention(qh, kh, vh, causal=causal)
@@ -228,8 +259,12 @@ def _lower_mha(params):
                 )
         else:
             flash = (
-                use_flash is True or (use_flash == "auto" and seq >= 1024)
-            ) and not dropping  # the Pallas kernel has no prob-dropout path
+                use_flash is True
+                or (
+                    use_flash == "auto"
+                    and _auto_flash(q.shape[0], q.shape[2], seq, k.shape[1])
+                )
+            ) and not dropping  # the blockwise kernel has no prob-dropout path
             if flash:
                 from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 

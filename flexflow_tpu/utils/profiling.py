@@ -39,10 +39,14 @@ def profile_operators(
     for guid in ex.topo:
         node = ex.graph.nodes[guid]
         if node.op_type in (OperatorType.INPUT, OperatorType.NOOP) and not node.inputs:
+            if node.name not in sharded:
+                raise KeyError(f"batch missing input '{node.name}'")
             values[(guid, 0)] = sharded[node.name]
             continue
         ins = [values[(r.guid, r.out_idx)] for r in node.inputs]
         ws = model.params.get(guid, [])
+        # mirror Executor.forward_values' ctx so profiled shapes match the
+        # real step (seq_length truncation included)
         ctx = LowerCtx(
             train=False,
             rng=None,
@@ -50,6 +54,7 @@ def profile_operators(
             axis_names=ex.mesh_config.axis_names,
             in_shapes=[ex.graph.shape_of(r) for r in node.inputs],
             bf16_matmul=ex.mixed_precision,
+            seq_length=ex.seq_length,
         )
         fn = ex._lowered[guid]
         jitted = jax.jit(lambda i, w, _fn=fn, _ctx=ctx: _fn(i, w, _ctx))

@@ -1,0 +1,111 @@
+/* Flat C API over the flexflow_tpu framework.
+ *
+ * Rebuild of the reference's C API (reference: python/flexflow_c.h, 681
+ * lines of flexflow_* handle functions over FFModel). The reference's C
+ * API exists so Python can drive the C++ core; this framework is
+ * Python-first on JAX, so the direction inverts: the C API embeds the
+ * CPython runtime and drives the Python core, letting C/C++ programs
+ * build, compile, and train models with the same flat handle-based
+ * surface.
+ *
+ * All handles are opaque; every flexflow_* call returns NULL / non-zero on
+ * failure with the Python error printed to stderr. Not thread-safe (one
+ * embedded interpreter).
+ */
+
+#ifndef FLEXFLOW_C_H
+#define FLEXFLOW_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *flexflow_config_t;
+typedef void *flexflow_model_t;
+typedef void *flexflow_tensor_t;
+
+/* runtime ------------------------------------------------------------- */
+
+/* Start the embedded interpreter and import the framework. argc/argv are
+ * accepted for signature parity with the reference but not consumed —
+ * pass CLI args (reference spellings: -b, --budget, ...) to
+ * flexflow_config_create instead. Returns 0 on success. */
+int flexflow_init(int argc, char **argv);
+void flexflow_finalize(void);
+
+/* config / model ------------------------------------------------------- */
+
+flexflow_config_t flexflow_config_create(int argc, char **argv);
+flexflow_model_t flexflow_model_create(flexflow_config_t config);
+
+/* tensors -------------------------------------------------------------- */
+
+flexflow_tensor_t flexflow_tensor_create(flexflow_model_t model, int ndims,
+                                         const int *dims, const char *name);
+
+/* layer builders (reference: flexflow_model_add_* in flexflow_c.h) ----- */
+
+/* activation: 0 = none, 1 = relu, 2 = sigmoid, 3 = tanh, 4 = gelu */
+flexflow_tensor_t flexflow_model_add_dense(flexflow_model_t model,
+                                           flexflow_tensor_t input,
+                                           int out_features, int activation,
+                                           int use_bias);
+flexflow_tensor_t flexflow_model_add_conv2d(flexflow_model_t model,
+                                            flexflow_tensor_t input,
+                                            int out_channels, int kernel_h,
+                                            int kernel_w, int stride_h,
+                                            int stride_w, int padding_h,
+                                            int padding_w, int activation);
+flexflow_tensor_t flexflow_model_add_pool2d(flexflow_model_t model,
+                                            flexflow_tensor_t input,
+                                            int kernel_h, int kernel_w,
+                                            int stride_h, int stride_w,
+                                            int padding_h, int padding_w,
+                                            int pool_type /*0 max, 1 avg*/);
+flexflow_tensor_t flexflow_model_add_flat(flexflow_model_t model,
+                                          flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_embedding(flexflow_model_t model,
+                                               flexflow_tensor_t input,
+                                               int num_entries, int out_dim);
+flexflow_tensor_t flexflow_model_add_multihead_attention(
+    flexflow_model_t model, flexflow_tensor_t query, flexflow_tensor_t key,
+    flexflow_tensor_t value, int embed_dim, int num_heads);
+flexflow_tensor_t flexflow_model_add_unary(flexflow_model_t model,
+                                           const char *op /* "relu" ... */,
+                                           flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_binary(flexflow_model_t model,
+                                            const char *op /* "add" ... */,
+                                            flexflow_tensor_t a,
+                                            flexflow_tensor_t b);
+flexflow_tensor_t flexflow_model_add_softmax(flexflow_model_t model,
+                                             flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_dropout(flexflow_model_t model,
+                                             flexflow_tensor_t input,
+                                             float rate);
+
+/* compile / train ------------------------------------------------------ */
+
+/* loss: "sparse_categorical_crossentropy" | "categorical_crossentropy" |
+ * "mean_squared_error"; metrics: "accuracy" (may be NULL). Returns 0 on
+ * success. */
+int flexflow_model_compile(flexflow_model_t model, const char *loss,
+                           const char *metrics, double learning_rate);
+
+/* x: float32 [n, ...input dims]; y: int32 [n] (sparse CE) or float32.
+ * Returns the final epoch's average loss, or NaN on failure. */
+double flexflow_model_fit(flexflow_model_t model, const float *x,
+                          const int64_t *x_shape, int x_ndims, const void *y,
+                          const int64_t *y_shape, int y_ndims, int y_is_int,
+                          int epochs);
+
+/* handles -------------------------------------------------------------- */
+
+void flexflow_handle_destroy(void *handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* FLEXFLOW_C_H */

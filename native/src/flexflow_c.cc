@@ -1,0 +1,227 @@
+// Flat C API implementation: embeds CPython and drives the Python core
+// through flexflow_tpu.capi_shim (see native/include/flexflow_c.h for the
+// design note; reference: python/flexflow_c.cc — the same surface in the
+// opposite direction).
+
+#include "../include/flexflow_c.h"
+
+#include <Python.h>
+
+#include <cmath>
+#include <cstdio>
+#include <vector>
+
+namespace {
+
+PyObject *g_shim = nullptr;  // flexflow_tpu.capi_shim module
+
+void print_error() {
+  if (PyErr_Occurred()) PyErr_Print();
+}
+
+// Call shim.<fn>(*args); returns a NEW reference or nullptr.
+PyObject *shim_call(const char *fn, PyObject *args) {
+  if (g_shim == nullptr) {
+    std::fprintf(stderr, "flexflow_c: flexflow_init() not called\n");
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(g_shim, fn);
+  if (f == nullptr) {
+    print_error();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (out == nullptr) print_error();
+  return out;
+}
+
+PyObject *int_list(const int *v, int n) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i) PyList_SET_ITEM(l, i, PyLong_FromLong(v[i]));
+  return l;
+}
+
+PyObject *int64_list(const int64_t *v, int n) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyLong_FromLongLong(v[i]));
+  return l;
+}
+
+}  // namespace
+
+extern "C" {
+
+int flexflow_init(int argc, char **argv) {
+  if (g_shim != nullptr) return 0;
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  // make the working directory importable (the embedded interpreter has no
+  // script directory on sys.path)
+  PyRun_SimpleString("import sys, os; sys.path.insert(0, os.getcwd())");
+  g_shim = PyImport_ImportModule("flexflow_tpu.capi_shim");
+  if (g_shim == nullptr) {
+    print_error();
+    return 1;
+  }
+  (void)argc;
+  (void)argv;
+  return 0;
+}
+
+void flexflow_finalize(void) {
+  Py_XDECREF(g_shim);
+  g_shim = nullptr;
+  if (Py_IsInitialized()) Py_FinalizeEx();
+}
+
+flexflow_config_t flexflow_config_create(int argc, char **argv) {
+  PyObject *l = PyList_New(argc);
+  for (int i = 0; i < argc; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(argv[i]));
+  return shim_call("config_create", Py_BuildValue("(N)", l));
+}
+
+flexflow_model_t flexflow_model_create(flexflow_config_t config) {
+  return shim_call("model_create",
+                   Py_BuildValue("(O)", (PyObject *)config));
+}
+
+flexflow_tensor_t flexflow_tensor_create(flexflow_model_t model, int ndims,
+                                         const int *dims, const char *name) {
+  return shim_call(
+      "tensor_create",
+      Py_BuildValue("(ONs)", (PyObject *)model, int_list(dims, ndims),
+                    name ? name : ""));
+}
+
+flexflow_tensor_t flexflow_model_add_dense(flexflow_model_t model,
+                                           flexflow_tensor_t input,
+                                           int out_features, int activation,
+                                           int use_bias) {
+  return shim_call("add_dense",
+                   Py_BuildValue("(OOiii)", (PyObject *)model,
+                                 (PyObject *)input, out_features, activation,
+                                 use_bias));
+}
+
+flexflow_tensor_t flexflow_model_add_conv2d(flexflow_model_t model,
+                                            flexflow_tensor_t input,
+                                            int out_channels, int kernel_h,
+                                            int kernel_w, int stride_h,
+                                            int stride_w, int padding_h,
+                                            int padding_w, int activation) {
+  return shim_call(
+      "add_conv2d",
+      Py_BuildValue("(OOiiiiiiii)", (PyObject *)model, (PyObject *)input,
+                    out_channels, kernel_h, kernel_w, stride_h, stride_w,
+                    padding_h, padding_w, activation));
+}
+
+flexflow_tensor_t flexflow_model_add_pool2d(flexflow_model_t model,
+                                            flexflow_tensor_t input,
+                                            int kernel_h, int kernel_w,
+                                            int stride_h, int stride_w,
+                                            int padding_h, int padding_w,
+                                            int pool_type) {
+  return shim_call(
+      "add_pool2d",
+      Py_BuildValue("(OOiiiiiii)", (PyObject *)model, (PyObject *)input,
+                    kernel_h, kernel_w, stride_h, stride_w, padding_h,
+                    padding_w, pool_type));
+}
+
+flexflow_tensor_t flexflow_model_add_flat(flexflow_model_t model,
+                                          flexflow_tensor_t input) {
+  return shim_call("add_flat", Py_BuildValue("(OO)", (PyObject *)model,
+                                             (PyObject *)input));
+}
+
+flexflow_tensor_t flexflow_model_add_embedding(flexflow_model_t model,
+                                               flexflow_tensor_t input,
+                                               int num_entries, int out_dim) {
+  return shim_call("add_embedding",
+                   Py_BuildValue("(OOii)", (PyObject *)model,
+                                 (PyObject *)input, num_entries, out_dim));
+}
+
+flexflow_tensor_t flexflow_model_add_multihead_attention(
+    flexflow_model_t model, flexflow_tensor_t query, flexflow_tensor_t key,
+    flexflow_tensor_t value, int embed_dim, int num_heads) {
+  return shim_call(
+      "add_multihead_attention",
+      Py_BuildValue("(OOOOii)", (PyObject *)model, (PyObject *)query,
+                    (PyObject *)key, (PyObject *)value, embed_dim,
+                    num_heads));
+}
+
+flexflow_tensor_t flexflow_model_add_unary(flexflow_model_t model,
+                                           const char *op,
+                                           flexflow_tensor_t input) {
+  return shim_call("add_unary", Py_BuildValue("(OsO)", (PyObject *)model, op,
+                                              (PyObject *)input));
+}
+
+flexflow_tensor_t flexflow_model_add_binary(flexflow_model_t model,
+                                            const char *op,
+                                            flexflow_tensor_t a,
+                                            flexflow_tensor_t b) {
+  return shim_call("add_binary",
+                   Py_BuildValue("(OsOO)", (PyObject *)model, op,
+                                 (PyObject *)a, (PyObject *)b));
+}
+
+flexflow_tensor_t flexflow_model_add_softmax(flexflow_model_t model,
+                                             flexflow_tensor_t input) {
+  return shim_call("add_softmax", Py_BuildValue("(OO)", (PyObject *)model,
+                                                (PyObject *)input));
+}
+
+flexflow_tensor_t flexflow_model_add_dropout(flexflow_model_t model,
+                                             flexflow_tensor_t input,
+                                             float rate) {
+  return shim_call("add_dropout",
+                   Py_BuildValue("(OOf)", (PyObject *)model,
+                                 (PyObject *)input, rate));
+}
+
+int flexflow_model_compile(flexflow_model_t model, const char *loss,
+                           const char *metrics, double learning_rate) {
+  PyObject *out = shim_call(
+      "compile_model",
+      Py_BuildValue("(Ossd)", (PyObject *)model, loss ? loss : "",
+                    metrics ? metrics : "", learning_rate));
+  if (out == nullptr) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+double flexflow_model_fit(flexflow_model_t model, const float *x,
+                          const int64_t *x_shape, int x_ndims, const void *y,
+                          const int64_t *y_shape, int y_ndims, int y_is_int,
+                          int epochs) {
+  PyObject *out = shim_call(
+      "fit_ptr",
+      Py_BuildValue("(OKNKNii)", (PyObject *)model,
+                    (unsigned long long)(uintptr_t)x,
+                    int64_list(x_shape, x_ndims),
+                    (unsigned long long)(uintptr_t)y,
+                    int64_list(y_shape, y_ndims), y_is_int, epochs));
+  if (out == nullptr) return NAN;
+  double v = PyFloat_AsDouble(out);
+  Py_DECREF(out);
+  if (PyErr_Occurred()) {
+    print_error();
+    return NAN;
+  }
+  return v;
+}
+
+void flexflow_handle_destroy(void *handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+
+}  // extern "C"

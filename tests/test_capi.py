@@ -1,0 +1,57 @@
+"""C API tests: compile the C demo against libflexflow_c and run it
+(reference: python/flexflow_c.{h,cc} — the flat handle API surface;
+here C embeds the Python core instead of Python wrapping C++)."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("make") is None,
+    reason="no C toolchain",
+)
+def test_capi_mlp_end_to_end(tmp_path):
+    build = subprocess.run(
+        ["make", "-C", os.path.join(ROOT, "native"), "capi"],
+        capture_output=True,
+        text=True,
+    )
+    assert build.returncode == 0, build.stderr
+    exe = str(tmp_path / "capi_mlp")
+    cc = subprocess.run(
+        [
+            "gcc",
+            os.path.join(ROOT, "examples", "capi_mlp.c"),
+            "-I" + os.path.join(ROOT, "native", "include"),
+            "-L" + os.path.join(ROOT, "native", "build"),
+            "-lflexflow_c",
+            "-Wl,-rpath," + os.path.join(ROOT, "native", "build"),
+            "-o",
+            exe,
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert cc.returncode == 0, cc.stderr
+    env = dict(os.environ)
+    env["FF_CAPI_PLATFORM"] = "cpu"
+    env.pop("PYTHONHOME", None)
+    run = subprocess.run(
+        [exe],
+        cwd=ROOT,  # flexflow_init adds cwd to sys.path
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=600,
+    )
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "capi_mlp ok" in run.stdout
+    # the model must actually have learned something (4-class CE < ln(4))
+    loss_line = [l for l in run.stdout.splitlines() if "final loss" in l][0]
+    assert float(loss_line.split()[-1]) < 1.38
