@@ -1,0 +1,59 @@
+"""Keras-frontend CNN on CIFAR-10 (reference: examples/python/keras/
+func_cifar10_cnn.py and friends — 28 keras scripts in the reference zoo).
+
+Uses the keras dataset loaders (synthetic fallback when no cached copy
+exists) and the Sequential API over the FFModel builder.
+
+    python examples/keras_cnn_cifar10.py -b 64 -i 4 -e 1
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu import FFConfig  # noqa: E402
+from flexflow_tpu.frontends import keras_api as keras  # noqa: E402
+from flexflow_tpu.frontends.keras_datasets import load_cifar10  # noqa: E402
+
+
+def build(cfg: FFConfig):
+    return keras.Sequential(
+        [
+            keras.Input(shape=(32, 32, 3)),
+            keras.Conv2D(32, (3, 3), padding="same", activation="relu"),
+            keras.Conv2D(32, (3, 3), padding="same", activation="relu"),
+            keras.MaxPooling2D((2, 2), strides=(2, 2)),
+            keras.Conv2D(64, (3, 3), padding="same", activation="relu"),
+            keras.Conv2D(64, (3, 3), padding="same", activation="relu"),
+            keras.MaxPooling2D((2, 2), strides=(2, 2)),
+            keras.Flatten(),
+            keras.Dense(512, activation="relu"),
+            keras.Dense(10),
+        ],
+        config=cfg,
+    )
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    n = cfg.batch_size * (cfg.iterations or 4)
+    (x_train, y_train), _ = load_cifar10(n_train=n, n_test=max(cfg.batch_size, 1))
+    x = (x_train.astype(np.float32) / 255.0)[:n]
+    y = y_train.reshape(-1)[:n].astype(np.int32)
+
+    model = build(cfg)
+    model.compile(
+        optimizer=keras.SGD(cfg.learning_rate, momentum=0.9),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    model.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

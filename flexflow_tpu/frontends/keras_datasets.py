@@ -1,0 +1,114 @@
+"""Keras-style dataset loaders (reference: python/flexflow/keras/datasets/
+— mnist, cifar10/100, reuters loaders used by the keras example zoo).
+
+Each `load_data()` first looks for a locally cached copy (the standard
+`~/.keras/datasets` npz layout, or `FF_DATASETS_DIR`); with no cache and no
+network (this environment has zero egress) it falls back to DETERMINISTIC
+synthetic data with the real shapes/dtypes/class counts so the example zoo
+runs end-to-end — a warning marks the substitution.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Tuple
+
+import numpy as np
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "FF_DATASETS_DIR",
+        os.path.join(os.path.expanduser("~"), ".keras", "datasets"),
+    )
+
+
+def _synthetic_images(name, n_train, n_test, shape, classes, seed):
+    warnings.warn(
+        f"{name}: no cached dataset found; using deterministic synthetic "
+        f"data (set FF_DATASETS_DIR to use a real copy)",
+        stacklevel=3,
+    )
+    rng = np.random.RandomState(seed)
+    x_train = rng.randint(0, 256, size=(n_train,) + shape, dtype=np.uint8)
+    y_train = rng.randint(0, classes, size=(n_train,)).astype(np.int64)
+    x_test = rng.randint(0, 256, size=(n_test,) + shape, dtype=np.uint8)
+    y_test = rng.randint(0, classes, size=(n_test,)).astype(np.int64)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def _load_npz(path, keys):
+    with np.load(path, allow_pickle=True) as f:
+        return tuple(f[k] for k in keys)
+
+
+def load_mnist(n_train: int = 60000, n_test: int = 10000):
+    """(x_train [n,28,28] u8, y_train), (x_test, y_test)."""
+    path = os.path.join(_cache_dir(), "mnist.npz")
+    if os.path.exists(path):
+        x_tr, y_tr, x_te, y_te = _load_npz(
+            path, ["x_train", "y_train", "x_test", "y_test"]
+        )
+        return (x_tr, y_tr), (x_te, y_te)
+    return _synthetic_images("mnist", n_train, n_test, (28, 28), 10, seed=0)
+
+
+def load_cifar10(n_train: int = 50000, n_test: int = 10000):
+    """(x_train [n,32,32,3] u8, y_train [n,1]), (x_test, y_test) — the
+    keras cifar layout (labels are column vectors)."""
+    path = os.path.join(_cache_dir(), "cifar10.npz")
+    if os.path.exists(path):
+        x_tr, y_tr, x_te, y_te = _load_npz(
+            path, ["x_train", "y_train", "x_test", "y_test"]
+        )
+        return (x_tr, y_tr), (x_te, y_te)
+    (x_tr, y_tr), (x_te, y_te) = _synthetic_images(
+        "cifar10", n_train, n_test, (32, 32, 3), 10, seed=1
+    )
+    return (x_tr, y_tr.reshape(-1, 1)), (x_te, y_te.reshape(-1, 1))
+
+
+def load_cifar100(n_train: int = 50000, n_test: int = 10000):
+    path = os.path.join(_cache_dir(), "cifar100.npz")
+    if os.path.exists(path):
+        x_tr, y_tr, x_te, y_te = _load_npz(
+            path, ["x_train", "y_train", "x_test", "y_test"]
+        )
+        return (x_tr, y_tr), (x_te, y_te)
+    (x_tr, y_tr), (x_te, y_te) = _synthetic_images(
+        "cifar100", n_train, n_test, (32, 32, 3), 100, seed=2
+    )
+    return (x_tr, y_tr.reshape(-1, 1)), (x_te, y_te.reshape(-1, 1))
+
+
+def load_reuters(
+    num_words: int = 10000,
+    maxlen: int = 200,
+    n_train: int = 8982,
+    n_test: int = 2246,
+) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
+    """Padded int32 sequences [n, maxlen] + 46-class labels (the reference's
+    reuters MLP example consumes exactly this after its own pad step)."""
+    path = os.path.join(_cache_dir(), "reuters.npz")
+    if os.path.exists(path):
+        x_tr, y_tr, x_te, y_te = _load_npz(
+            path, ["x_train", "y_train", "x_test", "y_test"]
+        )
+        return (x_tr, y_tr), (x_te, y_te)
+    warnings.warn(
+        "reuters: no cached dataset found; using deterministic synthetic "
+        "sequences",
+        stacklevel=2,
+    )
+    rng = np.random.RandomState(3)
+
+    def seqs(n):
+        x = rng.randint(1, num_words, size=(n, maxlen)).astype(np.int32)
+        lengths = rng.randint(maxlen // 4, maxlen, size=n)
+        for i, L in enumerate(lengths):  # zero-pad the tails like real data
+            x[i, L:] = 0
+        y = rng.randint(0, 46, size=n).astype(np.int64)
+        return x, y
+
+    return seqs(n_train), seqs(n_test)

@@ -186,7 +186,7 @@ def _lower_mha(params):
         b_local = b // (ctx.mesh.shape[batch_ax] if batch_ax else 1)
         if use_flash is True or (
             use_flash == "auto"
-            and _auto_flash(b_local, h // sp_deg, s, s)
+            and _auto_flash(b_local, h // sp_deg, s, kh.shape[1])
         ):
             from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -262,7 +262,9 @@ def _lower_mha(params):
                 use_flash is True
                 or (
                     use_flash == "auto"
-                    and _auto_flash(q.shape[0], q.shape[2], seq, k.shape[1])
+                    and _auto_flash(
+                        q.shape[0], q.shape[2], seq, k.shape[1], ctx
+                    )
                 )
             ) and not dropping  # the blockwise kernel has no prob-dropout path
             if flash:

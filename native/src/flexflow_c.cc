@@ -26,6 +26,14 @@ PyObject *shim_call(const char *fn, PyObject *args) {
     Py_XDECREF(args);
     return nullptr;
   }
+  if (args == nullptr) {
+    // a failed Py_BuildValue (e.g. a NULL handle from an earlier failed
+    // call formatted with "O") — surface that instead of calling the shim
+    // with no arguments
+    std::fprintf(stderr, "flexflow_c: %s called with invalid handle\n", fn);
+    print_error();
+    return nullptr;
+  }
   PyObject *f = PyObject_GetAttrString(g_shim, fn);
   if (f == nullptr) {
     print_error();

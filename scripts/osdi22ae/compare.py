@@ -1,0 +1,82 @@
+"""Unity-AE-style comparison: searched strategy vs --only-data-parallel.
+
+Rebuild of the reference's OSDI'22 artifact scripts (reference:
+scripts/osdi22ae/{bert,dlrm,candle_uno,inception,mlp,resnext-50,xdl}.sh —
+each runs the same binary twice, once with a search budget and once with
+--only-data-parallel, and compares the printed THROUGHPUT lines).
+
+    python scripts/osdi22ae/compare.py mlp --budget 30 -b 64
+    python scripts/osdi22ae/compare.py bert_proxy --budget 30
+    python scripts/osdi22ae/compare.py --all --budget 10
+
+Runs each example's main() twice in-process and prints a summary table.
+On a single real chip the search degenerates to data-parallel; run with a
+virtual mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8 and
+FF_CAPI_PLATFORM=cpu-style forcing) or on a pod slice for real comparisons.
+"""
+
+from __future__ import annotations
+
+import importlib
+import io
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, ROOT)
+
+WORKLOADS = ["mlp", "bert_proxy", "dlrm", "candle_uno", "inception", "resnext", "xdl"]
+
+
+def run_one(name: str, argv) -> float:
+    """Run examples/<name>.main() with argv; return the last THROUGHPUT."""
+    old_argv = sys.argv
+    old_stdout = sys.stdout
+    sys.argv = [name] + list(argv)
+    sys.stdout = cap = io.StringIO()
+    try:
+        mod = importlib.import_module(f"examples.{name}")
+        mod.main()
+    finally:
+        sys.argv = old_argv
+        sys.stdout = old_stdout
+    text = cap.getvalue()
+    print(text, end="")
+    matches = re.findall(r"THROUGHPUT = ([0-9.]+)", text)
+    if not matches:
+        raise RuntimeError(f"{name}: no THROUGHPUT line in output")
+    return float(matches[-1])
+
+
+def main():
+    args = sys.argv[1:]
+    if args and args[0] == "--all":
+        names = WORKLOADS
+        rest = args[1:]
+    elif args and not args[0].startswith("-"):
+        names = [args[0]]
+        rest = args[1:]
+    else:
+        names = ["mlp"]
+        rest = args
+
+    rows = []
+    for name in names:
+        print(f"=== {name}: data-parallel baseline ===")
+        dp = run_one(name, rest + ["--only-data-parallel"])
+        print(f"=== {name}: searched strategy ===")
+        searched = run_one(name, rest)
+        rows.append((name, dp, searched))
+
+    print()
+    print(f"{'workload':<14} {'DP samples/s':>14} {'searched':>14} {'speedup':>9}")
+    for name, dp, searched in rows:
+        print(
+            f"{name:<14} {dp:>14.2f} {searched:>14.2f} "
+            f"{searched / dp if dp else float('nan'):>8.2f}x"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,13 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 def test_capi_mlp_end_to_end(tmp_path):
     build = subprocess.run(
-        ["make", "-C", os.path.join(ROOT, "native"), "capi"],
+        [
+            "make",
+            "-C",
+            os.path.join(ROOT, "native"),
+            f"PYTHON={sys.executable}",  # embed THIS interpreter's Python
+            "capi",
+        ],
         capture_output=True,
         text=True,
     )

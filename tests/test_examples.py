@@ -17,6 +17,7 @@ EXAMPLES = [
     "candle_uno",
     "dlrm",
     "inception",
+    "keras_cnn_cifar10",
     "mlp",
     "moe",
     "mt5_encoder",

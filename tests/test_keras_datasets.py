@@ -1,0 +1,60 @@
+"""Keras dataset loader tests (reference: python/flexflow/keras/datasets/).
+No network in this environment: the synthetic fallback must produce the
+real shapes/dtypes/class ranges deterministically."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.frontends import keras_datasets as kd
+
+
+def test_mnist_shapes():
+    with pytest.warns(UserWarning):
+        (x_tr, y_tr), (x_te, y_te) = kd.load_mnist(n_train=64, n_test=16)
+    assert x_tr.shape == (64, 28, 28) and x_tr.dtype == np.uint8
+    assert y_tr.shape == (64,)
+    assert set(np.unique(y_tr)) <= set(range(10))
+    assert x_te.shape == (16, 28, 28)
+
+
+def test_cifar10_layout_matches_keras():
+    with pytest.warns(UserWarning):
+        (x_tr, y_tr), _ = kd.load_cifar10(n_train=32, n_test=8)
+    assert x_tr.shape == (32, 32, 32, 3)
+    assert y_tr.shape == (32, 1)  # keras cifar labels are column vectors
+
+
+def test_cifar100_classes():
+    with pytest.warns(UserWarning):
+        (_, y_tr), _ = kd.load_cifar100(n_train=512, n_test=8)
+    assert y_tr.max() < 100 and y_tr.min() >= 0
+
+
+def test_reuters_padded_sequences():
+    with pytest.warns(UserWarning):
+        (x_tr, y_tr), _ = kd.load_reuters(
+            num_words=1000, maxlen=50, n_train=32, n_test=8
+        )
+    assert x_tr.shape == (32, 50) and x_tr.dtype == np.int32
+    assert x_tr.max() < 1000
+    # zero-padded tails exist
+    assert (x_tr == 0).any()
+    assert set(np.unique(y_tr)) <= set(range(46))
+
+
+def test_deterministic():
+    with pytest.warns(UserWarning):
+        a = kd.load_mnist(n_train=8, n_test=4)
+    with pytest.warns(UserWarning):
+        b = kd.load_mnist(n_train=8, n_test=4)
+    np.testing.assert_array_equal(a[0][0], b[0][0])
+
+
+def test_cached_file_wins(tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_DATASETS_DIR", str(tmp_path))
+    x = np.arange(4 * 28 * 28, dtype=np.uint8).reshape(4, 28, 28)
+    y = np.array([1, 2, 3, 4])
+    np.savez(tmp_path / "mnist.npz", x_train=x, y_train=y, x_test=x, y_test=y)
+    (x_tr, y_tr), _ = kd.load_mnist()
+    np.testing.assert_array_equal(x_tr, x)
+    np.testing.assert_array_equal(y_tr, y)
