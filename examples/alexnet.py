@@ -11,7 +11,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from examples.common import run_training, synthetic_images
+from examples.common import run_training
 
 from flexflow_tpu import (  # noqa: E402
     FFConfig,
@@ -36,7 +36,15 @@ def main():
         metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
     )
     n = cfg.batch_size * (cfg.iterations or 8)
-    X, y = synthetic_images(n, 229, 229)
+    # CIFAR-10 through the keras loaders (real data when cached, synthetic
+    # fallback otherwise — bootcamp_demo/ff_alexnet_cifar10.py parity),
+    # nearest-neighbor upscaled 32→229 like the reference demo's resize
+    from flexflow_tpu.frontends.keras_datasets import load_cifar10
+
+    (x_tr, y_tr), _ = load_cifar10(n_train=n, n_test=1)
+    idx = np.linspace(0, 31, 229).astype(np.int32)
+    X = (x_tr[:n].astype(np.float32) / 255.0)[:, idx][:, :, idx]
+    y = y_tr.reshape(-1)[:n].astype(np.int32)
     run_training(ff, {"image": X}, y, cfg)
 
 

@@ -1,0 +1,68 @@
+"""PyTorch-frontend workload: torch.fx-trace a torch module, replay it as
+an FFModel, copy the torch weights, and train (reference:
+examples/python/pytorch/* — 14 scripts driving flexflow.torch's
+torch_to_flexflow + PyTorchModel.apply pipeline).
+
+    python examples/torch_mlp_import.py -b 32 -i 4 -e 1
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training  # noqa: E402
+
+from flexflow_tpu import (  # noqa: E402
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.frontends.torch_fx import PyTorchModel  # noqa: E402
+
+
+def build_torch_module():
+    import torch
+
+    return torch.nn.Sequential(
+        torch.nn.Linear(64, 128),
+        torch.nn.ReLU(),
+        torch.nn.Linear(128, 128),
+        torch.nn.ReLU(),
+        torch.nn.Linear(128, 8),
+    )
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    module = build_torch_module()
+    pt = PyTorchModel(module)
+
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 64], name="input")
+    logits = pt.apply(ff, [x])
+    if isinstance(logits, (list, tuple)):
+        logits = logits[0]
+    ff.compile(
+        optimizer=SGDOptimizer(lr=cfg.learning_rate),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        logits=logits,
+    )
+    pt.copy_weights(ff, module)  # start from the torch initialization
+
+    n = cfg.batch_size * (cfg.iterations or 4)
+    rng = np.random.RandomState(cfg.seed)
+    X = rng.randn(n, 64).astype(np.float32)
+    y = rng.randint(0, 8, size=n).astype(np.int32)
+    run_training(ff, {"input": X}, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

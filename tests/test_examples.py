@@ -25,6 +25,7 @@ EXAMPLES = [
     "resnet",
     "resnext",
     "split_test",
+    "torch_mlp_import",
     "transformer",
     "xdl",
 ]
