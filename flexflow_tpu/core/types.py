@@ -130,6 +130,10 @@ class OperatorType(enum.Enum):
     GROUP_BY = enum.auto()
     AGGREGATE = enum.auto()
     AGGREGATE_SPEC = enum.auto()
+    # TPU-native addition (no reference counterpart): batched expert FFN
+    # whose leading expert dim shards over the mesh — GShard-style expert
+    # parallelism (the reference's EP is per-expert op placement instead)
+    EXPERT_FFN = enum.auto()
     CACHE = enum.auto()
     GATHER = enum.auto()
 

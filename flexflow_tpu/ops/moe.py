@@ -77,6 +77,12 @@ def _infer_group_by(input_shapes, params):
     tokens = data.volume() // d
     k = assign.dims[-1].size
     cap = _capacity(tokens, k, n, alpha)
+    if params.get("stacked", False):
+        # one [n, cap, d] tensor whose expert dim may shard (EP)
+        out = ParallelTensorShape(
+            (ParallelDim(n), ParallelDim(cap), ParallelDim(d)), data.dtype
+        )
+        return (out,), ()
     out = ParallelTensorShape(
         (ParallelDim(cap), ParallelDim(d)), data.dtype
     )
@@ -113,6 +119,7 @@ def dispatch_mask(assign, n_experts, capacity):
 def _lower_group_by(params):
     n = params["n"]
     alpha = params.get("alpha", 1.0)
+    stacked = params.get("stacked", False)
 
     def fn(ins, ws, ctx):
         data, assign = ins
@@ -124,6 +131,8 @@ def _lower_group_by(params):
         cap = _capacity(tokens, k, n, alpha)
         d = dispatch_mask(assign2, n, cap)  # [n, cap, tokens]
         outs = jnp.einsum("ncb,bd->ncd", d.astype(data.dtype), data2)
+        if stacked:
+            return [outs]
         return [outs[e] for e in range(n)]
 
     return fn
@@ -133,29 +142,99 @@ register_op(OperatorType.GROUP_BY, _infer_group_by, _lower_group_by)
 
 
 # ---------------------------------------------------------------------------
+# ExpertFFN — batched per-expert two-layer MLP, EP-shardable (TPU-native;
+# the reference's experts are separate Linear ops the search places on
+# different GPUs — here the expert dim shards over the mesh like GShard)
+# ---------------------------------------------------------------------------
+
+
+def _infer_expert_ffn(input_shapes, params):
+    (x,) = input_shapes  # [n, cap, d], expert dim may be partitioned
+    hidden = params["hidden"]
+    e, cap, d = x.dims
+    out = ParallelTensorShape(
+        (e, cap, ParallelDim(hidden)), x.dtype
+    )
+    # weights carry the expert dim's partitioning (each chip holds only
+    # its experts' parameters — the point of EP)
+    w1 = ParallelTensorShape(
+        (e, ParallelDim(d.size), ParallelDim(hidden)), x.dtype
+    )
+    b1 = ParallelTensorShape((e, ParallelDim(hidden)), x.dtype)
+    w2 = ParallelTensorShape(
+        (e, ParallelDim(hidden), ParallelDim(hidden)), x.dtype
+    )
+    b2 = ParallelTensorShape((e, ParallelDim(hidden)), x.dtype)
+    return (out,), (w1, b1, w2, b2)
+
+
+def _lower_expert_ffn(params):
+    from flexflow_tpu.ops.registry import mm_operands
+
+    def fn(ins, ws, ctx):
+        (x,) = ins
+        w1, b1, w2, b2 = ws
+        dt = x.dtype
+        x, w1, w2 = mm_operands(ctx, x, w1, w2)
+        h = jnp.einsum(
+            "ecd,edh->ech", x, w1, preferred_element_type=jnp.float32
+        ).astype(dt)
+        h = jax.nn.relu(h + b1[:, None, :])
+        (hm, w2m) = mm_operands(ctx, h, w2)
+        y = jnp.einsum(
+            "ech,ehf->ecf", hm, w2m, preferred_element_type=jnp.float32
+        ).astype(dt)
+        return [y + b2[:, None, :]]
+
+    return fn
+
+
+def _flops_expert_ffn(input_shapes, params):
+    (x,) = input_shapes
+    n, cap, d = x.logical_sizes
+    h = params["hidden"]
+    return 2.0 * n * cap * (d * h + h * h)
+
+
+register_op(
+    OperatorType.EXPERT_FFN, _infer_expert_ffn, _lower_expert_ffn,
+    _flops_expert_ffn,
+)
+
+
+# ---------------------------------------------------------------------------
 # Aggregate (reference: src/ops/aggregate.cc) — gate-weighted gather
 # ---------------------------------------------------------------------------
 
 
 def _infer_aggregate(input_shapes, params):
-    # inputs: gate_values [*lead,k], gate_assign [*lead,k],
-    # exp_pred_0..n-1 [cap, d] -> output [*lead, d]
-    n = params["n"]
+    # inputs: gate_values [*lead,k], gate_assign [*lead,k], then either
+    # exp_pred_0..n-1 [cap, d] or one stacked [n, cap, d] -> [*lead, d]
     gate_values = input_shapes[0]
     exp0 = input_shapes[2]
-    d = exp0.dims[-1].size
+    d_dim = exp0.dims[-1]
     lead = gate_values.dims[:-1]
-    out = ParallelTensorShape(tuple(lead) + (ParallelDim(d),), exp0.dtype)
+    out_dims = []
+    if params.get("stacked", False):
+        e = exp0.dims[0]
+        if e.degree > 1:
+            # EP: each shard sums only its experts' contributions — the
+            # output carries a replica dim a downstream Reduction folds
+            # (exactly the Linear contraction-dim protocol)
+            out_dims.append(ParallelDim(e.degree, e.degree, e.parallel_idx, True))
+    out_dims.extend(lead)
+    out_dims.append(ParallelDim(d_dim.size))
+    out = ParallelTensorShape(tuple(out_dims), exp0.dtype)
     return (out,), ()
 
 
 def _lower_aggregate(params):
     n = params["n"]
-    alpha = params.get("alpha", 1.0)
+    stacked = params.get("stacked", False)
 
     def fn(ins, ws, ctx):
         gate_values, assign = ins[0], ins[1]
-        exp_preds = jnp.stack(ins[2:], axis=0)  # [n, cap, d]
+        exp_preds = ins[2] if stacked else jnp.stack(ins[2:], axis=0)
         lead = assign.shape[:-1]
         k = assign.shape[-1]
         assign2 = assign.reshape(-1, k)

@@ -504,25 +504,52 @@ class FFModel:
             OperatorType.TOPK, "topk", [input], {"k": k, "sorted": sorted}, name
         )
 
-    def group_by(self, data: Tensor, assign: Tensor, n: int, alpha: float = 1.0, name=None):
-        return self._add(
-            OperatorType.GROUP_BY, "group_by", [data, assign], {"n": n, "alpha": alpha}, name
+    def group_by(
+        self,
+        data: Tensor,
+        assign: Tensor,
+        n: int,
+        alpha: float = 1.0,
+        stacked: bool = False,
+        name=None,
+    ):
+        out = self._add(
+            OperatorType.GROUP_BY,
+            "group_by",
+            [data, assign],
+            {"n": n, "alpha": alpha, "stacked": stacked},
+            name,
         )
+        return out[0] if stacked else out
+
+    def expert_ffn(self, stacked: Tensor, hidden: int, name=None):
+        """Batched per-expert 2-layer MLP on a stacked [n, cap, d] tensor;
+        the expert dim shards over the mesh (GShard-style EP — TPU-native,
+        no reference counterpart: its experts are separate Linear ops)."""
+        return self._add(
+            OperatorType.EXPERT_FFN,
+            "expert_ffn",
+            [stacked],
+            {"hidden": hidden},
+            name,
+        )[0]
 
     def aggregate(
         self,
         gate_values: Tensor,
         gate_assign: Tensor,
-        exp_preds: Sequence[Tensor],
+        exp_preds,
         n: int,
         lambda_bal: float = 0.0,
         name=None,
     ):
+        stacked = isinstance(exp_preds, Tensor)
+        preds = [exp_preds] if stacked else list(exp_preds)
         return self._add(
             OperatorType.AGGREGATE,
             "aggregate",
-            [gate_values, gate_assign] + list(exp_preds),
-            {"n": n, "lambda_bal": lambda_bal},
+            [gate_values, gate_assign] + preds,
+            {"n": n, "lambda_bal": lambda_bal, "stacked": stacked},
             name,
         )[0]
 
@@ -534,12 +561,20 @@ class FFModel:
         expert_hidden_size: int,
         alpha: float = 2.0,
         lambda_bal: float = 0.0,
+        batched: bool = False,
     ) -> Tensor:
         """MoE sugar (reference: FFModel::moe, model.h:487-492): gate network
-        → topk → group_by → per-expert dense → aggregate."""
+        → topk → group_by → experts → aggregate. batched=True uses ONE
+        stacked ExpertFFN whose expert dim can shard over the mesh
+        (expert parallelism); False mirrors the reference's per-expert
+        Linear ops."""
         gate = self.dense(input, num_exp, name=None)
         gate = self.softmax(gate)
         values, assign = self.top_k(gate, num_select)
+        if batched:
+            stacked = self.group_by(input, assign, num_exp, alpha, stacked=True)
+            preds = self.expert_ffn(stacked, expert_hidden_size)
+            return self.aggregate(values, assign, preds, num_exp, lambda_bal)
         grouped = self.group_by(input, assign, num_exp, alpha)
         exp_preds = [
             self.dense(

@@ -191,6 +191,39 @@ class SingleLinearSite(Site):
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class ExpertParallelSite(Site):
+    """Batched ExpertFFN + its Aggregate consumer: shard the expert dim
+    over the model axis (GShard-style EP; the reference instead lets the
+    search place per-expert Linear ops on different GPUs)."""
+
+    def divisible_by(self, graph, tp):
+        ffn = graph.nodes[self.guids[0]]
+        n = graph.shape_of(ffn.inputs[0]).dims[0].size
+        return n % tp == 0
+
+    def apply(self, graph, tp, axis):
+        ffn_guid, agg_guid = self.guids
+        ffn = graph.nodes[ffn_guid]
+        # scatter the stacked [n, cap, d] tensor's expert dim over the axis
+        _insert_before(
+            graph,
+            ffn_guid,
+            ffn.inputs[0],
+            OperatorType.REPARTITION,
+            f"{ffn.name}.repartition",
+            {"axis": 0, "degree": tp, "parallel_idx": axis},
+        )
+        # aggregate contracts the (sharded) expert dim -> partial sums
+        _insert_after(
+            graph,
+            agg_guid,
+            OperatorType.REDUCTION,
+            f"{graph.nodes[agg_guid].name}.reduction",
+            {"degree": tp},
+        )
+
+
 def find_tp_sites(graph: PCGGraph) -> List[Site]:
     """Detect tensor-parallel rewrite sites (the search's substitution
     candidates). Linear pairs are preferred over two singles; attention
@@ -203,6 +236,18 @@ def find_tp_sites(graph: PCGGraph) -> List[Site]:
         if node.op_type == OperatorType.MULTIHEAD_ATTENTION:
             sites.append(AttentionSite("attention", (guid,)))
             claimed.add(guid)
+        elif node.op_type == OperatorType.EXPERT_FFN:
+            aggs = [
+                c
+                for c in graph.consumers(guid)
+                if graph.nodes[c].op_type
+                in (OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC)
+            ]
+            if len(aggs) == 1:
+                sites.append(
+                    ExpertParallelSite("expert_parallel", (guid, aggs[0]))
+                )
+                claimed.update({guid, aggs[0]})
 
     # linear→elementwise*→linear chains
     for guid in graph.topo_order():

@@ -22,6 +22,7 @@ _SITE_KINDS = {}
 def _register_site_kinds():
     from flexflow_tpu.search.rewrites import (
         AttentionSite,
+        ExpertParallelSite,
         LinearChainSite,
         SingleLinearSite,
     )
@@ -29,6 +30,7 @@ def _register_site_kinds():
     _SITE_KINDS.update(
         {
             "attention": AttentionSite,
+            "expert_parallel": ExpertParallelSite,
             "linear_chain": LinearChainSite,
             "single_linear": SingleLinearSite,
         }
