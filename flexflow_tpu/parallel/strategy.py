@@ -87,13 +87,12 @@ def data_parallel_strategy(num_devices: int, graph: PCGGraph = None) -> Strategy
     )
 
 
-def sequence_parallel_strategy(
-    dp: int, sp: int, graph: PCGGraph = None, seq_axis: int = 1
+def _second_axis_strategy(
+    axis_name: str, dp: int, degree: int, axis: int, eligible, name: str
 ) -> Strategy:
-    """dp × sp mesh: inputs' batch dim on the "data" axis and sequence dim on
-    the "seq" axis. Attention under the partitioned sequence dim runs the
-    ring-attention path (ops/pallas/ring_attention.py) — the long-context
-    capability the reference lacks (SURVEY §5)."""
+    """Shared builder for (data × <axis>) strategies: batch on "data",
+    one more input dim (seq / spatial) on the second mesh axis when the
+    eligibility predicate admits it."""
 
     def apply(g: PCGGraph):
         annotate_input_batch(g, dp)
@@ -101,20 +100,55 @@ def sequence_parallel_strategy(
             if node.op_type == OperatorType.INPUT and not node.inputs:
                 shape: ParallelTensorShape = node.params["shape"]
                 if (
-                    sp > 1
-                    # a real sequence dim has a trailing feature dim after
-                    # it; plain [b, features] inputs must not be seq-sharded
-                    and shape.ndim > seq_axis + 1
-                    and shape.dims[seq_axis].size % sp == 0
+                    degree > 1
+                    and eligible(shape)
+                    and shape.dims[axis].size % degree == 0
                 ):
-                    shape = shape.with_degree(seq_axis, sp, 1)
+                    shape = shape.with_degree(axis, degree, 1)
                 node.params["shape"] = shape
                 node.output_shapes = (shape,)
 
     return Strategy(
-        MeshConfig(("data", "seq"), (max(dp, 1), max(sp, 1))),
+        MeshConfig(("data", axis_name), (max(dp, 1), max(degree, 1))),
         apply,
-        name=f"dp{dp}xsp{sp}",
+        name=name,
+    )
+
+
+def sequence_parallel_strategy(
+    dp: int, sp: int, graph: PCGGraph = None, seq_axis: int = 1
+) -> Strategy:
+    """dp × sp mesh: inputs' batch dim on the "data" axis and sequence dim on
+    the "seq" axis. Attention under the partitioned sequence dim runs the
+    ring-attention path (ops/pallas/ring_attention.py) — the long-context
+    capability the reference lacks (SURVEY §5)."""
+    return _second_axis_strategy(
+        "seq",
+        dp,
+        sp,
+        seq_axis,
+        # a real sequence dim has a trailing feature dim after it; plain
+        # [b, features] inputs must not be seq-sharded
+        lambda shape: shape.ndim > seq_axis + 1,
+        f"dp{dp}xsp{sp}",
+    )
+
+
+def spatial_parallel_strategy(
+    dp: int, hp: int, graph: PCGGraph = None, spatial_axis: int = 1
+) -> Strategy:
+    """Attribute/spatial parallelism (reference: --enable-attribute-parallel,
+    model.cc:3602 — partition non-sample activation dims): image inputs'
+    H dim shards over a "spatial" mesh axis. Convolutions under a sharded
+    spatial dim are handled by GSPMD's windowed-op halo exchange — the
+    TPU-native replacement for the reference's Legion-partition overlap."""
+    return _second_axis_strategy(
+        "spatial",
+        dp,
+        hp,
+        spatial_axis,
+        lambda shape: shape.ndim == 4,  # NHWC rank-4 images only
+        f"dp{dp}xhp{hp}",
     )
 
 
