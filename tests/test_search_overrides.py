@@ -1,0 +1,71 @@
+"""Search-without-hardware overrides (reference: --search-num-nodes/
+--search-num-workers, model.cc:3673-3680 — search for a 64-chip strategy
+while running on 1; SURVEY §4.6 calls this the mock-cluster substitute)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.search.unity import UnitySearch
+
+
+def _graph(batch=64, hidden=256):
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, hidden], name="x")
+    t = m.dense(x, 4 * hidden, activation=ActiMode.RELU, use_bias=False)
+    t = m.dense(t, hidden, use_bias=False)
+    m.dense(t, 8)
+    return m
+
+
+def test_unity_searches_64_chips_without_hardware():
+    """The DP explores a 64-chip machine purely analytically."""
+    m = _graph()
+    spec = MachineSpec(num_nodes=8, chips_per_node=8, chip="v4")
+    result = UnitySearch(m.graph, spec).optimize()
+    assert result.cost > 0
+    # at least one op got a multi-chip view
+    assert any(v.num_devices > 1 for v in result.views.values())
+    assert all(v.num_devices <= 64 for v in result.views.values())
+
+
+def test_compile_with_search_worker_override_exports_strategy(tmp_path):
+    """--search-num-workers 16 --export-strategy on an 8-device mesh: the
+    search targets 16 virtual chips; the exported file records per-op
+    views; lowering clamps to the REAL device count."""
+    path = tmp_path / "strategy64.json"
+    cfg = FFConfig(batch_size=64)
+    cfg.search_budget = 10
+    cfg.search_engine = "unity"
+    cfg.search_num_nodes = 2
+    cfg.search_num_workers = 8  # 2 nodes x 8 = 16 searched chips
+    cfg.export_strategy_file = str(path)
+    model = _graph()
+    model.config = cfg
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    doc = json.loads(path.read_text())
+    assert doc["engine"] == "unity"
+    searched_devices = {
+        max(
+            op["start_device_id"]
+            + sum((d - 1) * s for d, s in zip(op["dims"], op["strides"])),
+            0,
+        )
+        for op in doc["ops"].values()
+    }
+    assert max(searched_devices) <= 15  # views live on the 16-chip machine
+    # the real mesh never exceeds the actual 8 devices
+    assert model.executor.mesh.size <= 8
+    # and the model still trains on the real devices
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 256).astype(np.float32)
+    y = rng.randint(0, 8, (64,)).astype(np.int32)
+    hist = model.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(hist[-1]["loss_sum"])
