@@ -77,6 +77,15 @@ def _declare(lib: ctypes.CDLL):
     lib.ffn_loader_reset.argtypes = [ctypes.c_void_p, i64p]
     lib.ffn_loader_destroy.restype = None
     lib.ffn_loader_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffn_unity_dp.restype = ctypes.c_int
+    lib.ffn_unity_dp.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, f64p,  # edges
+        i64p, i64p, f64p, f64p, f64p, f64p,  # per-node scalars
+        ctypes.c_int32, ctypes.c_int32,  # machine geometry
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int32,  # sink
+        i32p, i32p, f64p,  # out
+    ]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -120,6 +129,60 @@ def _as_i32(a) -> np.ndarray:
 
 def _i32p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def unity_dp(
+    edges,  # [(src, dst, bytes)] with node indices 0..n-1
+    batch,  # per-node sample-dim sizes (<=0: single-chip only)
+    chan,  # per-node channel sizes (<=0: no 2-D views)
+    flops,
+    bytes_moved,
+    wbytes,
+    bwd_mult,
+    machine_nodes: int,
+    chips_per_node: int,
+    peak_eff: float,
+    hbm_eff: float,
+    ici_eff: float,
+    ici_lat: float,
+    sink: int,
+):
+    """Native Unity DP (native/src/unity_dp.cc — the reference's
+    SearchHelper::graph_cost role). Returns (cost, dp[], ch[]) or None
+    when the native library is unavailable or the graph exceeds 64 nodes."""
+    n = len(batch)
+    lib = get_lib()
+    if lib is None or n > 64 or n == 0:
+        return None
+    esrc = _as_i32([e[0] for e in edges])
+    edst = _as_i32([e[1] for e in edges])
+    ebytes = np.ascontiguousarray([e[2] for e in edges], dtype=np.float64)
+    b = np.ascontiguousarray(batch, dtype=np.int64)
+    c = np.ascontiguousarray(chan, dtype=np.int64)
+    f = np.ascontiguousarray(flops, dtype=np.float64)
+    by = np.ascontiguousarray(bytes_moved, dtype=np.float64)
+    w = np.ascontiguousarray(wbytes, dtype=np.float64)
+    bm = np.ascontiguousarray(bwd_mult, dtype=np.float64)
+    out_dp = np.empty(n, dtype=np.int32)
+    out_ch = np.empty(n, dtype=np.int32)
+    out_cost = np.empty(1, dtype=np.float64)
+    rc = lib.ffn_unity_dp(
+        n, len(edges), _i32p(esrc), _i32p(edst), _f64p(ebytes),
+        _i64p(b), _i64p(c), _f64p(f), _f64p(by), _f64p(w), _f64p(bm),
+        machine_nodes, chips_per_node, peak_eff, hbm_eff, ici_eff, ici_lat,
+        sink, _i32p(out_dp), _i32p(out_ch), _f64p(out_cost),
+    )
+    if rc != 0:
+        return None
+    return float(out_cost[0]), out_dp.tolist(), out_ch.tolist()
 
 
 # -- graph algorithms ---------------------------------------------------------

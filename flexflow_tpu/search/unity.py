@@ -228,8 +228,105 @@ class UnitySearch:
 
     def optimize(self) -> UnityResult:
         """Full-graph entry: enumerate sink views, run the DP
-        (reference: Graph::optimal_cost, graph.cc:1433)."""
+        (reference: Graph::optimal_cost, graph.cc:1433). Single-sink
+        graphs on the flat machine model run the NATIVE C++ solver
+        (native/src/unity_dp.cc — SURVEY §7's prescription that the
+        compute-bound tree search be native); everything else uses the
+        Python recursion with identical semantics."""
+        from flexflow_tpu import native as native_mod
+
         sinks = self.graph.sinks()
+        if (
+            len(sinks) == 1
+            and self.cm.machine_model is None
+            and self.include_backward
+            # guard BEFORE the per-node extraction pass: without the
+            # library (or past the 64-node bitset cap) the pass would be
+            # wasted and redone by the Python path
+            and len(self.graph.nodes) <= 64
+            and native_mod.get_lib() is not None
+        ):
+            native_result = self._optimize_native(sinks[0])
+            if native_result is not None:
+                return native_result
+        return self._optimize_python(sinks)
+
+    def _optimize_native(self, sink: int) -> Optional[UnityResult]:
+        from flexflow_tpu import native
+        from flexflow_tpu.search.cost_model import (
+            _DEFAULT_EFFICIENCY as EFF,
+            _ICI_LATENCY_S as LAT,
+        )
+
+        guids = sorted(self.graph.nodes)
+        index = {g: i for i, g in enumerate(guids)}
+        batch, chan, flops, bytes_moved, wbytes, bwd = [], [], [], [], [], []
+        edges = []
+        for g in guids:
+            node = self.graph.nodes[g]
+            batch.append(_batch_size(node))
+            is_chan = node.op_type in _CHANNEL_OPS
+            chan.append(_node_channel_size(node) or -1 if is_chan else -1)
+            in_shapes = [self.graph.shape_of(r) for r in node.inputs]
+            if node.op_type == OperatorType.INPUT or node.is_parallel_op:
+                flops.append(0.0)
+                bytes_moved.append(0.0)
+                wbytes.append(0.0)
+                bwd.append(0.0)
+            else:
+                flops.append(op_flops(node.op_type, in_shapes, node.params))
+                data = sum(s.volume() * 4 for s in in_shapes)
+                data += sum(s.volume() * 4 for s in node.output_shapes)
+                data += sum(s.volume() * 4 for s in node.weight_shapes)
+                bytes_moved.append(data)
+                wbytes.append(sum(s.volume() * 4 for s in node.weight_shapes))
+                mxu = is_chan or node.op_type in (
+                    OperatorType.CONV2D,
+                    OperatorType.BATCHMATMUL,
+                )
+                bwd.append(3.0 if mxu else 2.0)
+            for r in node.inputs:
+                if r.guid in index:
+                    edges.append(
+                        (
+                            index[r.guid],
+                            index[g],
+                            self.graph.shape_of(r).volume() * 4,
+                        )
+                    )
+        out = native.unity_dp(
+            edges,
+            batch,
+            chan,
+            flops,
+            bytes_moved,
+            wbytes,
+            bwd,
+            self.resource.num_nodes,
+            self.resource.chips_per_node,
+            self.spec.peak_tflops * 1e12 * EFF,
+            self.spec.hbm_gbps * 1e9 * EFF,
+            self.spec.ici_gbps * 1e9 * EFF,
+            LAT,
+            index[sink],
+        )
+        if out is None:
+            return None
+        cost, dps, chs = out
+        views: Dict[int, ViewOption] = {}
+        for g, dp, ch in zip(guids, dps, chs):
+            n = dp * ch
+            # canonical full-resource geometry; a count chosen on a
+            # concurrent sub-block may not tile the full block — fall back
+            # to a plain 1-D strided view (placement detail is dropped; the
+            # (dp, ch) factorization, which lowering consumes, is exact)
+            mv = self._block_view(self.resource, n) or MachineView(
+                0, (n,), (1,)
+            )
+            views[g] = ViewOption(mv, dp=dp, ch=ch)
+        return UnityResult(cost, views)
+
+    def _optimize_python(self, sinks) -> UnityResult:
         if len(sinks) != 1:
             # multiple sinks (rare; metrics heads): cost the largest
             # subgraph first, then only each later sink's EXCLUSIVE nodes —
@@ -376,7 +473,7 @@ class UnitySearch:
         rest = set(sub) - {sink}
         comps = []
         while rest:
-            seed = next(iter(rest))
+            seed = min(rest)  # deterministic (matches the native solver)
             comp = {seed}
             frontier = [seed]
             while frontier:

@@ -1,0 +1,503 @@
+// Native Unity DP search core.
+//
+// The reference's SearchHelper::graph_cost (src/runtime/graph.cc:1346-1431)
+// is compute-bound tree search in C++; this is the TPU rebuild's native
+// counterpart (SURVEY §7 prescribes exactly this split). Python
+// (flexflow_tpu/search/unity.py) precomputes per-node scalars — FLOPs,
+// bytes moved, weight bytes, batch/channel divisibility — and this library
+// owns the hot part: machine-view enumeration per resource block, roofline
+// + ring-collective costing, bottleneck detection via immediate
+// post-dominators, the memoized sequence/nonsequence recursion, and choice
+// reconstruction. Graphs up to 64 nodes use a bitset subgraph key; larger
+// graphs fall back to the Python implementation.
+//
+// Semantics mirror unity.py exactly (equivalence-tested from Python):
+//   op cost   = max(flops/n / peak, bytes/n / hbm) * bwd_mult
+//             + ring_all_reduce(wbytes / ch, dp)
+//   xfer cost = 0 if views equal else all_to_all(bytes / ndst, max(ns, nd))
+//   views     = 1-D data views (n | block, batch % n == 0, block-tileable)
+//             + 2-D (dp, ch) grids for channel ops (chan % ch == 0)
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Machine {
+  int num_nodes;
+  int chips_per_node;
+  double peak;     // effective FLOP/s
+  double hbm;      // effective bytes/s
+  double ici;      // effective bytes/s per link
+  double lat;      // seconds per hop
+};
+
+struct Block {  // MachineResource
+  int nn, cpn, sn, sc;
+  int chips() const { return nn * cpn; }
+  bool operator==(const Block &o) const {
+    return nn == o.nn && cpn == o.cpn && sn == o.sn && sc == o.sc;
+  }
+};
+
+struct View {
+  int dp, ch;
+  // placement identity, mirroring the Python ViewOption key (MachineView
+  // hash covers start + dims/strides): origin = block's first chip id,
+  // grid_rows = 0 for 1-D in-node views (geometry independent of the
+  // block), else n/cpn for node-major grids. Cross-block views with equal
+  // (dp, ch) are NOT interchangeable — transfers between them cost.
+  int origin, grid_rows;
+  int ndev() const { return dp * ch; }
+  bool operator==(const View &o) const {
+    return dp == o.dp && ch == o.ch && origin == o.origin &&
+           grid_rows == o.grid_rows;
+  }
+};
+
+struct NodeInfo {
+  int64_t batch;    // partitionable sample-dim size (<=0: only 1-chip view)
+  int64_t chan;     // channel/head size (<=0: no 2-D views)
+  double flops, bytes, wbytes;
+  double bwd_mult;  // 3 for MXU ops, 2 elementwise, 0 input/parallel
+};
+
+struct Problem {
+  int n;
+  std::vector<NodeInfo> nodes;
+  std::vector<std::vector<int>> preds;   // producers per node
+  std::vector<std::vector<int>> succs;   // consumers per node
+  std::vector<std::vector<std::pair<int, double>>> in_edges;  // (src, bytes)
+  Machine m;
+};
+
+double ring_all_reduce(const Machine &m, double bytes_per_chip, int g) {
+  if (g <= 1 || bytes_per_chip <= 0) return 0.0;
+  double wire = 2.0 * (g - 1) / g * bytes_per_chip;
+  return wire / m.ici + 2.0 * (g - 1) * m.lat;
+}
+
+double all_to_all(const Machine &m, double bytes_per_chip, int g) {
+  if (g <= 1 || bytes_per_chip <= 0) return 0.0;
+  double wire = (double)(g - 1) / g * bytes_per_chip;
+  return wire / m.ici + (g - 1) * m.lat;
+}
+
+double op_cost(const Problem &p, int node, View v) {
+  const NodeInfo &ni = p.nodes[node];
+  if (ni.bwd_mult <= 0.0) return 0.0;
+  int n = v.ndev();
+  double t_f = (ni.flops / n) / p.m.peak;
+  double t_m = (ni.bytes / n) / p.m.hbm;
+  double t = (t_f > t_m ? t_f : t_m) * ni.bwd_mult;
+  if (ni.wbytes > 0) t += ring_all_reduce(p.m, ni.wbytes / v.ch, v.dp);
+  return t;
+}
+
+double xfer_cost(const Problem &p, double bytes, View a, View b) {
+  if (a == b) return 0.0;
+  int n = a.ndev() > b.ndev() ? a.ndev() : b.ndev();
+  return all_to_all(p.m, bytes / b.ndev(), n);
+}
+
+// block-tileable device counts (unity.py _block_view)
+bool block_tileable(const Block &b, int n) {
+  if (n <= b.cpn) return true;
+  return (n % b.cpn == 0) && (n / b.cpn <= b.nn);
+}
+
+void valid_views(const Problem &p, int node, const Block &b,
+                 std::vector<View> &out) {
+  out.clear();
+  const NodeInfo &ni = p.nodes[node];
+  int total = b.chips();
+  int origin = b.sn * p.m.chips_per_node + b.sc;
+  auto rows = [&b](int n) { return n <= b.cpn ? 0 : n / b.cpn; };
+  for (int n = 1; n <= total; ++n) {
+    if (total % n != 0 || !block_tileable(b, n)) continue;
+    if (ni.batch > 0 && ni.batch % n == 0)
+      out.push_back({n, 1, origin, rows(n)});
+    if (ni.chan > 0) {
+      for (int dp = 1; dp <= n; ++dp) {
+        if (n % dp != 0) continue;
+        int ch = n / dp;
+        if (ch > 1 && (ni.batch > 0 && ni.batch % dp == 0) &&
+            ni.chan % ch == 0)
+          out.push_back({dp, ch, origin, rows(n)});
+      }
+    }
+  }
+  if (out.empty()) out.push_back({1, 1, origin, 0});
+}
+
+using Bits = uint64_t;
+
+struct Key {
+  Bits sub;
+  int src_node;
+  View src_view;
+  int sink;
+  View sink_view;
+  Block block;
+  bool operator==(const Key &o) const {
+    return sub == o.sub && src_node == o.src_node &&
+           src_view == o.src_view && sink == o.sink &&
+           sink_view == o.sink_view && block == o.block;
+  }
+};
+
+struct KeyHash {
+  size_t operator()(const Key &k) const {
+    uint64_t h = k.sub;
+    auto mix = [&h](uint64_t v) {
+      h ^= v + 0x9e3779b97f4a7c15ULL + (h << 6) + (h >> 2);
+    };
+    mix((uint64_t)(k.src_node + 1));
+    mix(((uint64_t)k.src_view.dp << 32) | (uint64_t)k.src_view.ch);
+    mix(((uint64_t)(k.src_view.origin + 1) << 32) |
+        (uint64_t)(k.src_view.grid_rows + 1));
+    mix((uint64_t)k.sink);
+    mix(((uint64_t)k.sink_view.dp << 32) | (uint64_t)k.sink_view.ch);
+    mix(((uint64_t)(k.sink_view.origin + 1) << 32) |
+        (uint64_t)(k.sink_view.grid_rows + 1));
+    mix(((uint64_t)k.block.nn << 48) | ((uint64_t)k.block.cpn << 32) |
+        ((uint64_t)k.block.sn << 16) | (uint64_t)k.block.sc);
+    return (size_t)h;
+  }
+};
+
+struct Entry {
+  double cost;
+  std::vector<std::pair<int, View>> views;  // choices for sub \ {sink}
+};
+
+struct Solver {
+  const Problem &p;
+  std::unordered_map<Key, Entry, KeyHash> memo;
+  explicit Solver(const Problem &prob) : p(prob) {}
+
+  Bits ancestors_within(int node, Bits sub) const {
+    Bits seen = (Bits)1 << node;
+    std::vector<int> stack{node};
+    while (!stack.empty()) {
+      int v = stack.back();
+      stack.pop_back();
+      for (int u : p.preds[v]) {
+        Bits bit = (Bits)1 << u;
+        if ((sub & bit) && !(seen & bit)) {
+          seen |= bit;
+          stack.push_back(u);
+        }
+      }
+    }
+    return seen;
+  }
+
+  // interior node on every source->sink path of `sub` (unity.py
+  // _find_bottleneck: first interior node post-dominating the virtual
+  // source), or -1.
+  int find_bottleneck(Bits sub, int sink) const {
+    std::vector<int> nodes;
+    for (int i = 0; i < p.n; ++i)
+      if (sub & ((Bits)1 << i)) nodes.push_back(i);
+    int n = (int)nodes.size();
+    std::vector<int> index(p.n, -1);
+    for (int i = 0; i < n; ++i) index[nodes[i]] = i;
+    // local succs within sub, plus virtual source n feeding sub-sources
+    std::vector<std::vector<int>> succ(n + 1);
+    std::vector<int> indeg(n, 0);
+    for (int i = 0; i < n; ++i)
+      for (int u : p.preds[nodes[i]])
+        if (index[u] >= 0) {
+          succ[index[u]].push_back(i);
+          indeg[i]++;
+        }
+    for (int i = 0; i < n; ++i)
+      if (indeg[i] == 0) succ[n].push_back(i);
+    // topo order (local) — Kahn over the n+1 nodes incl. the virtual source
+    std::vector<int> order;
+    order.reserve(n + 1);
+    std::vector<int> full_deg(n + 1, 0);
+    for (int v = 0; v <= n; ++v)
+      for (int w : succ[v]) full_deg[w]++;
+    std::vector<int> ready;
+    for (int v = 0; v <= n; ++v)
+      if (full_deg[v] == 0) ready.push_back(v);
+    while (!ready.empty()) {
+      int v = ready.back();
+      ready.pop_back();
+      order.push_back(v);
+      for (int w : succ[v])
+        if (--full_deg[w] == 0) ready.push_back(w);
+    }
+    if ((int)order.size() != n + 1) return -1;
+    // post-dominator sets by reverse-topo bitset dataflow (n <= 64)
+    std::vector<Bits> pdom(n + 1, ~(Bits)0);
+    std::vector<int> pos(n + 1);
+    for (int i = 0; i <= n; ++i) pos[order[i]] = i;
+    for (int i = n; i >= 0; --i) {
+      int v = order[i];
+      if (succ[v].empty()) {
+        pdom[v] = (v < n) ? ((Bits)1 << v) : 0;
+      } else {
+        Bits inter = ~(Bits)0;
+        for (int w : succ[v]) inter &= pdom[w];
+        pdom[v] = inter | (v < n ? ((Bits)1 << v) : 0);
+      }
+    }
+    // nearest strict post-dominators of the virtual source, in topo order
+    Bits cands = pdom[n];
+    int best = -1, best_pos = 1 << 30;
+    for (int i = 0; i < n; ++i) {
+      if ((cands & ((Bits)1 << i)) && nodes[i] != sink && pos[i] < best_pos) {
+        best_pos = pos[i];
+        best = nodes[i];
+      }
+    }
+    return best;
+  }
+
+  Entry graph_cost(Bits sub, int src_node, View src_view, int sink,
+                   View sink_view, const Block &block) {
+    Key key{sub, src_node, src_view, sink, sink_view, block};
+    auto it = memo.find(key);
+    if (it != memo.end()) return it->second;
+
+    Bits sink_bit = (Bits)1 << sink;
+    Bits interior = sub & ~sink_bit;
+    Entry out;
+    if (interior == 0) {
+      double c = op_cost(p, sink, sink_view);
+      for (auto &e : p.in_edges[sink])
+        if (e.first == src_node)
+          c += xfer_cost(p, e.second, src_view, sink_view);
+      out.cost = c;
+      memo.emplace(key, out);
+      return out;
+    }
+
+    int b = find_bottleneck(sub, sink);
+    if (b >= 0) {
+      Bits pre = ancestors_within(b, sub);
+      Bits post = (sub & ~pre) | sink_bit;
+      std::vector<View> views;
+      valid_views(p, b, block, views);
+      bool first = true;
+      for (View v : views) {
+        Entry e1 = graph_cost(pre, src_node, src_view, b, v, block);
+        Entry e2 = graph_cost(post, b, v, sink, sink_view, block);
+        double c = e1.cost + e2.cost;
+        if (first || c < out.cost) {
+          first = false;
+          out.cost = c;
+          out.views = e1.views;
+          out.views.insert(out.views.end(), e2.views.begin(), e2.views.end());
+          out.views.push_back({b, v});
+        }
+      }
+      memo.emplace(key, out);
+      return out;
+    }
+
+    out = nonsequence(sub, src_node, src_view, sink, sink_view, block);
+    memo.emplace(key, out);
+    return out;
+  }
+
+  std::vector<Bits> branches(Bits sub, int sink) const {
+    Bits rest = sub & ~((Bits)1 << sink);
+    std::vector<Bits> comps;
+    while (rest) {
+      int seed = __builtin_ctzll(rest);
+      Bits comp = (Bits)1 << seed;
+      std::vector<int> stack{seed};
+      while (!stack.empty()) {
+        int v = stack.back();
+        stack.pop_back();
+        auto visit = [&](int u) {
+          Bits bit = (Bits)1 << u;
+          if ((rest & bit) && !(comp & bit)) {
+            comp |= bit;
+            stack.push_back(u);
+          }
+        };
+        for (int u : p.preds[v]) visit(u);
+        for (int u : p.succs[v]) visit(u);
+      }
+      comps.push_back(comp);
+      rest &= ~comp;
+    }
+    return comps;
+  }
+
+  Entry branch_cost(Bits branch, int src_node, View src_view, int sink,
+                    View sink_view, const Block &block) {
+    // terminals: branch nodes with no consumer inside the branch
+    std::vector<int> terms;
+    for (int i = 0; i < p.n; ++i) {
+      if (!(branch & ((Bits)1 << i))) continue;
+      bool internal_consumer = false;
+      for (int c : p.succs[i])
+        if (branch & ((Bits)1 << c)) internal_consumer = true;
+      if (!internal_consumer) terms.push_back(i);
+    }
+    Entry out;
+    if (terms.size() != 1) {
+      // multi-terminal fallback: independent per-node minima (unity.py)
+      out.cost = 0.0;
+      for (int i = 0; i < p.n; ++i) {
+        if (!(branch & ((Bits)1 << i))) continue;
+        std::vector<View> views;
+        valid_views(p, i, block, views);
+        double best = -1;
+        View bv{1, 1, 0, 0};
+        for (View v : views) {
+          double c = op_cost(p, i, v);
+          if (best < 0 || c < best) {
+            best = c;
+            bv = v;
+          }
+        }
+        out.cost += best;
+        out.views.push_back({i, bv});
+      }
+      return out;
+    }
+    int term = terms[0];
+    std::vector<View> views;
+    valid_views(p, term, block, views);
+    bool first = true;
+    for (View v : views) {
+      Entry e = graph_cost(branch, src_node, src_view, term, v, block);
+      double c = e.cost;
+      for (auto &edge : p.in_edges[sink])
+        if (edge.first == term)
+          c += xfer_cost(p, edge.second, v, sink_view);
+      if (first || c < out.cost) {
+        first = false;
+        out.cost = c;
+        out.views = e.views;
+        out.views.push_back({term, v});
+      }
+    }
+    return out;
+  }
+
+  Entry nonsequence(Bits sub, int src_node, View src_view, int sink,
+                    View sink_view, const Block &block) {
+    auto comps = branches(sub, sink);
+    double sink_cost = op_cost(p, sink, sink_view);
+    for (auto &e : p.in_edges[sink])
+      if (e.first == src_node)
+        sink_cost += xfer_cost(p, e.second, src_view, sink_view);
+
+    // sequential: all branches on the full block
+    Entry best;
+    best.cost = sink_cost;
+    std::vector<Entry> per_branch;
+    per_branch.reserve(comps.size());
+    for (Bits br : comps) {
+      Entry e = branch_cost(br, src_node, src_view, sink, sink_view, block);
+      best.cost += e.cost;
+      best.views.insert(best.views.end(), e.views.begin(), e.views.end());
+      per_branch.push_back(std::move(e));
+    }
+
+    // concurrent two-way: {first} vs {rest} on vertical/horizontal splits
+    if (comps.size() >= 2) {
+      std::vector<std::pair<Block, Block>> splits;
+      for (int i = 1; i < block.nn; ++i)
+        splits.push_back({{i, block.cpn, block.sn, block.sc},
+                          {block.nn - i, block.cpn, block.sn + i, block.sc}});
+      for (int i = 1; i < block.cpn; ++i)
+        splits.push_back({{block.nn, i, block.sn, block.sc},
+                          {block.nn, block.cpn - i, block.sn, block.sc + i}});
+      for (auto &sp : splits) {
+        Entry e1 =
+            branch_cost(comps[0], src_node, src_view, sink, sink_view, sp.first);
+        double c2 = 0.0;
+        std::vector<std::pair<int, View>> v2;
+        for (size_t bi = 1; bi < comps.size(); ++bi) {
+          Entry e = branch_cost(comps[bi], src_node, src_view, sink, sink_view,
+                                sp.second);
+          c2 += e.cost;
+          v2.insert(v2.end(), e.views.begin(), e.views.end());
+        }
+        double c = (e1.cost > c2 ? e1.cost : c2) + sink_cost;
+        if (c < best.cost) {
+          best.cost = c;
+          best.views = e1.views;
+          best.views.insert(best.views.end(), v2.begin(), v2.end());
+        }
+      }
+    }
+    return best;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. out_dp/out_ch get the chosen view per node
+// (1/1 when unassigned); out_cost the optimal simulated step seconds.
+int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
+                 const int32_t *edst, const double *edge_bytes,
+                 const int64_t *batch, const int64_t *chan,
+                 const double *flops, const double *bytes_moved,
+                 const double *wbytes, const double *bwd_mult,
+                 int machine_nodes, int chips_per_node, double peak_eff,
+                 double hbm_eff, double ici_eff, double ici_lat, int sink,
+                 int32_t *out_dp, int32_t *out_ch, double *out_cost) {
+  if (n_nodes <= 0 || n_nodes > 64) return 1;
+  Problem p;
+  p.n = n_nodes;
+  p.m = {machine_nodes, chips_per_node, peak_eff, hbm_eff, ici_eff, ici_lat};
+  p.nodes.resize(n_nodes);
+  for (int i = 0; i < n_nodes; ++i)
+    p.nodes[i] = {batch[i], chan[i], flops[i], bytes_moved[i], wbytes[i],
+                  bwd_mult[i]};
+  p.preds.assign(n_nodes, {});
+  p.succs.assign(n_nodes, {});
+  p.in_edges.assign(n_nodes, {});
+  for (int e = 0; e < n_edges; ++e) {
+    int s = esrc[e], d = edst[e];
+    if (s < 0 || s >= n_nodes || d < 0 || d >= n_nodes) return 2;
+    p.preds[d].push_back(s);
+    p.succs[s].push_back(d);
+    p.in_edges[d].push_back({s, edge_bytes[e]});
+  }
+
+  Solver solver(p);
+  Block full{machine_nodes, chips_per_node, 0, 0};
+  Bits sub = solver.ancestors_within(sink, ~(Bits)0 >> (64 - n_nodes));
+  std::vector<View> sink_views;
+  valid_views(p, sink, full, sink_views);
+  bool first = true;
+  Entry best;
+  View best_sink{1, 1, 0, 0};
+  for (View v : sink_views) {
+    Entry e = solver.graph_cost(sub, -1, {1, 1, 0, 0}, sink, v, full);
+    if (first || e.cost < best.cost) {
+      first = false;
+      best = e;
+      best_sink = v;
+    }
+  }
+  for (int i = 0; i < n_nodes; ++i) {
+    out_dp[i] = 1;
+    out_ch[i] = 1;
+  }
+  for (auto &cv : best.views) {
+    out_dp[cv.first] = cv.second.dp;
+    out_ch[cv.first] = cv.second.ch;
+  }
+  out_dp[sink] = best_sink.dp;
+  out_ch[sink] = best_sink.ch;
+  *out_cost = best.cost;
+  return 0;
+}
+
+}  // extern "C"

@@ -57,7 +57,9 @@ class TestUnityDP:
     def test_memoization_fires(self):
         model = chain_model(layers=4)
         search = UnitySearch(model.graph, SPEC)
-        search.optimize()
+        # exercise the Python recursion explicitly (optimize() dispatches
+        # eligible graphs to the native C++ solver, which has its own memo)
+        search._optimize_python(model.graph.sinks())
         assert search.memo_hits > 0
 
     def test_bottleneck_on_chain(self):
