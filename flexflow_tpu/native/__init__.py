@@ -157,10 +157,10 @@ def unity_dp(
 ):
     """Native Unity DP (native/src/unity_dp.cc — the reference's
     SearchHelper::graph_cost role). Returns (cost, dp[], ch[]) or None
-    when the native library is unavailable or the graph exceeds 64 nodes."""
+    when the native library is unavailable or the graph exceeds 256 nodes."""
     n = len(batch)
     lib = get_lib()
-    if lib is None or n > 64 or n == 0:
+    if lib is None or n > 256 or n == 0:
         return None
     esrc = _as_i32([e[0] for e in edges])
     edst = _as_i32([e[1] for e in edges])

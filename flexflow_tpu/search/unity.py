@@ -241,9 +241,9 @@ class UnitySearch:
             and self.cm.machine_model is None
             and self.include_backward
             # guard BEFORE the per-node extraction pass: without the
-            # library (or past the 64-node bitset cap) the pass would be
+            # library (or past the 256-node bitset cap) the pass would be
             # wasted and redone by the Python path
-            and len(self.graph.nodes) <= 64
+            and len(self.graph.nodes) <= 256
             and native_mod.get_lib() is not None
         ):
             native_result = self._optimize_native(sinks[0])

@@ -8,7 +8,7 @@
 // owns the hot part: machine-view enumeration per resource block, roofline
 // + ring-collective costing, bottleneck detection via immediate
 // post-dominators, the memoized sequence/nonsequence recursion, and choice
-// reconstruction. Graphs up to 64 nodes use a bitset subgraph key; larger
+// reconstruction. Graphs up to 256 nodes use a bitset subgraph key; larger
 // graphs fall back to the Python implementation.
 //
 // Semantics mirror unity.py exactly (equivalence-tested from Python):
@@ -18,6 +18,7 @@
 //   views     = 1-D data views (n | block, batch % n == 0, block-tileable)
 //             + 2-D (dp, ch) grids for channel ops (chan % ch == 0)
 
+#include <bitset>
 #include <cstdint>
 #include <cstring>
 #include <unordered_map>
@@ -132,7 +133,14 @@ void valid_views(const Problem &p, int node, const Block &b,
   if (out.empty()) out.push_back({1, 1, origin, 0});
 }
 
-using Bits = uint64_t;
+constexpr int kMaxNodes = 256;
+using Bits = std::bitset<kMaxNodes>;
+
+inline Bits one_bit(int i) {
+  Bits b;
+  b.set(i);
+  return b;
+}
 
 struct Key {
   Bits sub;
@@ -150,7 +158,7 @@ struct Key {
 
 struct KeyHash {
   size_t operator()(const Key &k) const {
-    uint64_t h = k.sub;
+    uint64_t h = (uint64_t)std::hash<Bits>{}(k.sub);
     auto mix = [&h](uint64_t v) {
       h ^= v + 0x9e3779b97f4a7c15ULL + (h << 6) + (h >> 2);
     };
@@ -178,16 +186,15 @@ struct Solver {
   std::unordered_map<Key, Entry, KeyHash> memo;
   explicit Solver(const Problem &prob) : p(prob) {}
 
-  Bits ancestors_within(int node, Bits sub) const {
-    Bits seen = (Bits)1 << node;
+  Bits ancestors_within(int node, const Bits &sub) const {
+    Bits seen = one_bit(node);
     std::vector<int> stack{node};
     while (!stack.empty()) {
       int v = stack.back();
       stack.pop_back();
       for (int u : p.preds[v]) {
-        Bits bit = (Bits)1 << u;
-        if ((sub & bit) && !(seen & bit)) {
-          seen |= bit;
+        if (sub.test(u) && !seen.test(u)) {
+          seen.set(u);
           stack.push_back(u);
         }
       }
@@ -198,10 +205,10 @@ struct Solver {
   // interior node on every source->sink path of `sub` (unity.py
   // _find_bottleneck: first interior node post-dominating the virtual
   // source), or -1.
-  int find_bottleneck(Bits sub, int sink) const {
+  int find_bottleneck(const Bits &sub, int sink) const {
     std::vector<int> nodes;
     for (int i = 0; i < p.n; ++i)
-      if (sub & ((Bits)1 << i)) nodes.push_back(i);
+      if (sub.test(i)) nodes.push_back(i);
     int n = (int)nodes.size();
     std::vector<int> index(p.n, -1);
     for (int i = 0; i < n; ++i) index[nodes[i]] = i;
@@ -233,25 +240,28 @@ struct Solver {
         if (--full_deg[w] == 0) ready.push_back(w);
     }
     if ((int)order.size() != n + 1) return -1;
-    // post-dominator sets by reverse-topo bitset dataflow (n <= 64)
-    std::vector<Bits> pdom(n + 1, ~(Bits)0);
+    // post-dominator sets by reverse-topo bitset dataflow
+    Bits full;
+    full.set();
+    std::vector<Bits> pdom(n + 1, full);
     std::vector<int> pos(n + 1);
     for (int i = 0; i <= n; ++i) pos[order[i]] = i;
     for (int i = n; i >= 0; --i) {
       int v = order[i];
       if (succ[v].empty()) {
-        pdom[v] = (v < n) ? ((Bits)1 << v) : 0;
+        pdom[v] = (v < n) ? one_bit(v) : Bits();
       } else {
-        Bits inter = ~(Bits)0;
+        Bits inter = full;
         for (int w : succ[v]) inter &= pdom[w];
-        pdom[v] = inter | (v < n ? ((Bits)1 << v) : 0);
+        if (v < n) inter.set(v);
+        pdom[v] = inter;
       }
     }
     // nearest strict post-dominators of the virtual source, in topo order
-    Bits cands = pdom[n];
+    const Bits &cands = pdom[n];
     int best = -1, best_pos = 1 << 30;
     for (int i = 0; i < n; ++i) {
-      if ((cands & ((Bits)1 << i)) && nodes[i] != sink && pos[i] < best_pos) {
+      if (cands.test(i) && nodes[i] != sink && pos[i] < best_pos) {
         best_pos = pos[i];
         best = nodes[i];
       }
@@ -259,16 +269,16 @@ struct Solver {
     return best;
   }
 
-  Entry graph_cost(Bits sub, int src_node, View src_view, int sink,
+  Entry graph_cost(const Bits &sub, int src_node, View src_view, int sink,
                    View sink_view, const Block &block) {
     Key key{sub, src_node, src_view, sink, sink_view, block};
     auto it = memo.find(key);
     if (it != memo.end()) return it->second;
 
-    Bits sink_bit = (Bits)1 << sink;
+    Bits sink_bit = one_bit(sink);
     Bits interior = sub & ~sink_bit;
     Entry out;
-    if (interior == 0) {
+    if (interior.none()) {
       double c = op_cost(p, sink, sink_view);
       for (auto &e : p.in_edges[sink])
         if (e.first == src_node)
@@ -306,20 +316,24 @@ struct Solver {
     return out;
   }
 
-  std::vector<Bits> branches(Bits sub, int sink) const {
-    Bits rest = sub & ~((Bits)1 << sink);
+  std::vector<Bits> branches(const Bits &sub, int sink) const {
+    Bits rest = sub & ~one_bit(sink);
     std::vector<Bits> comps;
-    while (rest) {
-      int seed = __builtin_ctzll(rest);
-      Bits comp = (Bits)1 << seed;
+    while (rest.any()) {
+#ifdef __GLIBCXX__
+      int seed = (int)rest._Find_first();  // libstdc++ O(words) extension
+#else
+      int seed = 0;
+      while (!rest.test(seed)) ++seed;
+#endif
+      Bits comp = one_bit(seed);
       std::vector<int> stack{seed};
       while (!stack.empty()) {
         int v = stack.back();
         stack.pop_back();
         auto visit = [&](int u) {
-          Bits bit = (Bits)1 << u;
-          if ((rest & bit) && !(comp & bit)) {
-            comp |= bit;
+          if (rest.test(u) && !comp.test(u)) {
+            comp.set(u);
             stack.push_back(u);
           }
         };
@@ -332,15 +346,15 @@ struct Solver {
     return comps;
   }
 
-  Entry branch_cost(Bits branch, int src_node, View src_view, int sink,
+  Entry branch_cost(const Bits &branch, int src_node, View src_view, int sink,
                     View sink_view, const Block &block) {
     // terminals: branch nodes with no consumer inside the branch
     std::vector<int> terms;
     for (int i = 0; i < p.n; ++i) {
-      if (!(branch & ((Bits)1 << i))) continue;
+      if (!branch.test(i)) continue;
       bool internal_consumer = false;
       for (int c : p.succs[i])
-        if (branch & ((Bits)1 << c)) internal_consumer = true;
+        if (branch.test(c)) internal_consumer = true;
       if (!internal_consumer) terms.push_back(i);
     }
     Entry out;
@@ -348,7 +362,7 @@ struct Solver {
       // multi-terminal fallback: independent per-node minima (unity.py)
       out.cost = 0.0;
       for (int i = 0; i < p.n; ++i) {
-        if (!(branch & ((Bits)1 << i))) continue;
+        if (!branch.test(i)) continue;
         std::vector<View> views;
         valid_views(p, i, block, views);
         double best = -1;
@@ -385,7 +399,7 @@ struct Solver {
     return out;
   }
 
-  Entry nonsequence(Bits sub, int src_node, View src_view, int sink,
+  Entry nonsequence(const Bits &sub, int src_node, View src_view, int sink,
                     View sink_view, const Block &block) {
     auto comps = branches(sub, sink);
     double sink_cost = op_cost(p, sink, sink_view);
@@ -451,7 +465,7 @@ int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
                  int machine_nodes, int chips_per_node, double peak_eff,
                  double hbm_eff, double ici_eff, double ici_lat, int sink,
                  int32_t *out_dp, int32_t *out_ch, double *out_cost) {
-  if (n_nodes <= 0 || n_nodes > 64) return 1;
+  if (n_nodes <= 0 || n_nodes > kMaxNodes) return 1;
   Problem p;
   p.n = n_nodes;
   p.m = {machine_nodes, chips_per_node, peak_eff, hbm_eff, ici_eff, ici_lat};
@@ -472,7 +486,9 @@ int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
 
   Solver solver(p);
   Block full{machine_nodes, chips_per_node, 0, 0};
-  Bits sub = solver.ancestors_within(sink, ~(Bits)0 >> (64 - n_nodes));
+  Bits all;
+  for (int i = 0; i < n_nodes; ++i) all.set(i);
+  Bits sub = solver.ancestors_within(sink, all);
   std::vector<View> sink_views;
   valid_views(p, sink, full, sink_views);
   bool first = true;

@@ -69,7 +69,7 @@ def test_native_matches_python(builder):
 
 def test_native_used_by_default():
     """optimize() must actually dispatch to the C++ solver for eligible
-    graphs (flat machine model, single sink, <= 64 nodes)."""
+    graphs (flat machine model, single sink, <= 256 nodes)."""
     model = chain_model()
     search = UnitySearch(model.graph, SPEC)
     called = {}
