@@ -96,9 +96,15 @@ def optimize(
     seed: int = 0,
     verbose: bool = False,
     machine_model=None,
+    mixed_precision: bool = False,
 ) -> SearchResult:
     """Run the search on a PCG; returns the best found configuration."""
-    cm = CostModel(spec, measure=measure, machine_model=machine_model)
+    cm = CostModel(
+        spec,
+        measure=measure,
+        machine_model=machine_model,
+        mixed_precision=mixed_precision,
+    )
     rng = random.Random(seed)
     evals = 0
     best: Optional[SearchResult] = None
@@ -208,7 +214,10 @@ def search_strategy(model, num_devices: int) -> Strategy:
 
         if cfg.search_engine == "unity":
             result = unity_mod.UnitySearch(
-                model.graph, spec, machine_model=mm
+                model.graph,
+                spec,
+                machine_model=mm,
+                mixed_precision=cfg.allow_mixed_precision,
             ).optimize()
         else:
             from flexflow_tpu.search.mcmc import mcmc_optimize
@@ -221,6 +230,7 @@ def search_strategy(model, num_devices: int) -> Strategy:
                 seed=cfg.seed,
                 verbose=cfg.profiling,
                 machine_model=mm,
+                mixed_precision=cfg.allow_mixed_precision,
             )
         # reference prints exactly this at the end of its search
         # (substitution.cc:1909, model.cc:3298)
@@ -245,6 +255,7 @@ def search_strategy(model, num_devices: int) -> Strategy:
         seed=cfg.seed,
         verbose=cfg.profiling,
         machine_model=mm,
+        mixed_precision=cfg.allow_mixed_precision,
     )
     print(f"[flexflow_tpu] search: best strategy = {result.describe()}")
     if cfg.export_strategy_file:

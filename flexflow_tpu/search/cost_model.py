@@ -25,7 +25,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 from flexflow_tpu.core.machine import MachineSpec
 from flexflow_tpu.core.parallel_tensor import ParallelTensorShape
-from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.core.types import DataType, OperatorType
 from flexflow_tpu.ops.registry import op_flops
 
 
@@ -64,17 +64,41 @@ class CostModel:
         measure: bool = False,
         efficiency: float = _DEFAULT_EFFICIENCY,
         machine_model=None,
+        mixed_precision: bool = False,
     ):
         """machine_model: an optional search.machine_model.MachineModel
         (Enhanced / Networked); when given, collectives are costed as ring
         steps over its actual comm paths instead of the flat ICI formulas
         (reference: the simulator routes messages over
-        MachineModel::get_comm_path, simulator.cc:810+)."""
+        MachineModel::get_comm_path, simulator.cc:810+).
+
+        mixed_precision: cost f32 tensors at 2 bytes/element — under the
+        executor's bf16 mode (FFConfig.allow_mixed_precision) activations
+        and matmul operands live in bfloat16, so every HBM and wire term
+        halves. Master weights stay f32 for the optimizer, but the grad
+        all-reduce also rides bf16; the per-element approximation is
+        uniform by design and documented here."""
         self.spec = spec
         self.measure = measure
         self.efficiency = efficiency
         self.machine_model = machine_model
+        self.mixed_precision = mixed_precision
         self._measured: Dict[Tuple[int, Tuple], float] = {}
+
+    def elem_bytes(self, shape: ParallelTensorShape) -> int:
+        """Bytes per element the executor will actually move for this
+        tensor (the reference hardcodes sizeof(float) throughout its
+        simulator; dtype-awareness is a deliberate improvement).
+
+        Only f32 downcasts: the executor's mm_operands casts f32 matmul
+        operands to bf16 and nothing else (ops/registry.py)."""
+        if self.mixed_precision and shape.dtype == DataType.FLOAT:
+            return 2
+        return shape.dtype.size_bytes
+
+    def piece_bytes(self, shape: ParallelTensorShape) -> float:
+        """Per-shard bytes under this cost model's precision rules."""
+        return shape.piece_volume() * self.elem_bytes(shape)
 
     # -- collectives --------------------------------------------------------
 
@@ -170,11 +194,13 @@ class CostModel:
             return OpCost()
         degree = max(1, out.total_degree)
         flops = op_flops(node.op_type, input_shapes, node.params) / degree
-        bytes_moved = sum(s.piece_bytes() for s in input_shapes)
-        bytes_moved += sum(s.piece_bytes() for s in node.output_shapes)
-        bytes_moved += sum(s.piece_bytes() for s in node.weight_shapes)
-        mem = sum(s.piece_bytes() for s in node.output_shapes)
-        mem += sum(s.piece_bytes() for s in node.weight_shapes)
+
+        _pb = self.piece_bytes
+        bytes_moved = sum(_pb(s) for s in input_shapes)
+        bytes_moved += sum(_pb(s) for s in node.output_shapes)
+        bytes_moved += sum(_pb(s) for s in node.weight_shapes)
+        mem = sum(_pb(s) for s in node.output_shapes)
+        mem += sum(_pb(s) for s in node.weight_shapes)
 
         if self.measure and node.op_type in _MXU_OPS:
             fwd = self._measure_op(node, input_shapes)

@@ -73,10 +73,14 @@ def mcmc_optimize(
     seed: int = 0,
     verbose: bool = False,
     machine_model=None,
+    mixed_precision: bool = False,
 ) -> UnityResult:
     """reference: mcmc_optimize (model.cc:3271) — budget proposals, periodic
     reset to best every budget/10 non-improving steps."""
-    search = UnitySearch(graph, spec, machine_model=machine_model)
+    search = UnitySearch(
+        graph, spec, machine_model=machine_model,
+        mixed_precision=mixed_precision,
+    )
     resource = search.resource
     rng = random.Random(seed)
     guids = [

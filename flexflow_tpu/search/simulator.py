@@ -81,32 +81,34 @@ def _parallel_op_comm(
     x = in_shapes[0]
     y = node.output_shapes[0]
     axis = _collective_axis(node, mesh_sizes)
+
+    _pb = cm.piece_bytes  # wire bytes honor dtype + bf16 mixed precision
     fwd = bwd = 0.0
     if node.op_type == OperatorType.REPLICATE:
         deg = node.params["degree"]
         bwd = cm.all_reduce(
-            x.piece_bytes(), deg, chips=_axis_group_chips(axis, deg, mesh_sizes)
+            _pb(x), deg, chips=_axis_group_chips(axis, deg, mesh_sizes)
         )
     elif node.op_type == OperatorType.REDUCTION:
         deg = node.params["degree"]
         fwd = cm.all_reduce(
-            y.piece_bytes(), deg, chips=_axis_group_chips(axis, deg, mesh_sizes)
+            _pb(y), deg, chips=_axis_group_chips(axis, deg, mesh_sizes)
         )
     elif node.op_type == OperatorType.REPARTITION:
         deg = node.params["degree"]
         chips = _axis_group_chips(axis, deg, mesh_sizes)
-        fwd = cm.all_to_all(x.piece_bytes(), deg, chips=chips)
-        bwd = cm.all_gather(y.piece_bytes(), deg, chips=chips)
+        fwd = cm.all_to_all(_pb(x), deg, chips=chips)
+        bwd = cm.all_gather(_pb(y), deg, chips=chips)
     elif node.op_type == OperatorType.COMBINE:
         deg = node.params["degree"]
         chips = _axis_group_chips(axis, deg, mesh_sizes)
-        fwd = cm.all_gather(x.piece_bytes(), deg, chips=chips)
-        bwd = cm.all_to_all(y.piece_bytes(), deg, chips=chips)
+        fwd = cm.all_gather(_pb(x), deg, chips=chips)
+        bwd = cm.all_to_all(_pb(y), deg, chips=chips)
     elif node.op_type in (OperatorType.ALLTOALL, OperatorType.FUSED_PARALLEL):
         deg = max(x.total_degree, y.total_degree)
         chips = _axis_group_chips(axis, deg, mesh_sizes)
-        fwd = cm.all_to_all(x.piece_bytes(), deg, chips=chips)
-        bwd = cm.all_to_all(y.piece_bytes(), deg, chips=chips)
+        fwd = cm.all_to_all(_pb(x), deg, chips=chips)
+        bwd = cm.all_to_all(_pb(y), deg, chips=chips)
     return fwd, bwd
 
 
@@ -178,6 +180,8 @@ def estimate_graph_cost(
         in_shapes = [graph.shape_of(r) for r in node.inputs]
 
         if node.op_type == OperatorType.INPUT:
+            # stored at true dtype: mixed precision downcasts matmul
+            # operands on the fly, not residents (ops/registry.mm_operands)
             act_bytes += sum(s.piece_bytes() for s in node.output_shapes)
             t = add_task(_CHIP, 0.0, f"{node.name}.in")
         elif node.is_parallel_op:
@@ -243,7 +247,7 @@ def estimate_graph_cost(
                     if g >= total_chips
                     else _axis_group_chips(0, g, mesh_sizes)
                 )
-                t_sync += cm.all_reduce(w.piece_bytes(), g, chips=chips)
+                t_sync += cm.all_reduce(cm.piece_bytes(w), g, chips=chips)
         if include_backward and t_sync > 0:
             total.sync_time += t_sync
             t = add_task(link(0), t_sync, f"{node.name}.sync")

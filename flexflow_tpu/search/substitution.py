@@ -620,7 +620,9 @@ def apply_substitution_pass(
         ),
         chip=cfg.chip,
     )
-    cm = CostModel(spec, measure=False)
+    cm = CostModel(
+        spec, measure=False, mixed_precision=cfg.allow_mixed_precision
+    )
 
     def cost_fn(gr: PCGGraph) -> float:
         try:

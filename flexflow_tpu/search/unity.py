@@ -112,10 +112,13 @@ class UnitySearch:
         resource: Optional[MachineResource] = None,
         include_backward: bool = True,
         machine_model=None,
+        mixed_precision: bool = False,
     ):
         self.graph = graph
         self.spec = spec
-        self.cm = CostModel(spec, machine_model=machine_model)
+        self.cm = CostModel(
+            spec, machine_model=machine_model, mixed_precision=mixed_precision
+        )
         self.resource = resource or spec.resource()
         self.include_backward = include_backward
         self._memo: Dict[Tuple, Tuple[float, Dict[int, ViewOption]]] = {}
@@ -195,9 +198,10 @@ class UnitySearch:
         n = opt.num_devices
         in_shapes = [self.graph.shape_of(r) for r in node.inputs]
         flops = op_flops(node.op_type, in_shapes, node.params) / n
-        data = sum(s.volume() * 4 for s in in_shapes)
-        data += sum(s.volume() * 4 for s in node.output_shapes)
-        data += sum(s.volume() * 4 for s in node.weight_shapes)
+        eb = self.cm.elem_bytes
+        data = sum(s.volume() * eb(s) for s in in_shapes)
+        data += sum(s.volume() * eb(s) for s in node.output_shapes)
+        data += sum(s.volume() * eb(s) for s in node.weight_shapes)
         t = self.cm._roofline(flops, data / n)
         if self.include_backward:
             mxu = node.op_type in _CHANNEL_OPS or node.op_type in (
@@ -210,7 +214,9 @@ class UnitySearch:
         # ids of one replica group (ids are laid out (dp, ch) row-major, so
         # a group is every ch-th device — possibly crossing nodes)
         if self.include_backward and node.weight_shapes:
-            w_bytes = sum(s.volume() * 4 for s in node.weight_shapes) / opt.ch
+            w_bytes = (
+                sum(s.volume() * eb(s) for s in node.weight_shapes) / opt.ch
+            )
             group = opt.view.device_ids()[:: opt.ch]
             t += self.cm.all_reduce(w_bytes, opt.dp, chips=group)
         return t
@@ -220,7 +226,8 @@ class UnitySearch:
         estimate_xfer_cost, graph.cc:1291 → simulator.cc:617)."""
         if src.key() == dst.key():
             return 0.0
-        bytes_total = self.graph.shape_of(ref).volume() * 4
+        shape = self.graph.shape_of(ref)
+        bytes_total = shape.volume() * self.cm.elem_bytes(shape)
         n = max(src.num_devices, dst.num_devices)
         return self.cm.all_to_all(bytes_total / dst.num_devices, n)
 
@@ -262,6 +269,9 @@ class UnitySearch:
         index = {g: i for i, g in enumerate(guids)}
         batch, chan, flops, bytes_moved, wbytes, bwd = [], [], [], [], [], []
         edges = []
+        eb = self.cm.elem_bytes  # byte counts reach the solver pre-scaled,
+        # so the native path is dtype/mixed-precision aware for free and the
+        # Python↔native bit-equivalence is preserved by construction
         for g in guids:
             node = self.graph.nodes[g]
             batch.append(_batch_size(node))
@@ -275,11 +285,13 @@ class UnitySearch:
                 bwd.append(0.0)
             else:
                 flops.append(op_flops(node.op_type, in_shapes, node.params))
-                data = sum(s.volume() * 4 for s in in_shapes)
-                data += sum(s.volume() * 4 for s in node.output_shapes)
-                data += sum(s.volume() * 4 for s in node.weight_shapes)
+                data = sum(s.volume() * eb(s) for s in in_shapes)
+                data += sum(s.volume() * eb(s) for s in node.output_shapes)
+                data += sum(s.volume() * eb(s) for s in node.weight_shapes)
                 bytes_moved.append(data)
-                wbytes.append(sum(s.volume() * 4 for s in node.weight_shapes))
+                wbytes.append(
+                    sum(s.volume() * eb(s) for s in node.weight_shapes)
+                )
                 mxu = is_chan or node.op_type in (
                     OperatorType.CONV2D,
                     OperatorType.BATCHMATMUL,
@@ -287,11 +299,12 @@ class UnitySearch:
                 bwd.append(3.0 if mxu else 2.0)
             for r in node.inputs:
                 if r.guid in index:
+                    shape = self.graph.shape_of(r)
                     edges.append(
                         (
                             index[r.guid],
                             index[g],
-                            self.graph.shape_of(r).volume() * 4,
+                            shape.volume() * eb(shape),
                         )
                     )
         out = native.unity_dp(
