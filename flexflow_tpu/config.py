@@ -57,6 +57,12 @@ class FFConfig:
     # 0 = simple ring formulas, 1 = Enhanced from file, 2 = Networked torus
     machine_model_version: int = 0
     machine_model_file: str = ""
+    # measured-kernel search calibration (reference: the simulator ALWAYS
+    # times real kernels, simulator.cc:532-572; here it is opt-in because
+    # the analytic roofline keeps search-without-hardware working).
+    # calibration_file persists the measured table across runs.
+    measure_costs: bool = False
+    calibration_file: str = ""
 
     # runtime
     perform_fusion: bool = False  # reference: --fusion
@@ -146,6 +152,10 @@ class FFConfig:
                 cfg.machine_model_version = int(take())
             elif a == "--machine-model-file":
                 cfg.machine_model_file = take()
+            elif a == "--measure-costs":
+                cfg.measure_costs = True
+            elif a == "--calibration-file":
+                cfg.calibration_file = take()
             elif a == "--fusion":
                 cfg.perform_fusion = True
             elif a == "--allow-tensor-op-math-conversion":

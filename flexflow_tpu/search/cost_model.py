@@ -52,6 +52,11 @@ _MXU_OPS = {
     OperatorType.MULTIHEAD_ATTENTION,
 }
 
+# ops worth timing for real in measured mode: the MXU set plus Embedding,
+# whose backward materializes a dense table-sized gradient the roofline
+# badly mis-prices (the dominant cost of DLRM-class models)
+_MEASURED_OPS = _MXU_OPS | {OperatorType.EMBEDDING}
+
 # collective latency floor per hop (ICI); dominates small messages
 _ICI_LATENCY_S = 1e-6
 _DEFAULT_EFFICIENCY = 0.6  # achievable fraction of peak (MXU and ICI alike)
@@ -65,6 +70,7 @@ class CostModel:
         efficiency: float = _DEFAULT_EFFICIENCY,
         machine_model=None,
         mixed_precision: bool = False,
+        calibration_file: str = "",
     ):
         """machine_model: an optional search.machine_model.MachineModel
         (Enhanced / Networked); when given, collectives are costed as ring
@@ -83,7 +89,15 @@ class CostModel:
         self.efficiency = efficiency
         self.machine_model = machine_model
         self.mixed_precision = mixed_precision
-        self._measured: Dict[Tuple[int, Tuple], float] = {}
+        # measured-mode cache: stable string key -> (fwd_s, bwd_s) | None
+        # (reference: hash_to_operator_cost, simulator.cc:532-572). When
+        # calibration_file is set the table persists across processes, so
+        # one real-chip calibration run serves every later search.
+        self._measured: Dict[str, Optional[Tuple[float, float]]] = {}
+        self.calibration_file = calibration_file
+        self._unsaved = 0
+        if calibration_file:
+            self._load_calibration()
 
     def elem_bytes(self, shape: ParallelTensorShape) -> int:
         """Bytes per element the executor will actually move for this
@@ -202,73 +216,311 @@ class CostModel:
         mem = sum(_pb(s) for s in node.output_shapes)
         mem += sum(_pb(s) for s in node.weight_shapes)
 
-        if self.measure and node.op_type in _MXU_OPS:
-            fwd = self._measure_op(node, input_shapes)
-            if fwd is not None:
-                # bwd of a matmul-family op = two matmuls of the same size
-                return OpCost(fwd, 2.0 * fwd, 0.0, mem)
+        if self.measure and node.op_type in _MEASURED_OPS:
+            times = self.measure_shard(
+                node.op_type, node.params, input_shapes, node.weight_shapes
+            )
+            if times is not None:
+                return OpCost(times[0], times[1], 0.0, mem)
 
         fwd = self._roofline(flops, bytes_moved)
         # backward: dX and dW each cost about one forward for MXU ops;
         # elementwise backward re-reads the same bytes.
         bwd = 2.0 * fwd if node.op_type in _MXU_OPS else fwd
+
+        # ring attention under a partitioned sequence dim: each device
+        # passes its K/V block around the ring (sp-1) times forward and
+        # roughly twice that backward (dK/dV return trip) — the TPU
+        # sequence-parallel capability the reference lacks (SURVEY §5)
+        if (
+            node.op_type == OperatorType.MULTIHEAD_ATTENTION
+            and input_shapes
+        ):
+            x0 = input_shapes[0]
+            seq_deg = 1
+            for i, d in enumerate(x0.dims):
+                if not d.is_replica_dim and i == 1 and d.degree > 1:
+                    seq_deg = d.degree
+            if seq_deg > 1:
+                kv_piece = 2 * x0.piece_volume() * self.elem_bytes(x0)
+                ring = (seq_deg - 1) * self._ici_time(kv_piece)
+                # the ring pipelines each K/V hop behind the previous
+                # block's score compute (ops/pallas/ring_attention.py), so
+                # the step costs max(compute, comm), not their sum
+                fwd = max(fwd, ring)
+                bwd = max(bwd, 2.0 * ring)
         return OpCost(fwd, bwd, 0.0, mem)
 
     # -- measured mode ------------------------------------------------------
+    #
+    # The direct analog of the reference's inner_measure_operator_cost
+    # (model.cu:38-74, cached per (OperatorParameters, MachineView) in
+    # simulator.cc:532-572), adapted to the two TPU realities the analytic
+    # path cannot capture:
+    #   * XLA fusion and MXU tiling make real op time diverge from the
+    #     roofline in shape-dependent ways;
+    #   * on the axon-tunneled platform block_until_ready does NOT
+    #     synchronize, so timing uses the readback-differencing methodology
+    #     BASELINE.md established for bench.py: two chained runs of n1 and
+    #     n2 dispatches, each ended by ONE scalar readback, differenced so
+    #     the tunnel RTT and dispatch constants cancel. Each dispatch runs
+    #     _MEASURE_CHAIN scan-chained kernel applications whose inputs are
+    #     data-dependent on the previous iteration (a 1e-30-scaled scalar
+    #     perturbation), so XLA cannot hoist the body out of the loop.
 
-    def _measure_op(self, node, input_shapes) -> Optional[float]:
-        """Time the real lowered kernel on shard shapes (reference:
-        inner_measure_operator_cost, model.cu:38-74). Cached like the
-        reference's hash_to_op_cost (simulator.cc:532-572)."""
-        key = (
-            node.params_hash(),
-            tuple(s.piece_sizes for s in input_shapes),
+    _MEASURE_CHAIN = 8
+    # differencing needs the timed work to dominate the tunnel's per-call
+    # jitter (~ms): grow the dispatch count until the differenced window
+    # exceeds _MEASURE_MIN_DIFF_S (or the cap is hit for very large ops)
+    _MEASURE_MIN_DIFF_S = 0.25
+    _MEASURE_MAX_CALLS = 512
+
+    def _shard_key(
+        self, op_type, params: dict, in_shapes, weight_shapes
+    ) -> str:
+        """Stable (across processes — no salted hash()) cache key."""
+        p = ",".join(f"{k}={params[k]!r}" for k in sorted(params))
+        def fmt(shapes):
+            return ";".join(
+                "x".join(
+                    str(d.piece_size)
+                    for d in s.dims
+                    if not d.is_replica_dim
+                )
+                + ":" + s.dtype.value
+                for s in shapes
+            )
+        return (
+            f"{op_type.name}|{p}|in={fmt(in_shapes)}|w={fmt(weight_shapes)}"
+            f"|mp{int(self.mixed_precision)}"
         )
+
+    def measure_shard(
+        self, op_type, params: dict, in_shapes, weight_shapes
+    ) -> Optional[Tuple[float, float]]:
+        """(forward_s, backward_s) of the real jitted kernel on SHARD
+        shapes (each shape's piece_sizes are what one chip sees). Returns
+        None when the op cannot be measured (lowering error, odd params);
+        callers fall back to the roofline."""
+        key = self._shard_key(op_type, params, in_shapes, weight_shapes)
         if key in self._measured:
             return self._measured[key]
+        times = self._time_kernel(op_type, params, in_shapes, weight_shapes)
+        self._measured[key] = times
+        if self.calibration_file and times is not None:
+            # throttled persistence (full-file rewrite): every few keys,
+            # plus an explicit flush_calibration() for callers at the end
+            self._unsaved += 1
+            if self._unsaved >= 4:
+                self.flush_calibration()
+        return times
+
+    def flush_calibration(self):
+        if self.calibration_file:
+            self._save_calibration()
+            self._unsaved = 0
+
+    def _time_kernel(
+        self, op_type, params, in_shapes, weight_shapes
+    ) -> Optional[Tuple[float, float]]:
         try:
-            import time
+            import time as _time
 
             import jax
             import jax.numpy as jnp
+            import numpy as np
+            from jax import lax
 
             from flexflow_tpu.ops.registry import LowerCtx, lower_op
 
-            fn = lower_op(node.op_type, node.params)
-            ins = [
-                jnp.zeros(
+            fn = lower_op(op_type, params)
+            ctx = LowerCtx(
+                train=False, rng=None, bf16_matmul=self.mixed_precision
+            )
+
+            def arr(s):
+                return jnp.full(
                     tuple(
                         d.piece_size
                         for d in s.dims
                         if not d.is_replica_dim
                     ),
+                    0.01,
                     s.dtype.to_jnp(),
                 )
-                for s in input_shapes
+
+            ins = [arr(s) for s in in_shapes]
+            ws = [arr(s) for s in weight_shapes]
+
+            def as_list(x):
+                return list(x) if isinstance(x, (list, tuple)) else [x]
+
+            def perturb_first(arrs, seed):
+                # perturb the first float array by a vanishing function of
+                # the previous iteration's result: forces true iteration
+                # dependence without changing the math measurably
+                out = list(arrs)
+                for i, a in enumerate(out):
+                    if jnp.issubdtype(a.dtype, jnp.floating):
+                        out[i] = a * (1.0 + seed * 1e-30).astype(a.dtype)
+                        return out, True
+                return out, False
+
+            def apply_op(inputs, weights, seed):
+                pins, done = perturb_first(inputs, seed)
+                pws = list(weights)
+                if not done:
+                    pws, _ = perturb_first(weights, seed)
+                outs = as_list(fn(pins, pws, ctx))
+                tot = jnp.float32(0.0)
+                for o in outs:
+                    tot = tot + jnp.sum(o.astype(jnp.float32))
+                return tot
+
+            k = self._MEASURE_CHAIN
+            # differentiable leaves: float inputs + all weights (integer
+            # inputs — embedding ids — are closed over, not grad args)
+            fidx = [
+                i
+                for i, a in enumerate(ins)
+                if jnp.issubdtype(a.dtype, jnp.floating)
             ]
-            ws = [
-                jnp.zeros(
-                    tuple(
-                        d.piece_size
-                        for d in s.dims
-                        if not d.is_replica_dim
-                    ),
-                    s.dtype.to_jnp(),
+
+            def fwd_chain(inputs, weights):
+                def body(s, _):
+                    return apply_op(inputs, weights, s) * 1e-30, None
+
+                s, _ = lax.scan(
+                    body, jnp.float32(0.0), None, length=k
                 )
-                for s in node.weight_shapes
-            ]
-            ctx = LowerCtx(train=False, rng=None)
-            jitted = jax.jit(lambda i, w: fn(i, w, ctx))
-            outs = jitted(ins, ws)  # compile + warmup
-            jax.block_until_ready(outs)
-            reps = 5
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                outs = jitted(ins, ws)
-            jax.block_until_ready(outs)
-            t = (time.perf_counter() - t0) / reps
-            self._measured[key] = t
-            return t
+                return s
+
+            def bwd_chain(inputs, weights):
+                def body(s, _):
+                    def loss(args):
+                        flt, w2 = args
+                        pins = list(inputs)
+                        for j, i2 in enumerate(fidx):
+                            pins[i2] = flt[j]
+                        return apply_op(pins, list(w2), s)
+
+                    val, grads = jax.value_and_grad(loss)(
+                        (
+                            tuple(inputs[i] for i in fidx),
+                            tuple(weights),
+                        )
+                    )
+                    acc = val
+                    for leaf in jax.tree_util.tree_leaves(grads):
+                        acc = acc + jnp.sum(leaf.astype(jnp.float32))
+                    return acc * 1e-30, None
+
+                s, _ = lax.scan(
+                    body, jnp.float32(0.0), None, length=k
+                )
+                return s
+
+            def timed(jitted):
+                out = jitted(ins, ws)  # compile + warmup
+                float(np.asarray(out))
+
+                def run(n):
+                    t0 = _time.perf_counter()
+                    for _ in range(n):
+                        out = jitted(ins, ws)
+                    float(np.asarray(out))  # forces the whole chain
+                    return _time.perf_counter() - t0
+
+                n = 2
+                while True:
+                    t1 = run(n)
+                    t2 = run(2 * n)
+                    diff = t2 - t1
+                    if (
+                        diff > self._MEASURE_MIN_DIFF_S
+                        or n >= self._MEASURE_MAX_CALLS
+                    ):
+                        break
+                    # jump straight to a count that should clear the bar
+                    grow = self._MEASURE_MIN_DIFF_S / max(diff, 1e-4)
+                    n = min(
+                        max(2 * n, int(n * grow) + 1),
+                        self._MEASURE_MAX_CALLS,
+                    )
+                per_iter = diff / (n * k)
+                return max(per_iter, 1e-9)
+
+            fwd = timed(jax.jit(fwd_chain))
+            if fwd < 1e-7:
+                # below the differencing noise floor: a negative or ~zero
+                # window means the measurement failed — do not poison the
+                # cache/table with it (roofline fallback instead)
+                return None
+            if not fidx and not ws:
+                return (fwd, fwd)  # nothing differentiable: estimate
+            total = timed(jax.jit(bwd_chain))
+            bwd = total - fwd
+            if bwd < 0.5 * fwd:
+                # bwd can't be cheaper than re-running forward; a smaller
+                # difference is noise — substitute the analytic ratio
+                bwd = (2.0 if op_type in _MXU_OPS else 1.0) * fwd
+            return (fwd, bwd)
         except Exception:
-            self._measured[key] = None
             return None
+
+    # -- optimizer update ----------------------------------------------------
+
+    def update_cost(
+        self, weight_shape: ParallelTensorShape, state_factor: float = 3.0
+    ) -> float:
+        """HBM time of one parameter's optimizer update (reference models
+        update tasks in its task graph, simulator.cc:810+; the NCCL/PS sync
+        is costed separately). Traffic ≈ read w + read g + r/w each state
+        slot + write w = (2·state_factor − 1) × master-precision bytes."""
+        traffic = (2.0 * state_factor - 1.0) * weight_shape.piece_bytes()
+        return traffic / (self.spec.hbm_gbps * 1e9 * self.efficiency)
+
+    # -- calibration-table persistence --------------------------------------
+
+    def _load_calibration(self):
+        import json
+        import os
+        import warnings
+
+        if not os.path.exists(self.calibration_file):
+            return
+        try:
+            with open(self.calibration_file) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        table_chip = doc.get("chip")
+        if table_chip and table_chip != self.spec.chip:
+            warnings.warn(
+                f"calibration table {self.calibration_file} was measured "
+                f"on chip {table_chip!r} but this search targets "
+                f"{self.spec.chip!r}; ignoring the table",
+                stacklevel=2,
+            )
+            return
+        for key, val in doc.get("ops", {}).items():
+            if val:  # failed measurements (null) are never persisted/read
+                self._measured[key] = tuple(val)
+
+    def _save_calibration(self):
+        import json
+        import os
+
+        doc = {
+            "version": 1,
+            "chip": self.spec.chip,
+            "ops": {
+                key: list(val)
+                for key, val in self._measured.items()
+                if val is not None
+            },
+        }
+        tmp = self.calibration_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, self.calibration_file)

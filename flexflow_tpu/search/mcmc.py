@@ -74,12 +74,16 @@ def mcmc_optimize(
     verbose: bool = False,
     machine_model=None,
     mixed_precision: bool = False,
+    measure: bool = False,
+    calibration_file: str = "",
 ) -> UnityResult:
     """reference: mcmc_optimize (model.cc:3271) — budget proposals, periodic
     reset to best every budget/10 non-improving steps."""
     search = UnitySearch(
         graph, spec, machine_model=machine_model,
         mixed_precision=mixed_precision,
+        measure=measure,
+        calibration_file=calibration_file,
     )
     resource = search.resource
     rng = random.Random(seed)

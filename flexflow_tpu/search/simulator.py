@@ -44,6 +44,7 @@ class GraphCost:
     compute_time: float = 0.0
     comm_time: float = 0.0
     sync_time: float = 0.0
+    update_time: float = 0.0  # optimizer HBM traffic (CostModel.update_cost)
     memory_per_chip: int = 0
 
     def feasible(self, spec: MachineSpec) -> bool:
@@ -235,6 +236,7 @@ def estimate_graph_cost(
         if not node.weight_shapes:
             continue
         t_sync = 0.0
+        t_update = 0.0
         total_chips = 1
         for s in mesh_sizes:
             total_chips *= s
@@ -248,15 +250,31 @@ def estimate_graph_cost(
                     else _axis_group_chips(0, g, mesh_sizes)
                 )
                 t_sync += cm.all_reduce(cm.piece_bytes(w), g, chips=chips)
+                t_update += cm.update_cost(w, optimizer_state_factor)
+        t = None
         if include_backward and t_sync > 0:
             total.sync_time += t_sync
             t = add_task(link(0), t_sync, f"{node.name}.sync")
             add_edge(bwd_task.get(guid, fwd_task[guid]), t)
+        if include_backward and t_update > 0:
+            # the update consumes the synced grad: a chip-resource task
+            # after both the bwd compute and the sync (reference: per-
+            # parameter SGD/ADAM_UPD tasks, optimizer_kernel.cu:88)
+            total.update_time += t_update
+            tu = add_task(_CHIP, t_update, f"{node.name}.update")
+            add_edge(bwd_task.get(guid, fwd_task[guid]), tu)
+            if t is not None:
+                add_edge(t, tu)
 
     total.memory_per_chip = int(weight_bytes * optimizer_state_factor + act_bytes)
 
     if not taskgraph:
-        total.step_time = total.compute_time + total.comm_time + total.sync_time
+        total.step_time = (
+            total.compute_time
+            + total.comm_time
+            + total.sync_time
+            + total.update_time
+        )
         return total
 
     if export is not None:
@@ -272,7 +290,12 @@ def estimate_graph_cost(
 
     sim = native.simulate(resource_of, duration, edges, num_resources)
     if sim is None:  # malformed candidate graph — treat as analytic
-        total.step_time = total.compute_time + total.comm_time + total.sync_time
+        total.step_time = (
+            total.compute_time
+            + total.comm_time
+            + total.sync_time
+            + total.update_time
+        )
     else:
         total.step_time = sim[0]
     return total

@@ -37,6 +37,7 @@ from collections import Counter
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.core.machine import MachineResource, MachineSpec, MachineView
+from flexflow_tpu.core.parallel_tensor import ParallelTensorShape
 from flexflow_tpu.core.pcg import PCGGraph
 from flexflow_tpu.core.types import OperatorType
 from flexflow_tpu.ops.registry import op_flops
@@ -113,11 +114,17 @@ class UnitySearch:
         include_backward: bool = True,
         machine_model=None,
         mixed_precision: bool = False,
+        measure: bool = False,
+        calibration_file: str = "",
     ):
         self.graph = graph
         self.spec = spec
         self.cm = CostModel(
-            spec, machine_model=machine_model, mixed_precision=mixed_precision
+            spec,
+            machine_model=machine_model,
+            mixed_precision=mixed_precision,
+            measure=measure,
+            calibration_file=calibration_file,
         )
         self.resource = resource or spec.resource()
         self.include_backward = include_backward
@@ -188,27 +195,76 @@ class UnitySearch:
 
     # -- per-(node, view) costs ---------------------------------------------
 
+    def _measured_times(
+        self, node, in_shapes, opt: ViewOption
+    ) -> Optional[Tuple[float, float]]:
+        """(fwd, bwd) of the real jitted kernel on the shard this view
+        gives one chip (reference: measure_operator_cost at the search's
+        leaves, simulator.cc:532). dp shards the batch dim; ch shards
+        Linear output channels exactly (params rewrite + re-infer) and MHA
+        heads approximately (full-head shard measured, time / ch — head
+        shards are the same matmuls at 1/ch width)."""
+        from flexflow_tpu.ops.registry import infer_shapes
+        from flexflow_tpu.search.cost_model import _MXU_OPS
+
+        if node.op_type not in _MXU_OPS:
+            return None
+        try:
+            shard_ins = []
+            for s in in_shapes:
+                sizes = list(s.logical_sizes)
+                if opt.dp > 1:
+                    if not sizes or sizes[0] % opt.dp != 0:
+                        return None
+                    sizes[0] //= opt.dp
+                shard_ins.append(
+                    ParallelTensorShape.make(sizes, s.dtype)
+                )
+            params = dict(node.params)
+            divide = 1
+            if opt.ch > 1:
+                if (
+                    node.op_type == OperatorType.LINEAR
+                    and params.get("out_features", 0) % opt.ch == 0
+                ):
+                    params["out_features"] //= opt.ch
+                else:
+                    divide = opt.ch
+            _, ws = infer_shapes(node.op_type, shard_ins, params)
+            times = self.cm.measure_shard(node.op_type, params, shard_ins, ws)
+            if times is None:
+                return None
+            return (times[0] / divide, times[1] / divide)
+        except Exception:
+            return None
+
     def op_cost(self, guid: int, opt: ViewOption) -> float:
-        """Roofline fwd(+bwd) seconds of the node's shard under `opt`
-        (the reference measures the real kernel here, simulator.cc:532;
-        our analytic default mirrors CostModel.op_cost)."""
+        """Fwd(+bwd) seconds of the node's shard under `opt`: the real
+        measured kernel when the cost model is in measured mode
+        (reference: simulator.cc:532), the analytic roofline otherwise."""
         node = self.graph.nodes[guid]
         if node.op_type == OperatorType.INPUT or node.is_parallel_op:
             return 0.0
         n = opt.num_devices
         in_shapes = [self.graph.shape_of(r) for r in node.inputs]
-        flops = op_flops(node.op_type, in_shapes, node.params) / n
         eb = self.cm.elem_bytes
-        data = sum(s.volume() * eb(s) for s in in_shapes)
-        data += sum(s.volume() * eb(s) for s in node.output_shapes)
-        data += sum(s.volume() * eb(s) for s in node.weight_shapes)
-        t = self.cm._roofline(flops, data / n)
-        if self.include_backward:
-            mxu = node.op_type in _CHANNEL_OPS or node.op_type in (
-                OperatorType.CONV2D,
-                OperatorType.BATCHMATMUL,
-            )
-            t *= 3.0 if mxu else 2.0
+        t = None
+        if self.cm.measure:
+            mt = self._measured_times(node, in_shapes, opt)
+            if mt is not None:
+                t = mt[0] + (mt[1] if self.include_backward else 0.0)
+        if t is None:
+            flops = op_flops(node.op_type, in_shapes, node.params) / n
+            data = sum(s.volume() * eb(s) for s in in_shapes)
+            data += sum(s.volume() * eb(s) for s in node.output_shapes)
+            data += sum(s.volume() * eb(s) for s in node.weight_shapes)
+            t = self.cm._roofline(flops, data / n)
+            if self.include_backward:
+                mxu = node.op_type in _CHANNEL_OPS or node.op_type in (
+                    OperatorType.CONV2D,
+                    OperatorType.BATCHMATMUL,
+                )
+                t *= 3.0 if mxu else 2.0
         # gradient sync: weights are sharded ch ways and replicated across
         # the dp data replicas; all-reduce the shards over the actual device
         # ids of one replica group (ids are laid out (dp, ch) row-major, so
@@ -246,6 +302,7 @@ class UnitySearch:
         if (
             len(sinks) == 1
             and self.cm.machine_model is None
+            and not self.cm.measure  # measured leaf costs need Python leaves
             and self.include_backward
             # guard BEFORE the per-node extraction pass: without the
             # library (or past the 256-node bitset cap) the pass would be

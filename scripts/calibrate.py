@@ -1,0 +1,210 @@
+"""Measured-kernel search calibration on the real chip (VERDICT r1 item 1).
+
+For each workload this script:
+  1. measures every MXU op of the model's PCG with the real jitted kernel
+     (CostModel.measure_shard — the analog of the reference's
+     inner_measure_operator_cost, model.cu:38-74), persisting the table to
+     --calibration-file so later searches reuse it;
+  2. predicts the training-step time from those measured leaf costs
+     (search.simulator.estimate_graph_cost);
+  3. measures the ACTUAL step time of the compiled model with the
+     readback-differencing methodology (BASELINE.md) and reports
+     predicted/actual.
+
+Run:  python scripts/calibrate.py [transformer resnet dlrm]
+      [--calibration-file calibration/v5e.json] [-b N]
+
+The validation target (VERDICT): predicted within ~20% of measured.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+CHIP = "v5e"  # the real chip behind the axon tunnel
+
+
+def _measure_actual_step(model, data, n1=5, n2=25):
+    """Differencing step-time of the jitted train step (bench.py method)."""
+    import jax
+
+    step = model.executor.train_step()
+    batch = model.executor.shard_batch(data)
+    params, opt_state = model.params, model.opt_state
+    key = jax.random.PRNGKey(0)
+
+    def chain(n, p, o):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            p, o, loss, _ = step(p, o, batch, key)
+        _ = float(np.asarray(loss))
+        return time.perf_counter() - t0, p, o
+
+    _, params, opt_state = chain(2, params, opt_state)  # compile + warmup
+    t1, params, opt_state = chain(n1, params, opt_state)
+    t2, params, opt_state = chain(n2, params, opt_state)
+    return (t2 - t1) / (n2 - n1)
+
+
+def _predict_step(model, calibration_file, mixed_precision):
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.simulator import estimate_graph_cost
+
+    spec = MachineSpec(num_nodes=1, chips_per_node=1, chip=model.config.chip)
+    cm = CostModel(
+        spec,
+        measure=True,
+        mixed_precision=mixed_precision,
+        calibration_file=calibration_file,
+    )
+    cost = estimate_graph_cost(model.graph, cm, (1,))
+    cm.flush_calibration()
+    measured_keys = sum(
+        1 for v in cm._measured.values() if v is not None
+    )
+    return cost.step_time, measured_keys
+
+
+def build_transformer_wl(batch):
+    from examples.transformer import build_transformer, synthetic_batch
+    from flexflow_tpu import FFConfig
+
+    cfg = FFConfig(batch_size=batch, learning_rate=0.01)
+    cfg.chip = CHIP
+    cfg.allow_mixed_precision = True
+    model, _ = build_transformer(
+        cfg, batch_size=batch, seq_len=512, hidden=1024,
+        num_heads=16, num_layers=12,
+    )
+    return model, synthetic_batch(batch, 512, 1024)
+
+
+def build_resnet_wl(batch):
+    from examples.common import synthetic_images
+    from flexflow_tpu import (
+        FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+    from flexflow_tpu.models import build_resnet50
+
+    cfg = FFConfig(batch_size=batch)
+    cfg.chip = CHIP
+    ff = FFModel(cfg)
+    x = ff.create_tensor([batch, 224, 224, 3], name="image")
+    build_resnet50(ff, x, num_classes=10)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    X, y = synthetic_images(batch, 224, 224)
+    return ff, {"image": X, "label": y}
+
+
+def build_dlrm_wl(batch):
+    from flexflow_tpu import (
+        DataType, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+    )
+    from flexflow_tpu.models import build_dlrm
+
+    cfg = FFConfig(batch_size=batch)
+    cfg.chip = CHIP
+    emb_sizes = [1000000] * 4
+    ff = FFModel(cfg)
+    dense = ff.create_tensor([batch, 4], name="dense_features")
+    sparse = [
+        ff.create_tensor([batch, 1], dtype=DataType.INT32, name=f"sparse_{i}")
+        for i in range(len(emb_sizes))
+    ]
+    build_dlrm(ff, dense, sparse, embedding_sizes=emb_sizes)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    rng = np.random.RandomState(0)
+    data = {"dense_features": rng.randn(batch, 4).astype(np.float32)}
+    for i, v in enumerate(emb_sizes):
+        data[f"sparse_{i}"] = rng.randint(0, v, size=(batch, 1)).astype(
+            np.int32
+        )
+    data["label"] = rng.rand(batch, 2).astype(np.float32)
+    return ff, data
+
+
+WORKLOADS = {
+    "transformer": (build_transformer_wl, 8),
+    "resnet": (build_resnet_wl, 16),
+    "dlrm": (build_dlrm_wl, 64),
+}
+
+
+def main():
+    args = sys.argv[1:]
+    calib = "calibration/v5e.json"
+    batch_override = None
+    names = []
+    i = 0
+    while i < len(args):
+        if args[i] == "--calibration-file":
+            i += 1
+            calib = args[i]
+        elif args[i] == "-b":
+            i += 1
+            batch_override = int(args[i])
+        elif args[i] in WORKLOADS:
+            names.append(args[i])
+        i += 1
+    names = names or list(WORKLOADS)
+    os.makedirs(os.path.dirname(calib) or ".", exist_ok=True)
+
+    rows = []
+    for name in names:
+        build, default_batch = WORKLOADS[name]
+        batch = batch_override or default_batch
+        print(f"[calibrate] building {name} (batch {batch})...", flush=True)
+        model, data = build(batch)
+        mixed = model.config.allow_mixed_precision
+        print(f"[calibrate] measuring per-op kernels for {name}...", flush=True)
+        predicted, nkeys = _predict_step(model, calib, mixed)
+        print(
+            f"[calibrate] {name}: {nkeys} measured op keys; "
+            f"predicted step {predicted * 1e3:.3f} ms",
+            flush=True,
+        )
+        actual = _measure_actual_step(model, data)
+        ratio = predicted / actual if actual > 0 else float("nan")
+        rows.append((name, batch, predicted * 1e3, actual * 1e3, ratio))
+        print(
+            f"[calibrate] {name}: actual step {actual * 1e3:.3f} ms, "
+            f"predicted/actual = {ratio:.2f}",
+            flush=True,
+        )
+
+    print("\n| workload | batch | predicted ms | measured ms | pred/meas |")
+    print("|---|---|---|---|---|")
+    for name, batch, p, a, r in rows:
+        print(f"| {name} | {batch} | {p:.3f} | {a:.3f} | {r:.2f} |")
+    print(f"\ncalibration table: {calib}")
+    print(
+        json.dumps(
+            {
+                "metric": "calibration_ratio_" + "_".join(names),
+                "rows": [
+                    {"workload": n, "predicted_ms": round(p, 3),
+                     "measured_ms": round(a, 3), "ratio": round(r, 3)}
+                    for n, _, p, a, r in rows
+                ],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""Measured-kernel cost calibration (VERDICT r1 item 1).
+
+Runs the real measured mode (CPU jit here; scripts/calibrate.py runs the
+same path on the TPU) so CostModel._time_kernel / measure_shard /
+UnitySearch._measured_times cannot rot as dead code. Mirrors the
+reference's inner_measure_operator_cost + hash_to_operator_cost
+(model.cu:38-74, simulator.cc:532-572).
+"""
+
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.search.cost_model import CostModel
+from flexflow_tpu.search.unity import UnitySearch
+
+SPEC = MachineSpec(num_nodes=1, chips_per_node=4, chip="v4")
+
+
+def linear_node(batch=16, in_dim=32, out_dim=32):
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, in_dim], name="x")
+    m.dense(x, out_dim, activation=ActiMode.RELU)
+    from flexflow_tpu.runtime.executor import propagate_shapes
+
+    propagate_shapes(m.graph)
+    node = next(
+        n for n in m.graph.nodes.values()
+        if n.op_type == OperatorType.LINEAR
+    )
+    in_shapes = [m.graph.shape_of(r) for r in node.inputs]
+    return m, node, in_shapes
+
+
+def test_measured_op_cost_real_kernel():
+    m, node, in_shapes = linear_node()
+    cm = CostModel(SPEC, measure=True)
+    cost = cm.op_cost(node, in_shapes)
+    assert cost.forward_time > 0
+    assert cost.backward_time >= 0
+    # cached: a second call must not re-measure
+    calls = {"n": 0}
+    orig = cm._time_kernel
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    cm._time_kernel = counting
+    again = cm.op_cost(node, in_shapes)
+    assert calls["n"] == 0
+    assert again.forward_time == cost.forward_time
+
+
+def test_calibration_file_roundtrip(tmp_path):
+    path = str(tmp_path / "calib.json")
+    m, node, in_shapes = linear_node()
+    cm1 = CostModel(SPEC, measure=True, calibration_file=path)
+    c1 = cm1.op_cost(node, in_shapes)
+    cm1.flush_calibration()  # saves are throttled; callers flush at the end
+
+    cm2 = CostModel(SPEC, measure=True, calibration_file=path)
+    cm2._time_kernel = lambda *a, **k: pytest.fail(
+        "calibration table should have served this key"
+    )
+    c2 = cm2.op_cost(node, in_shapes)
+    assert c2.forward_time == pytest.approx(c1.forward_time)
+    assert c2.backward_time == pytest.approx(c1.backward_time)
+
+
+def test_calibration_chip_mismatch_ignored(tmp_path):
+    path = str(tmp_path / "calib.json")
+    m, node, in_shapes = linear_node()
+    cm1 = CostModel(SPEC, measure=True, calibration_file=path)
+    cm1.op_cost(node, in_shapes)
+    cm1.flush_calibration()
+
+    other = MachineSpec(num_nodes=1, chips_per_node=4, chip="v5e")
+    with pytest.warns(UserWarning, match="measured on chip"):
+        cm2 = CostModel(other, measure=True, calibration_file=path)
+    assert not cm2._measured  # v4-measured table must not cost a v5e search
+
+
+def test_failed_measurement_not_persisted(tmp_path):
+    path = str(tmp_path / "calib.json")
+    m, node, in_shapes = linear_node()
+    cm1 = CostModel(SPEC, measure=True, calibration_file=path)
+    cm1._time_kernel = lambda *a, **k: None  # transient failure
+    cm1.op_cost(node, in_shapes)
+    cm1.flush_calibration()
+
+    cm2 = CostModel(SPEC, measure=True, calibration_file=path)
+    calls = {"n": 0}
+
+    def probe(*a, **k):
+        calls["n"] += 1
+        return (1e-4, 2e-4)
+
+    cm2._time_kernel = probe
+    cost = cm2.op_cost(node, in_shapes)
+    assert calls["n"] == 1  # a fresh process retries, not poisoned
+    assert cost.forward_time == pytest.approx(1e-4)
+
+
+def test_unmeasurable_op_falls_back_to_roofline():
+    m, node, in_shapes = linear_node()
+    cm = CostModel(SPEC, measure=True)
+    cm._time_kernel = lambda *a, **k: None  # simulate lowering failure
+    cost = cm.op_cost(node, in_shapes)
+    analytic = CostModel(SPEC).op_cost(node, in_shapes)
+    assert cost.forward_time == pytest.approx(analytic.forward_time)
+
+
+def test_unity_search_measured_mode():
+    """The DP search runs on measured leaf costs (Python leaves — the
+    native solver must not be dispatched) and still returns a strategy."""
+    m = FFModel(FFConfig(batch_size=16))
+    x = m.create_tensor([16, 32], name="x")
+    t = m.dense(x, 32, activation=ActiMode.RELU)
+    m.dense(t, 8)
+    search = UnitySearch(m.graph, SPEC, measure=True)
+    search._optimize_native = lambda sink: pytest.fail(
+        "measured mode must use the Python DP (per-view measured leaves)"
+    )
+    result = search.optimize()
+    assert result.cost > 0
+    assert result.views
+    # at least one MXU leaf actually came from measurement
+    assert any(v is not None for v in search.cm._measured.values())
+
+
+def test_compile_threads_measure_flag():
+    import flexflow_tpu.search.auto as auto
+
+    cfg = FFConfig(batch_size=16)
+    cfg.search_engine = "unity"
+    cfg.search_budget = 5
+    cfg.measure_costs = True
+    m = FFModel(cfg)
+    x = m.create_tensor([16, 32], name="x")
+    m.dense(x, 16)
+
+    seen = {}
+    orig = UnitySearch.__init__
+
+    def spy(self, *args, **kwargs):
+        seen["measure"] = kwargs.get("measure", False)
+        return orig(self, *args, **kwargs)
+
+    UnitySearch.__init__ = spy
+    try:
+        auto.search_strategy(m, 4)
+    finally:
+        UnitySearch.__init__ = orig
+    assert seen.get("measure") is True
+
+
+def test_parse_args_measure_flags():
+    cfg = FFConfig.parse_args(
+        ["--measure-costs", "--calibration-file", "/tmp/c.json"]
+    )
+    assert cfg.measure_costs is True
+    assert cfg.calibration_file == "/tmp/c.json"
