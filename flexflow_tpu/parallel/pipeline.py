@@ -92,6 +92,7 @@ def pipeline_apply(
     axis_name: str = "pipe",
     num_microbatches: int = 4,
     data_axis: str | None = None,
+    stage_leading_axis: bool = False,
 ):
     """jit-able entry: shard_map the GPipe loop over `mesh`.
 
@@ -100,11 +101,19 @@ def pipeline_apply(
     x: global [batch, ...] input; optionally data-parallel over `data_axis`
     (pipeline × data two-axis meshes compose).
 
+    stage_leading_axis: when each stage runs SEVERAL model blocks (leaves
+    stacked [num_stages * blocks_per_stage, ...]), pass True — block_fn
+    then receives its slice with the per-stage leading axis intact
+    ([blocks_per_stage, ...]) and is responsible for looping over it.
+
     Returns the global [batch, ...] output, replicated over `axis_name`
     (psum of the last stage's emission).
     """
     def inner(params, xin):
-        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        if stage_leading_axis:
+            local = params
+        else:
+            local = jax.tree_util.tree_map(lambda p: p[0], params)
         y = gpipe(
             block_fn,
             local,

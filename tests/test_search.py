@@ -84,7 +84,9 @@ def test_optimize_returns_feasible_strategy():
     m, _ = _transformer_block_model(batch=16, seq=64, hidden=512, heads=8)
     spec = MachineSpec(num_nodes=1, chips_per_node=8, chip="v4")
     result = optimize(m.graph, 8, spec, budget=40, seed=0)
-    assert result.dp * result.tp == 8
+    # the search also enumerates idle-chip dp baselines, so the winner may
+    # legitimately use fewer than 8 chips for a small model
+    assert 1 <= result.dp * result.tp <= 8
     assert result.cost.step_time > 0
     # strategy must be applicable to the real graph
     strat = result_to_strategy(result, m.graph)
@@ -126,6 +128,18 @@ def test_strategy_export_import_roundtrip(tmp_path):
     strat = load_strategy(path, m2.graph, 8)
     strat.apply(m2.graph)
     propagate_shapes(m2.graph)
-    assert strat.mesh_config.axis_sizes == (
-        (result.dp, result.tp) if result.tp > 1 else (result.dp,)
-    )
+    if result.kind == "seq":
+        expect = (
+            (result.dp, result.extra["sp"])
+            if result.dp > 1
+            else (result.extra["sp"],)
+        )
+        # sequence strategy meshes are (data, seq)
+        expect_len = 2 if result.dp > 1 else 1
+        assert strat.mesh_config.axis_sizes[-expect_len:] == expect[-expect_len:]
+    elif result.kind == "pipeline":
+        assert "pipe" in strat.mesh_config.axis_names
+    else:
+        assert strat.mesh_config.axis_sizes == (
+            (result.dp, result.tp) if result.tp > 1 else (result.dp,)
+        )
