@@ -258,7 +258,23 @@ def _infer_aggregate_spec(input_shapes, params):
     return _infer_aggregate(input_shapes, params)
 
 
-register_op(OperatorType.AGGREGATE_SPEC, _infer_aggregate_spec, _lower_aggregate)
+def _lower_aggregate_spec(params):
+    """AggregateSpec = Aggregate that does NOT backprop into the gate
+    network (reference: aggregate_spec.cc — the speculative variant's
+    backward sends expert gradients but no gate gradient; the reference
+    MoE example pairs it with a plain Aggregate that trains the gate)."""
+    inner = _lower_aggregate(params)
+
+    def fn(ins, ws, ctx):
+        ins2 = [jax.lax.stop_gradient(ins[0])] + list(ins[1:])
+        return inner(ins2, ws, ctx)
+
+    return fn
+
+
+register_op(
+    OperatorType.AGGREGATE_SPEC, _infer_aggregate_spec, _lower_aggregate_spec
+)
 
 
 # ---------------------------------------------------------------------------
@@ -288,12 +304,32 @@ def _infer_cache(input_shapes, params):
 
 
 def _lower_cache(params):
-    # Under XLA a trained-step cache is a passthrough; the recompile hook
-    # (runtime.recompile) owns cross-iteration memoization decisions.
+    # In-graph the cache is an identity (reusing stale activations inside
+    # a jitted step would silently change training math); the
+    # MEMOIZATION lives host-side: the executor surfaces every cache
+    # node's input each training step, FFModel keeps the last
+    # `num_batches` of them and scores fresh-vs-cached drift with the
+    # node's score function (reference: cache.cc score_f), and the score
+    # feeds recompile_on_condition triggers — the moe.cc:65-99 pattern of
+    # cached expert assignments driving re-sharding.
     def fn(ins, ws, ctx):
         return [ins[0]]
 
     return fn
+
+
+def default_cache_score(cached, fresh):
+    """Relative L1 drift of the fresh batch vs the rolling cached mean
+    (reference: the moe example's score_f compares cached vs new expert
+    assignments, moe.cc)."""
+    import numpy as np
+
+    if not cached:
+        return 1.0
+    ref = np.mean([np.asarray(c, dtype=np.float64) for c in cached], axis=0)
+    fresh = np.asarray(fresh, dtype=np.float64)
+    denom = np.abs(ref).sum() + 1e-12
+    return float(np.abs(fresh - ref).sum() / denom)
 
 
 register_op(OperatorType.CACHE, _infer_cache, _lower_cache)

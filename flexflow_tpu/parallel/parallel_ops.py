@@ -204,6 +204,69 @@ def _infer_fused_parallel(input_shapes, params):
 
 register_op(OperatorType.FUSED_PARALLEL, _infer_fused_parallel, _identity_lower)
 
+_FOLDABLE = {
+    OperatorType.REPARTITION,
+    OperatorType.COMBINE,
+    OperatorType.REPLICATE,
+    OperatorType.REDUCTION,
+    OperatorType.FUSED_PARALLEL,
+}
+
+
+def _chain_of(node) -> Tuple[ParallelOpInfo, ...]:
+    if node.op_type == OperatorType.FUSED_PARALLEL:
+        return tuple(node.params["chain"])
+    return (
+        ParallelOpInfo(
+            node.op_type,
+            node.params.get("axis", 0),
+            node.params["degree"],
+            node.params.get("parallel_idx", -1),
+        ),
+    )
+
+
+def fold_parallel_ops(graph) -> int:
+    """Fold runs of adjacent single-consumer parallel ops into one
+    FUSED_PARALLEL node (reference: fused_parallel_op.cc applies a chain
+    of ParallelOpInfos in one task — here one node means ONE sharding
+    constraint for the whole re-layout, letting GSPMD emit a single fused
+    collective instead of a chain). Returns the number of folds. Callers
+    re-propagate shapes after."""
+    from flexflow_tpu.core.pcg import TensorRef
+
+    folded = 0
+    changed = True
+    while changed:
+        changed = False
+        for guid in list(graph.topo_order()):
+            node = graph.nodes.get(guid)
+            if node is None or node.op_type not in _FOLDABLE:
+                continue
+            cons = graph.consumers(guid)
+            if len(cons) != 1:
+                continue
+            nxt = graph.nodes[next(iter(cons))]
+            if nxt.op_type not in _FOLDABLE:
+                continue
+            chain = _chain_of(node) + _chain_of(nxt)
+            fused = graph.add_node(
+                OperatorType.FUSED_PARALLEL,
+                f"{node.name}+{nxt.name}",
+                [node.inputs[0]],
+                {"chain": chain},
+                list(nxt.output_shapes),
+            )
+            new_ref = TensorRef(fused.guid, 0)
+            for c in list(graph.consumers(nxt.guid)):
+                graph.replace_input(c, TensorRef(nxt.guid, 0), new_ref)
+            graph.remove_node(nxt.guid)
+            graph.remove_node(guid)
+            folded += 1
+            changed = True
+            break
+    return folded
+
 
 # ---------------------------------------------------------------------------
 # Pipeline (OP_PIPELINE) — declared but UNIMPLEMENTED in the reference

@@ -119,6 +119,14 @@ class Executor:
             g: lower_op(graph.nodes[g].op_type, graph.nodes[g].params)
             for g in self.topo
         }
+        # cache ops surface their input to the host memoizer each train
+        # step (reference: cache.cc forward stores the batch; here the
+        # value rides the metrics pytree out of the jitted step)
+        self.cache_guids = [
+            g
+            for g in self.topo
+            if graph.nodes[g].op_type == OperatorType.CACHE
+        ]
         self._train_step = None
         self._eval_step = None
         self._fwd = None
@@ -229,6 +237,12 @@ class Executor:
         mets = compute_metrics(
             self.metric_types, logits, labels, from_logits=self.logits_from_logits
         )
+        if train and self.cache_guids:
+            mets = dict(mets)
+            for guid in self.cache_guids:
+                node = self.graph.nodes[guid]
+                r = node.inputs[0]
+                mets[f"__cache_{node.name}"] = values[(r.guid, r.out_idx)]
         return loss, mets
 
     # -- compiled entry points ----------------------------------------------

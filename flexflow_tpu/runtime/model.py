@@ -71,6 +71,10 @@ class FFModel:
         self.executor: Optional[Executor] = None
         self.params = None
         self.opt_state = None
+        # host-side cache-op memoization (reference: src/ops/cache.cc)
+        self._cache_specs: Dict[str, tuple] = {}
+        self._cache_state: Dict[str, list] = {}
+        self._cache_scores: Dict[str, float] = {}
         self.optimizer: Optional[Optimizer] = None
         self.loss_type: Optional[LossType] = None
         self.metric_types: Sequence[MetricsType] = ()
@@ -553,6 +557,71 @@ class FFModel:
             name,
         )[0]
 
+    def aggregate_spec(
+        self,
+        gate_values: Tensor,
+        gate_assign: Tensor,
+        exp_preds,
+        n: int,
+        lambda_bal: float = 0.0,
+        name=None,
+    ):
+        """Speculative aggregate: expert outputs combine like aggregate()
+        but the gate network receives no gradient (reference:
+        src/ops/aggregate_spec.cc)."""
+        stacked = isinstance(exp_preds, Tensor)
+        preds = [exp_preds] if stacked else list(exp_preds)
+        return self._add(
+            OperatorType.AGGREGATE_SPEC,
+            "aggregate_spec",
+            [gate_values, gate_assign] + preds,
+            {"n": n, "lambda_bal": lambda_bal, "stacked": stacked},
+            name,
+        )[0]
+
+    def cache(
+        self,
+        input: Tensor,
+        num_batches: int = 1,
+        score_f=None,
+        name=None,
+    ) -> Tensor:
+        """Activation memoization (reference: FFModel::cache, src/ops/
+        cache.cc): keeps the last `num_batches` values of `input` on the
+        host and scores fresh-vs-cached drift with `score_f(cached_list,
+        fresh) -> float` each training step. Read the rolling score with
+        `cache_score(name)` — the moe.cc:65-99 pattern feeds it to
+        recompile_on_condition to trigger expert re-sharding."""
+        out = self._add(
+            OperatorType.CACHE,
+            "cache",
+            [input],
+            {"num_batches": int(num_batches)},
+            name,
+        )[0]
+        node = self.graph.nodes[out.ref.guid]
+        if score_f is None:
+            from flexflow_tpu.ops.moe import default_cache_score
+
+            score_f = default_cache_score
+        self._cache_specs[node.name] = (int(num_batches), score_f)
+        return out
+
+    def cache_score(self, name: str) -> float:
+        """Latest drift score of a cache op (1.0 until enough batches)."""
+        return self._cache_scores.get(name, 1.0)
+
+    def _update_cache(self, name: str, fresh) -> None:
+        spec = self._cache_specs.get(name)
+        if spec is None:
+            return
+        num_batches, score_f = spec
+        state = self._cache_state.setdefault(name, [])
+        if len(state) >= num_batches:
+            self._cache_scores[name] = float(score_f(list(state), fresh))
+        state.append(fresh)
+        del state[: max(0, len(state) - num_batches)]
+
     def moe(
         self,
         input: Tensor,
@@ -644,6 +713,17 @@ class FFModel:
         self.strategy.apply(self.graph)
         propagate_shapes(self.graph)
 
+        # fold adjacent parallel-op chains into FusedParallelOp nodes
+        # (reference: fused_parallel_op.cc; enabled with the fusion pass)
+        if (
+            self.config.perform_fusion
+            and getattr(self.strategy, "pipeline", None) is None
+        ):
+            from flexflow_tpu.parallel.parallel_ops import fold_parallel_ops
+
+            if fold_parallel_ops(self.graph):
+                propagate_shapes(self.graph)
+
         # substitution optimization pass (reference: base_optimize inside
         # GraphSearchHelper::graph_optimize; enabled by --substitution-json
         # or --fusion, SURVEY §2.5). A pipelined strategy pins the trunk's
@@ -702,7 +782,8 @@ class FFModel:
         lam_nodes = [
             n
             for n in self.graph.nodes.values()
-            if n.op_type == OperatorType.AGGREGATE
+            if n.op_type
+            in (OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC)
             and n.params.get("lambda_bal", 0.0) > 0.0
         ]
         if lam_nodes:
@@ -850,6 +931,17 @@ class FFModel:
                 self.params, self.opt_state, loss, mets = step(
                     self.params, self.opt_state, batch, key
                 )
+                if self._cache_specs:
+                    # surface cache-op inputs to the host memoizer
+                    # (syncs; only models that built cache() ops pay it)
+                    mets = dict(mets)
+                    for mname in [
+                        k for k in mets if k.startswith("__cache_")
+                    ]:
+                        self._update_cache(
+                            mname[len("__cache_"):],
+                            np.asarray(mets.pop(mname)),
+                        )
                 if not warm:
                     # exclude compile time from throughput (the reference's
                     # timing also starts after warmup, alexnet.cc:125-135)

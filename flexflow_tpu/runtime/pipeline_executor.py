@@ -70,6 +70,16 @@ class PipelinedExecutor(Executor):
         super().__init__(*args, **kwargs)
         self.pspec = pipeline_spec
         st = pipeline_spec.structure
+        for blk in st.blocks:
+            for g in blk:
+                if self.graph.nodes[g].op_type == OperatorType.CACHE:
+                    raise ValueError(
+                        "cache ops inside a pipelined trunk are not "
+                        "supported (the host memoizer needs the trunk-"
+                        "internal activation, which the GPipe schedule "
+                        "does not surface); place the cache in the "
+                        "prologue/epilogue or use a non-pipeline strategy"
+                    )
         self.template = st.blocks[0]
         self.block_pos = {g: i for i, g in enumerate(self.template)}
         self.entry_guid = st.prologue[-1] if st.prologue else None

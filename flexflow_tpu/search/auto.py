@@ -99,7 +99,8 @@ def _seq_candidate(
 
 
 def _pipeline_candidate(
-    base: PCGGraph, structure, dp: int, pp: int, mb: int, cm: CostModel
+    base: PCGGraph, structure, dp: int, pp: int, mb: int, cm: CostModel,
+    spec: MachineSpec = None,
 ) -> Optional[GraphCost]:
     """Analytic GPipe cost of a (dp, pipe) mesh: per-stage compute is the
     trunk's dp-sharded cost / pp, schedule stretch is the GPipe bubble
@@ -120,12 +121,15 @@ def _pipeline_candidate(
     rest = 0.0
     sync = 0.0
     update = 0.0
+    weight_bytes = 0.0
+    act_bytes = 0.0
     for guid, node in g.nodes.items():
         if node.op_type == OperatorType.INPUT or node.is_parallel_op:
             continue
         in_shapes = [g.shape_of(r) for r in node.inputs]
         c = cm.op_cost(node, in_shapes)
         t = c.forward_time + c.backward_time
+        act_bytes += sum(s.piece_bytes() for s in node.output_shapes)
         if guid in block_guids:
             trunk += t
         else:
@@ -133,6 +137,7 @@ def _pipeline_candidate(
         for w in node.weight_shapes:
             # weights replicate over BOTH axes in v1 storage, but grads
             # only need reducing over the dp replicas that computed them
+            weight_bytes += w.piece_bytes()
             if dp > 1:
                 sync += cm.all_reduce(cm.piece_bytes(w), dp)
             update += cm.update_cost(w)
@@ -151,7 +156,13 @@ def _pipeline_candidate(
         comm_time=hops,
         sync_time=sync,
         update_time=update,
+        # v1 pipeline storage REPLICATES weights on every chip
+        # (runtime/pipeline_executor.py) — the feasibility gate must see
+        # the full weight footprint, not a sharded one
+        memory_per_chip=int(weight_bytes * 3.0 + act_bytes / pp),
     )
+    if spec is not None and not cost.feasible(spec):
+        return None
     return cost
 
 
@@ -289,7 +300,9 @@ def optimize(
                 continue
             for mb in (4, 8):
                 evals += 1
-                cost = _pipeline_candidate(graph, structure, dp, pp, mb, cm)
+                cost = _pipeline_candidate(
+                    graph, structure, dp, pp, mb, cm, spec
+                )
                 if cost is None:
                     continue
                 cur = SearchResult(

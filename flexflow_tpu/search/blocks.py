@@ -44,13 +44,6 @@ class BlockStructure:
     def num_blocks(self) -> int:
         return len(self.blocks)
 
-    def block_weight_guids(self) -> List[List[int]]:
-        """Per-block guids that carry weights, in template order."""
-        return [
-            [g for g in blk]  # template order == topo order within block
-            for blk in self.blocks
-        ]
-
 
 def _node_signature(node, pos_of_guid, seg_guids, prev_cut) -> Tuple:
     params = tuple(

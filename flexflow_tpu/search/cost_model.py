@@ -451,6 +451,11 @@ class CostModel:
                 return max(per_iter, 1e-9)
 
             fwd = timed(jax.jit(fwd_chain))
+            if fwd > 1.0:
+                # no single-op shard at search scale runs for a second —
+                # this is tunnel contention (another process holding the
+                # device); don't poison the table
+                return None
             if fwd < 1e-7:
                 # below the differencing noise floor: a negative or ~zero
                 # window means the measurement failed — do not poison the

@@ -192,6 +192,39 @@ class SingleLinearSite(Site):
 
 
 @dataclasses.dataclass(frozen=True)
+class EmbeddingSite(Site):
+    """Model-parallel embedding: shard the table's embedding (out_dim)
+    column dim over the model axis — the reference's key DLRM pattern
+    ("embedding weight sharded or replicated", embedding.cc; DLRM
+    strategies shard tables while the MLPs stay data-parallel). Replicate
+    the ids, let the replica-dim protocol shard the table column-wise,
+    Combine gathers the feature dim after."""
+
+    def divisible_by(self, graph, tp):
+        return graph.nodes[self.guids[0]].params["out_dim"] % tp == 0
+
+    def apply(self, graph, tp, axis):
+        guid = self.guids[0]
+        node = graph.nodes[guid]
+        _insert_before(
+            graph,
+            guid,
+            node.inputs[0],
+            OperatorType.REPLICATE,
+            f"{node.name}.replicate",
+            {"degree": tp, "parallel_idx": axis},
+        )
+        out_ndim = len(node.output_shapes[0].dims)
+        _insert_after(
+            graph,
+            guid,
+            OperatorType.COMBINE,
+            f"{node.name}.combine",
+            {"axis": out_ndim - 1, "degree": tp},
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class ExpertParallelSite(Site):
     """Batched ExpertFFN + its Aggregate consumer: shard the expert dim
     over the model axis (GShard-style EP; the reference instead lets the
@@ -235,6 +268,9 @@ def find_tp_sites(graph: PCGGraph) -> List[Site]:
         node = graph.nodes[guid]
         if node.op_type == OperatorType.MULTIHEAD_ATTENTION:
             sites.append(AttentionSite("attention", (guid,)))
+            claimed.add(guid)
+        elif node.op_type == OperatorType.EMBEDDING:
+            sites.append(EmbeddingSite("embedding", (guid,)))
             claimed.add(guid)
         elif node.op_type == OperatorType.EXPERT_FFN:
             aggs = [

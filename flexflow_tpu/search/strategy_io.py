@@ -22,6 +22,7 @@ _SITE_KINDS = {}
 def _register_site_kinds():
     from flexflow_tpu.search.rewrites import (
         AttentionSite,
+        EmbeddingSite,
         ExpertParallelSite,
         LinearChainSite,
         SingleLinearSite,
@@ -30,6 +31,7 @@ def _register_site_kinds():
     _SITE_KINDS.update(
         {
             "attention": AttentionSite,
+            "embedding": EmbeddingSite,
             "expert_parallel": ExpertParallelSite,
             "linear_chain": LinearChainSite,
             "single_linear": SingleLinearSite,

@@ -44,11 +44,13 @@ from flexflow_tpu.ops.registry import op_flops
 from flexflow_tpu.search.cost_model import CostModel
 
 # ops that may take a 2-D (data × channel) view: the second view dim
-# partitions output channels / heads (reference: Linear::
-# get_random_parallel_config explores exactly these grids, linear.cc:707-744)
+# partitions output channels / heads / embedding columns (reference:
+# Linear::get_random_parallel_config explores exactly these grids,
+# linear.cc:707-744; DLRM shards embedding tables, embedding.cc)
 _CHANNEL_OPS = {
     OperatorType.LINEAR,
     OperatorType.MULTIHEAD_ATTENTION,
+    OperatorType.EMBEDDING,
 }
 
 
@@ -57,6 +59,8 @@ def _node_channel_size(node) -> Optional[int]:
         return node.params.get("out_features")
     if node.op_type == OperatorType.MULTIHEAD_ATTENTION:
         return node.params.get("num_heads")
+    if node.op_type == OperatorType.EMBEDDING:
+        return node.params.get("out_dim")
     return None
 
 
@@ -205,9 +209,9 @@ class UnitySearch:
         heads approximately (full-head shard measured, time / ch — head
         shards are the same matmuls at 1/ch width)."""
         from flexflow_tpu.ops.registry import infer_shapes
-        from flexflow_tpu.search.cost_model import _MXU_OPS
+        from flexflow_tpu.search.cost_model import _MEASURED_OPS
 
-        if node.op_type not in _MXU_OPS:
+        if node.op_type not in _MEASURED_OPS:
             return None
         try:
             shard_ins = []
@@ -228,6 +232,11 @@ class UnitySearch:
                     and params.get("out_features", 0) % opt.ch == 0
                 ):
                     params["out_features"] //= opt.ch
+                elif (
+                    node.op_type == OperatorType.EMBEDDING
+                    and params.get("out_dim", 0) % opt.ch == 0
+                ):
+                    params["out_dim"] //= opt.ch
                 else:
                     divide = opt.ch
             _, ws = infer_shapes(node.op_type, shard_ins, params)
