@@ -1,0 +1,123 @@
+/* C API demo 3: a transformer block from C — multihead attention, layer
+ * norm, residual adds — trained with the REFERENCE training-loop verbs
+ * (dataloader next_batch; forward; zero_gradients; backward; update) and
+ * scored with the metrics verbs.
+ * (reference: flexflow_cffi.py fit loop + flexflow_single_dataloader_*) */
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "flexflow_c.h"
+
+#define CHECK(x)                                         \
+  do {                                                   \
+    if (!(x)) {                                          \
+      fprintf(stderr, "FAILED: %s (line %d)\n", #x, __LINE__); \
+      return 1;                                          \
+    }                                                    \
+  } while (0)
+
+enum { B = 8, S = 16, H = 32, CLASSES = 4, NUM = 32 };
+
+int main(void) {
+  CHECK(flexflow_init(0, NULL) == 0);
+
+  char *argv[] = {"-b", "8"};
+  flexflow_config_t cfg = flexflow_config_create(2, argv);
+  flexflow_model_t model = flexflow_model_create(cfg);
+  CHECK(model != NULL);
+
+  int dims[3] = {B, S, H};
+  flexflow_tensor_t x = flexflow_tensor_create(model, 3, dims, "x");
+  CHECK(x != NULL);
+
+  /* pre-norm transformer block */
+  int norm_axes[1] = {2};
+  flexflow_tensor_t t =
+      flexflow_model_add_layer_norm(model, x, 1, norm_axes, 1, 1e-5f);
+  flexflow_tensor_t attn = flexflow_model_add_multihead_attention_ex(
+      model, t, t, t, H, /*heads*/ 4, 0, 0, 0.0f, /*bias*/ 1, /*causal*/ 1);
+  CHECK(attn != NULL);
+  t = flexflow_model_add_add(model, x, attn); /* residual */
+  flexflow_tensor_t h =
+      flexflow_model_add_dense(model, t, 4 * H, /*gelu*/ 4, 1);
+  h = flexflow_model_add_dense(model, h, H, 0, 1);
+  t = flexflow_model_add_add(model, t, h);
+  /* pool over sequence -> classify */
+  int mean_dims[1] = {1};
+  t = flexflow_model_add_mean(model, t, 1, mean_dims, 0);
+  flexflow_tensor_t logits =
+      flexflow_model_add_dense(model, t, CLASSES, 0, 1);
+  CHECK(logits != NULL);
+
+  flexflow_sgd_optimizer_t sgd =
+      flexflow_sgd_optimizer_create(model, 0.01, 0.0, 0, 0.0);
+  CHECK(sgd != NULL);
+  CHECK(flexflow_model_set_sgd_optimizer(model, sgd) == 0);
+  CHECK(flexflow_model_compile(model, "sparse_categorical_crossentropy",
+                               "accuracy", 0.01) == 0);
+  CHECK(flexflow_model_init_layers(model) == 0);
+
+  /* dataset + dataloaders */
+  float *X = (float *)malloc((size_t)NUM * S * H * sizeof(float));
+  int *Y = (int *)malloc((size_t)NUM * sizeof(int));
+  for (int i = 0; i < NUM * S * H; ++i)
+    X[i] = (float)((i * 2654435761u) % 997) / 997.0f - 0.5f;
+  for (int i = 0; i < NUM; ++i) Y[i] = i % CLASSES;
+  int64_t xs[3] = {NUM, S, H};
+  int64_t ys[1] = {NUM};
+  flexflow_single_dataloader_t dx =
+      flexflow_single_dataloader_create(model, x, X, xs, 3, 0);
+  flexflow_single_dataloader_t dy =
+      flexflow_single_dataloader_create_label(model, Y, ys, 1, 1);
+  CHECK(dx != NULL && dy != NULL);
+  CHECK(flexflow_single_dataloader_get_num_samples(dx) == NUM);
+
+  /* the reference's training loop, verb for verb */
+  double first_loss = NAN, last_loss = NAN;
+  int iters = NUM / B;
+  for (int epoch = 0; epoch < 2; ++epoch) {
+    if (epoch == 1) /* reference LR-decay pattern: set_lr mid-training */
+      flexflow_sgd_optimizer_set_lr(sgd, 0.001);
+    flexflow_single_dataloader_reset(dx);
+    flexflow_single_dataloader_reset(dy);
+    for (int it = 0; it < iters; ++it) {
+      flexflow_begin_trace(model, 111);
+      CHECK(flexflow_single_dataloader_next_batch(dx) == 0);
+      CHECK(flexflow_single_dataloader_next_batch(dy) == 0);
+      CHECK(flexflow_model_forward(model) == 0);
+      CHECK(flexflow_model_zero_gradients(model) == 0);
+      CHECK(flexflow_model_backward(model) == 0);
+      CHECK(flexflow_model_update(model) == 0);
+      flexflow_end_trace(model, 111);
+      double loss = flexflow_model_get_last_loss(model);
+      CHECK(!isnan(loss));
+      if (isnan(first_loss)) first_loss = loss;
+      last_loss = loss;
+    }
+  }
+  CHECK(last_loss < first_loss + 1.0); /* sane, typically decreasing */
+
+  /* metrics verbs on the final staged batch */
+  CHECK(flexflow_model_reset_metrics(model) == 0);
+  CHECK(flexflow_model_compute_metrics(model) == 0);
+  flexflow_perf_metrics_t pm = flexflow_model_get_perf_metrics(model);
+  CHECK(pm != NULL);
+  double acc = flexflow_per_metrics_get_accuracy(pm);
+  CHECK(acc >= 0.0 && acc <= 100.0);
+  flexflow_per_metrics_destroy(pm);
+
+  printf("capi_attention ok (loss %.4f -> %.4f, acc %.1f%%)\n", first_loss,
+         last_loss, acc);
+
+  free(X);
+  free(Y);
+  flexflow_sgd_optimizer_destroy(sgd);
+  flexflow_single_dataloader_destroy(dx);
+  flexflow_single_dataloader_destroy(dy);
+  flexflow_model_destroy(model);
+  flexflow_config_destroy(cfg);
+  flexflow_finalize();
+  return 0;
+}

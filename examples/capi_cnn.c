@@ -1,0 +1,118 @@
+/* C API demo 2: a small conv net built entirely from C — conv2d with
+ * initializers, pool, batch-norm, concat, Adam optimizer handle, and a
+ * post-training parameter round-trip (get/set weights).
+ * (reference surface: python/flexflow_c.h per-layer constructors,
+ * flexflow_parameter_get/set_weights_float) */
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "flexflow_c.h"
+
+#define CHECK(x)                                         \
+  do {                                                   \
+    if (!(x)) {                                          \
+      fprintf(stderr, "FAILED: %s (line %d)\n", #x, __LINE__); \
+      return 1;                                          \
+    }                                                    \
+  } while (0)
+
+int main(void) {
+  CHECK(flexflow_init(0, NULL) == 0);
+
+  char *argv[] = {"-b", "8", "-e", "1"};
+  flexflow_config_t cfg = flexflow_config_create(4, argv);
+  CHECK(cfg != NULL);
+  CHECK(flexflow_config_get_batch_size(cfg) == 8);
+  CHECK(flexflow_config_get_epochs(cfg) == 1);
+
+  flexflow_model_t model = flexflow_model_create(cfg);
+  CHECK(model != NULL);
+
+  int dims[4] = {8, 16, 16, 3}; /* NHWC */
+  flexflow_tensor_t x = flexflow_tensor_create(model, 4, dims, "image");
+  CHECK(x != NULL);
+  CHECK(flexflow_tensor_get_num_dims(x) == 4);
+  int got[4];
+  CHECK(flexflow_tensor_get_dims(x, got, 4) == 4);
+  CHECK(got[3] == 3);
+
+  flexflow_initializer_t glorot = flexflow_glorot_uniform_initializer_create(7);
+  flexflow_initializer_t zero = flexflow_zero_initializer_create();
+  CHECK(glorot != NULL && zero != NULL);
+
+  /* two parallel conv branches, concatenated (exercises concat) */
+  flexflow_tensor_t a = flexflow_model_add_conv2d_ex(
+      model, x, 8, 3, 3, 1, 1, 1, 1, /*relu*/ 1, /*groups*/ 1,
+      /*use_bias*/ 1, glorot, zero);
+  flexflow_tensor_t b = flexflow_model_add_conv2d(model, x, 8, 5, 5, 1, 1, 2,
+                                                  2, /*relu*/ 1);
+  CHECK(a != NULL && b != NULL);
+  flexflow_tensor_t branches[2] = {a, b};
+  flexflow_tensor_t t = flexflow_model_add_concat(model, 2, branches, 3);
+  CHECK(t != NULL);
+  t = flexflow_model_add_batch_norm(model, t, 1);
+  t = flexflow_model_add_pool2d(model, t, 2, 2, 2, 2, 0, 0, 0);
+  CHECK(t != NULL);
+  t = flexflow_model_add_flat(model, t);
+  /* scalar ops (incl. the reference's "truediv" spelling) */
+  t = flexflow_model_add_scalar_multiply(model, t, 2.0f);
+  t = flexflow_model_add_scalar_truediv(model, t, 2.0f);
+  CHECK(t != NULL);
+  t = flexflow_model_add_dense_ex(model, t, 32, /*relu*/ 1, 1, glorot, zero);
+  flexflow_tensor_t logits = flexflow_model_add_dense(model, t, 4, 0, 1);
+  CHECK(logits != NULL);
+
+  flexflow_adam_optimizer_t adam =
+      flexflow_adam_optimizer_create(model, 0.001, 0.9, 0.999, 0.0, 1e-8);
+  CHECK(adam != NULL);
+  flexflow_adam_optimizer_set_lr(adam, 0.002);
+  CHECK(flexflow_model_set_adam_optimizer(model, adam) == 0);
+
+  CHECK(flexflow_model_compile(model, "sparse_categorical_crossentropy",
+                               "accuracy", 0.001) == 0);
+
+  /* introspection: the last layer is the logits dense; round-trip its
+   * kernel through host buffers */
+  flexflow_op_t last = flexflow_model_get_last_layer(model);
+  CHECK(last != NULL);
+  CHECK(flexflow_op_get_num_parameters(last) == 2); /* kernel + bias */
+  flexflow_parameter_t kernel = flexflow_op_get_parameter_by_id(last, 0);
+  CHECK(kernel != NULL);
+  int64_t n = flexflow_parameter_get_num_elements(kernel);
+  CHECK(n == 32 * 4);
+  float *w = (float *)malloc(n * sizeof(float));
+  CHECK(flexflow_parameter_get_weights_float(kernel, w, n) == 0);
+  for (int64_t i = 0; i < n; ++i) w[i] = 0.25f;
+  CHECK(flexflow_parameter_set_weights_float(kernel, w, n) == 0);
+  CHECK(flexflow_parameter_get_weights_float(kernel, w, n) == 0);
+  CHECK(fabsf(w[0] - 0.25f) < 1e-6f);
+  free(w);
+
+  /* train one epoch through fit */
+  int num = 32;
+  float *X = (float *)malloc((size_t)num * 16 * 16 * 3 * sizeof(float));
+  int *Y = (int *)malloc((size_t)num * sizeof(int));
+  for (int i = 0; i < num * 16 * 16 * 3; ++i)
+    X[i] = (float)((i * 2654435761u) % 1000) / 1000.0f - 0.5f;
+  for (int i = 0; i < num; ++i) Y[i] = i % 4;
+  int64_t xs[4] = {num, 16, 16, 3};
+  int64_t ys[1] = {num};
+  double loss = flexflow_model_fit(model, X, xs, 4, Y, ys, 1, /*y_is_int*/ 1,
+                                   /*epochs*/ 1);
+  CHECK(!isnan(loss));
+  printf("capi_cnn ok (loss %.4f)\n", loss);
+
+  free(X);
+  free(Y);
+  flexflow_handle_destroy(kernel);
+  flexflow_handle_destroy(last);
+  flexflow_adam_optimizer_destroy(adam);
+  flexflow_initializer_destroy(glorot);
+  flexflow_initializer_destroy(zero);
+  flexflow_model_destroy(model);
+  flexflow_config_destroy(cfg);
+  flexflow_finalize();
+  return 0;
+}

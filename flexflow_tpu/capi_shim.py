@@ -3,14 +3,19 @@
 The C layer embeds CPython and calls these flat helpers with primitive
 arguments only (ints, floats, strings, raw addresses) — all object
 plumbing stays here. Mirrors the role of the reference's flexflow_c.cc
-body (reference: python/flexflow_c.cc:1884 LoC of handle unwrapping).
+body (reference: python/flexflow_c.cc:1884 LoC of handle unwrapping),
+now at entry-point parity with the reference header's ~140 flexflow_*
+functions (python/flexflow_c.h:80-681): per-layer constructors for every
+op class, optimizer/initializer handles, parameter host I/O, dataloader
+verbs, and the training-loop verbs.
 """
 
 from __future__ import annotations
 
 import ctypes
+import dataclasses
 import os
-from typing import Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -29,13 +34,22 @@ _maybe_force_platform()
 
 from flexflow_tpu import (  # noqa: E402
     ActiMode,
+    AdamOptimizer,
+    DataType,
     FFConfig,
     FFModel,
     LossType,
     MetricsType,
     SGDOptimizer,
 )
-from flexflow_tpu.core.types import PoolType  # noqa: E402
+from flexflow_tpu.core.types import AggrMode, PoolType  # noqa: E402
+from flexflow_tpu.runtime.initializer import (  # noqa: E402
+    ConstantInitializer,
+    GlorotUniform,
+    NormInitializer,
+    UniformInitializer,
+    ZeroInitializer,
+)
 
 _ACTI = {
     0: ActiMode.NONE,
@@ -54,29 +68,199 @@ _METRIC = {
     "sparse_categorical_crossentropy": MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
     "mean_squared_error": MetricsType.MEAN_SQUARED_ERROR,
 }
+_DTYPE = {0: DataType.FLOAT, 1: DataType.INT32, 2: DataType.INT64}
+_AGGR = {0: AggrMode.NONE, 1: AggrMode.SUM, 2: AggrMode.AVG}
+
+
+# -- config / model ----------------------------------------------------------
 
 
 def config_create(argv: Sequence[str]) -> FFConfig:
     return FFConfig.parse_args(list(argv))
 
 
+def config_get_batch_size(cfg):
+    return int(cfg.batch_size)
+
+
+def config_get_epochs(cfg):
+    return int(cfg.epochs)
+
+
+def config_get_num_nodes(cfg):
+    return int(cfg.num_nodes)
+
+
+def config_get_workers_per_node(cfg):
+    return int(cfg.workers_per_node)
+
+
 def model_create(config: FFConfig) -> FFModel:
     return FFModel(config)
 
 
-def tensor_create(model: FFModel, dims: Sequence[int], name: str):
-    return model.create_tensor(list(dims), name=name or None)
+# -- tensors -----------------------------------------------------------------
 
 
-def add_dense(model, t, out_features, activation, use_bias):
-    return model.dense(
-        t, out_features, activation=_ACTI[activation], use_bias=bool(use_bias)
+def tensor_create(model: FFModel, dims: Sequence[int], dtype: int, name: str):
+    return model.create_tensor(
+        list(dims), dtype=_DTYPE.get(dtype, DataType.FLOAT), name=name or None
     )
 
 
-def add_conv2d(model, t, oc, kh, kw, sh, sw, ph, pw, activation):
+def tensor_num_dims(t):
+    return len(t.dims)
+
+
+def tensor_dims(t):
+    return [int(d) for d in t.dims]
+
+
+def tensor_dtype(t):
+    for code, dt in _DTYPE.items():
+        if dt == t.dtype:
+            return code
+    return -1
+
+
+class OpHandle:
+    """Opaque op handle (reference: flexflow_op_t is an Op*)."""
+
+    def __init__(self, model: FFModel, guid: int):
+        self.model = model
+        self.guid = guid
+
+    @property
+    def node(self):
+        return self.model.graph.nodes[self.guid]
+
+
+class ParamHandle:
+    """Opaque parameter handle (reference: flexflow_parameter_t)."""
+
+    def __init__(self, model: FFModel, guid: int, idx: int):
+        self.model = model
+        self.guid = guid
+        self.idx = idx
+
+
+def tensor_owner_op(t):
+    return OpHandle(t.model, t.ref.guid)
+
+
+def tensor_attach_raw_ptr(model, t, addr, shape, is_int):
+    arr = _array_from_ptr(
+        addr, tuple(shape), np.int32 if is_int else np.float32
+    )
+    name = model.graph.nodes[t.ref.guid].name
+    staged = getattr(model, "_capi_batch", None) or {}
+    staged[name] = arr
+    model._capi_batch = staged
+
+
+def tensor_detach_raw_ptr(model, t):
+    name = model.graph.nodes[t.ref.guid].name
+    getattr(model, "_capi_batch", {}).pop(name, None)
+
+
+# -- initializers ------------------------------------------------------------
+
+
+def initializer_create(kind: str, seed: int, a: float, b: float, c: float):
+    if kind == "glorot":
+        return GlorotUniform(seed=seed)
+    if kind == "zero":
+        return ZeroInitializer()
+    if kind == "uniform":
+        return UniformInitializer(seed=seed, min_val=a, max_val=b)
+    if kind == "norm":
+        return NormInitializer(seed=seed, mean=a, stddev=b)
+    if kind == "constant":
+        return ConstantInitializer(a)
+    raise ValueError(f"unknown initializer kind {kind!r}")
+
+
+# -- optimizers --------------------------------------------------------------
+
+
+class OptHandle:
+    """Mutable wrapper (the framework's optimizers are frozen
+    dataclasses; reference set_lr mutates in place, so the handle
+    rebinds — and propagates to compiled models it is bound to, matching
+    the reference's mid-training LR-decay pattern)."""
+
+    def __init__(self, opt):
+        self.opt = opt
+        self.models = []  # FFModels bound via model_set_optimizer
+
+
+def sgd_optimizer_create(lr, momentum, nesterov, weight_decay):
+    return OptHandle(
+        SGDOptimizer(
+            lr=lr,
+            momentum=momentum,
+            nesterov=bool(nesterov),
+            weight_decay=weight_decay,
+        )
+    )
+
+
+def adam_optimizer_create(alpha, beta1, beta2, weight_decay, epsilon):
+    return OptHandle(
+        AdamOptimizer(
+            alpha=alpha,
+            beta1=beta1,
+            beta2=beta2,
+            weight_decay=weight_decay,
+            epsilon=epsilon,
+        )
+    )
+
+
+def optimizer_set_lr(handle: OptHandle, lr: float):
+    field = "alpha" if isinstance(handle.opt, AdamOptimizer) else "lr"
+    handle.opt = dataclasses.replace(handle.opt, **{field: lr})
+    for model in handle.models:
+        if model.executor is not None:
+            # already compiled: swap the optimizer into the executor and
+            # drop the cached jitted step so the next iteration re-traces
+            # with the new LR (state structure is unchanged)
+            model.optimizer = handle.opt
+            model.executor.optimizer = handle.opt
+            model.executor._train_step = None
+
+
+def model_set_optimizer(model, handle: OptHandle):
+    model._capi_optimizer = handle
+    if model not in handle.models:
+        handle.models.append(model)
+
+
+# -- layer builders ----------------------------------------------------------
+
+
+def add_dense(model, t, out_features, activation, use_bias, kinit, binit):
+    return model.dense(
+        t,
+        out_features,
+        activation=_ACTI[activation],
+        use_bias=bool(use_bias),
+        kernel_initializer=kinit,
+        bias_initializer=binit,
+    )
+
+
+def add_conv2d(
+    model, t, oc, kh, kw, sh, sw, ph, pw, activation, groups, use_bias,
+    kinit, binit,
+):
     return model.conv2d(
-        t, oc, kh, kw, sh, sw, ph, pw, activation=_ACTI[activation]
+        t, oc, kh, kw, sh, sw, ph, pw,
+        activation=_ACTI[activation],
+        groups=max(1, groups),
+        use_bias=bool(use_bias),
+        kernel_initializer=kinit,
+        bias_initializer=binit,
     )
 
 
@@ -91,16 +275,84 @@ def add_flat(model, t):
     return model.flat(t)
 
 
-def add_embedding(model, t, num_entries, out_dim):
-    return model.embedding(t, num_entries, out_dim)
+def add_embedding(model, t, num_entries, out_dim, aggr, kinit):
+    return model.embedding(
+        t,
+        num_entries,
+        out_dim,
+        aggr=_AGGR.get(aggr, AggrMode.NONE),
+        kernel_initializer=kinit,
+    )
 
 
-def add_multihead_attention(model, q, k, v, embed_dim, num_heads):
-    return model.multihead_attention(q, k, v, embed_dim, num_heads)
+def add_multihead_attention(
+    model, q, k, v, embed_dim, num_heads, kdim, vdim, dropout, bias, causal
+):
+    return model.multihead_attention(
+        q, k, v, embed_dim, num_heads,
+        kdim=kdim, vdim=vdim, dropout=float(dropout),
+        bias=bool(bias), causal=bool(causal),
+    )
+
+
+def add_batch_matmul(model, a, b):
+    return model.batch_matmul(a, b)
+
+
+def add_batch_norm(model, t, relu):
+    return model.batch_norm(t, relu=bool(relu))
+
+
+def add_layer_norm(model, t, axes, elementwise_affine, eps):
+    return model.layer_norm(
+        t,
+        axes=list(axes) or None,
+        elementwise_affine=bool(elementwise_affine),
+        eps=float(eps),
+    )
+
+
+def add_concat(model, tensors, axis):
+    return model.concat(list(tensors), axis)
+
+
+def add_split(model, t, sizes, axis):
+    return list(model.split(t, list(sizes), axis))
+
+
+def add_reshape(model, t, dims):
+    return model.reshape(t, list(dims))
+
+
+def add_transpose(model, t, perm):
+    return model.transpose(t, list(perm))
+
+
+def add_reverse(model, t, axis):
+    return model.reverse(t, axis)
+
+
+def add_mean(model, t, dims, keepdims):
+    return model.mean(t, list(dims), keepdims=bool(keepdims))
+
+
+def add_reduce_sum(model, t, dims, keepdims):
+    return model.reduce_sum(t, list(dims), keepdims=bool(keepdims))
+
+
+def add_cast(model, t, dtype):
+    return model.cast(t, _DTYPE.get(dtype, DataType.FLOAT))
 
 
 def add_unary(model, op: str, t):
     return getattr(model, op)(t)
+
+
+def add_scalar_op(model, op: str, t, scalar):
+    # C surface keeps the reference spelling "scalar_truediv"
+    # (flexflow_c.h); the builder method is scalar_true_divide
+    method = "scalar_true_divide" if op == "scalar_truediv" else op
+    return getattr(model, method)(t, float(scalar))
 
 
 def add_binary(model, op: str, a, b):
@@ -115,6 +367,9 @@ def add_dropout(model, t, rate):
     return model.dropout(t, rate=float(rate))
 
 
+# -- compile / train ---------------------------------------------------------
+
+
 def compile_model(model, loss: str, metrics: str, learning_rate: float):
     if loss not in _LOSS:
         raise ValueError(f"unknown loss {loss!r}; one of {sorted(_LOSS)}")
@@ -125,11 +380,9 @@ def compile_model(model, loss: str, metrics: str, learning_rate: float):
             if m not in _METRIC:
                 raise ValueError(f"unknown metric {m!r}")
             mets.append(_METRIC[m])
-    model.compile(
-        optimizer=SGDOptimizer(lr=learning_rate),
-        loss_type=_LOSS[loss],
-        metrics=mets,
-    )
+    handle = getattr(model, "_capi_optimizer", None)
+    opt = handle.opt if handle is not None else SGDOptimizer(lr=learning_rate)
+    model.compile(optimizer=opt, loss_type=_LOSS[loss], metrics=mets)
 
 
 def _array_from_ptr(addr: int, shape, dtype) -> np.ndarray:
@@ -138,6 +391,11 @@ def _array_from_ptr(addr: int, shape, dtype) -> np.ndarray:
     buf = (ctypes.c_char * (n * itemsize)).from_address(addr)
     # copy: the caller's buffer lifetime ends when the C call returns
     return np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+
+
+def _array_to_ptr(arr: np.ndarray, addr: int):
+    arr = np.ascontiguousarray(arr)
+    ctypes.memmove(addr, arr.ctypes.data, arr.nbytes)
 
 
 def fit_ptr(
@@ -156,3 +414,249 @@ def fit_ptr(
     hist = model.fit(x, y, epochs=int(epochs), verbose=False)
     last = hist[-1]
     return float(last["loss_sum"] / max(last["train_all"], 1))
+
+
+# -- training-loop verbs (reference: flexflow_cffi fit loop) -----------------
+#
+# forward: inference on the staged batch; backward: run the fused
+# grad+update step and HOLD the result; update: commit it. This preserves
+# the reference call sequence's observable semantics (weights change at
+# update) on a functional engine where grads and the optimizer live in
+# one jitted program.
+
+
+def _staged_batch(model) -> Dict[str, np.ndarray]:
+    batch = getattr(model, "_capi_batch", None)
+    if not batch:
+        raise RuntimeError(
+            "no batch staged: attach data via flexflow_tensor_attach_raw_ptr"
+            " or a flexflow_single_dataloader"
+        )
+    return batch
+
+
+def model_init_layers(model):
+    model.init_operators()
+
+
+def model_forward(model):
+    logits = model.forward(_staged_batch(model))
+    model._capi_logits = logits
+
+
+def model_zero_gradients(model):
+    model.zero_gradients()
+
+
+def model_backward(model):
+    import jax
+
+    batch = _staged_batch(model)
+    step = model.executor.train_step()
+    sharded = model.executor.shard_batch(batch)
+    model._rng, key = jax.random.split(model._rng)
+    model._capi_pending = step(model.params, model.opt_state, sharded, key)
+
+
+def model_update(model):
+    pending = getattr(model, "_capi_pending", None)
+    if pending is None:
+        raise RuntimeError("flexflow_model_backward must run before update")
+    model.params, model.opt_state, loss, _ = pending
+    model._capi_last_loss = float(np.asarray(loss))
+    model._capi_pending = None
+
+
+def model_last_loss(model):
+    return float(getattr(model, "_capi_last_loss", float("nan")))
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def model_reset_metrics(model):
+    from flexflow_tpu.runtime.metrics import PerfMetrics
+
+    model._capi_perf = PerfMetrics()
+
+
+def model_compute_metrics(model):
+    import jax
+
+    from flexflow_tpu.runtime.metrics import PerfMetrics
+
+    if getattr(model, "_capi_perf", None) is None:
+        model._capi_perf = PerfMetrics()
+    batch = _staged_batch(model)
+    loss, mets = model.executor.eval_step()(
+        model.params, model.executor.shard_batch(batch)
+    )
+    model._capi_perf.update(
+        jax.tree_util.tree_map(float, mets), float(loss)
+    )
+
+
+def model_perf_metrics(model):
+    from flexflow_tpu.runtime.metrics import PerfMetrics
+
+    return getattr(model, "_capi_perf", None) or PerfMetrics()
+
+
+def perf_metrics_accuracy(perf):
+    total = max(getattr(perf, "train_all", 0), 1)
+    return 100.0 * getattr(perf, "train_correct", 0) / total
+
+
+# -- layer / parameter introspection -----------------------------------------
+
+
+def _layer_guids(model):
+    from flexflow_tpu.core.types import OperatorType
+
+    return [
+        g
+        for g in model.graph.topo_order()
+        if model.graph.nodes[g].op_type != OperatorType.INPUT
+    ]
+
+
+def model_num_layers(model):
+    return len(_layer_guids(model))
+
+
+def model_layer_by_id(model, idx):
+    return OpHandle(model, _layer_guids(model)[idx])
+
+
+def model_last_layer(model):
+    return OpHandle(model, _layer_guids(model)[-1])
+
+
+def model_print_layers(model):
+    for g in _layer_guids(model):
+        n = model.graph.nodes[g]
+        print(f"{g}: {n.op_type.name} {n.name} -> "
+              f"{[str(s) for s in n.output_shapes]}")
+
+
+def op_num_inputs(op: OpHandle):
+    return len(op.node.inputs)
+
+
+def op_num_outputs(op: OpHandle):
+    return len(op.node.output_shapes)
+
+
+def op_num_parameters(op: OpHandle):
+    return len(op.node.weight_shapes)
+
+
+def op_input_by_id(op: OpHandle, idx):
+    from flexflow_tpu.runtime.model import Tensor
+
+    return Tensor(op.model, op.node.inputs[idx])
+
+
+def op_output_by_id(op: OpHandle, idx):
+    from flexflow_tpu.core.pcg import TensorRef
+    from flexflow_tpu.runtime.model import Tensor
+
+    return Tensor(op.model, TensorRef(op.guid, idx))
+
+
+def op_parameter_by_id(op: OpHandle, idx):
+    if idx >= len(op.node.weight_shapes):
+        raise IndexError(f"op has {len(op.node.weight_shapes)} parameters")
+    return ParamHandle(op.model, op.guid, idx)
+
+
+def parameter_num_elements(p: ParamHandle):
+    shape = p.model.graph.nodes[p.guid].weight_shapes[p.idx]
+    return int(
+        np.prod([d.size for d in shape.dims if not d.is_replica_dim])
+    )
+
+
+def parameter_get_weights(p: ParamHandle, addr: int, count: int):
+    w = p.model.get_tensor(p.guid, p.idx)
+    if w.size != count:
+        raise ValueError(f"parameter has {w.size} elements, buffer {count}")
+    _array_to_ptr(w.astype(np.float32), addr)
+
+
+def parameter_set_weights(p: ParamHandle, addr: int, count: int):
+    shape = p.model.graph.nodes[p.guid].weight_shapes[p.idx]
+    dims = tuple(d.size for d in shape.dims if not d.is_replica_dim)
+    if int(np.prod(dims)) != count:
+        raise ValueError(
+            f"parameter has {int(np.prod(dims))} elements, buffer {count}"
+        )
+    arr = _array_from_ptr(addr, dims, np.float32)
+    p.model.set_tensor(p.guid, p.idx, arr)
+
+
+# -- dataloader --------------------------------------------------------------
+
+
+class CApiDataLoader:
+    """Host dataloader staging fixed-size batches into the model's
+    staged batch (reference: SingleDataLoader next_batch index-launches,
+    python/flexflow_dataloader.cc; here the jitted step consumes the
+    staged arrays)."""
+
+    def __init__(self, model, name: str, data: np.ndarray):
+        self.model = model
+        self.name = name
+        self.data = data
+        self.num_samples = int(data.shape[0])
+        self.batch_size = int(model.config.batch_size)
+        self.index = 0
+
+    def reset(self):
+        self.index = 0
+
+    def next_batch(self):
+        b = self.batch_size
+        if self.num_samples < b:
+            raise RuntimeError(
+                f"dataloader num_samples {self.num_samples} < batch size "
+                f"{b}; a short batch would change the jitted step's shapes"
+            )
+        if self.index + b > self.num_samples:
+            self.index = 0
+        sl = self.data[self.index : self.index + b]
+        self.index += b
+        staged = getattr(self.model, "_capi_batch", None) or {}
+        staged[self.name] = sl
+        self.model._capi_batch = staged
+
+
+def dataloader_create(model, t, addr, shape, is_int):
+    data = _array_from_ptr(
+        addr, tuple(shape), np.int32 if is_int else np.float32
+    )
+    name = model.graph.nodes[t.ref.guid].name
+    return CApiDataLoader(model, name, data)
+
+
+def dataloader_create_label(model, addr, shape, is_int):
+    data = _array_from_ptr(
+        addr, tuple(shape), np.int32 if is_int else np.float32
+    )
+    return CApiDataLoader(model, "label", data)
+
+
+def dataloader_num_samples(loader):
+    return loader.num_samples
+
+
+def dataloader_set_num_samples(loader, num):
+    loader.num_samples = min(int(num), int(loader.data.shape[0]))
+
+
+def dataloader_reset(loader):
+    loader.reset()
+
+
+def dataloader_next_batch(loader):
+    loader.next_batch()

@@ -464,6 +464,8 @@ class CostModel:
             if not fidx and not ws:
                 return (fwd, fwd)  # nothing differentiable: estimate
             total = timed(jax.jit(bwd_chain))
+            if total > 1.0:
+                return None  # contended during the backward window
             bwd = total - fwd
             if bwd < 0.5 * fwd:
                 # bwd can't be cheaper than re-running forward; a smaller

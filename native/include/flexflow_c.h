@@ -1,16 +1,20 @@
 /* Flat C API over the flexflow_tpu framework.
  *
  * Rebuild of the reference's C API (reference: python/flexflow_c.h, 681
- * lines of flexflow_* handle functions over FFModel). The reference's C
- * API exists so Python can drive the C++ core; this framework is
+ * lines / ~140 flexflow_* handle functions over FFModel). The reference's
+ * C API exists so Python can drive the C++ core; this framework is
  * Python-first on JAX, so the direction inverts: the C API embeds the
  * CPython runtime and drives the Python core, letting C/C++ programs
  * build, compile, and train models with the same flat handle-based
- * surface.
+ * surface — per-layer constructors for every op class, optimizer and
+ * initializer handles, tensor/parameter host I/O, dataloader verbs, and
+ * the reference's training-loop verbs.
  *
- * All handles are opaque; every flexflow_* call returns NULL / non-zero on
- * failure with the Python error printed to stderr. Not thread-safe (one
- * embedded interpreter).
+ * All handles are opaque; every flexflow_* call returns NULL / non-zero /
+ * NaN on failure with the Python error printed to stderr. Not thread-safe
+ * (one embedded interpreter). Free any returned handle with
+ * flexflow_handle_destroy (the per-type *_destroy names alias it, matching
+ * the reference's surface).
  */
 
 #ifndef FLEXFLOW_C_H
@@ -25,6 +29,13 @@ extern "C" {
 typedef void *flexflow_config_t;
 typedef void *flexflow_model_t;
 typedef void *flexflow_tensor_t;
+typedef void *flexflow_op_t;
+typedef void *flexflow_parameter_t;
+typedef void *flexflow_sgd_optimizer_t;
+typedef void *flexflow_adam_optimizer_t;
+typedef void *flexflow_initializer_t;
+typedef void *flexflow_perf_metrics_t;
+typedef void *flexflow_single_dataloader_t;
 
 /* runtime ------------------------------------------------------------- */
 
@@ -34,30 +45,105 @@ typedef void *flexflow_tensor_t;
  * flexflow_config_create instead. Returns 0 on success. */
 int flexflow_init(int argc, char **argv);
 void flexflow_finalize(void);
+double flexflow_get_current_time(void); /* seconds, monotonic */
 
-/* config / model ------------------------------------------------------- */
+/* config --------------------------------------------------------------- */
 
 flexflow_config_t flexflow_config_create(int argc, char **argv);
+int flexflow_config_get_batch_size(flexflow_config_t config);
+int flexflow_config_get_epochs(flexflow_config_t config);
+int flexflow_config_get_num_nodes(flexflow_config_t config);
+int flexflow_config_get_workers_per_node(flexflow_config_t config);
+void flexflow_config_destroy(flexflow_config_t config);
+
+/* model ---------------------------------------------------------------- */
+
 flexflow_model_t flexflow_model_create(flexflow_config_t config);
+void flexflow_model_destroy(flexflow_model_t model);
 
 /* tensors -------------------------------------------------------------- */
 
+/* dtype: 0 = float32, 1 = int32, 2 = int64 (reference: DataType enum) */
+flexflow_tensor_t flexflow_tensor_create_ex(flexflow_model_t model, int ndims,
+                                            const int *dims, int dtype,
+                                            const char *name);
 flexflow_tensor_t flexflow_tensor_create(flexflow_model_t model, int ndims,
                                          const int *dims, const char *name);
+int flexflow_tensor_get_num_dims(flexflow_tensor_t tensor);
+/* writes up to max_dims entries; returns ndims or -1 */
+int flexflow_tensor_get_dims(flexflow_tensor_t tensor, int *dims,
+                             int max_dims);
+int flexflow_tensor_get_data_type(flexflow_tensor_t tensor);
+flexflow_op_t flexflow_tensor_get_owner_op(flexflow_tensor_t tensor);
+void flexflow_tensor_destroy(flexflow_tensor_t tensor);
 
-/* layer builders (reference: flexflow_model_add_* in flexflow_c.h) ----- */
+/* Stage a host buffer as this input tensor's data for dataloader-free
+ * runs (reference: flexflow_tensor_attach_raw_ptr). The buffer must stay
+ * alive until detach; the data is copied at attach time. */
+int flexflow_tensor_attach_raw_ptr(flexflow_model_t model,
+                                   flexflow_tensor_t tensor, const void *ptr,
+                                   const int64_t *shape, int ndims,
+                                   int is_int);
+int flexflow_tensor_detach_raw_ptr(flexflow_model_t model,
+                                   flexflow_tensor_t tensor);
+
+/* initializers (reference: flexflow_*_initializer_create) -------------- */
+
+flexflow_initializer_t flexflow_glorot_uniform_initializer_create(int seed);
+flexflow_initializer_t flexflow_zero_initializer_create(void);
+flexflow_initializer_t flexflow_uniform_initializer_create(int seed,
+                                                           float min_val,
+                                                           float max_val);
+flexflow_initializer_t flexflow_norm_initializer_create(int seed, float mean,
+                                                        float stddev);
+flexflow_initializer_t flexflow_constant_initializer_create(float value);
+void flexflow_initializer_destroy(flexflow_initializer_t handle);
+
+/* optimizers (reference: flexflow_sgd/adam_optimizer_*) ---------------- */
+
+flexflow_sgd_optimizer_t flexflow_sgd_optimizer_create(flexflow_model_t model,
+                                                       double lr,
+                                                       double momentum,
+                                                       int nesterov,
+                                                       double weight_decay);
+void flexflow_sgd_optimizer_set_lr(flexflow_sgd_optimizer_t handle,
+                                   double lr);
+flexflow_adam_optimizer_t flexflow_adam_optimizer_create(
+    flexflow_model_t model, double alpha, double beta1, double beta2,
+    double weight_decay, double epsilon);
+void flexflow_adam_optimizer_set_lr(flexflow_adam_optimizer_t handle,
+                                    double lr);
+/* attach an optimizer for the next compile (reference:
+ * flexflow_model_set_sgd_optimizer) */
+int flexflow_model_set_sgd_optimizer(flexflow_model_t model,
+                                     flexflow_sgd_optimizer_t handle);
+int flexflow_model_set_adam_optimizer(flexflow_model_t model,
+                                      flexflow_adam_optimizer_t handle);
+void flexflow_sgd_optimizer_destroy(flexflow_sgd_optimizer_t handle);
+void flexflow_adam_optimizer_destroy(flexflow_adam_optimizer_t handle);
+
+/* layer builders (reference: flexflow_model_add_*) --------------------- */
 
 /* activation: 0 = none, 1 = relu, 2 = sigmoid, 3 = tanh, 4 = gelu */
 flexflow_tensor_t flexflow_model_add_dense(flexflow_model_t model,
                                            flexflow_tensor_t input,
                                            int out_features, int activation,
                                            int use_bias);
+flexflow_tensor_t flexflow_model_add_dense_ex(
+    flexflow_model_t model, flexflow_tensor_t input, int out_features,
+    int activation, int use_bias, flexflow_initializer_t kernel_init,
+    flexflow_initializer_t bias_init);
 flexflow_tensor_t flexflow_model_add_conv2d(flexflow_model_t model,
                                             flexflow_tensor_t input,
                                             int out_channels, int kernel_h,
                                             int kernel_w, int stride_h,
                                             int stride_w, int padding_h,
                                             int padding_w, int activation);
+flexflow_tensor_t flexflow_model_add_conv2d_ex(
+    flexflow_model_t model, flexflow_tensor_t input, int out_channels,
+    int kernel_h, int kernel_w, int stride_h, int stride_w, int padding_h,
+    int padding_w, int activation, int groups, int use_bias,
+    flexflow_initializer_t kernel_init, flexflow_initializer_t bias_init);
 flexflow_tensor_t flexflow_model_add_pool2d(flexflow_model_t model,
                                             flexflow_tensor_t input,
                                             int kernel_h, int kernel_w,
@@ -69,27 +155,127 @@ flexflow_tensor_t flexflow_model_add_flat(flexflow_model_t model,
 flexflow_tensor_t flexflow_model_add_embedding(flexflow_model_t model,
                                                flexflow_tensor_t input,
                                                int num_entries, int out_dim);
+/* aggr: 0 = none, 1 = sum, 2 = avg (reference: AggrMode) */
+flexflow_tensor_t flexflow_model_add_embedding_ex(
+    flexflow_model_t model, flexflow_tensor_t input, int num_entries,
+    int out_dim, int aggr, flexflow_initializer_t kernel_init);
 flexflow_tensor_t flexflow_model_add_multihead_attention(
     flexflow_model_t model, flexflow_tensor_t query, flexflow_tensor_t key,
     flexflow_tensor_t value, int embed_dim, int num_heads);
-flexflow_tensor_t flexflow_model_add_unary(flexflow_model_t model,
-                                           const char *op /* "relu" ... */,
-                                           flexflow_tensor_t input);
-flexflow_tensor_t flexflow_model_add_binary(flexflow_model_t model,
-                                            const char *op /* "add" ... */,
-                                            flexflow_tensor_t a,
-                                            flexflow_tensor_t b);
+flexflow_tensor_t flexflow_model_add_multihead_attention_ex(
+    flexflow_model_t model, flexflow_tensor_t query, flexflow_tensor_t key,
+    flexflow_tensor_t value, int embed_dim, int num_heads, int kdim,
+    int vdim, float dropout, int bias, int causal);
+flexflow_tensor_t flexflow_model_add_batch_matmul(flexflow_model_t model,
+                                                  flexflow_tensor_t a,
+                                                  flexflow_tensor_t b);
+flexflow_tensor_t flexflow_model_add_batch_norm(flexflow_model_t model,
+                                                flexflow_tensor_t input,
+                                                int relu);
+flexflow_tensor_t flexflow_model_add_layer_norm(flexflow_model_t model,
+                                                flexflow_tensor_t input,
+                                                int n_axes, const int *axes,
+                                                int elementwise_affine,
+                                                float eps);
+flexflow_tensor_t flexflow_model_add_concat(flexflow_model_t model,
+                                            int n_tensors,
+                                            const flexflow_tensor_t *tensors,
+                                            int axis);
+/* writes n handles into outputs[]; returns 0 on success */
+int flexflow_model_add_split(flexflow_model_t model, flexflow_tensor_t input,
+                             int n, const int *sizes, int axis,
+                             flexflow_tensor_t *outputs);
+flexflow_tensor_t flexflow_model_add_reshape(flexflow_model_t model,
+                                             flexflow_tensor_t input,
+                                             int ndims, const int *dims);
+flexflow_tensor_t flexflow_model_add_transpose(flexflow_model_t model,
+                                               flexflow_tensor_t input,
+                                               int ndims, const int *perm);
+flexflow_tensor_t flexflow_model_add_reverse(flexflow_model_t model,
+                                             flexflow_tensor_t input,
+                                             int axis);
+flexflow_tensor_t flexflow_model_add_mean(flexflow_model_t model,
+                                          flexflow_tensor_t input,
+                                          int n_dims, const int *dims,
+                                          int keepdims);
+flexflow_tensor_t flexflow_model_add_reduce_sum(flexflow_model_t model,
+                                                flexflow_tensor_t input,
+                                                int n_dims, const int *dims,
+                                                int keepdims);
+flexflow_tensor_t flexflow_model_add_cast(flexflow_model_t model,
+                                          flexflow_tensor_t input, int dtype);
 flexflow_tensor_t flexflow_model_add_softmax(flexflow_model_t model,
                                              flexflow_tensor_t input);
 flexflow_tensor_t flexflow_model_add_dropout(flexflow_model_t model,
                                              flexflow_tensor_t input,
                                              float rate);
 
+/* element unaries (reference: flexflow_model_add_relu etc.) */
+flexflow_tensor_t flexflow_model_add_relu(flexflow_model_t model,
+                                          flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_sigmoid(flexflow_model_t model,
+                                             flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_tanh(flexflow_model_t model,
+                                          flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_elu(flexflow_model_t model,
+                                         flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_gelu(flexflow_model_t model,
+                                          flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_identity(flexflow_model_t model,
+                                              flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_exp(flexflow_model_t model,
+                                         flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_sin(flexflow_model_t model,
+                                         flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_cos(flexflow_model_t model,
+                                         flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_rsqrt(flexflow_model_t model,
+                                           flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_pow(flexflow_model_t model,
+                                         flexflow_tensor_t input,
+                                         float exponent);
+flexflow_tensor_t flexflow_model_add_scalar_add(flexflow_model_t model,
+                                                flexflow_tensor_t input,
+                                                float scalar);
+flexflow_tensor_t flexflow_model_add_scalar_sub(flexflow_model_t model,
+                                                flexflow_tensor_t input,
+                                                float scalar);
+flexflow_tensor_t flexflow_model_add_scalar_multiply(flexflow_model_t model,
+                                                     flexflow_tensor_t input,
+                                                     float scalar);
+flexflow_tensor_t flexflow_model_add_scalar_truediv(flexflow_model_t model,
+                                                    flexflow_tensor_t input,
+                                                    float scalar);
+
+/* element binaries */
+flexflow_tensor_t flexflow_model_add_add(flexflow_model_t model,
+                                         flexflow_tensor_t a,
+                                         flexflow_tensor_t b);
+flexflow_tensor_t flexflow_model_add_subtract(flexflow_model_t model,
+                                              flexflow_tensor_t a,
+                                              flexflow_tensor_t b);
+flexflow_tensor_t flexflow_model_add_multiply(flexflow_model_t model,
+                                              flexflow_tensor_t a,
+                                              flexflow_tensor_t b);
+flexflow_tensor_t flexflow_model_add_divide(flexflow_model_t model,
+                                            flexflow_tensor_t a,
+                                            flexflow_tensor_t b);
+
+/* generic escapes (kept from v1; any builder by name) */
+flexflow_tensor_t flexflow_model_add_unary(flexflow_model_t model,
+                                           const char *op,
+                                           flexflow_tensor_t input);
+flexflow_tensor_t flexflow_model_add_binary(flexflow_model_t model,
+                                            const char *op,
+                                            flexflow_tensor_t a,
+                                            flexflow_tensor_t b);
+
 /* compile / train ------------------------------------------------------ */
 
 /* loss: "sparse_categorical_crossentropy" | "categorical_crossentropy" |
- * "mean_squared_error"; metrics: "accuracy" (may be NULL). Returns 0 on
- * success. */
+ * "mean_squared_error"; metrics: comma-separated ("accuracy", may be
+ * NULL). Uses the optimizer set via flexflow_model_set_*_optimizer when
+ * present, else SGD(learning_rate). Returns 0 on success. */
 int flexflow_model_compile(flexflow_model_t model, const char *loss,
                            const char *metrics, double learning_rate);
 
@@ -99,6 +285,73 @@ double flexflow_model_fit(flexflow_model_t model, const float *x,
                           const int64_t *x_shape, int x_ndims, const void *y,
                           const int64_t *y_shape, int y_ndims, int y_is_int,
                           int epochs);
+
+/* Reference training-loop verbs (flexflow_cffi fit loop: begin_trace;
+ * next_batch; forward; zero_gradients; backward; update; end_trace).
+ * forward runs inference on the staged batch; backward computes the
+ * fused grad+update step and holds it; update commits the new weights.
+ * Batches are staged by the dataloader or tensor_attach_raw_ptr. */
+int flexflow_model_init_layers(flexflow_model_t model);
+int flexflow_model_forward(flexflow_model_t model);
+int flexflow_model_zero_gradients(flexflow_model_t model);
+int flexflow_model_backward(flexflow_model_t model);
+int flexflow_model_update(flexflow_model_t model);
+void flexflow_begin_trace(flexflow_model_t model, int trace_id);
+void flexflow_end_trace(flexflow_model_t model, int trace_id);
+/* loss of the last committed update (NaN before the first) */
+double flexflow_model_get_last_loss(flexflow_model_t model);
+
+/* metrics -------------------------------------------------------------- */
+
+int flexflow_model_reset_metrics(flexflow_model_t model);
+/* evaluates the staged batch and accumulates into the model's metrics */
+int flexflow_model_compute_metrics(flexflow_model_t model);
+flexflow_perf_metrics_t flexflow_model_get_perf_metrics(
+    flexflow_model_t model);
+double flexflow_per_metrics_get_accuracy(flexflow_perf_metrics_t handle);
+void flexflow_per_metrics_destroy(flexflow_perf_metrics_t handle);
+
+/* layer / parameter introspection -------------------------------------- */
+
+int flexflow_model_get_num_layers(flexflow_model_t model);
+flexflow_op_t flexflow_model_get_layer_by_id(flexflow_model_t model,
+                                             int layer_id);
+flexflow_op_t flexflow_model_get_last_layer(flexflow_model_t model);
+int flexflow_model_print_layers(flexflow_model_t model);
+int flexflow_op_get_num_inputs(flexflow_op_t op);
+int flexflow_op_get_num_outputs(flexflow_op_t op);
+int flexflow_op_get_num_parameters(flexflow_op_t op);
+flexflow_tensor_t flexflow_op_get_input_by_id(flexflow_op_t op, int idx);
+flexflow_tensor_t flexflow_op_get_output_by_id(flexflow_op_t op, int idx);
+flexflow_parameter_t flexflow_op_get_parameter_by_id(flexflow_op_t op,
+                                                     int idx);
+/* number of float elements, or -1 */
+int64_t flexflow_parameter_get_num_elements(flexflow_parameter_t handle);
+/* copies the weight into/from buf (count = element count); 0 on success.
+ * Only valid after compile (weights exist post-init). */
+int flexflow_parameter_get_weights_float(flexflow_parameter_t handle,
+                                         float *buf, int64_t count);
+int flexflow_parameter_set_weights_float(flexflow_parameter_t handle,
+                                         const float *buf, int64_t count);
+
+/* dataloader (reference: flexflow_single_dataloader_*) ----------------- */
+
+/* full_data: the whole dataset for `tensor` ([num_samples, ...]); copied.
+ * Batches of config.batch_size are staged round-robin by next_batch. */
+flexflow_single_dataloader_t flexflow_single_dataloader_create(
+    flexflow_model_t model, flexflow_tensor_t tensor, const void *full_data,
+    const int64_t *shape, int ndims, int is_int);
+/* label variant: tensor_handle may be NULL, stages under "label" */
+flexflow_single_dataloader_t flexflow_single_dataloader_create_label(
+    flexflow_model_t model, const void *full_data, const int64_t *shape,
+    int ndims, int is_int);
+int flexflow_single_dataloader_get_num_samples(
+    flexflow_single_dataloader_t loader);
+int flexflow_single_dataloader_set_num_samples(
+    flexflow_single_dataloader_t loader, int num);
+int flexflow_single_dataloader_reset(flexflow_single_dataloader_t loader);
+int flexflow_single_dataloader_next_batch(flexflow_single_dataloader_t loader);
+void flexflow_single_dataloader_destroy(flexflow_single_dataloader_t loader);
 
 /* handles -------------------------------------------------------------- */
 

@@ -7,6 +7,7 @@
 
 #include <Python.h>
 
+#include <chrono>
 #include <cmath>
 #include <cstdio>
 #include <vector>
@@ -47,6 +48,38 @@ PyObject *shim_call(const char *fn, PyObject *args) {
   return out;
 }
 
+// shim call returning int (discarding the Python result); 0 on success
+int shim_call_status(const char *fn, PyObject *args) {
+  PyObject *out = shim_call(fn, args);
+  if (out == nullptr) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+long shim_call_long(const char *fn, PyObject *args, long on_error) {
+  PyObject *out = shim_call(fn, args);
+  if (out == nullptr) return on_error;
+  long v = PyLong_AsLong(out);
+  Py_DECREF(out);
+  if (PyErr_Occurred()) {
+    print_error();
+    return on_error;
+  }
+  return v;
+}
+
+double shim_call_double(const char *fn, PyObject *args) {
+  PyObject *out = shim_call(fn, args);
+  if (out == nullptr) return NAN;
+  double v = PyFloat_AsDouble(out);
+  Py_DECREF(out);
+  if (PyErr_Occurred()) {
+    print_error();
+    return NAN;
+  }
+  return v;
+}
+
 PyObject *int_list(const int *v, int n) {
   PyObject *l = PyList_New(n);
   for (int i = 0; i < n; ++i) PyList_SET_ITEM(l, i, PyLong_FromLong(v[i]));
@@ -58,6 +91,12 @@ PyObject *int64_list(const int64_t *v, int n) {
   for (int i = 0; i < n; ++i)
     PyList_SET_ITEM(l, i, PyLong_FromLongLong(v[i]));
   return l;
+}
+
+PyObject *none_or(PyObject *h) {
+  if (h == nullptr) Py_RETURN_NONE;
+  Py_INCREF(h);
+  return h;
 }
 
 }  // namespace
@@ -86,6 +125,14 @@ void flexflow_finalize(void) {
   if (Py_IsInitialized()) Py_FinalizeEx();
 }
 
+double flexflow_get_current_time(void) {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+/* config ---------------------------------------------------------------- */
+
 flexflow_config_t flexflow_config_create(int argc, char **argv) {
   PyObject *l = PyList_New(argc);
   for (int i = 0; i < argc; ++i)
@@ -93,27 +140,205 @@ flexflow_config_t flexflow_config_create(int argc, char **argv) {
   return shim_call("config_create", Py_BuildValue("(N)", l));
 }
 
+#define CONFIG_GET(name)                                            \
+  int flexflow_config_get_##name(flexflow_config_t config) {        \
+    return (int)shim_call_long("config_get_" #name,                 \
+                               Py_BuildValue("(O)", (PyObject *)config), -1); \
+  }
+CONFIG_GET(batch_size)
+CONFIG_GET(epochs)
+CONFIG_GET(num_nodes)
+CONFIG_GET(workers_per_node)
+#undef CONFIG_GET
+
+void flexflow_config_destroy(flexflow_config_t config) {
+  Py_XDECREF((PyObject *)config);
+}
+
+/* model ----------------------------------------------------------------- */
+
 flexflow_model_t flexflow_model_create(flexflow_config_t config) {
   return shim_call("model_create",
                    Py_BuildValue("(O)", (PyObject *)config));
 }
 
-flexflow_tensor_t flexflow_tensor_create(flexflow_model_t model, int ndims,
-                                         const int *dims, const char *name) {
+void flexflow_model_destroy(flexflow_model_t model) {
+  Py_XDECREF((PyObject *)model);
+}
+
+/* tensors --------------------------------------------------------------- */
+
+flexflow_tensor_t flexflow_tensor_create_ex(flexflow_model_t model, int ndims,
+                                            const int *dims, int dtype,
+                                            const char *name) {
   return shim_call(
       "tensor_create",
-      Py_BuildValue("(ONs)", (PyObject *)model, int_list(dims, ndims),
+      Py_BuildValue("(ONis)", (PyObject *)model, int_list(dims, ndims), dtype,
                     name ? name : ""));
 }
+
+flexflow_tensor_t flexflow_tensor_create(flexflow_model_t model, int ndims,
+                                         const int *dims, const char *name) {
+  return flexflow_tensor_create_ex(model, ndims, dims, 0, name);
+}
+
+int flexflow_tensor_get_num_dims(flexflow_tensor_t tensor) {
+  return (int)shim_call_long("tensor_num_dims",
+                             Py_BuildValue("(O)", (PyObject *)tensor), -1);
+}
+
+int flexflow_tensor_get_dims(flexflow_tensor_t tensor, int *dims,
+                             int max_dims) {
+  PyObject *out =
+      shim_call("tensor_dims", Py_BuildValue("(O)", (PyObject *)tensor));
+  if (out == nullptr) return -1;
+  int n = (int)PyList_Size(out);
+  for (int i = 0; i < n && i < max_dims; ++i)
+    dims[i] = (int)PyLong_AsLong(PyList_GetItem(out, i));
+  Py_DECREF(out);
+  return n;
+}
+
+int flexflow_tensor_get_data_type(flexflow_tensor_t tensor) {
+  return (int)shim_call_long("tensor_dtype",
+                             Py_BuildValue("(O)", (PyObject *)tensor), -1);
+}
+
+flexflow_op_t flexflow_tensor_get_owner_op(flexflow_tensor_t tensor) {
+  return shim_call("tensor_owner_op",
+                   Py_BuildValue("(O)", (PyObject *)tensor));
+}
+
+void flexflow_tensor_destroy(flexflow_tensor_t tensor) {
+  Py_XDECREF((PyObject *)tensor);
+}
+
+int flexflow_tensor_attach_raw_ptr(flexflow_model_t model,
+                                   flexflow_tensor_t tensor, const void *ptr,
+                                   const int64_t *shape, int ndims,
+                                   int is_int) {
+  return shim_call_status(
+      "tensor_attach_raw_ptr",
+      Py_BuildValue("(OOKNi)", (PyObject *)model, (PyObject *)tensor,
+                    (unsigned long long)(uintptr_t)ptr,
+                    int64_list(shape, ndims), is_int));
+}
+
+int flexflow_tensor_detach_raw_ptr(flexflow_model_t model,
+                                   flexflow_tensor_t tensor) {
+  return shim_call_status(
+      "tensor_detach_raw_ptr",
+      Py_BuildValue("(OO)", (PyObject *)model, (PyObject *)tensor));
+}
+
+/* initializers ---------------------------------------------------------- */
+
+flexflow_initializer_t flexflow_glorot_uniform_initializer_create(int seed) {
+  return shim_call("initializer_create",
+                   Py_BuildValue("(siddd)", "glorot", seed, 0.0, 0.0, 0.0));
+}
+flexflow_initializer_t flexflow_zero_initializer_create(void) {
+  return shim_call("initializer_create",
+                   Py_BuildValue("(siddd)", "zero", 0, 0.0, 0.0, 0.0));
+}
+flexflow_initializer_t flexflow_uniform_initializer_create(int seed,
+                                                           float min_val,
+                                                           float max_val) {
+  return shim_call(
+      "initializer_create",
+      Py_BuildValue("(siddd)", "uniform", seed, (double)min_val,
+                    (double)max_val, 0.0));
+}
+flexflow_initializer_t flexflow_norm_initializer_create(int seed, float mean,
+                                                        float stddev) {
+  return shim_call("initializer_create",
+                   Py_BuildValue("(siddd)", "norm", seed, (double)mean,
+                                 (double)stddev, 0.0));
+}
+flexflow_initializer_t flexflow_constant_initializer_create(float value) {
+  return shim_call(
+      "initializer_create",
+      Py_BuildValue("(siddd)", "constant", 0, (double)value, 0.0, 0.0));
+}
+void flexflow_initializer_destroy(flexflow_initializer_t handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+
+/* optimizers ------------------------------------------------------------ */
+
+flexflow_sgd_optimizer_t flexflow_sgd_optimizer_create(flexflow_model_t model,
+                                                       double lr,
+                                                       double momentum,
+                                                       int nesterov,
+                                                       double weight_decay) {
+  (void)model;  // reference passes the model; ours binds at compile
+  return shim_call("sgd_optimizer_create",
+                   Py_BuildValue("(ddid)", lr, momentum, nesterov,
+                                 weight_decay));
+}
+
+void flexflow_sgd_optimizer_set_lr(flexflow_sgd_optimizer_t handle,
+                                   double lr) {
+  shim_call_status("optimizer_set_lr",
+                   Py_BuildValue("(Od)", (PyObject *)handle, lr));
+}
+
+flexflow_adam_optimizer_t flexflow_adam_optimizer_create(
+    flexflow_model_t model, double alpha, double beta1, double beta2,
+    double weight_decay, double epsilon) {
+  (void)model;
+  return shim_call("adam_optimizer_create",
+                   Py_BuildValue("(ddddd)", alpha, beta1, beta2,
+                                 weight_decay, epsilon));
+}
+
+void flexflow_adam_optimizer_set_lr(flexflow_adam_optimizer_t handle,
+                                    double lr) {
+  shim_call_status("optimizer_set_lr",
+                   Py_BuildValue("(Od)", (PyObject *)handle, lr));
+}
+
+int flexflow_model_set_sgd_optimizer(flexflow_model_t model,
+                                     flexflow_sgd_optimizer_t handle) {
+  return shim_call_status(
+      "model_set_optimizer",
+      Py_BuildValue("(OO)", (PyObject *)model, (PyObject *)handle));
+}
+
+int flexflow_model_set_adam_optimizer(flexflow_model_t model,
+                                      flexflow_adam_optimizer_t handle) {
+  return shim_call_status(
+      "model_set_optimizer",
+      Py_BuildValue("(OO)", (PyObject *)model, (PyObject *)handle));
+}
+
+void flexflow_sgd_optimizer_destroy(flexflow_sgd_optimizer_t handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+void flexflow_adam_optimizer_destroy(flexflow_adam_optimizer_t handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+
+/* layer builders -------------------------------------------------------- */
 
 flexflow_tensor_t flexflow_model_add_dense(flexflow_model_t model,
                                            flexflow_tensor_t input,
                                            int out_features, int activation,
                                            int use_bias) {
-  return shim_call("add_dense",
-                   Py_BuildValue("(OOiii)", (PyObject *)model,
-                                 (PyObject *)input, out_features, activation,
-                                 use_bias));
+  return flexflow_model_add_dense_ex(model, input, out_features, activation,
+                                     use_bias, nullptr, nullptr);
+}
+
+flexflow_tensor_t flexflow_model_add_dense_ex(
+    flexflow_model_t model, flexflow_tensor_t input, int out_features,
+    int activation, int use_bias, flexflow_initializer_t kernel_init,
+    flexflow_initializer_t bias_init) {
+  return shim_call(
+      "add_dense",
+      Py_BuildValue("(OOiiiNN)", (PyObject *)model, (PyObject *)input,
+                    out_features, activation, use_bias,
+                    none_or((PyObject *)kernel_init),
+                    none_or((PyObject *)bias_init)));
 }
 
 flexflow_tensor_t flexflow_model_add_conv2d(flexflow_model_t model,
@@ -122,11 +347,24 @@ flexflow_tensor_t flexflow_model_add_conv2d(flexflow_model_t model,
                                             int kernel_w, int stride_h,
                                             int stride_w, int padding_h,
                                             int padding_w, int activation) {
+  return flexflow_model_add_conv2d_ex(model, input, out_channels, kernel_h,
+                                      kernel_w, stride_h, stride_w, padding_h,
+                                      padding_w, activation, 1, 1, nullptr,
+                                      nullptr);
+}
+
+flexflow_tensor_t flexflow_model_add_conv2d_ex(
+    flexflow_model_t model, flexflow_tensor_t input, int out_channels,
+    int kernel_h, int kernel_w, int stride_h, int stride_w, int padding_h,
+    int padding_w, int activation, int groups, int use_bias,
+    flexflow_initializer_t kernel_init, flexflow_initializer_t bias_init) {
   return shim_call(
       "add_conv2d",
-      Py_BuildValue("(OOiiiiiiii)", (PyObject *)model, (PyObject *)input,
+      Py_BuildValue("(OOiiiiiiiiiiNN)", (PyObject *)model, (PyObject *)input,
                     out_channels, kernel_h, kernel_w, stride_h, stride_w,
-                    padding_h, padding_w, activation));
+                    padding_h, padding_w, activation, groups, use_bias,
+                    none_or((PyObject *)kernel_init),
+                    none_or((PyObject *)bias_init)));
 }
 
 flexflow_tensor_t flexflow_model_add_pool2d(flexflow_model_t model,
@@ -151,20 +389,225 @@ flexflow_tensor_t flexflow_model_add_flat(flexflow_model_t model,
 flexflow_tensor_t flexflow_model_add_embedding(flexflow_model_t model,
                                                flexflow_tensor_t input,
                                                int num_entries, int out_dim) {
-  return shim_call("add_embedding",
-                   Py_BuildValue("(OOii)", (PyObject *)model,
-                                 (PyObject *)input, num_entries, out_dim));
+  return flexflow_model_add_embedding_ex(model, input, num_entries, out_dim,
+                                         0, nullptr);
+}
+
+flexflow_tensor_t flexflow_model_add_embedding_ex(
+    flexflow_model_t model, flexflow_tensor_t input, int num_entries,
+    int out_dim, int aggr, flexflow_initializer_t kernel_init) {
+  return shim_call(
+      "add_embedding",
+      Py_BuildValue("(OOiiiN)", (PyObject *)model, (PyObject *)input,
+                    num_entries, out_dim, aggr,
+                    none_or((PyObject *)kernel_init)));
 }
 
 flexflow_tensor_t flexflow_model_add_multihead_attention(
     flexflow_model_t model, flexflow_tensor_t query, flexflow_tensor_t key,
     flexflow_tensor_t value, int embed_dim, int num_heads) {
+  return flexflow_model_add_multihead_attention_ex(
+      model, query, key, value, embed_dim, num_heads, 0, 0, 0.0f, 1, 0);
+}
+
+flexflow_tensor_t flexflow_model_add_multihead_attention_ex(
+    flexflow_model_t model, flexflow_tensor_t query, flexflow_tensor_t key,
+    flexflow_tensor_t value, int embed_dim, int num_heads, int kdim, int vdim,
+    float dropout, int bias, int causal) {
   return shim_call(
       "add_multihead_attention",
-      Py_BuildValue("(OOOOii)", (PyObject *)model, (PyObject *)query,
-                    (PyObject *)key, (PyObject *)value, embed_dim,
-                    num_heads));
+      Py_BuildValue("(OOOOiiiifii)", (PyObject *)model, (PyObject *)query,
+                    (PyObject *)key, (PyObject *)value, embed_dim, num_heads,
+                    kdim, vdim, dropout, bias, causal));
 }
+
+flexflow_tensor_t flexflow_model_add_batch_matmul(flexflow_model_t model,
+                                                  flexflow_tensor_t a,
+                                                  flexflow_tensor_t b) {
+  return shim_call("add_batch_matmul",
+                   Py_BuildValue("(OOO)", (PyObject *)model, (PyObject *)a,
+                                 (PyObject *)b));
+}
+
+flexflow_tensor_t flexflow_model_add_batch_norm(flexflow_model_t model,
+                                                flexflow_tensor_t input,
+                                                int relu) {
+  return shim_call("add_batch_norm",
+                   Py_BuildValue("(OOi)", (PyObject *)model,
+                                 (PyObject *)input, relu));
+}
+
+flexflow_tensor_t flexflow_model_add_layer_norm(flexflow_model_t model,
+                                                flexflow_tensor_t input,
+                                                int n_axes, const int *axes,
+                                                int elementwise_affine,
+                                                float eps) {
+  return shim_call(
+      "add_layer_norm",
+      Py_BuildValue("(OONif)", (PyObject *)model, (PyObject *)input,
+                    int_list(axes, n_axes), elementwise_affine, eps));
+}
+
+flexflow_tensor_t flexflow_model_add_concat(flexflow_model_t model,
+                                            int n_tensors,
+                                            const flexflow_tensor_t *tensors,
+                                            int axis) {
+  PyObject *l = PyList_New(n_tensors);
+  for (int i = 0; i < n_tensors; ++i) {
+    PyObject *t = (PyObject *)tensors[i];
+    Py_INCREF(t);
+    PyList_SET_ITEM(l, i, t);
+  }
+  return shim_call("add_concat",
+                   Py_BuildValue("(ONi)", (PyObject *)model, l, axis));
+}
+
+int flexflow_model_add_split(flexflow_model_t model, flexflow_tensor_t input,
+                             int n, const int *sizes, int axis,
+                             flexflow_tensor_t *outputs) {
+  PyObject *out = shim_call(
+      "add_split",
+      Py_BuildValue("(OONi)", (PyObject *)model, (PyObject *)input,
+                    int_list(sizes, n), axis));
+  if (out == nullptr || !PyList_Check(out)) {
+    Py_XDECREF(out);
+    return 1;
+  }
+  int m = (int)PyList_Size(out);
+  if (m != n) {
+    // nothing is written on a count mismatch: the caller owns no handles
+    // and outputs[] stays untouched
+    Py_DECREF(out);
+    return 1;
+  }
+  for (int i = 0; i < n; ++i) {
+    PyObject *t = PyList_GetItem(out, i);
+    Py_INCREF(t);
+    outputs[i] = t;
+  }
+  Py_DECREF(out);
+  return 0;
+}
+
+flexflow_tensor_t flexflow_model_add_reshape(flexflow_model_t model,
+                                             flexflow_tensor_t input,
+                                             int ndims, const int *dims) {
+  return shim_call("add_reshape",
+                   Py_BuildValue("(OON)", (PyObject *)model,
+                                 (PyObject *)input, int_list(dims, ndims)));
+}
+
+flexflow_tensor_t flexflow_model_add_transpose(flexflow_model_t model,
+                                               flexflow_tensor_t input,
+                                               int ndims, const int *perm) {
+  return shim_call("add_transpose",
+                   Py_BuildValue("(OON)", (PyObject *)model,
+                                 (PyObject *)input, int_list(perm, ndims)));
+}
+
+flexflow_tensor_t flexflow_model_add_reverse(flexflow_model_t model,
+                                             flexflow_tensor_t input,
+                                             int axis) {
+  return shim_call("add_reverse",
+                   Py_BuildValue("(OOi)", (PyObject *)model,
+                                 (PyObject *)input, axis));
+}
+
+flexflow_tensor_t flexflow_model_add_mean(flexflow_model_t model,
+                                          flexflow_tensor_t input,
+                                          int n_dims, const int *dims,
+                                          int keepdims) {
+  return shim_call("add_mean",
+                   Py_BuildValue("(OONi)", (PyObject *)model,
+                                 (PyObject *)input, int_list(dims, n_dims),
+                                 keepdims));
+}
+
+flexflow_tensor_t flexflow_model_add_reduce_sum(flexflow_model_t model,
+                                                flexflow_tensor_t input,
+                                                int n_dims, const int *dims,
+                                                int keepdims) {
+  return shim_call("add_reduce_sum",
+                   Py_BuildValue("(OONi)", (PyObject *)model,
+                                 (PyObject *)input, int_list(dims, n_dims),
+                                 keepdims));
+}
+
+flexflow_tensor_t flexflow_model_add_cast(flexflow_model_t model,
+                                          flexflow_tensor_t input,
+                                          int dtype) {
+  return shim_call("add_cast",
+                   Py_BuildValue("(OOi)", (PyObject *)model,
+                                 (PyObject *)input, dtype));
+}
+
+flexflow_tensor_t flexflow_model_add_softmax(flexflow_model_t model,
+                                             flexflow_tensor_t input) {
+  return shim_call("add_softmax", Py_BuildValue("(OO)", (PyObject *)model,
+                                                (PyObject *)input));
+}
+
+flexflow_tensor_t flexflow_model_add_dropout(flexflow_model_t model,
+                                             flexflow_tensor_t input,
+                                             float rate) {
+  return shim_call("add_dropout",
+                   Py_BuildValue("(OOf)", (PyObject *)model,
+                                 (PyObject *)input, rate));
+}
+
+#define UNARY(name)                                                         \
+  flexflow_tensor_t flexflow_model_add_##name(flexflow_model_t model,       \
+                                              flexflow_tensor_t input) {    \
+    return shim_call("add_unary",                                           \
+                     Py_BuildValue("(OsO)", (PyObject *)model, #name,       \
+                                   (PyObject *)input));                     \
+  }
+UNARY(relu)
+UNARY(sigmoid)
+UNARY(tanh)
+UNARY(elu)
+UNARY(gelu)
+UNARY(identity)
+UNARY(exp)
+UNARY(sin)
+UNARY(cos)
+UNARY(rsqrt)
+#undef UNARY
+
+flexflow_tensor_t flexflow_model_add_pow(flexflow_model_t model,
+                                         flexflow_tensor_t input,
+                                         float exponent) {
+  return shim_call("add_scalar_op",
+                   Py_BuildValue("(OsOf)", (PyObject *)model, "pow",
+                                 (PyObject *)input, exponent));
+}
+
+#define SCALAR(name)                                                        \
+  flexflow_tensor_t flexflow_model_add_scalar_##name(                       \
+      flexflow_model_t model, flexflow_tensor_t input, float scalar) {      \
+    return shim_call("add_scalar_op",                                       \
+                     Py_BuildValue("(OsOf)", (PyObject *)model,             \
+                                   "scalar_" #name, (PyObject *)input,      \
+                                   scalar));                                \
+  }
+SCALAR(add)
+SCALAR(sub)
+SCALAR(multiply)
+SCALAR(truediv)
+#undef SCALAR
+
+#define BINARY(name, pyname)                                                \
+  flexflow_tensor_t flexflow_model_add_##name(                              \
+      flexflow_model_t model, flexflow_tensor_t a, flexflow_tensor_t b) {   \
+    return shim_call("add_binary",                                          \
+                     Py_BuildValue("(OsOO)", (PyObject *)model, pyname,     \
+                                   (PyObject *)a, (PyObject *)b));          \
+  }
+BINARY(add, "add")
+BINARY(subtract, "subtract")
+BINARY(multiply, "multiply")
+BINARY(divide, "divide")
+#undef BINARY
 
 flexflow_tensor_t flexflow_model_add_unary(flexflow_model_t model,
                                            const char *op,
@@ -182,51 +625,191 @@ flexflow_tensor_t flexflow_model_add_binary(flexflow_model_t model,
                                  (PyObject *)a, (PyObject *)b));
 }
 
-flexflow_tensor_t flexflow_model_add_softmax(flexflow_model_t model,
-                                             flexflow_tensor_t input) {
-  return shim_call("add_softmax", Py_BuildValue("(OO)", (PyObject *)model,
-                                                (PyObject *)input));
-}
-
-flexflow_tensor_t flexflow_model_add_dropout(flexflow_model_t model,
-                                             flexflow_tensor_t input,
-                                             float rate) {
-  return shim_call("add_dropout",
-                   Py_BuildValue("(OOf)", (PyObject *)model,
-                                 (PyObject *)input, rate));
-}
+/* compile / train -------------------------------------------------------- */
 
 int flexflow_model_compile(flexflow_model_t model, const char *loss,
                            const char *metrics, double learning_rate) {
-  PyObject *out = shim_call(
+  return shim_call_status(
       "compile_model",
       Py_BuildValue("(Ossd)", (PyObject *)model, loss ? loss : "",
                     metrics ? metrics : "", learning_rate));
-  if (out == nullptr) return 1;
-  Py_DECREF(out);
-  return 0;
 }
 
 double flexflow_model_fit(flexflow_model_t model, const float *x,
                           const int64_t *x_shape, int x_ndims, const void *y,
                           const int64_t *y_shape, int y_ndims, int y_is_int,
                           int epochs) {
-  PyObject *out = shim_call(
+  return shim_call_double(
       "fit_ptr",
       Py_BuildValue("(OKNKNii)", (PyObject *)model,
                     (unsigned long long)(uintptr_t)x,
                     int64_list(x_shape, x_ndims),
                     (unsigned long long)(uintptr_t)y,
                     int64_list(y_shape, y_ndims), y_is_int, epochs));
-  if (out == nullptr) return NAN;
-  double v = PyFloat_AsDouble(out);
-  Py_DECREF(out);
-  if (PyErr_Occurred()) {
-    print_error();
-    return NAN;
-  }
-  return v;
 }
+
+#define MODEL_VERB(name)                                           \
+  int flexflow_model_##name(flexflow_model_t model) {              \
+    return shim_call_status("model_" #name,                        \
+                            Py_BuildValue("(O)", (PyObject *)model)); \
+  }
+MODEL_VERB(init_layers)
+MODEL_VERB(forward)
+MODEL_VERB(zero_gradients)
+MODEL_VERB(backward)
+MODEL_VERB(update)
+MODEL_VERB(reset_metrics)
+MODEL_VERB(compute_metrics)
+MODEL_VERB(print_layers)
+#undef MODEL_VERB
+
+void flexflow_begin_trace(flexflow_model_t model, int trace_id) {
+  (void)model;
+  (void)trace_id;  // subsumed by jit compile caching (SURVEY §5)
+}
+void flexflow_end_trace(flexflow_model_t model, int trace_id) {
+  (void)model;
+  (void)trace_id;
+}
+
+double flexflow_model_get_last_loss(flexflow_model_t model) {
+  return shim_call_double("model_last_loss",
+                          Py_BuildValue("(O)", (PyObject *)model));
+}
+
+/* metrics ---------------------------------------------------------------- */
+
+flexflow_perf_metrics_t flexflow_model_get_perf_metrics(
+    flexflow_model_t model) {
+  return shim_call("model_perf_metrics",
+                   Py_BuildValue("(O)", (PyObject *)model));
+}
+
+double flexflow_per_metrics_get_accuracy(flexflow_perf_metrics_t handle) {
+  return shim_call_double("perf_metrics_accuracy",
+                          Py_BuildValue("(O)", (PyObject *)handle));
+}
+
+void flexflow_per_metrics_destroy(flexflow_perf_metrics_t handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+
+/* layer / parameter introspection ----------------------------------------- */
+
+int flexflow_model_get_num_layers(flexflow_model_t model) {
+  return (int)shim_call_long("model_num_layers",
+                             Py_BuildValue("(O)", (PyObject *)model), -1);
+}
+
+flexflow_op_t flexflow_model_get_layer_by_id(flexflow_model_t model,
+                                             int layer_id) {
+  return shim_call("model_layer_by_id",
+                   Py_BuildValue("(Oi)", (PyObject *)model, layer_id));
+}
+
+flexflow_op_t flexflow_model_get_last_layer(flexflow_model_t model) {
+  return shim_call("model_last_layer",
+                   Py_BuildValue("(O)", (PyObject *)model));
+}
+
+int flexflow_op_get_num_inputs(flexflow_op_t op) {
+  return (int)shim_call_long("op_num_inputs",
+                             Py_BuildValue("(O)", (PyObject *)op), -1);
+}
+int flexflow_op_get_num_outputs(flexflow_op_t op) {
+  return (int)shim_call_long("op_num_outputs",
+                             Py_BuildValue("(O)", (PyObject *)op), -1);
+}
+int flexflow_op_get_num_parameters(flexflow_op_t op) {
+  return (int)shim_call_long("op_num_parameters",
+                             Py_BuildValue("(O)", (PyObject *)op), -1);
+}
+flexflow_tensor_t flexflow_op_get_input_by_id(flexflow_op_t op, int idx) {
+  return shim_call("op_input_by_id",
+                   Py_BuildValue("(Oi)", (PyObject *)op, idx));
+}
+flexflow_tensor_t flexflow_op_get_output_by_id(flexflow_op_t op, int idx) {
+  return shim_call("op_output_by_id",
+                   Py_BuildValue("(Oi)", (PyObject *)op, idx));
+}
+flexflow_parameter_t flexflow_op_get_parameter_by_id(flexflow_op_t op,
+                                                     int idx) {
+  return shim_call("op_parameter_by_id",
+                   Py_BuildValue("(Oi)", (PyObject *)op, idx));
+}
+
+int64_t flexflow_parameter_get_num_elements(flexflow_parameter_t handle) {
+  return (int64_t)shim_call_long(
+      "parameter_num_elements", Py_BuildValue("(O)", (PyObject *)handle), -1);
+}
+
+int flexflow_parameter_get_weights_float(flexflow_parameter_t handle,
+                                         float *buf, int64_t count) {
+  return shim_call_status(
+      "parameter_get_weights",
+      Py_BuildValue("(OKL)", (PyObject *)handle,
+                    (unsigned long long)(uintptr_t)buf, (long long)count));
+}
+
+int flexflow_parameter_set_weights_float(flexflow_parameter_t handle,
+                                         const float *buf, int64_t count) {
+  return shim_call_status(
+      "parameter_set_weights",
+      Py_BuildValue("(OKL)", (PyObject *)handle,
+                    (unsigned long long)(uintptr_t)buf, (long long)count));
+}
+
+/* dataloader -------------------------------------------------------------- */
+
+flexflow_single_dataloader_t flexflow_single_dataloader_create(
+    flexflow_model_t model, flexflow_tensor_t tensor, const void *full_data,
+    const int64_t *shape, int ndims, int is_int) {
+  return shim_call(
+      "dataloader_create",
+      Py_BuildValue("(OOKNi)", (PyObject *)model, (PyObject *)tensor,
+                    (unsigned long long)(uintptr_t)full_data,
+                    int64_list(shape, ndims), is_int));
+}
+
+flexflow_single_dataloader_t flexflow_single_dataloader_create_label(
+    flexflow_model_t model, const void *full_data, const int64_t *shape,
+    int ndims, int is_int) {
+  return shim_call(
+      "dataloader_create_label",
+      Py_BuildValue("(OKNi)", (PyObject *)model,
+                    (unsigned long long)(uintptr_t)full_data,
+                    int64_list(shape, ndims), is_int));
+}
+
+int flexflow_single_dataloader_get_num_samples(
+    flexflow_single_dataloader_t loader) {
+  return (int)shim_call_long("dataloader_num_samples",
+                             Py_BuildValue("(O)", (PyObject *)loader), -1);
+}
+
+int flexflow_single_dataloader_set_num_samples(
+    flexflow_single_dataloader_t loader, int num) {
+  return shim_call_status(
+      "dataloader_set_num_samples",
+      Py_BuildValue("(Oi)", (PyObject *)loader, num));
+}
+
+int flexflow_single_dataloader_reset(flexflow_single_dataloader_t loader) {
+  return shim_call_status("dataloader_reset",
+                          Py_BuildValue("(O)", (PyObject *)loader));
+}
+
+int flexflow_single_dataloader_next_batch(
+    flexflow_single_dataloader_t loader) {
+  return shim_call_status("dataloader_next_batch",
+                          Py_BuildValue("(O)", (PyObject *)loader));
+}
+
+void flexflow_single_dataloader_destroy(flexflow_single_dataloader_t loader) {
+  Py_XDECREF((PyObject *)loader);
+}
+
+/* handles ----------------------------------------------------------------- */
 
 void flexflow_handle_destroy(void *handle) {
   Py_XDECREF((PyObject *)handle);
