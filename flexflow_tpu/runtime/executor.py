@@ -130,6 +130,7 @@ class Executor:
         self._train_step = None
         self._eval_step = None
         self._fwd = None
+        self._grad_fn = None
 
     # -- shardings -----------------------------------------------------------
 
@@ -270,6 +271,7 @@ class Executor:
             self._train_step = None
             self._eval_step = None
             self._fwd = None
+            self._grad_fn = None
 
     def train_step(self):
         if self._train_step is None:
@@ -284,6 +286,23 @@ class Executor:
 
             self._eval_step = jax.jit(step)
         return self._eval_step
+
+    def grad_fn(self):
+        """Loss gradients wrt params: (params, batch) -> grads pytree.
+        Dropout/rng-free (train=False), jitted and cached like eval_step."""
+        if self._grad_fn is None:
+
+            def grads(params, batch):
+                def loss_fn(p):
+                    loss, _ = self._loss_and_metrics(
+                        p, batch, None, train=False
+                    )
+                    return loss
+
+                return jax.grad(loss_fn)(params)
+
+            self._grad_fn = jax.jit(grads)
+        return self._grad_fn
 
     def forward_fn(self):
         """Inference forward: (params, batch) -> logits."""

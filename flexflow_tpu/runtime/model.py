@@ -1009,6 +1009,24 @@ class FFModel:
         """reference: FFModel::backward (model.cc:2432). Subsumed: the
         jitted train step computes grads via jax.value_and_grad."""
 
+    def compute_gradients(self, x, y) -> Dict[int, list]:
+        """Per-parameter loss gradients for one batch, as host arrays keyed
+        like `params` ({guid: [grad per weight slot]}).
+
+        The alignment harness's window into the backward pass (reference:
+        align/align_ff_utils.py run_fwd_bwd reads each op's region gradients
+        after backward()); here one jax.grad over the whole compiled program
+        yields every weight gradient at once. Dropout is disabled
+        (train=False) so results are deterministic."""
+        if self.executor is None:
+            raise RuntimeError("call compile() before compute_gradients()")
+        self.executor.set_seq_length(self.config.seq_length)
+        batch = self.executor.shard_batch(self._pack_dataset(x, y))
+        grads = self.executor.grad_fn()(self.params, batch)
+        return {
+            guid: [np.asarray(g) for g in gs] for guid, gs in grads.items()
+        }
+
     def update(self):
         """reference: FFModel::update (model.cc:2463). Subsumed: the jitted
         train step applies the optimizer in the same program."""
