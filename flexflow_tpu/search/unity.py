@@ -582,18 +582,9 @@ class UnitySearch:
             if not any(c in branch for c in self.graph.consumers(g))
         ]
         if len(terms) != 1:
-            # multi-terminal branch: independent per-node minima (analytic
-            # fallback; the reference bounds this case with its own heuristic
-            # splits). Transfers within the branch are not charged.
-            views = {}
-            total = 0.0
-            for g in sorted(branch):
-                cands = self.valid_views(g, resource)
-                costs = [(self.op_cost(g, v), v) for v in cands]
-                c, v = min(costs, key=lambda t: t[0])
-                total += c
-                views[g] = v
-            return total, views
+            return self._multi_terminal_cost(
+                branch, src_pair, sink, sink_view, resource
+            )
         term = terms[0]
         best: Optional[Tuple[float, Dict[int, ViewOption]]] = None
         for view in self.valid_views(term, resource):
@@ -604,6 +595,142 @@ class UnitySearch:
             if best is None or c < best[0]:
                 best = (c, {**v, term: view})
         return best
+
+    # product cap for the exact multi-terminal solve; beyond it the greedy
+    # topological pass runs instead (mirrored by native/src/unity_dp.cc)
+    _MT_EXACT_CAP = 4096
+
+    def _branch_topo_order(self, branch: FrozenSet[int]) -> List[int]:
+        """Topological order within the branch, smallest guid first.
+        Builder guids are already topological, but substitution rewrites
+        wire fresh higher-guid producers into existing lower-guid
+        consumers, so Kahn it is. Mirrored by the native solver's
+        multi_terminal_cost (same smallest-first tie-break)."""
+        indeg = {
+            g: sum(
+                1 for r in self.graph.nodes[g].inputs if r.guid in branch
+            )
+            for g in branch
+        }
+        remaining = set(branch)
+        order: List[int] = []
+        while remaining:
+            ready = [g for g in remaining if indeg[g] == 0]
+            if not ready:  # cycle (impossible in a PCG): keep guid order
+                return sorted(branch)
+            g = min(ready)
+            order.append(g)
+            remaining.remove(g)
+            for c in remaining:
+                indeg[c] -= sum(
+                    1 for r in self.graph.nodes[c].inputs if r.guid == g
+                )
+        return order
+
+    def _multi_terminal_cost(
+        self, branch: FrozenSet[int], src_pair, sink, sink_view, resource
+    ) -> Tuple[float, Dict[int, ViewOption]]:
+        """Multi-terminal branch (no single node post-dominates it): assign
+        views over the whole branch JOINTLY, charging intra-branch
+        transfers, the producer boundary, and every terminal→sink transfer.
+        Small branches are solved exactly (view-set product ≤ _MT_EXACT_CAP);
+        larger ones greedily in topological order, each node taking the view
+        minimizing its op cost plus transfers from already-assigned
+        producers. Replaces the round-1 independent-minima fallback that
+        charged no transfers at all and underestimated real branch costs 2×+
+        (bounded by tests/test_unity_exhaustive.py)."""
+        import itertools
+
+        order = self._branch_topo_order(branch)
+        pos = {g: k for k, g in enumerate(order)}
+        opts = [self.valid_views(g, resource) for g in order]
+        nk = len(order)
+
+        # cost tables: per-(node, view) op costs; per-edge view-pair
+        # transfer tables (producer is always earlier: order is topological)
+        opc = [
+            [self.op_cost(g, v) for v in cands]
+            for g, cands in zip(order, opts)
+        ]
+        intra = []  # (ks, kd, table[src_view_idx][dst_view_idx])
+        src_edges = []  # (kd, cost per dst view) from the fixed src boundary
+        for kd, g in enumerate(order):
+            for r in self.graph.nodes[g].inputs:
+                if r.guid in pos:
+                    ks = pos[r.guid]
+                    intra.append(
+                        (
+                            ks,
+                            kd,
+                            [
+                                [self.xfer_cost(r, vs, vd) for vd in opts[kd]]
+                                for vs in opts[ks]
+                            ],
+                        )
+                    )
+                elif src_pair is not None and r.guid == src_pair[0]:
+                    src_edges.append(
+                        (
+                            kd,
+                            [
+                                self.xfer_cost(r, src_pair[1], vd)
+                                for vd in opts[kd]
+                            ],
+                        )
+                    )
+        sink_edges = []  # (ks, cost per src view) onto the fixed sink view
+        for r in self.graph.nodes[sink].inputs:
+            if r.guid in pos:
+                ks = pos[r.guid]
+                sink_edges.append(
+                    (ks, [self.xfer_cost(r, v, sink_view) for v in opts[ks]])
+                )
+
+        def total_cost(idx) -> float:
+            c = 0.0
+            for k in range(nk):
+                c += opc[k][idx[k]]
+            for ks, kd, table in intra:
+                c += table[idx[ks]][idx[kd]]
+            for kd, costs in src_edges:
+                c += costs[idx[kd]]
+            for ks, costs in sink_edges:
+                c += costs[idx[ks]]
+            return c
+
+        n_combos = 1
+        for o in opts:
+            n_combos *= len(o)
+        if n_combos <= self._MT_EXACT_CAP:
+            best = None
+            for idx in itertools.product(*(range(len(o)) for o in opts)):
+                c = total_cost(idx)
+                if best is None or c < best[0]:
+                    best = (c, idx)
+            return best[0], {
+                g: opts[k][best[1][k]] for k, g in enumerate(order)
+            }
+
+        idx: List[int] = []
+        for k in range(nk):
+            best_j = None
+            for j in range(len(opts[k])):
+                c = opc[k][j]
+                for ks, kd, table in intra:
+                    if kd == k:
+                        c += table[idx[ks]][j]
+                for kd, costs in src_edges:
+                    if kd == k:
+                        c += costs[j]
+                for ks, costs in sink_edges:
+                    if ks == k:
+                        c += costs[j]
+                if best_j is None or c < best_j[0]:
+                    best_j = (c, j)
+            idx.append(best_j[1])
+        return total_cost(idx), {
+            g: opts[k][idx[k]] for k, g in enumerate(order)
+        }
 
     def _nonsequence_cost(
         self, sub, src_pair, sink, sink_view, resource

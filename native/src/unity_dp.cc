@@ -346,6 +346,133 @@ struct Solver {
     return comps;
   }
 
+  // product cap for the exact multi-terminal solve (unity.py _MT_EXACT_CAP)
+  static constexpr long kMTExactCap = 4096;
+
+  Entry multi_terminal_cost(const Bits &branch, int src_node, View src_view,
+                            int sink, View sink_view, const Block &block) {
+    // Joint view assignment over the whole branch, charging intra-branch
+    // transfers, the src boundary, and terminal->sink transfers: exact
+    // enumeration when the view product fits kMTExactCap, greedy in
+    // topological (ascending-index) order otherwise. Mirrors
+    // unity.py:_multi_terminal_cost bit-for-bit, including tie-breaking
+    // (first candidate wins) and product iteration order (last node's
+    // views cycle fastest).
+    std::vector<int> nodes;
+    for (int i = 0; i < p.n; ++i)
+      if (branch.test(i)) nodes.push_back(i);
+    size_t k_n = nodes.size();
+    // topological order within the branch, smallest index first (Kahn) —
+    // index order mirrors guid order, which substitution rewrites can
+    // leave non-topological (mirrors unity.py _branch_topo_order)
+    {
+      std::vector<int> indeg(p.n, 0);
+      for (int g : nodes)
+        for (auto &e : p.in_edges[g])
+          if (branch.test(e.first)) indeg[g]++;
+      std::vector<char> done(p.n, 0);
+      std::vector<int> order;
+      order.reserve(k_n);
+      while (order.size() < k_n) {
+        int pick = -1;
+        for (int g : nodes)  // nodes ascend: first ready == smallest
+          if (!done[g] && indeg[g] == 0) { pick = g; break; }
+        if (pick < 0) break;  // cycle (impossible in a PCG): keep order
+        done[pick] = 1;
+        order.push_back(pick);
+        for (int c : nodes)
+          if (!done[c])
+            for (auto &e : p.in_edges[c])
+              if (e.first == pick) indeg[c]--;
+      }
+      if (order.size() == k_n) nodes = order;
+    }
+    std::vector<int> pos(p.n, -1);
+    for (size_t k = 0; k < k_n; ++k) pos[nodes[k]] = (int)k;
+    std::vector<std::vector<View>> opts(k_n);
+    long combos = 1;
+    for (size_t k = 0; k < k_n; ++k) {
+      valid_views(p, nodes[k], block, opts[k]);
+      if (combos <= kMTExactCap) combos *= (long)opts[k].size();
+    }
+
+    // transfers into node g under view v from already-assigned producers
+    // (every intra-branch producer of nodes[k] has pos < k: indices are
+    // topological) or from the src boundary
+    auto edge_in_cost = [&](size_t k, View v, const std::vector<View> &assign,
+                            size_t assigned_upto) {
+      double c = 0.0;
+      for (auto &e : p.in_edges[nodes[k]]) {
+        int u = e.first;
+        if (pos[u] >= 0 && (size_t)pos[u] < assigned_upto)
+          c += xfer_cost(p, e.second, assign[pos[u]], v);
+        else if (u == src_node)
+          c += xfer_cost(p, e.second, src_view, v);
+      }
+      return c;
+    };
+    auto total_cost = [&](const std::vector<View> &assign) {
+      // assign is complete here, so every intra-branch producer edge is
+      // charged (assigned_upto = k_n), exactly like unity.py's total_cost
+      double c = 0.0;
+      for (size_t k = 0; k < k_n; ++k)
+        c += op_cost(p, nodes[k], assign[k]) +
+             edge_in_cost(k, assign[k], assign, k_n);
+      for (auto &e : p.in_edges[sink])
+        if (pos[e.first] >= 0)
+          c += xfer_cost(p, e.second, assign[pos[e.first]], sink_view);
+      return c;
+    };
+
+    std::vector<View> assign(k_n, View{1, 1, 0, 0});
+    Entry out;
+    if (combos <= kMTExactCap) {
+      std::vector<size_t> idx(k_n, 0);
+      bool first = true;
+      std::vector<View> best_assign;
+      for (;;) {
+        for (size_t k = 0; k < k_n; ++k) assign[k] = opts[k][idx[k]];
+        double c = total_cost(assign);
+        if (first || c < out.cost) {
+          first = false;
+          out.cost = c;
+          best_assign = assign;
+        }
+        // odometer: last position increments fastest (itertools.product)
+        size_t k = k_n;
+        while (k > 0) {
+          --k;
+          if (++idx[k] < opts[k].size()) break;
+          idx[k] = 0;
+          if (k == 0) { k = k_n + 1; break; }
+        }
+        if (k == k_n + 1 || k_n == 0) break;
+      }
+      for (size_t k = 0; k < k_n; ++k)
+        out.views.push_back({nodes[k], best_assign[k]});
+      return out;
+    }
+
+    for (size_t k = 0; k < k_n; ++k) {
+      double bestc = -1;
+      View bv = opts[k][0];
+      for (View v : opts[k]) {
+        double c = op_cost(p, nodes[k], v) + edge_in_cost(k, v, assign, k);
+        for (auto &e : p.in_edges[sink])
+          if (e.first == nodes[k]) c += xfer_cost(p, e.second, v, sink_view);
+        if (bestc < 0 || c < bestc) {
+          bestc = c;
+          bv = v;
+        }
+      }
+      assign[k] = bv;
+    }
+    out.cost = total_cost(assign);
+    for (size_t k = 0; k < k_n; ++k)
+      out.views.push_back({nodes[k], assign[k]});
+    return out;
+  }
+
   Entry branch_cost(const Bits &branch, int src_node, View src_view, int sink,
                     View sink_view, const Block &block) {
     // terminals: branch nodes with no consumer inside the branch
@@ -358,27 +485,9 @@ struct Solver {
       if (!internal_consumer) terms.push_back(i);
     }
     Entry out;
-    if (terms.size() != 1) {
-      // multi-terminal fallback: independent per-node minima (unity.py)
-      out.cost = 0.0;
-      for (int i = 0; i < p.n; ++i) {
-        if (!branch.test(i)) continue;
-        std::vector<View> views;
-        valid_views(p, i, block, views);
-        double best = -1;
-        View bv{1, 1, 0, 0};
-        for (View v : views) {
-          double c = op_cost(p, i, v);
-          if (best < 0 || c < best) {
-            best = c;
-            bv = v;
-          }
-        }
-        out.cost += best;
-        out.views.push_back({i, bv});
-      }
-      return out;
-    }
+    if (terms.size() != 1)
+      return multi_terminal_cost(branch, src_node, src_view, sink, sink_view,
+                                 block);
     int term = terms[0];
     std::vector<View> views;
     valid_views(p, term, block, views);

@@ -1,0 +1,221 @@
+"""Bound the Unity DP's documented approximations against brute-force
+exhaustive search on small graphs (VERDICT r1 weak item 2).
+
+The DP's objective on sequential execution is
+    sum_g op_cost(g, view_g)  +  sum_{edges u->v} xfer_cost(u.view, v.view)
+which `exhaustive_sequential_min` evaluates over EVERY assignment of valid
+views to nodes. On chains and diamonds the decomposition (bottleneck split +
+single-terminal branches, unity.py:_graph_cost/_branch_cost) charges every
+edge exactly once, so the DP must match the exhaustive optimum exactly.
+The remaining approximations — the greedy pass for over-cap multi-terminal
+branches (unity.py:_multi_terminal_cost) and multi-sink trunk→tail
+boundaries (unity.py:_optimize_python) — must stay sandwiched: never above
+the exhaustive sequential optimum, never below the per-node best-op-cost
+lower bound; the exact small-branch solve must match brute force.
+
+reference: the search these bound is SearchHelper::graph_cost
+(graph.cc:1346-1431); the reference ships no such optimality test."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.search.unity import UnitySearch
+
+SPEC = MachineSpec(num_nodes=1, chips_per_node=4, chip="v5e")
+
+
+def exhaustive_sequential_min(search: UnitySearch) -> float:
+    """Brute-force optimum of the DP's sequential objective."""
+    g = search.graph
+    guids = sorted(g.nodes)
+    opts = [search.valid_views(u, search.resource) for u in guids]
+    n_combos = int(np.prod([len(o) for o in opts]))
+    assert n_combos <= 200_000, "graph too large for the exhaustive bound"
+    best = float("inf")
+    for combo in itertools.product(*opts):
+        assign = dict(zip(guids, combo))
+        total = 0.0
+        for u in guids:
+            total += search.op_cost(u, assign[u])
+            for r in g.nodes[u].inputs:
+                total += search.xfer_cost(r, assign[r.guid], assign[u])
+        best = min(best, total)
+    return best
+
+
+def per_node_lower_bound(search: UnitySearch) -> float:
+    """Valid lower bound for ANY execution model the DP costs: transfers
+    are nonnegative and concurrency can only overlap, never shrink, a
+    node's own best-view time... except concurrent resource splits give a
+    branch FEWER chips — so take each node's min over every sub-resource
+    the splits can produce too."""
+    total = 0.0
+    resources = [search.resource]
+    for i in range(1, search.resource.num_nodes):
+        resources.extend(search.resource.vertical_split(i))
+    for i in range(1, search.resource.chips_per_node):
+        resources.extend(search.resource.horizontal_split(i))
+    for u in sorted(search.graph.nodes):
+        total += min(
+            search.op_cost(u, v)
+            for r in resources
+            for v in search.valid_views(u, r)
+        )
+    return total
+
+
+def chain_model(batch=16, hidden=64, layers=3):
+    m = FFModel(FFConfig(batch_size=batch))
+    t = m.create_tensor([batch, hidden], name="x")
+    for i in range(layers):
+        t = m.dense(t, hidden, activation=ActiMode.RELU, name=f"d{i}")
+    m.dense(t, 8, name="head")
+    return m
+
+
+def diamond_model(batch=16, hidden=64):
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, hidden], name="x")
+    a = m.dense(x, hidden, name="left")
+    b = m.dense(x, hidden, name="right")
+    m.dense(m.add(a, b), 8, name="head")
+    return m
+
+
+def multi_terminal_model(batch=16, hidden=64):
+    """One weakly-connected branch with TWO terminals feeding the sink —
+    triggers _branch_cost's independent-minima fallback. Shape: x->A,
+    A->B, A->C, y->E, sink = concat(B, C, E)."""
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, hidden], name="x")
+    y = m.create_tensor([batch, hidden], name="y")
+    a = m.dense(x, hidden, name="A")
+    b = m.dense(a, hidden, name="B")
+    c = m.dense(a, hidden, name="C")
+    e = m.dense(y, hidden, name="E")
+    m.concat([b, c, e], axis=1, name="sink")
+    return m
+
+
+def multi_sink_model(batch=16, hidden=64):
+    """Shared trunk, two sinks (the reference's metrics-head shape)."""
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, hidden], name="x")
+    t = m.dense(x, hidden, name="trunk")
+    m.dense(t, 8, name="head1")
+    m.dense(t, 4, name="head2")
+    return m
+
+
+class TestExactOnDecomposableGraphs:
+    """Where the decomposition charges every edge once, DP == exhaustive."""
+
+    @pytest.mark.parametrize("layers", [1, 2, 3])
+    def test_chain_exact(self, layers):
+        m = chain_model(layers=layers)
+        s = UnitySearch(m.graph, SPEC)
+        got = s._optimize_python(m.graph.sinks()).cost
+        want = exhaustive_sequential_min(s)
+        assert got == pytest.approx(want, rel=1e-9)
+
+    def test_chain_native_matches_exhaustive(self):
+        m = chain_model(layers=3)
+        s = UnitySearch(m.graph, SPEC)
+        got = s.optimize().cost  # dispatches to the C++ solver if built
+        want = exhaustive_sequential_min(s)
+        assert got == pytest.approx(want, rel=1e-9)
+
+    def test_diamond_never_above_sequential_optimum(self):
+        # concurrent branch execution on resource splits may legitimately
+        # beat the sequential optimum; it must never be worse
+        m = diamond_model()
+        s = UnitySearch(m.graph, SPEC)
+        got = s._optimize_python(m.graph.sinks()).cost
+        seq = exhaustive_sequential_min(s)
+        assert got <= seq * (1 + 1e-9)
+        assert got >= per_node_lower_bound(s) * (1 - 1e-9)
+
+
+def _find_multi_terminal_branch(search):
+    g = search.graph
+    sink = g.sinks()[0]
+    sub = frozenset(g.ancestors_of([sink])) | {sink}
+    for br in search._branches(sub, sink):
+        terms = [
+            u for u in br if not any(c in br for c in g.consumers(u))
+        ]
+        if len(terms) > 1:
+            return br, sink
+    pytest.fail("graph has no multi-terminal branch")
+
+
+def _branch_objective_min(search, branch, sink, sink_view):
+    """Brute-force optimum of the branch's joint objective: op costs +
+    intra-branch transfers + terminal→sink transfers (no src boundary)."""
+    g = search.graph
+    order = sorted(branch)
+    opts = [search.valid_views(u, search.resource) for u in order]
+    best = float("inf")
+    for combo in itertools.product(*opts):
+        a = dict(zip(order, combo))
+        c = 0.0
+        for u in order:
+            c += search.op_cost(u, a[u])
+            for r in g.nodes[u].inputs:
+                if r.guid in a:
+                    c += search.xfer_cost(r, a[r.guid], a[u])
+        for r in g.nodes[sink].inputs:
+            if r.guid in a:
+                c += search.xfer_cost(r, a[r.guid], sink_view)
+        best = min(best, c)
+    return best
+
+
+class TestApproximationsBounded:
+    def test_multi_terminal_cost_exact_on_small_branch(self):
+        """The joint multi-terminal solve matches brute force when the
+        view product fits the exact cap."""
+        m = multi_terminal_model()
+        s = UnitySearch(m.graph, SPEC)
+        br, sink = _find_multi_terminal_branch(s)
+        sink_view = s.valid_views(sink, s.resource)[0]
+        got, _ = s._multi_terminal_cost(br, None, sink, sink_view, s.resource)
+        want = _branch_objective_min(s, br, sink, sink_view)
+        assert got == pytest.approx(want, rel=1e-9)
+
+    def test_multi_terminal_greedy_upper_bounds_exact(self):
+        """Past the cap the greedy topological pass runs; it evaluates a
+        real assignment of the same objective, so it can only be ≥ the
+        exact optimum — and on this graph stays within 1.5× (canary)."""
+        m = multi_terminal_model()
+        s = UnitySearch(m.graph, SPEC)
+        br, sink = _find_multi_terminal_branch(s)
+        sink_view = s.valid_views(sink, s.resource)[0]
+        exact, _ = s._multi_terminal_cost(br, None, sink, sink_view, s.resource)
+        s._MT_EXACT_CAP = 1  # force the greedy path
+        greedy, _ = s._multi_terminal_cost(br, None, sink, sink_view, s.resource)
+        assert greedy >= exact * (1 - 1e-9)
+        assert greedy <= exact * 1.5
+
+    def test_multi_terminal_graph_sandwiched(self):
+        m = multi_terminal_model()
+        s = UnitySearch(m.graph, SPEC)
+        got = s._optimize_python(m.graph.sinks()).cost
+        seq = exhaustive_sequential_min(s)
+        low = per_node_lower_bound(s)
+        # below seq only via legitimate concurrent branch overlap
+        assert low * (1 - 1e-9) <= got <= seq * (1 + 1e-9)
+        assert got >= 0.75 * seq  # regression canary (0.785 measured)
+
+    def test_multi_sink_sandwiched(self):
+        m = multi_sink_model()
+        s = UnitySearch(m.graph, SPEC)
+        got = s._optimize_python(m.graph.sinks()).cost
+        seq = exhaustive_sequential_min(s)
+        low = per_node_lower_bound(s)
+        assert low * (1 - 1e-9) <= got <= seq * (1 + 1e-9)
+        assert got >= 0.75 * seq  # regression canary (0.890 measured)
