@@ -34,7 +34,7 @@ def main():
                           num_heads=heads, num_layers=layers)
     ff.dense(t, 1, use_bias=False)
     ff.compile(
-        optimizer=AdamOptimizer(lr=0.0001),
+        optimizer=AdamOptimizer(alpha=0.0001),
         loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
         metrics=[MetricsType.MEAN_SQUARED_ERROR],
     )

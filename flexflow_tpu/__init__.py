@@ -33,7 +33,7 @@ from flexflow_tpu.runtime.model import FFModel, Tensor
 from flexflow_tpu.runtime.optimizer import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.runtime.recompile import RecompileState
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "FFConfig",

@@ -44,6 +44,10 @@ class FFConfig:
     enable_inplace_optimizations: bool = False
     base_optimize_threshold: int = 10  # reference: config.h:155
     substitution_json: str = ""
+    # the bundled default rewrite set runs at every compile (the reference
+    # runs base_optimize as a core graph_optimize phase, not opt-in);
+    # --no-substitution turns it off
+    enable_substitution: bool = True
     # search-without-hardware overrides (reference: model.cc:3673-3680)
     search_num_nodes: int = -1
     search_num_workers: int = -1
@@ -142,6 +146,8 @@ class FFConfig:
                 cfg.base_optimize_threshold = int(take())
             elif a == "--substitution-json":
                 cfg.substitution_json = take()
+            elif a == "--no-substitution":
+                cfg.enable_substitution = False
             elif a == "--search-num-nodes":
                 cfg.search_num_nodes = int(take())
             elif a == "--search-num-workers":

@@ -24,6 +24,11 @@ _REPO_ROOT = os.path.dirname(
 )
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libffnative.so")
+# wheel installs ship a prebuilt copy inside the package (setup.py
+# build_py_with_native); source checkouts build via the Makefile instead
+_PKG_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "libffnative.so"
+)
 
 _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -100,22 +105,25 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if _sources_newer_than_lib():
-                import sys
+            if os.path.exists(_PKG_LIB_PATH):
+                lib = ctypes.CDLL(_PKG_LIB_PATH)
+            else:
+                if _sources_newer_than_lib():
+                    import sys
 
-                print(
-                    "[flexflow_tpu] building native core (libffnative.so)…",
-                    file=sys.stderr,
-                    flush=True,
-                )
-                subprocess.run(
-                    ["make", "-s", "-j4"],
-                    cwd=_NATIVE_DIR,
-                    check=True,
-                    capture_output=True,
-                    timeout=300,
-                )
-            lib = ctypes.CDLL(_LIB_PATH)
+                    print(
+                        "[flexflow_tpu] building native core (libffnative.so)…",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    subprocess.run(
+                        ["make", "-s", "-j4"],
+                        cwd=_NATIVE_DIR,
+                        check=True,
+                        capture_output=True,
+                        timeout=300,
+                    )
+                lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
             _lib = lib
         except Exception:

@@ -221,6 +221,11 @@ def _lower_conv2d(params):
         (x,) = ins
         kernel = ws[0]
         xm, km = mm_operands(ctx, x, kernel)
+        # bf16 operands skip preferred_element_type=f32: the conv VJP
+        # transposes a f32 cotangent onto the bf16 operand and dies on the
+        # dtype mismatch (unlike dot_general's). MXU conv accumulation is
+        # f32 internally either way; only the pre-upcast rounding differs.
+        pet = jnp.float32 if xm.dtype == jnp.float32 else None
         y = jax.lax.conv_general_dilated(
             xm,
             km,
@@ -228,7 +233,7 @@ def _lower_conv2d(params):
             padding=[ph, pw],
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=groups,
-            preferred_element_type=jnp.float32,
+            preferred_element_type=pet,
         ).astype(kernel.dtype)
         if use_bias:
             y = y + ws[1]

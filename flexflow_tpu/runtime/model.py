@@ -725,11 +725,17 @@ class FFModel:
                 propagate_shapes(self.graph)
 
         # substitution optimization pass (reference: base_optimize inside
-        # GraphSearchHelper::graph_optimize; enabled by --substitution-json
-        # or --fusion, SURVEY §2.5). A pipelined strategy pins the trunk's
-        # guids (PipelineSpec.structure), so graph-rewriting passes are
-        # skipped — rewritten guids would dangle in the block template.
+        # GraphSearchHelper::graph_optimize — a core compile phase; the
+        # bundled default rules run unless --no-substitution, SURVEY §2.5).
+        # A pipelined strategy pins the trunk's guids
+        # (PipelineSpec.structure), so graph-rewriting passes are skipped —
+        # rewritten guids would dangle in the block template.
         pipelined = getattr(self.strategy, "pipeline", None) is not None
+        subst_requested = (
+            self.config.enable_substitution
+            or self.config.substitution_json
+            or self.config.perform_fusion
+        )
         if pipelined and (
             self.config.substitution_json or self.config.perform_fusion
         ):
@@ -740,9 +746,7 @@ class FFModel:
                 "strategy (the block template pins pre-rewrite node ids)",
                 stacklevel=2,
             )
-        if not pipelined and (
-            self.config.substitution_json or self.config.perform_fusion
-        ):
+        if not pipelined and subst_requested:
             from flexflow_tpu.search.substitution import apply_substitution_pass
 
             self.graph, new_ref = apply_substitution_pass(
@@ -1074,11 +1078,35 @@ class FFModel:
 
         return recompile_on_condition(self, state)
 
+    def _live_guid(self, guid: int) -> int:
+        """Resolve a builder-graph guid to the compiled graph. Graph
+        rewrites (the default substitution pass, fusion) replace builder
+        nodes with fresh guids but thread the original identity through
+        params['weight_key'] (substitution.py:_dst_params) — the same key
+        the recompile hook restores weights by."""
+        if guid in self.graph.nodes:
+            return guid
+        src = (
+            self._prestrategy_graph.nodes.get(guid)
+            if getattr(self, "_prestrategy_graph", None) is not None
+            else None
+        )
+        if src is not None:
+            key = src.params.get("weight_key", src.name)
+            for g, n in self.graph.nodes.items():
+                if n.params.get("weight_key", n.name) == key:
+                    return g
+        raise KeyError(
+            f"tensor guid {guid} not in the compiled graph (and no rewrite "
+            "carried its weight_key forward)"
+        )
+
     def get_tensor(self, guid: int, idx: int = 0) -> np.ndarray:
         """Pull a weight to host (reference: ParallelTensor get_tensor)."""
-        return np.asarray(self.params[guid][idx])
+        return np.asarray(self.params[self._live_guid(guid)][idx])
 
     def set_tensor(self, guid: int, idx: int, value: np.ndarray):
+        guid = self._live_guid(guid)
         node = self.graph.nodes[guid]
         sharding = self.executor.sharding_for(node.weight_shapes[idx])
         self.params[guid][idx] = jax.device_put(

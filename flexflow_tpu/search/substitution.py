@@ -46,6 +46,7 @@ import dataclasses
 import heapq
 import itertools
 import json
+import os
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.core.pcg import PCGGraph, PCGNode, TensorRef
@@ -566,9 +567,24 @@ def create_linear_relu_merge(model_axis: int = 1) -> GraphXfer:
     )
 
 
+# the bundled default rule collection (the analog of the reference's
+# substitutions/graph_subst_3_v2.json, which ships with the repo and loads
+# without any flag) — hand-authored for the TPU rebuild, see the file's
+# _comment fields
+DEFAULT_RULES_PATH = os.path.join(
+    os.path.dirname(__file__), "substitutions", "default_rules.json"
+)
+
+
 def default_xfers(parallel_degree: int, model_axis: int = 1) -> List[GraphXfer]:
-    """The built-in rewrite set used when no JSON file is given."""
-    return [create_linear_relu_merge(model_axis)]
+    """The built-in rewrite set: the hand-written builders plus the bundled
+    default rule collection (reference: ship-with-repo rule files used as a
+    core search phase, SURVEY §2.5)."""
+    xfers = [create_linear_relu_merge(model_axis)]
+    xfers += load_substitution_rules(
+        DEFAULT_RULES_PATH, parallel_degree, model_axis
+    )
+    return xfers
 
 
 # ---------------------------------------------------------------------------
@@ -601,7 +617,13 @@ def apply_substitution_pass(
     model_degree = mesh_sizes[1] if len(mesh_sizes) > 1 else 2
     model_axis = 1 if len(mesh_sizes) > 1 else 0
 
-    xfers = default_xfers(model_degree, model_axis)
+    # --no-substitution drops the bundled default rule set even when the
+    # pass itself still runs for an explicit --substitution-json/--fusion
+    xfers = (
+        default_xfers(model_degree, model_axis)
+        if getattr(cfg, "enable_substitution", True)
+        else []
+    )
     if cfg.substitution_json:
         xfers += load_substitution_rules(
             cfg.substitution_json, model_degree, model_axis

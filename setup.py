@@ -1,0 +1,81 @@
+"""Build backend hooks: compile the native C++ core into the wheel.
+
+The reference distributes via setup.py with a CMake build of the CUDA
+runtime (reference: setup.py + cmake/). Here the native core is four
+dependency-free C++17 translation units (native/src/{graph_algos,
+simulator,dataloader,unity_dp}.cc) compiled straight into
+flexflow_tpu/native/libffnative.so inside the wheel; the ctypes loader
+(flexflow_tpu/native/__init__.py) prefers that packaged copy and falls
+back to the Makefile build in source checkouts. The embeddable C API
+(libflexflow_c.so) stays a `make -C native capi` target — it links
+against a specific libpython and so does not belong in a portable wheel.
+"""
+
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+NATIVE_SRCS = [
+    "native/src/graph_algos.cc",
+    "native/src/simulator.cc",
+    "native/src/dataloader.cc",
+    "native/src/unity_dp.cc",
+]
+
+
+class build_py_with_native(build_py):
+    """build_py + native core compilation into the build tree."""
+
+    def run(self):
+        super().run()
+        if os.environ.get("FFTPU_NO_NATIVE"):
+            return
+        here = os.path.dirname(os.path.abspath(__file__))
+        srcs = [os.path.join(here, s) for s in NATIVE_SRCS]
+        missing = [s for s in srcs if not os.path.exists(s)]
+        if missing:
+            print(
+                f"[flexflow-tpu] native sources missing ({missing}); "
+                "wheel will use the pure-Python fallbacks",
+                file=sys.stderr,
+            )
+            return
+        out = os.path.join(
+            self.build_lib, "flexflow_tpu", "native", "libffnative.so"
+        )
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        cxx = os.environ.get("CXX", "g++")
+        cmd = [
+            cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            *srcs, "-o", out,
+        ]
+        print("[flexflow-tpu]", " ".join(cmd), file=sys.stderr)
+        try:
+            subprocess.run(cmd, check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            # a wheel without the native lib still works (Python fallbacks)
+            print(
+                f"[flexflow-tpu] native build failed ({e}); continuing "
+                "with pure-Python fallbacks",
+                file=sys.stderr,
+            )
+
+
+class BinaryDistribution(Distribution):
+    """The bundled libffnative.so is platform-specific: tag the wheel for
+    the build platform instead of py3-none-any, so pip never installs a
+    Linux/x86_64 native lib on another platform (where the loader would
+    silently fall back to pure Python)."""
+
+    def has_ext_modules(self):
+        return not os.environ.get("FFTPU_NO_NATIVE")
+
+
+setup(
+    cmdclass={"build_py": build_py_with_native},
+    distclass=BinaryDistribution,
+)

@@ -1,0 +1,83 @@
+"""Packaging smoke tests (VERDICT r1 item 10; reference: setup.py pip
+distribution). Runs against whichever flexflow_tpu is importable — the
+source checkout in the main suite, the installed wheel in CI's package
+job — and asserts the pieces a wheel must carry: the bundled substitution
+rules, the native library (or its documented fallback), and a working
+build→compile→fit path."""
+
+import os
+
+import numpy as np
+
+from flexflow_tpu import (
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+
+
+def test_bundled_rules_ship_with_package():
+    from flexflow_tpu.search.substitution import (
+        DEFAULT_RULES_PATH,
+        load_substitution_rules,
+    )
+
+    assert os.path.exists(DEFAULT_RULES_PATH)
+    assert len(load_substitution_rules(DEFAULT_RULES_PATH, 2)) >= 8
+
+
+def test_native_lib_or_fallback():
+    from flexflow_tpu import native
+
+    lib = native.get_lib()
+    if lib is None:
+        # fallbacks must still answer (FFTPU_NO_NATIVE or no toolchain)
+        assert native.topo_sort(2, [(0, 1)]) == [0, 1]
+    else:
+        assert native.imm_post_dominators(2, [(0, 1)]) is not None
+
+
+def test_smoke_train():
+    m = FFModel(FFConfig(batch_size=8))
+    x = m.create_tensor([8, 16], name="x")
+    t = m.dense(x, 32)
+    t = m.relu(t)
+    m.dense(t, 4)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    rng = np.random.RandomState(0)
+    hist = m.fit(
+        {"x": rng.randn(16, 16).astype(np.float32)},
+        rng.randint(0, 4, size=(16,)),
+        epochs=1,
+        verbose=False,
+    )
+    assert len(hist) == 1
+
+
+def test_metadata_consistent():
+    # pyproject version drives the wheel; the package reports the same
+    import flexflow_tpu
+
+    v = getattr(flexflow_tpu, "__version__", None)
+    if v is not None and os.path.exists(
+        os.path.join(
+            os.path.dirname(os.path.dirname(flexflow_tpu.__file__)),
+            "pyproject.toml",
+        )
+    ):
+        import re
+
+        with open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(flexflow_tpu.__file__)),
+                "pyproject.toml",
+            )
+        ) as f:
+            m = re.search(r'^version = "([^"]+)"', f.read(), re.M)
+        assert m and m.group(1) == v

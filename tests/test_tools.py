@@ -1,0 +1,115 @@
+"""The tools/ scripts (reference: tools/protobuf_to_json,
+tools/substitutions_to_dot)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_PB = "/root/reference/substitutions/graph_subst_3_v2.pb"
+REF_JSON = "/root/reference/substitutions/graph_subst_3_v2.json"
+DEFAULT_RULES = os.path.join(
+    REPO, "flexflow_tpu", "search", "substitutions", "default_rules.json"
+)
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REF_PB), reason="reference .pb collection absent"
+)
+def test_protobuf_to_json_round_trips_reference_collection(tmp_path):
+    out = tmp_path / "rules.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "protobuf_to_json.py"),
+            REF_PB,
+            str(out),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    got = json.loads(out.read_text())["rule"]
+    want = json.load(open(REF_JSON))["rule"]
+    assert len(got) == len(want) == 640
+    # field-exact except the synthesized rule names
+    for g, w in zip(got, want):
+        for side in ("srcOp", "dstOp"):
+            assert len(g[side]) == len(w[side])
+            for go, wo in zip(g[side], w[side]):
+                assert go["type"] == wo["type"]
+                assert [
+                    (t["opId"], t["tsId"]) for t in go["input"]
+                ] == [(t["opId"], t["tsId"]) for t in wo["input"]]
+                assert [
+                    (p["key"], p["value"]) for p in go["para"]
+                ] == [(p["key"], p["value"]) for p in wo["para"]]
+        assert g["mappedOutput"] == [
+            {"_t": "MapOutput", **{k: v for k, v in m.items() if k != "_t"}}
+            for m in w["mappedOutput"]
+        ]
+
+
+def test_converted_rules_load(tmp_path):
+    """The converter's output feeds straight into the rule loader."""
+    if not os.path.exists(REF_PB):
+        pytest.skip("reference .pb collection absent")
+    from flexflow_tpu.search.substitution import load_substitution_rules
+
+    out = tmp_path / "rules.json"
+    subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "protobuf_to_json.py"),
+            REF_PB,
+            str(out),
+        ],
+        check=True,
+        capture_output=True,
+    )
+    xfers = load_substitution_rules(str(out), parallel_degree=4)
+    assert len(xfers) == 640
+
+
+def test_substitutions_to_dot(tmp_path):
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "substitutions_to_dot.py"),
+            DEFAULT_RULES,
+            str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    dots = list(tmp_path.glob("*.dot"))
+    assert len(dots) == 8
+    text = (tmp_path / "partition_linear_combine_2d.dot").read_text()
+    assert "digraph" in text
+    assert "cluster_src" in text and "cluster_dst" in text
+    assert "PARALLEL_DEGREE=2" in text
+    # every dot file is structurally sane (balanced braces)
+    for d in dots:
+        t = d.read_text()
+        assert t.count("{") == t.count("}")
+
+
+def test_substitutions_to_dot_selects_rules(tmp_path):
+    subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "substitutions_to_dot.py"),
+            DEFAULT_RULES,
+            str(tmp_path),
+            "combine_relu_swap",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    assert [p.name for p in tmp_path.glob("*.dot")] == [
+        "combine_relu_swap.dot"
+    ]

@@ -1,0 +1,152 @@
+#!/usr/bin/env python3
+"""Convert a TASO-generated substitution RuleCollection .pb to the JSON
+format the rule loader reads (reference: tools/protobuf_to_json — a C++
+protobuf program; this rebuild decodes the proto2 wire format directly, no
+protobuf dependency).
+
+Message shape (reference: tools/protobuf_to_json/rules.proto):
+  RuleCollection{ repeated Rule rule=1 }
+  Rule{ repeated Operator srcOp=1, dstOp=2; repeated MapOutput mappedOutput=3 }
+  Operator{ int32 type=1; repeated Tensor input=2; repeated Parameter para=3 }
+  Tensor{ int32 opId=1, tsId=2 }  Parameter{ int32 key=1, value=2 }
+  MapOutput{ int32 srcOpId=1, dstOpId=2, srcTsId=3, dstTsId=4 }
+
+Usage: python tools/protobuf_to_json.py rules.pb rules.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# enum value -> rule-file name (the generator's OperatorType / PMParameter
+# codes, verified bit-exact against the reference's paired .pb/.json
+# collections); unknown codes fall back to OP_<n>/PM_<n> and are skipped by
+# the loader's vocabulary filter
+OP_NAMES = {
+    5: "OP_LINEAR",
+    8: "OP_RELU",
+    12: "OP_CONCAT",
+    13: "OP_SPLIT",
+    16: "OP_EW_ADD",
+    17: "OP_EW_MUL",
+    26: "OP_PARTITION",
+    27: "OP_COMBINE",
+    28: "OP_REPLICATE",
+    29: "OP_REDUCE",
+}
+PM_NAMES = {
+    1: "PM_NUM_INPUTS",
+    2: "PM_NUM_OUTPUTS",
+    9: "PM_ACTI",
+    10: "PM_NUMDIM",
+    11: "PM_AXIS",
+    15: "PM_PARALLEL_DIM",
+    16: "PM_PARALLEL_DEGREE",
+}
+
+
+def _read_varint(buf: bytes, i: int):
+    v = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_no, value) — varints as signed int, length-delimited
+    as bytes."""
+    i = 0
+    while i < len(buf):
+        tag, i = _read_varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:  # varint (int32: negatives arrive 64-bit sign-extended)
+            v, i = _read_varint(buf, i)
+            if v >= 1 << 63:
+                v -= 1 << 64
+            yield field, v
+        elif wt == 2:  # length-delimited (sub-message)
+            ln, i = _read_varint(buf, i)
+            yield field, buf[i : i + ln]
+            i += ln
+        else:
+            raise ValueError(f"unsupported wire type {wt} (field {field})")
+
+
+def _decode_operator(buf: bytes) -> dict:
+    op = {"_t": "Operator", "type": None, "input": [], "para": []}
+    for f, v in _fields(buf):
+        if f == 1:
+            op["type"] = OP_NAMES.get(v, f"OP_{v}")
+        elif f == 2:
+            t = dict(_fields(v))
+            op["input"].append(
+                {"_t": "Tensor", "opId": t.get(1, 0), "tsId": t.get(2, 0)}
+            )
+        elif f == 3:
+            p = dict(_fields(v))
+            op["para"].append(
+                {
+                    "_t": "Parameter",
+                    "key": PM_NAMES.get(p.get(1), f"PM_{p.get(1)}"),
+                    "value": p.get(2, 0),
+                }
+            )
+    return op
+
+
+def _decode_rule(buf: bytes, idx: int) -> dict:
+    rule = {
+        "_t": "Rule",
+        "name": f"taso_rule_{idx}",
+        "srcOp": [],
+        "dstOp": [],
+        "mappedOutput": [],
+    }
+    for f, v in _fields(buf):
+        if f == 1:
+            rule["srcOp"].append(_decode_operator(v))
+        elif f == 2:
+            rule["dstOp"].append(_decode_operator(v))
+        elif f == 3:
+            m = dict(_fields(v))
+            rule["mappedOutput"].append(
+                {
+                    "_t": "MapOutput",
+                    "srcOpId": m.get(1, 0),
+                    "dstOpId": m.get(2, 0),
+                    "srcTsId": m.get(3, 0),
+                    "dstTsId": m.get(4, 0),
+                }
+            )
+    return rule
+
+
+def convert(pb_bytes: bytes) -> dict:
+    rules = [
+        _decode_rule(v, i)
+        for i, (f, v) in enumerate(_fields(pb_bytes))
+        if f == 1
+    ]
+    return {"_t": "RuleCollection", "rule": rules}
+
+
+def main(argv):
+    if len(argv) != 3:
+        print(__doc__.strip().splitlines()[-1], file=sys.stderr)
+        return 2
+    with open(argv[1], "rb") as f:
+        collection = convert(f.read())
+    with open(argv[2], "w") as f:
+        json.dump(collection, f, indent=1)
+        f.write("\n")
+    print(f"wrote {len(collection['rule'])} rules to {argv[2]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

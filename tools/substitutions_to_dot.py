@@ -1,0 +1,95 @@
+#!/usr/bin/env python3
+"""Render substitution rules from a JSON RuleCollection as Graphviz DOT —
+one digraph per rule with the source pattern and replacement side by side
+(reference: tools/substitutions_to_dot/substitution_to_dot.cc).
+
+Usage:
+  python tools/substitutions_to_dot.py rules.json out_dir [rule_name ...]
+
+Writes out_dir/<rule_name>.dot for every rule (or just the named ones).
+External inputs are diamonds shared by both sides; mapped outputs are drawn
+as dashed edges from the src op to its dst replacement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _op_label(op: dict) -> str:
+    label = op["type"].replace("OP_", "")
+    paras = [
+        f"{p['key'].replace('PM_', '')}={p['value']}"
+        for p in op.get("para", [])
+    ]
+    return "\\n".join([label] + paras) if paras else label
+
+
+def rule_to_dot(rule: dict) -> str:
+    name = rule.get("name", "rule")
+    lines = [
+        f'digraph "{name}" {{',
+        "  rankdir=TB;",
+        '  node [shape=box, fontname="sans-serif"];',
+    ]
+    externals = set()
+    for side in ("srcOp", "dstOp"):
+        for op in rule[side]:
+            for t in op["input"]:
+                if t["opId"] < 0:
+                    externals.add((t["opId"], t["tsId"]))
+    for op_id, ts_id in sorted(externals, reverse=True):
+        label = f"in{-op_id - 1}" + (f":{ts_id}" if ts_id else "")
+        lines.append(
+            f'  "x{op_id}_{ts_id}" [shape=diamond, label="{label}"];'
+        )
+
+    for side, color in (("srcOp", "lightcoral"), ("dstOp", "lightblue")):
+        tag = side[:3]
+        lines.append(f"  subgraph cluster_{tag} {{")
+        lines.append(f'    label="{tag}"; style=filled; color={color};')
+        for i, op in enumerate(rule[side]):
+            lines.append(f'    "{tag}{i}" [label="{_op_label(op)}"];')
+        lines.append("  }")
+        for i, op in enumerate(rule[side]):
+            for t in op["input"]:
+                src = (
+                    f'x{t["opId"]}_{t["tsId"]}'
+                    if t["opId"] < 0
+                    else f'{tag}{t["opId"]}'
+                )
+                lines.append(f'  "{src}" -> "{tag}{i}";')
+
+    for m in rule.get("mappedOutput", []):
+        lines.append(
+            f'  "src{m["srcOpId"]}" -> "dst{m["dstOpId"]}"'
+            " [style=dashed, constraint=false, color=gray];"
+        )
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv):
+    if len(argv) < 3:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    with open(argv[1]) as f:
+        rules = json.load(f)["rule"]
+    only = set(argv[3:])
+    os.makedirs(argv[2], exist_ok=True)
+    written = 0
+    for i, rule in enumerate(rules):
+        name = rule.get("name", f"rule_{i}")
+        if only and name not in only:
+            continue
+        with open(os.path.join(argv[2], f"{name}.dot"), "w") as f:
+            f.write(rule_to_dot(rule))
+        written += 1
+    print(f"wrote {written} dot files to {argv[2]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
