@@ -201,12 +201,22 @@ class ParallelTensorShape:
 
     # -- lowering ------------------------------------------------------------
 
-    def partition_spec(self, mesh_axis_names: Sequence[str]):
+    def partition_spec(
+        self,
+        mesh_axis_names: Sequence[str],
+        mesh_axis_sizes: Optional[Sequence[int]] = None,
+    ):
         """Lower to a jax PartitionSpec over the global mesh.
 
         Replica dims produce no spec entry (GSPMD replicates across unused
         axes implicitly). Each partitioned logical dim maps to the mesh axis
-        named by its parallel_idx.
+        named by its parallel_idx — or, when `mesh_axis_sizes` is given and
+        the degree exceeds that axis, to the run of consecutive axes whose
+        sizes multiply to the degree (a tuple entry). Spans are how one op
+        runs FULL-width data parallel (batch over data×model) while its
+        neighbors shard channels on the model axis — the per-op
+        heterogeneous lowering (reference: per-op MachineViews,
+        graph.cc:1346-1431).
         """
         from jax.sharding import PartitionSpec
 
@@ -221,7 +231,26 @@ class ParallelTensorShape:
                     raise ValueError(
                         f"dim {d} has degree {d.degree} but no valid mesh axis"
                     )
-                entries.append(mesh_axis_names[d.parallel_idx])
+                if (
+                    mesh_axis_sizes is None
+                    or mesh_axis_sizes[d.parallel_idx] == d.degree
+                ):
+                    entries.append(mesh_axis_names[d.parallel_idx])
+                else:
+                    run: list = []
+                    prod = 1
+                    i = d.parallel_idx
+                    while i < len(mesh_axis_names) and prod < d.degree:
+                        run.append(mesh_axis_names[i])
+                        prod *= mesh_axis_sizes[i]
+                        i += 1
+                    if prod != d.degree:
+                        raise ValueError(
+                            f"dim {d}: degree {d.degree} is not the product "
+                            f"of consecutive mesh axes starting at "
+                            f"{d.parallel_idx} (sizes {tuple(mesh_axis_sizes)})"
+                        )
+                    entries.append(tuple(run))
         # trim trailing Nones for cleanliness
         while entries and entries[-1] is None:
             entries.pop()
@@ -229,18 +258,25 @@ class ParallelTensorShape:
 
     def is_valid_for_mesh(self, mesh_shape: Sequence[int]) -> bool:
         """Check degrees fit the mesh: each partitioned dim's degree must
-        equal the size of its assigned mesh axis, and no axis is used twice."""
+        equal the size of its assigned mesh axis (or the product of the
+        consecutive run starting there — a span), and no axis is used
+        twice."""
         used = set()
         for d in self.dims:
             if d.degree == 1:
                 continue
-            if d.parallel_idx < 0 or d.parallel_idx >= len(mesh_shape):
+            i = d.parallel_idx
+            if i < 0 or i >= len(mesh_shape):
                 return False
-            if d.parallel_idx in used:
+            prod = 1
+            while i < len(mesh_shape) and prod < d.degree:
+                if i in used:
+                    return False
+                used.add(i)
+                prod *= mesh_shape[i]
+                i += 1
+            if prod != d.degree:
                 return False
-            if mesh_shape[d.parallel_idx] != d.degree:
-                return False
-            used.add(d.parallel_idx)
         return True
 
     def __str__(self):

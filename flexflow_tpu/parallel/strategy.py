@@ -232,6 +232,73 @@ def site_strategy(
     )
 
 
+def mixed_site_strategy(
+    graph: PCGGraph,
+    num_devices: int,
+    tp: int,
+    sites,
+    name_prefix: str = "searched",
+) -> Strategy:
+    """Per-op heterogeneous lowering (reference: per-op MachineViews in
+    SearchHelper::graph_cost, graph.cc:1346-1431 — e.g. DLRM shards
+    embedding tables model-parallel while the MLPs stay data-parallel).
+
+    One (data × model) mesh, two sharding regimes on it: ops OUTSIDE the
+    TP sites shard their batch over BOTH axes (full-width data parallelism
+    via PartitionSpec spans, ParallelTensorShape.partition_spec), while
+    each site shards channels/heads/columns on the model axis. Sites are
+    bracketed by batch-Combine (full→data-axis-only) on entry and
+    batch-Repartition (back to full width) on exit; GSPMD lowers the
+    brackets to the boundary collectives. Falls back to the uniform
+    `site_strategy` when the full-width batch shard is infeasible or a
+    site kind has no batch-dim-0 bracket semantics."""
+    from flexflow_tpu.search.rewrites import _insert_after, _insert_before
+
+    sites = list(sites)
+    tp = max(1, tp)
+    dp = effective_dp_degree(graph, max(1, num_devices // tp))
+    full = dp * tp
+    bracketable = {"linear_chain", "single_linear", "attention", "embedding"}
+    if (
+        tp == 1
+        or effective_dp_degree(graph, full) != full
+        or any(s.kind not in bracketable for s in sites)
+    ):
+        return site_strategy(graph, num_devices, tp, sites, name_prefix)
+
+    def apply(g: PCGGraph):
+        annotate_input_batch(g, full)
+        for site in sites:
+            head, tail = site.guids[0], site.guids[-1]
+            hnode = g.nodes[head]
+            for ref in dict.fromkeys(hnode.inputs):
+                _insert_before(
+                    g,
+                    head,
+                    ref,
+                    OperatorType.COMBINE,
+                    f"{hnode.name}.batch_combine",
+                    {"axis": 0, "degree": tp},
+                )
+            _insert_after(
+                g,
+                tail,
+                OperatorType.REPARTITION,
+                f"{g.nodes[tail].name}.batch_repartition",
+                {"axis": 0, "degree": tp, "parallel_idx": 0},
+            )
+            site.apply(g, tp, 1)
+
+    return Strategy(
+        MeshConfig(("data", "model"), (dp, tp)),
+        apply,
+        name=(
+            f"{name_prefix}: mixed mesh(data={dp}, model={tp}), "
+            f"{len(sites)} TP sites, full-width dp={full} outside them"
+        ),
+    )
+
+
 def choose_strategy(model, num_devices: int) -> Strategy:
     """Strategy selection at compile() (reference: model.cc:2789 →
     graph_optimize_task, graph.cc:1545-1613): data-parallel unless a search

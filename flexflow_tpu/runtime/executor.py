@@ -135,7 +135,9 @@ class Executor:
     # -- shardings -----------------------------------------------------------
 
     def sharding_for(self, shape: ParallelTensorShape) -> NamedSharding:
-        spec = shape.partition_spec(self.mesh_config.axis_names)
+        spec = shape.partition_spec(
+            self.mesh_config.axis_names, self.mesh_config.axis_sizes
+        )
         return NamedSharding(self.mesh, spec)
 
     def _constrain(self, x, shape: ParallelTensorShape):

@@ -647,6 +647,14 @@ def apply_substitution_pass(
     )
 
     def cost_fn(gr: PCGGraph) -> float:
+        # degrees must actually be expressible on the mesh: without this
+        # guard the simulator REWARDS stacking partition rules past the
+        # device count (smaller pieces look faster), and the executor later
+        # mis-shards or rejects the annotation (partition_spec span check)
+        for node in gr.nodes.values():
+            for s in list(node.output_shapes) + list(node.weight_shapes):
+                if not s.is_valid_for_mesh(mesh_sizes):
+                    return float("inf")
         try:
             return estimate_graph_cost(gr, cm, mesh_sizes).step_time
         except (ValueError, KeyError):

@@ -789,8 +789,18 @@ def result_to_strategy(
 ):
     """Reduce the per-op view map to one global mesh + TP rewrite sites
     (SURVEY §7's v1 restriction — per-op device subsets beyond one mesh are
-    exported but not lowered)."""
-    from flexflow_tpu.parallel.strategy import site_strategy
+    exported but not lowered).
+
+    When the search's views are HETEROGENEOUS — some compute ops sharded
+    on channels while others keep a wider pure-data-parallel view than the
+    uniform (data = devices/tp) mesh would grant them — the lowering goes
+    through `mixed_site_strategy`: full-width batch sharding outside the
+    TP sites, matching what the DP search actually costed per node
+    (reference: per-op MachineViews, graph.cc:1346-1431)."""
+    from flexflow_tpu.parallel.strategy import (
+        mixed_site_strategy,
+        site_strategy,
+    )
     from flexflow_tpu.search.rewrites import find_tp_sites
 
     channel = [v for v in result.views.values() if v.ch > 1]
@@ -805,12 +815,23 @@ def result_to_strategy(
         for s in find_tp_sites(graph)
         if (set(s.guids) & tp_guids) and s.divisible_by(graph, tp)
     ] if tp > 1 else []
+    prefix = f"{engine}(step {result.cost * 1e3:.3f} ms)"
+    uniform_dp = max(1, num_devices // tp)
+    site_guids = {g for s in sites for g in s.guids}
+    wants_full_dp = tp > 1 and any(
+        v.ch == 1 and v.dp > uniform_dp
+        for g, v in result.views.items()
+        if g in graph.nodes
+        and g not in site_guids
+        and graph.nodes[g].op_type != OperatorType.INPUT
+        and not graph.nodes[g].is_parallel_op
+    )
+    if wants_full_dp:
+        return mixed_site_strategy(
+            graph, num_devices, tp, sites, name_prefix=prefix
+        )
     return site_strategy(
-        graph,
-        num_devices,
-        tp,
-        sites,
-        name_prefix=f"{engine}(step {result.cost * 1e3:.3f} ms)",
+        graph, num_devices, tp, sites, name_prefix=prefix
     )
 
 

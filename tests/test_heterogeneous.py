@@ -105,6 +105,141 @@ def test_mixed_strategy_lowers_and_trains():
     assert np.isfinite(hist[-1]["loss_sum"])
 
 
+def _mixed_result(m):
+    """Hand-built heterogeneous view map (deterministic, independent of the
+    cost model): embeddings channel-sharded over all chips, everything else
+    FULL-width data-parallel — wider than the uniform (data=8/tp) mesh
+    would grant, so result_to_strategy must take the mixed lowering."""
+    from flexflow_tpu.core.machine import MachineView
+    from flexflow_tpu.search.unity import UnityResult, ViewOption
+
+    mv = MachineView(0, (8,), (1,))
+    views = {}
+    for g, n in m.graph.nodes.items():
+        if n.op_type == OperatorType.EMBEDDING:
+            views[g] = ViewOption(mv, dp=1, ch=8)
+        else:
+            views[g] = ViewOption(mv, dp=8, ch=1)
+    return UnityResult(cost=0.0, views=views)
+
+
+def _compile(m, strategy=None):
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=strategy,
+    )
+
+
+def _dlrm_batch(batch=64):
+    rng = np.random.RandomState(0)
+    data = {
+        f"ids{i}": rng.randint(0, 200_000, (batch, 1)).astype(np.int32)
+        for i in range(2)
+    }
+    data["dense_in"] = rng.randn(batch, 16).astype(np.float32)
+    y = rng.randint(0, 2, (batch,)).astype(np.int32)
+    return data, y
+
+
+def test_mixed_lowering_full_width_dp():
+    """The heterogeneous lowering (VERDICT r1 item 8): embeddings
+    model-parallel on the model axis while the MLPs shard their batch over
+    ALL 8 chips (PartitionSpec span over data×model) — not the uniform
+    lowering's dp = 8/tp = 1 that would leave them replicated."""
+    from flexflow_tpu.config import FFConfig as _FF
+
+    m = dlrm_like()
+    strategy = result_to_strategy(_mixed_result(m), m.graph, 8)
+    assert "mixed" in strategy.name, strategy.name
+    m.config.enable_substitution = False  # isolate the lowering under test
+    _compile(m, strategy)
+    assert m.strategy.mesh_config.axis_sizes == (1, 8)
+    for n in m.graph.nodes.values():
+        if n.op_type == OperatorType.EMBEDDING:
+            # table column-sharded on the model axis
+            assert n.weight_shapes[0].dims[1].degree == 8
+        if n.op_type == OperatorType.LINEAR:
+            # activations batch-sharded over the FULL 8 chips
+            assert n.output_shapes[0].dims[0].degree == 8, (
+                n.name,
+                str(n.output_shapes[0]),
+            )
+    data, y = _dlrm_batch()
+    hist = m.fit(data, y, epochs=1, verbose=False)
+    assert np.isfinite(hist[-1]["loss_sum"])
+
+
+def test_mixed_lowering_matches_single_device():
+    """Parallel ops are layout-only: the mixed heterogeneous strategy must
+    compute bit-for-bit the same math as one device (same seeded weights)."""
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+
+    data, y = _dlrm_batch()
+
+    m1 = dlrm_like()
+    m1.config.enable_substitution = False
+    _compile(m1, data_parallel_strategy(1, m1.graph))
+    h1 = m1.fit(data, y, epochs=2, verbose=False)
+
+    m2 = dlrm_like()
+    m2.config.enable_substitution = False
+    strategy = result_to_strategy(_mixed_result(m2), m2.graph, 8)
+    _compile(m2, strategy)
+    h2 = m2.fit(data, y, epochs=2, verbose=False)
+
+    for a, b in zip(h1, h2):
+        assert np.isclose(a["loss_sum"], b["loss_sum"], rtol=1e-4), (h1, h2)
+
+
+def test_mixed_beats_uniform_lowering():
+    """The point of per-op heterogeneity (reference: DLRM mixed strategies,
+    graph.cc:1346-1431): on an MLP-heavy DLRM the mixed lowering — MLP
+    batch over all 8 chips — simulates faster than the uniform lowering
+    that pins dp to 8/tp for every op."""
+    from flexflow_tpu.parallel.strategy import mixed_site_strategy, site_strategy
+    from flexflow_tpu.runtime.executor import propagate_shapes
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.simulator import estimate_graph_cost
+
+    # MLP-heavy DLRM (reference DLRM MLPs are 512-4096 wide): the saved MLP
+    # compute must outweigh the site-boundary transfers for mixed to win
+    batch = 4096
+    m = FFModel(FFConfig(batch_size=batch))
+    from flexflow_tpu.core.types import AggrMode
+
+    feats = []
+    for i in range(2):
+        ids = m.create_tensor([batch, 1], dtype=DataType.INT32, name=f"ids{i}")
+        feats.append(m.embedding(ids, 1_000_000, 64, aggr=AggrMode.SUM))
+    dense_in = m.create_tensor([batch, 512], name="dense_in")
+    t = m.dense(dense_in, 1024, activation=ActiMode.RELU, name="bot1")
+    t = m.dense(t, 64, activation=ActiMode.RELU, name="bot2")
+    t = m.concat(feats + [t], axis=1)
+    t = m.dense(t, 1024, activation=ActiMode.RELU, name="top1")
+    m.dense(t, 2, name="top2")
+
+    sites = [s for s in find_tp_sites(m.graph) if isinstance(s, EmbeddingSite)]
+    cm = CostModel(SPEC)
+
+    def simulate(strategy):
+        g = m.graph.copy()
+        strategy.apply(g)
+        propagate_shapes(g)
+        return estimate_graph_cost(
+            g, cm, strategy.mesh_config.axis_sizes
+        ).step_time
+
+    mixed = mixed_site_strategy(m.graph, 8, 8, sites)
+    uniform = site_strategy(m.graph, 8, 8, sites)
+    assert "mixed" in mixed.name
+    assert simulate(mixed) < simulate(uniform), (
+        simulate(mixed),
+        simulate(uniform),
+    )
+
+
 def test_embedding_site_apply_shapes():
     m = dlrm_like(n_tables=1)
     g = m.graph.copy()
