@@ -8,44 +8,27 @@ The workload is the reference's headline Transformer benchmark
 `vs_baseline` is measured against BASELINE_SAMPLES_PER_SEC, the f32
 data-parallel number of this rebuild measured with the same methodology.
 
-Timing methodology: on the tunneled TPU platform `block_until_ready` does
-not synchronize with remote execution, and a device->host readback carries
-a large constant RTT. So we time two chained runs of N1 and N2 steps, each
-ended by a scalar readback (which forces the whole dependency chain), and
-difference them: per-step = (t2 - t1) / (N2 - N1). The readback RTT and
-dispatch constants cancel.
+Timing methodology (round 2): on-device lax.scan chain differencing
+with min-over-reps — flexflow_tpu/utils/benchmark.py has the details.
 """
 
 from __future__ import annotations
 
 import json
 import sys
-import time
 
 # f32 single-chip data-parallel throughput of this framework measured with
-# the differencing methodology below on one TPU v5e (the reference repo
-# publishes no figures — BASELINE.md; its perf story is self-relative).
-BASELINE_SAMPLES_PER_SEC = 234.0
-
-
-def _timed_chain(step, params, opt_state, batch, key, n):
-    import numpy as np
-
-    t0 = time.perf_counter()
-    p, o = params, opt_state
-    loss = None
-    for _ in range(n):
-        p, o, loss, _ = step(p, o, batch, key)
-    _ = float(np.asarray(loss))  # forces the whole chain on the tunnel
-    return time.perf_counter() - t0, p, o
+# the scan-differencing methodology below on one TPU v5e (the reference
+# repo publishes no figures — BASELINE.md; its perf story is
+# self-relative).
+BASELINE_SAMPLES_PER_SEC = 238.0
 
 
 def main():
-    import jax
-
     sys.path.insert(0, __file__.rsplit("/", 1)[0])
     from examples.transformer import build_transformer, synthetic_batch
     from flexflow_tpu import FFConfig
+    from flexflow_tpu.utils.benchmark import measure_train_step
 
     batch_size, seq, hidden, heads, layers = 8, 512, 1024, 16, 12
     cfg = FFConfig(batch_size=batch_size, learning_rate=0.01)
@@ -58,18 +41,8 @@ def main():
         num_heads=heads,
         num_layers=layers,
     )
-    step = model.executor.train_step()
     batch = model.executor.shard_batch(synthetic_batch(batch_size, seq, hidden))
-    params, opt_state = model.params, model.opt_state
-    key = jax.random.PRNGKey(0)
-
-    # compile + warmup
-    _, params, opt_state = _timed_chain(step, params, opt_state, batch, key, 2)
-
-    n1, n2 = 10, 60
-    t1, params, opt_state = _timed_chain(step, params, opt_state, batch, key, n1)
-    t2, params, opt_state = _timed_chain(step, params, opt_state, batch, key, n2)
-    per_step = (t2 - t1) / (n2 - n1)
+    per_step = measure_train_step(model, batch)
     thpt = batch_size / per_step
 
     print(

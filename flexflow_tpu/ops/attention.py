@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
 from flexflow_tpu.core.types import OperatorType
-from flexflow_tpu.ops.registry import mm_operands, register_op
+from flexflow_tpu.ops.registry import mm_operands, mm_out_dtype, register_op
 
 
 def _infer_mha(input_shapes, params):
@@ -281,9 +281,11 @@ def _lower_mha(params):
                     dropout_rng=ctx.rng if dropping else None,
                 )
         attn_m, wo_m = mm_operands(ctx, attn, wo)
-        y = jnp.einsum("bshd,hde->bse", attn_m, wo_m, **mm).astype(dt)
+        y = jnp.einsum("bshd,hde->bse", attn_m, wo_m, **mm).astype(
+            mm_out_dtype(ctx, dt)
+        )
         if use_bias:
-            y = y + ws[7]
+            y = y + ws[7].astype(y.dtype)
         return [y]
 
     return fn

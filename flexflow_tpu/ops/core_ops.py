@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
 from flexflow_tpu.core.types import ActiMode, AggrMode, DataType, OperatorType, PoolType
-from flexflow_tpu.ops.registry import mm_operands, register_op
+from flexflow_tpu.ops.registry import mm_operands, mm_out_dtype, register_op
 
 
 # ---------------------------------------------------------------------------
@@ -128,9 +128,9 @@ def _lower_linear(params):
         kernel = ws[0]
         xm, km = mm_operands(ctx, x, kernel)
         y = jnp.matmul(xm, km, preferred_element_type=jnp.float32)
-        y = y.astype(kernel.dtype)
+        y = y.astype(mm_out_dtype(ctx, kernel.dtype))
         if use_bias:
-            y = y + ws[1]
+            y = y + ws[1].astype(y.dtype)
         return [_apply_activation(y, act)]
 
     return fn
@@ -234,9 +234,9 @@ def _lower_conv2d(params):
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=groups,
             preferred_element_type=pet,
-        ).astype(kernel.dtype)
+        ).astype(mm_out_dtype(ctx, kernel.dtype))
         if use_bias:
-            y = y + ws[1]
+            y = y + ws[1].astype(y.dtype)
         return [_apply_activation(y, act)]
 
     return fn
@@ -347,10 +347,13 @@ def _lower_batchnorm(params):
         (x,) = ins
         gamma, beta = ws
         axes = tuple(range(x.ndim - 1))
-        mean = jnp.mean(x, axis=axes, keepdims=True)
-        var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
-        y = (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
-        return [_apply_activation(y, act)]
+        # stats accumulate in f32 even when activations flow bf16 (mixed
+        # precision): bf16 mean/var over big reductions loses too much
+        xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+        return [_apply_activation(y.astype(x.dtype), act)]
 
     return fn
 
@@ -380,12 +383,14 @@ def _lower_layernorm(params):
     def fn(ins, ws, ctx):
         (x,) = ins
         axes = params.get("axes", (x.ndim - 1,))
-        mean = jnp.mean(x, axis=axes, keepdims=True)
-        var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
-        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        # f32 statistics under bf16 activation flow (mixed precision)
+        xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + eps)
         if elementwise_affine:
             y = y * ws[0] + ws[1]
-        return [y]
+        return [y.astype(x.dtype)]
 
     return fn
 
@@ -612,7 +617,7 @@ def _lower_batchmatmul(params):
             b = _truncate(b, b_seq_dim, ctx.seq_length)
         am, bm = mm_operands(ctx, a, b)
         y = jnp.matmul(am, bm, preferred_element_type=jnp.float32)
-        return [y.astype(a.dtype)]
+        return [y.astype(mm_out_dtype(ctx, a.dtype))]
 
     return fn
 

@@ -64,6 +64,19 @@ def mm_operands(ctx, *arrays):
     return arrays
 
 
+def mm_out_dtype(ctx, default_dtype):
+    """Matmul OUTPUT dtype: bf16 when mixed precision is on, else the
+    weight/input dtype. Keeping activations bf16 between ops halves the
+    HBM traffic of every layer boundary (weights stay f32 master copies;
+    the operand-cast VJP returns f32 gradients). The loss upcasts logits
+    to f32 (runtime/loss.py), so training numerics stay AMP-standard."""
+    if ctx is not None and getattr(ctx, "bf16_matmul", False):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return default_dtype
+
+
 @dataclasses.dataclass
 class OpDef:
     op_type: OperatorType

@@ -75,7 +75,13 @@ def gpipe(
         # emit this step's output (only the last stage's is real)
         return send, out
 
-    _, outs = jax.lax.scan(step, jnp.zeros_like(xs[0]), stream)
+    # the carry dtype must match the BLOCK's output dtype, not the input's:
+    # under mixed precision blocks emit bf16 activations (mm_out_dtype)
+    # while the pipeline entry is f32
+    out_sd = jax.eval_shape(block_fn, stage_params, xs[0])
+    _, outs = jax.lax.scan(
+        step, jnp.zeros(out_sd.shape, out_sd.dtype), stream
+    )
     # the last stage produced microbatch m at step m + (S-1)
     tail = outs[num_stages - 1 :]
     y = tail.reshape((batch,) + tail.shape[2:])

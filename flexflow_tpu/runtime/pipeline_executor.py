@@ -149,6 +149,13 @@ class PipelinedExecutor(Executor):
             def body(carry, ws):
                 return one_block(carry, ws), None
 
+            # align the carry dtype with the block's output dtype (bf16
+            # activations under mixed precision, mm_out_dtype): blocks are
+            # dtype-preserving once the input matches their output
+            first_ws = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+            out_sd = jax.eval_shape(one_block, x, first_ws)
+            if out_sd.dtype != x.dtype:
+                x = x.astype(out_sd.dtype)
             out, _ = jax.lax.scan(body, x, stage_params)
             return out
 

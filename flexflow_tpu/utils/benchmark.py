@@ -1,0 +1,57 @@
+"""Shared train-step timing for the benchmark surfaces (bench.py,
+scripts/bench_configs.py, scripts/calibrate.py callers).
+
+Methodology (see BASELINE.md): on the tunneled TPU platform
+`block_until_ready` does not synchronize with remote execution, a
+device->host readback carries a large constant RTT, and host-side
+dispatch chains longer than ~25 steps can overflow the tunnel queue.
+So the N-step loop runs INSIDE one jitted program (`lax.scan` over the
+train step — the analog of the reference's Legion begin/end_trace
+replay loop, transformer.cc:192-198), ended by a scalar readback that
+forces the whole chain; two chain lengths are differenced so RTT and
+dispatch constants cancel, and the measurement repeats `reps` times
+taking the MIN (the tunnel adds contention spikes, never speedups).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def measure_train_step(model, batch, n1: int = 5, n2: int = 20, reps: int = 6):
+    """Differenced per-train-step seconds via on-device lax.scan chains.
+
+    `batch` must already be sharded (executor.shard_batch)."""
+    import jax
+    import numpy as np
+    from jax import lax
+
+    step_fn = model.executor.train_step_fn()
+    key = jax.random.PRNGKey(0)
+
+    def chain(n):
+        @jax.jit
+        def run(p, o):
+            def body(c, _):
+                cp, co = c
+                p2, o2, loss, _ = step_fn(cp, co, batch, key)
+                return (p2, o2), loss
+
+            _, losses = lax.scan(body, (p, o), None, length=n)
+            return losses[-1]
+
+        return run
+
+    r1, r2 = chain(n1), chain(n2)
+    p, o = model.params, model.opt_state
+    _ = float(np.asarray(r1(p, o)))  # compile + warmup
+    _ = float(np.asarray(r2(p, o)))
+    best = float("inf")
+    for _i in range(reps):
+        t0 = time.perf_counter()
+        _ = float(np.asarray(r1(p, o)))
+        t1 = time.perf_counter()
+        _ = float(np.asarray(r2(p, o)))
+        t2 = time.perf_counter()
+        best = min(best, ((t2 - t1) - (t1 - t0)) / (n2 - n1))
+    return best

@@ -4,10 +4,8 @@ nowhere — this script records ours).
 
     python scripts/bench_configs.py [--out BENCH_CONFIGS.json] [--f32]
 
-Times the jitted train step of each config with the chained-run
-differencing methodology (bench.py: on the tunneled TPU platform
-block_until_ready does not synchronize, so two chained runs of N1 and N2
-steps each ended by a scalar readback are differenced — RTT and dispatch
+Times the jitted train step of each config with the shared on-device
+lax.scan differencing (flexflow_tpu/utils/benchmark.py — RTT and dispatch
 constants cancel). Prints one JSON line per config and writes the table.
 """
 
@@ -16,52 +14,11 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 import numpy as np  # noqa: E402
-
-
-def measure(model, batch, n1=10, n2=60):
-    """Differenced per-step seconds via on-device lax.scan chains.
-
-    Host-side dispatch chains longer than ~25 steps can overflow the axon
-    tunnel's queue (observed: INVALID_ARGUMENT at readback) and short
-    chains sit below the RTT jitter floor, so the N-step loop runs INSIDE
-    one jitted program (the cost model's scan-differencing,
-    cost_model.py:_MEASURE_CHAIN, applied to the whole train step): one
-    dispatch + one scalar readback per timing, two lengths differenced."""
-    import jax
-    from jax import lax
-
-    step_fn = model.executor.train_step_fn()
-    sharded = model.executor.shard_batch(batch)
-    key = jax.random.PRNGKey(0)
-
-    def scan_steps(n):
-        @jax.jit
-        def run(p, o):
-            def body(carry, _):
-                cp, co = carry
-                np_, no_, loss, _ = step_fn(cp, co, sharded, key)
-                return (np_, no_), loss
-
-            (p2, o2), losses = lax.scan(body, (p, o), None, length=n)
-            return losses[-1]
-
-        return run
-
-    run1, run2 = scan_steps(n1), scan_steps(n2)
-    p, o = model.params, model.opt_state
-    times = {}
-    for name, fn in (("n1", run1), ("n2", run2)):
-        _ = float(np.asarray(fn(p, o)))  # compile + warmup
-        t0 = time.perf_counter()
-        _ = float(np.asarray(fn(p, o)))
-        times[name] = time.perf_counter() - t0
-    return (times["n2"] - times["n1"]) / (n2 - n1)
 
 
 def _cfg(batch_size, mixed):
@@ -217,7 +174,11 @@ def main():
         if only and name not in only:
             continue
         model, batch, bs = builder(mixed)
-        per_step = measure(model, batch)
+        from flexflow_tpu.utils.benchmark import measure_train_step
+
+        per_step = measure_train_step(
+            model, model.executor.shard_batch(batch), reps=3
+        )
         thpt = bs / per_step
         row = {
             "metric": name,

@@ -15,8 +15,9 @@ from flexflow_tpu.search.blocks import find_block_structure
 BATCH, DIM, CLASSES, LAYERS = 16, 32, 4, 4
 
 
-def build(strategy=None, layers=LAYERS, transformer=False):
+def build(strategy=None, layers=LAYERS, transformer=False, mixed=False):
     cfg = FFConfig(batch_size=BATCH, seed=0)
+    cfg.allow_mixed_precision = mixed
     m = FFModel(cfg)
     if transformer:
         x = m.create_tensor([BATCH, 16, DIM], name="x")
@@ -118,6 +119,26 @@ class TestPipelineCompile:
             piped.params, piped.executor.shard_batch(batch)
         )
         np.testing.assert_allclose(float(ls), float(lp), rtol=1e-5)
+
+    def test_mixed_precision_pipeline(self):
+        """Regression: bf16 activation flow (mm_out_dtype) changes the
+        block output dtype, so the GPipe scan carries must be seeded with
+        the BLOCK's dtype, not the f32 pipeline entry's — both the
+        microbatch stream carry (parallel/pipeline.py) and the
+        blocks-per-stage carry (runtime/pipeline_executor.py)."""
+        x, y = mlp_batch()
+        batch = {"x": x, "label": y}
+        for layers, pp in ((LAYERS, 4), (8, 4)):
+            piped = build(None, layers=layers, mixed=True)
+            piped2 = build(
+                pipe_strategy(piped._prestrategy_graph, dp=2, pp=pp),
+                layers=layers,
+                mixed=True,
+            )
+            ls, _ = piped2.executor.eval_step()(
+                piped2.params, piped2.executor.shard_batch(batch)
+            )
+            assert np.isfinite(float(ls))
 
     def test_indivisible_blocks_rejected(self):
         template = build(Strategy(MeshConfig(("data",), (1,)), None))
