@@ -190,7 +190,10 @@ def _lower_mha(params):
         ):
             from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
-            attn = flash_attention(qh, kh, vh, causal=causal)
+            attn = flash_attention(
+                qh, kh, vh, causal=causal,
+                use_lib=ctx.mesh is None or ctx.mesh.size == 1,
+            )
         else:
             attn = scaled_dot_product_attention(qh, kh, vh, causal=causal)
         seq_spec = NamedSharding(
@@ -270,7 +273,11 @@ def _lower_mha(params):
             if flash:
                 from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
-                attn = flash_attention(q, k, v, causal=causal)
+                # the library Pallas kernel is single-device only (no
+                # GSPMD partitioning rule); sharded meshes take the
+                # blockwise path, which XLA partitions over batch/heads
+                single = ctx is None or ctx.mesh is None or ctx.mesh.size == 1
+                attn = flash_attention(q, k, v, causal=causal, use_lib=single)
             else:
                 attn = scaled_dot_product_attention(
                     q,

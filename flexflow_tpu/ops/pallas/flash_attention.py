@@ -32,16 +32,22 @@ def _blockwise_attention(q, k, v, causal: bool, block_k: int):
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
     scale = 1.0 / math.sqrt(d)
-    q32 = q.astype(jnp.float32) * scale
-    kb = k.reshape(b, nk, block_k, h, d).astype(jnp.float32)
-    vb = v.reshape(b, nk, block_k, h, d).astype(jnp.float32)
+    # bf16 inputs keep bf16 MATMUL OPERANDS (MXU-native) with f32
+    # accumulation; f32 inputs stay f32 end-to-end for exactness
+    cdt = q.dtype if q.dtype == jnp.bfloat16 else jnp.float32
+    qs = (q.astype(jnp.float32) * scale).astype(cdt)
+    kb = k.reshape(b, nk, block_k, h, d).astype(cdt)
+    vb = v.reshape(b, nk, block_k, h, d).astype(cdt)
     kpos = jnp.arange(nk * block_k).reshape(nk, block_k)
     qpos = jnp.arange(sq)
 
     def body(carry, blk):
         m, l, acc = carry
         kblk, vblk, kp = blk
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kblk)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", qs, kblk,
+            preferred_element_type=jnp.float32,
+        )
         mask = kp[None, None, None, :] < sk
         if causal:
             mask = mask & (kp[None, None, None, :] <= qpos[None, None, :, None])
@@ -55,7 +61,8 @@ def _blockwise_attention(q, k, v, causal: bool, block_k: int):
         correction = jnp.where(jnp.isfinite(m), correction, 0.0)
         l_new = l * correction + jnp.sum(p, axis=-1)
         acc_new = acc * correction[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vblk
+            "bhqk,bkhd->bhqd", p.astype(cdt), vblk,
+            preferred_element_type=jnp.float32,
         )
         return (m_new, l_new, acc_new), None
 
@@ -75,7 +82,51 @@ def _blockwise_attention(q, k, v, causal: bool, block_k: int):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_k"))
-def flash_attention(q, k, v, causal: bool = False, block_k: int = 512):
-    """q, k, v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim]."""
+def _lib_flash(q, k, v, causal: bool):
+    """The public JAX Pallas TPU flash kernel ([b, h, s, d] layout) — a
+    hand-written fwd+bwd that beats the autodiff'd blockwise scan at long
+    sequence (measured on v5e, BENCH_LONGCTX.json: fwd+bwd 60 vs 75 ms at
+    seq 8192, and it compiles at 16384 where the scan formulation does
+    not)."""
+    import math as _math
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as pl_flash,
+    )
+
+    o = pl_flash(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal=causal,
+        sm_scale=1.0 / _math.sqrt(q.shape[-1]),
+    )
+    return o.transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_k", "use_lib")
+)
+def flash_attention(
+    q, k, v, causal: bool = False, block_k: int = 512, use_lib=None
+):
+    """q, k, v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim].
+
+    use_lib=None ("auto"): on SINGLE-device TPU the library Pallas kernel
+    is preferred; under a multi-device mesh the opaque pallas custom call
+    has no GSPMD partitioning rule (it would replicate or fail the
+    sharded compile), so the jnp blockwise formulation — which XLA shards
+    cleanly over batch/heads — is used instead. Callers inside a sharded
+    step (ops/attention.py) pass use_lib=False explicitly. `block_k`
+    tunes only the blockwise path; the library kernel uses its own block
+    sizes."""
+    if use_lib is None:
+        use_lib = (
+            jax.default_backend() == "tpu" and jax.device_count() == 1
+        )
+    if use_lib:
+        try:
+            return _lib_flash(q, k, v, causal)
+        except Exception:  # noqa: BLE001 — trace-time shape/support errors
+            pass
     return _blockwise_attention(q, k, v, causal, block_k)

@@ -322,15 +322,13 @@ class Executor:
     def shard_batch(self, batch: Dict[str, np.ndarray]):
         """Host→device transfer with each input's searched sharding
         (the TPU analog of the reference's SingleDataLoader index-launched
-        shard copies, python/flexflow_dataloader.cc)."""
-        out = {}
-        shapes = self.input_shapes()
-        for name, arr in batch.items():
-            if name in shapes:
-                out[name] = jax.device_put(arr, self.sharding_for(shapes[name]))
-            else:
-                out[name] = jax.device_put(arr)
-        return out
+        shard copies, python/flexflow_dataloader.cc). On multi-host runs
+        each process passes its LOCAL rows and the global array is
+        assembled across hosts; one placement loop serves both paths
+        (runtime/multihost.place_batch)."""
+        from flexflow_tpu.runtime.multihost import place_batch
+
+        return place_batch(self, batch, multi=jax.process_count() > 1)
 
     def input_shapes(self) -> Dict[str, ParallelTensorShape]:
         out = {}

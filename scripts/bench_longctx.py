@@ -1,0 +1,136 @@
+"""Long-context attention kernels on one real chip (SURVEY §5: the
+reference has NO long-context story — its attention is one cuDNN call per
+shard and nothing shards the sequence dim; this framework's auto-select
+switches dense → blockwise as the score tensor outgrows HBM, and ring
+attention carries sequences across chips).
+
+    python scripts/bench_longctx.py [--out BENCH_LONGCTX.json]
+
+Times fwd and fwd+bwd of the attention CORE (the part that scales
+quadratically) at growing sequence lengths, bf16, for:
+  * dense   — XLA attention, materializes the [b, h, s, s] f32 scores
+  * block   — ops/pallas/flash_attention blockwise online-softmax
+  * libpl   — jax.experimental.pallas TPU flash kernel (public JAX)
+Chained-scan differencing, min over reps (utils/benchmark.py rationale).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np  # noqa: E402
+
+
+def timed(fn, args, n1=4, n2=12, reps=3):
+    import jax
+    from jax import lax
+
+    def chain(n):
+        @jax.jit
+        def run(*a):
+            def body(c, _):
+                out = fn(*c)
+                dep = (out.sum() * 1e-12).astype(c[0].dtype)
+                return (c[0] + dep, *c[1:]), out.sum()
+
+            _, s = lax.scan(body, a, None, length=n)
+            return s[-1]
+
+        return run
+
+    r1, r2 = chain(n1), chain(n2)
+    _ = float(np.asarray(r1(*args)))
+    _ = float(np.asarray(r2(*args)))
+    best = float("inf")
+    for _i in range(reps):
+        t0 = time.perf_counter()
+        _ = float(np.asarray(r1(*args)))
+        t1 = time.perf_counter()
+        _ = float(np.asarray(r2(*args)))
+        t2 = time.perf_counter()
+        best = min(best, ((t2 - t1) - (t1 - t0)) / (n2 - n1))
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    out_path = "BENCH_LONGCTX.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+
+    H, D = 16, 64
+    key = jax.random.PRNGKey(0)
+
+    def dense(q, k, v):
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) / math.sqrt(D)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    from flexflow_tpu.ops.pallas.flash_attention import flash_attention
+
+    def block(q, k, v):
+        return flash_attention(q, k, v)
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as lib_flash,
+    )
+
+    def libpl(q, k, v):
+        o = lib_flash(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            sm_scale=1.0 / math.sqrt(D),
+        )
+        return o.transpose(0, 2, 1, 3)
+
+    def grad_of(f):
+        def g(q, k, v):
+            return jax.grad(
+                lambda q, k, v: f(q, k, v).astype(jnp.float32).sum(),
+                argnums=0,
+            )(q, k, v)
+
+        return g
+
+    kernels = {"dense": dense, "block": block, "libpl": libpl}
+    results = {}
+    for seq in (1024, 2048, 4096, 8192, 16384):
+        b = max(1, 8192 // seq)  # keep total tokens ~constant
+        qkv = [
+            jax.random.normal(kk, (b, seq, H, D), jnp.bfloat16)
+            for kk in jax.random.split(key, 3)
+        ]
+        # attention-core flops (fwd): 2 matmuls * 2BSSHD
+        flops = 2 * 2.0 * b * seq * seq * H * D
+        for name, f in kernels.items():
+            row = {"seq": seq, "batch": b, "kernel": name}
+            try:
+                tf = timed(f, qkv)
+                # record fwd immediately: a bwd OOM must not discard it
+                row["fwd_ms"] = round(tf * 1e3, 3)
+                row["fwd_tflops"] = round(flops / tf / 1e12, 1)
+                tb = timed(grad_of(f), qkv)
+                row["fwdbwd_ms"] = round(tb * 1e3, 3)
+            except Exception as e:  # noqa: BLE001 — OOM etc: record, move on
+                row["error"] = repr(e)[:120]
+            results[f"s{seq}_{name}"] = row
+            print(json.dumps(row), flush=True)
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -49,8 +49,74 @@ def run_one(name: str, argv) -> float:
     return float(matches[-1])
 
 
+def simulate_one(name: str, argv):
+    """Build the workload, run the search, and return the calibrated cost
+    model's (dp_step_s, searched_step_s, strategy_name) WITHOUT training —
+    the reference's own `Optimal cost:` line (substitution.cc:1909), for
+    workloads too compute-heavy to wall-clock on a 1-core virtual mesh."""
+    import jax
+
+    from examples import common
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.runtime.executor import propagate_shapes
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.simulator import estimate_graph_cost
+
+    captured = {}
+    real_run = common.run_training
+
+    def fake_run(model, data, labels, cfg, epochs=None):
+        captured["model"] = model
+        return []
+
+    old_argv = sys.argv
+    sys.argv = [name] + list(argv)
+    common.run_training = fake_run
+    try:
+        mod = importlib.import_module(f"examples.{name}")
+        # examples import run_training by name — patch their binding too
+        had = getattr(mod, "run_training", None)
+        if had is not None:
+            mod.run_training = fake_run
+        try:
+            mod.main()
+        finally:
+            if had is not None:
+                mod.run_training = real_run
+    finally:
+        common.run_training = real_run
+        sys.argv = old_argv
+
+    if "model" not in captured:
+        raise RuntimeError(
+            f"{name}: main() exited without reaching run_training "
+            "(bad flags for this workload?) — cannot simulate it"
+        )
+    model = captured["model"]
+    n = len(jax.devices())
+    spec = MachineSpec(num_nodes=1, chips_per_node=n, chip=model.config.chip)
+    cm = CostModel(spec, mixed_precision=model.config.allow_mixed_precision)
+
+    def cost_of(strategy):
+        g = model._prestrategy_graph.copy()
+        strategy.apply(g)
+        propagate_shapes(g)
+        return estimate_graph_cost(
+            g, cm, strategy.mesh_config.axis_sizes
+        ).step_time
+
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+
+    dp_cost = cost_of(data_parallel_strategy(n, model._prestrategy_graph))
+    searched_cost = cost_of(model.strategy)
+    return dp_cost, searched_cost, model.strategy.name
+
+
 def main():
     args = sys.argv[1:]
+    simulate = "--simulate" in args
+    if simulate:
+        args = [a for a in args if a != "--simulate"]
     if args and args[0] == "--all":
         names = WORKLOADS
         rest = args[1:]
@@ -60,6 +126,24 @@ def main():
     else:
         names = ["mlp"]
         rest = args
+
+    if simulate:
+        rows = []
+        for name in names:
+            dp_s, searched_s, sname = simulate_one(name, rest)
+            print(f"=== {name}: {sname}")
+            rows.append((name, dp_s, searched_s))
+        print()
+        print(
+            f"{'workload':<14} {'DP step ms':>12} {'searched ms':>12} "
+            f"{'speedup':>9}  (simulated, calibrated cost model)"
+        )
+        for name, dp_s, searched_s in rows:
+            print(
+                f"{name:<14} {dp_s * 1e3:>12.3f} {searched_s * 1e3:>12.3f} "
+                f"{dp_s / searched_s if searched_s else float('nan'):>8.2f}x"
+            )
+        return
 
     rows = []
     for name in names:
