@@ -27,6 +27,13 @@ class FFConfig:
     weight_decay: float = 0.0001
     iterations: Optional[int] = None
 
+    # sparse embedding-table updates (beyond-reference: the reference's
+    # embedding bwd scatter-adds into a DENSE weight-grad region,
+    # embedding_kernels.cu — here eligible tables skip the dense gradient
+    # and per-step full-table optimizer pass entirely; --no-sparse-embedding
+    # disables for A/B)
+    sparse_embedding_update: bool = True
+
     # machine (reference: -ll:gpu/-ll:cpu + numNodes)
     num_nodes: int = 1
     workers_per_node: int = 0  # 0 = use all local devices
@@ -148,6 +155,8 @@ class FFConfig:
                 cfg.substitution_json = take()
             elif a == "--no-substitution":
                 cfg.enable_substitution = False
+            elif a == "--no-sparse-embedding":
+                cfg.sparse_embedding_update = False
             elif a == "--search-num-nodes":
                 cfg.search_num_nodes = int(take())
             elif a == "--search-num-workers":

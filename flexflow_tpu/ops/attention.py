@@ -190,9 +190,12 @@ def _lower_mha(params):
         ):
             from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
+            single = ctx.mesh is None or ctx.mesh.size == 1
             attn = flash_attention(
                 qh, kh, vh, causal=causal,
-                use_lib=ctx.mesh is None or ctx.mesh.size == 1,
+                # None = auto (backend + device checks inside); a sharded
+                # mesh must force the partitionable blockwise path
+                use_lib=None if single else False,
             )
         else:
             attn = scaled_dot_product_attention(qh, kh, vh, causal=causal)
@@ -273,11 +276,16 @@ def _lower_mha(params):
             if flash:
                 from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
-                # the library Pallas kernel is single-device only (no
-                # GSPMD partitioning rule); sharded meshes take the
-                # blockwise path, which XLA partitions over batch/heads
+                # the library Pallas kernel is single-device TPU only
+                # (no GSPMD partitioning rule); sharded meshes take the
+                # blockwise path, which XLA partitions over batch/heads.
+                # use_lib=None defers the backend/device check to
+                # flash_attention's auto mode
                 single = ctx is None or ctx.mesh is None or ctx.mesh.size == 1
-                attn = flash_attention(q, k, v, causal=causal, use_lib=single)
+                attn = flash_attention(
+                    q, k, v, causal=causal,
+                    use_lib=None if single else False,
+                )
             else:
                 attn = scaled_dot_product_attention(
                     q,

@@ -101,6 +101,7 @@ class Executor:
         logits_from_logits: bool = True,
         mixed_precision: bool = False,
         seq_length: Optional[int] = None,
+        sparse_embedding_update: bool = False,
     ):
         self.graph = graph
         self.mesh_config = mesh_config
@@ -114,6 +115,7 @@ class Executor:
         self.logits_from_logits = logits_from_logits
         self.mixed_precision = mixed_precision
         self.seq_length = seq_length
+        self.sparse_embedding_update = sparse_embedding_update
         self.topo = graph.topo_order()
         self._lowered = {
             g: lower_op(graph.nodes[g].op_type, graph.nodes[g].params)
@@ -199,11 +201,20 @@ class Executor:
 
     # -- forward -------------------------------------------------------------
 
-    def forward_values(self, params, batch, rng=None, train=True):
-        """Evaluate the PCG; returns {(guid, out_idx): array}."""
+    def forward_values(self, params, batch, rng=None, train=True, injected=None):
+        """Evaluate the PCG; returns {(guid, out_idx): array}.
+
+        injected: {guid: array} precomputed single-output node values
+        (the sparse-embedding fast path differentiates wrt these
+        activations instead of the table weights)."""
         values: Dict[Tuple[int, int], jnp.ndarray] = {}
         for guid in self.topo:
             node = self.graph.nodes[guid]
+            if injected is not None and guid in injected:
+                values[(guid, 0)] = self._constrain(
+                    injected[guid], node.output_shapes[0]
+                )
+                continue
             if node.op_type in (OperatorType.INPUT, OperatorType.NOOP) and not node.inputs:
                 if node.name not in batch:
                     raise KeyError(f"batch missing input '{node.name}'")
@@ -228,8 +239,8 @@ class Executor:
                 values[(guid, i)] = out
         return values
 
-    def _loss_and_metrics(self, params, batch, rng, train):
-        values = self.forward_values(params, batch, rng, train)
+    def _loss_and_metrics(self, params, batch, rng, train, injected=None):
+        values = self.forward_values(params, batch, rng, train, injected)
         logits = values[(self.logits_ref.guid, self.logits_ref.out_idx)]
         labels = batch["label"]
         loss = compute_loss(
@@ -250,18 +261,130 @@ class Executor:
 
     # -- compiled entry points ----------------------------------------------
 
+    def _sparse_embedding_guids(self) -> List[int]:
+        """EMBEDDING nodes eligible for the sparse-update fast path: plain
+        SGD (no momentum / weight decay — lazy per-row state would change
+        semantics), ids read straight from a batch INPUT, unsharded table.
+
+        Why it matters (beyond-reference): autodiff of jnp.take produces a
+        DENSE [vocab, dim] cotangent and the optimizer walks the whole
+        table every step — for DLRM-class models the tables dominate the
+        step. The fast path differentiates wrt the embedding ACTIVATIONS
+        and scatter-applies the update to only the touched rows (the
+        reference's embedding bwd scatter-adds into a dense grad region
+        either way, embedding_kernels.cu:backward)."""
+        from flexflow_tpu.runtime.optimizer import SGDOptimizer
+
+        opt = self.optimizer
+        if not self.sparse_embedding_update or not isinstance(
+            opt, SGDOptimizer
+        ):
+            return []
+        if opt.momentum != 0.0 or opt.weight_decay != 0.0:
+            return []
+        out = []
+        for guid in self.topo:
+            node = self.graph.nodes[guid]
+            if node.op_type != OperatorType.EMBEDDING:
+                continue
+            if len(node.weight_shapes) != 1 or len(node.inputs) != 1:
+                continue
+            src = self.graph.nodes[node.inputs[0].guid]
+            if src.op_type != OperatorType.INPUT or src.inputs:
+                continue
+            if any(d.degree > 1 for d in node.weight_shapes[0].dims):
+                continue  # sharded tables keep the dense GSPMD path (v1)
+            out.append(guid)
+        return out
+
     def train_step_fn(self):
         """(params, opt_state, batch, rng) -> (params, opt_state, loss, metrics)"""
+        sparse = self._sparse_embedding_guids()
+        if not sparse:
 
-        def step(params, opt_state, batch, rng):
-            def loss_fn(p):
-                return self._loss_and_metrics(p, batch, rng, train=True)
+            def step(params, opt_state, batch, rng):
+                def loss_fn(p):
+                    return self._loss_and_metrics(p, batch, rng, train=True)
 
-            (loss, mets), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            new_params, new_state = self.optimizer.update(params, grads, opt_state)
+                (loss, mets), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                new_params, new_state = self.optimizer.update(
+                    params, grads, opt_state
+                )
+                return new_params, new_state, loss, mets
+
+            return step
+
+        from flexflow_tpu.core.types import AggrMode
+        from flexflow_tpu.ops.registry import LowerCtx
+
+        ids_name = {
+            g: self.graph.nodes[self.graph.nodes[g].inputs[0].guid].name
+            for g in sparse
+        }
+
+        def sparse_step(params, opt_state, batch, rng):
+            # forward lookups OUTSIDE the grad closure: the activations
+            # become the differentiable leaves, the tables constants
+            acts = {}
+            for g in sparse:
+                node = self.graph.nodes[g]
+                ctx = LowerCtx(
+                    train=True,
+                    rng=None,
+                    mesh=self.mesh,
+                    axis_names=self.mesh_config.axis_names,
+                    in_shapes=[self.graph.shape_of(node.inputs[0])],
+                    bf16_matmul=self.mixed_precision,
+                    seq_length=self.seq_length,
+                )
+                acts[g] = self._lowered[g](
+                    [batch[ids_name[g]]], [params[g][0]], ctx
+                )[0]
+
+            dense = {k: v for k, v in params.items() if k not in sparse}
+
+            def loss_fn(dense_p, acts_in):
+                full = dict(dense_p)
+                for g in sparse:
+                    full[g] = params[g]  # closed-over constant
+                return self._loss_and_metrics(
+                    full, batch, rng, train=True, injected=acts_in
+                )
+
+            (loss, mets), (gd, ga) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(dense, acts)
+            new_params, new_state = self.optimizer.update(
+                dense, gd, opt_state
+            )
+            lr = self.optimizer.lr
+            for g in sparse:
+                node = self.graph.nodes[g]
+                table = params[g][0]
+                ids = batch[ids_name[g]]
+                gact = ga[g]
+                aggr = node.params.get("aggr", AggrMode.NONE)
+                if aggr == AggrMode.SUM:
+                    rows = jnp.broadcast_to(
+                        gact[..., None, :], ids.shape + gact.shape[-1:]
+                    )
+                elif aggr == AggrMode.AVG:
+                    rows = (
+                        jnp.broadcast_to(
+                            gact[..., None, :], ids.shape + gact.shape[-1:]
+                        )
+                        / ids.shape[-1]
+                    )
+                else:  # NONE: cotangent already one row per id
+                    rows = gact
+                new_params[g] = [
+                    table.at[ids].add((-lr * rows).astype(table.dtype))
+                ]
             return new_params, new_state, loss, mets
 
-        return step
+        return sparse_step
 
     def set_seq_length(self, seq_length: Optional[int]):
         """Per-iteration dynamic sequence truncation (reference:

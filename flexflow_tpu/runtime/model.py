@@ -853,6 +853,12 @@ class FFModel:
             logits_from_logits=from_logits,
             mixed_precision=self.config.allow_mixed_precision,
             seq_length=self.config.seq_length,
+            # the GPipe executor has its own forward path; sparse table
+            # updates ride the plain executor only
+            sparse_embedding_update=(
+                self.config.sparse_embedding_update
+                and executor_cls is Executor
+            ),
             **executor_kwargs,
         )
         self._rng, init_key = jax.random.split(self._rng)

@@ -163,7 +163,12 @@ class PipelinedExecutor(Executor):
 
     # -- forward -------------------------------------------------------------
 
-    def forward_values(self, params, batch, rng=None, train=True):
+    def forward_values(self, params, batch, rng=None, train=True, injected=None):
+        if injected:
+            raise ValueError(
+                "the GPipe executor does not support injected activations "
+                "(sparse embedding updates ride the plain executor only)"
+            )
         from flexflow_tpu.parallel.pipeline import pipeline_apply
 
         st = self.pspec.structure

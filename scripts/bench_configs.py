@@ -179,6 +179,17 @@ def main():
         per_step = measure_train_step(
             model, model.executor.shard_batch(batch), reps=3
         )
+        import math as _math
+
+        if not _math.isfinite(per_step) or per_step <= 0:
+            row = {
+                "metric": name,
+                "error": "measurement below the tunnel noise floor",
+                "precision": "bf16-matmul" if mixed else "f32",
+            }
+            results[name] = row
+            print(json.dumps(row), flush=True)
+            continue
         thpt = bs / per_step
         row = {
             "metric": name,

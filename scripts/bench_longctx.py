@@ -11,7 +11,8 @@ quadratically) at growing sequence lengths, bf16, for:
   * dense   — XLA attention, materializes the [b, h, s, s] f32 scores
   * block   — ops/pallas/flash_attention blockwise online-softmax
   * libpl   — jax.experimental.pallas TPU flash kernel (public JAX)
-Chained-scan differencing, min over reps (utils/benchmark.py rationale).
+Chained-scan differencing with the adaptive-window noise guard
+(utils/benchmark.measure_fn — a corrupt negative time is reported NaN).
 """
 
 from __future__ import annotations
@@ -20,43 +21,11 @@ import json
 import math
 import os
 import sys
-import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 import numpy as np  # noqa: E402
-
-
-def timed(fn, args, n1=4, n2=12, reps=3):
-    import jax
-    from jax import lax
-
-    def chain(n):
-        @jax.jit
-        def run(*a):
-            def body(c, _):
-                out = fn(*c)
-                dep = (out.sum() * 1e-12).astype(c[0].dtype)
-                return (c[0] + dep, *c[1:]), out.sum()
-
-            _, s = lax.scan(body, a, None, length=n)
-            return s[-1]
-
-        return run
-
-    r1, r2 = chain(n1), chain(n2)
-    _ = float(np.asarray(r1(*args)))
-    _ = float(np.asarray(r2(*args)))
-    best = float("inf")
-    for _i in range(reps):
-        t0 = time.perf_counter()
-        _ = float(np.asarray(r1(*args)))
-        t1 = time.perf_counter()
-        _ = float(np.asarray(r2(*args)))
-        t2 = time.perf_counter()
-        best = min(best, ((t2 - t1) - (t1 - t0)) / (n2 - n1))
-    return best
 
 
 def main():
@@ -103,6 +72,8 @@ def main():
             )(q, k, v)
 
         return g
+
+    from flexflow_tpu.utils.benchmark import measure_fn as timed
 
     kernels = {"dense": dense, "block": block, "libpl": libpl}
     results = {}

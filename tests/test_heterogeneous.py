@@ -240,6 +240,36 @@ def test_mixed_beats_uniform_lowering():
     )
 
 
+def _mlp_heavy_dlrm(batch=4096):
+    m = FFModel(FFConfig(batch_size=batch))
+    from flexflow_tpu.core.types import AggrMode
+
+    feats = []
+    for i in range(2):
+        ids = m.create_tensor([batch, 1], dtype=DataType.INT32, name=f"ids{i}")
+        feats.append(m.embedding(ids, 1_000_000, 64, aggr=AggrMode.SUM))
+    dense_in = m.create_tensor([batch, 512], name="dense_in")
+    t = m.dense(dense_in, 1024, activation=ActiMode.RELU, name="bot1")
+    t = m.dense(t, 64, activation=ActiMode.RELU, name="bot2")
+    t = m.concat(feats + [t], axis=1)
+    t = m.dense(t, 1024, activation=ActiMode.RELU, name="top1")
+    m.dense(t, 2, name="top2")
+    return m
+
+
+def test_mesh_engine_finds_mixed_candidate():
+    """The default (mesh) search engine must discover the heterogeneous
+    DLRM pattern on its own — embedding sites model-parallel, MLPs at
+    full-width dp — and lower it through mixed_site_strategy."""
+    from flexflow_tpu.search.auto import optimize, result_to_strategy
+
+    m = _mlp_heavy_dlrm()
+    r = optimize(m.graph, 8, SPEC, budget=30)
+    assert r.kind == "mixed", r.describe()
+    s = result_to_strategy(r, m.graph)
+    assert "mixed" in s.name
+
+
 def test_embedding_site_apply_shapes():
     m = dlrm_like(n_tables=1)
     g = m.graph.copy()

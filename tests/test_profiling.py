@@ -67,3 +67,18 @@ def test_trace_context_manager(tmp_path):
     batch = {"x": np.random.RandomState(0).randn(16, 32).astype(np.float32)}
     with profiling.trace(str(tmp_path / "trace")):
         model.forward(batch)
+
+
+def test_xla_cost_analysis():
+    from flexflow_tpu.utils.profiling import xla_cost_analysis
+
+    model = _model()
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": rng.randn(16, 32).astype(np.float32),
+        "label": rng.randint(0, 4, (16,)).astype(np.int32),
+    }
+    cost = xla_cost_analysis(model, batch)
+    # backend-dependent accounting; the contract is a non-empty dict with
+    # a positive flop count
+    assert cost.get("flops", 0) > 0

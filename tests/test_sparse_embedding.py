@@ -1,0 +1,86 @@
+"""Sparse embedding-table updates (beyond-reference: the reference's
+embedding backward scatter-adds into a DENSE weight-grad region and the
+optimizer walks the whole table every step, embedding_kernels.cu; here
+eligible tables differentiate wrt the embedding ACTIVATIONS and
+scatter-apply the update to only the touched rows)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    SGDOptimizer,
+)
+from flexflow_tpu.core.types import AggrMode
+
+
+def build(aggr=AggrMode.SUM, sparse=True, momentum=0.0, batch=32, bag=4):
+    cfg = FFConfig(batch_size=batch, seed=7)
+    cfg.sparse_embedding_update = sparse
+    cfg.enable_substitution = False
+    m = FFModel(cfg)
+    shape = [batch, bag] if aggr != AggrMode.NONE else [batch]
+    ids = m.create_tensor(shape, dtype=DataType.INT32, name="ids")
+    t = m.embedding(ids, 1000, 16, aggr=aggr)
+    if aggr == AggrMode.NONE:
+        t = m.reshape(t, [batch, 16])
+    m.dense(t, 4)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05, momentum=momentum),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    return m
+
+
+def batch_for(aggr, batch=32, bag=4, seed=0):
+    rng = np.random.RandomState(seed)
+    shape = (batch, bag) if aggr != AggrMode.NONE else (batch,)
+    ids = rng.randint(0, 1000, shape).astype(np.int32)
+    # force duplicate ids (scatter-add accumulation must match the dense
+    # gradient's sum over repeated rows)
+    ids.flat[0] = ids.flat[1]
+    y = rng.randint(0, 4, (batch,)).astype(np.int32)
+    return {"ids": ids}, y
+
+
+def test_eligibility():
+    assert build(sparse=True).executor._sparse_embedding_guids()
+    assert not build(sparse=False).executor._sparse_embedding_guids()
+    assert not build(momentum=0.9).executor._sparse_embedding_guids()
+
+
+@pytest.mark.parametrize("aggr", [AggrMode.SUM, AggrMode.AVG, AggrMode.NONE])
+def test_sparse_matches_dense(aggr):
+    data, y = batch_for(aggr)
+    ms = build(aggr, sparse=True)
+    md = build(aggr, sparse=False)
+    assert ms.executor._sparse_embedding_guids()
+    hs = ms.fit(data, y, epochs=3, verbose=False)
+    hd = md.fit(data, y, epochs=3, verbose=False)
+    for a, b in zip(hs, hd):
+        assert np.isclose(a["loss_sum"], b["loss_sum"], rtol=1e-5), (hs, hd)
+    emb_guid = ms.executor._sparse_embedding_guids()[0]
+    np.testing.assert_allclose(
+        np.asarray(ms.params[emb_guid][0]),
+        np.asarray(md.params[emb_guid][0]),
+        rtol=1e-5,
+        atol=1e-7,
+    )
+
+
+def test_untouched_rows_unchanged():
+    """Only looked-up rows may change — the definition of sparse."""
+    ms = build(AggrMode.SUM, sparse=True)
+    emb_guid = ms.executor._sparse_embedding_guids()[0]
+    before = np.asarray(ms.params[emb_guid][0]).copy()
+    data, y = batch_for(AggrMode.SUM)
+    ms.fit(data, y, epochs=1, verbose=False)
+    after = np.asarray(ms.params[emb_guid][0])
+    touched = np.unique(data["ids"])
+    untouched = np.setdiff1d(np.arange(1000), touched)
+    np.testing.assert_array_equal(before[untouched], after[untouched])
+    assert not np.allclose(before[touched], after[touched])
