@@ -1,0 +1,90 @@
+"""End-to-end workflow tour: build -> search -> export strategy ->
+re-import -> train -> checkpoint -> resume (the complete user journey the
+reference spreads over --export/--import (strategy.cc:100-197), fit()
+(flexflow_cffi.py:1916), and external torch-state-dict scripts; the
+checkpoint/resume leg is beyond-reference, SURVEY §5).
+
+    python examples/full_workflow.py [-b 64] [--budget 10]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import (  # noqa: E402
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+
+
+def build(cfg: FFConfig) -> FFModel:
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 64], name="x")
+    t = ff.dense(x, 256, activation=ActiMode.RELU)
+    t = ff.dense(t, 256, activation=ActiMode.RELU)
+    ff.dense(t, 8)
+    return ff
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    workdir = tempfile.mkdtemp(prefix="ff_workflow_")
+    strategy_path = os.path.join(workdir, "strategy.json")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+
+    # 1) search a strategy and export it
+    cfg.search_budget = max(cfg.search_budget, 10)
+    cfg.export_strategy_file = strategy_path
+    model = build(cfg)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    print(f"searched strategy: {model.strategy.name}")
+    print(f"exported to {strategy_path}")
+
+    rng = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    x = rng.randn(n, 64).astype(np.float32)
+    y = rng.randint(0, 8, n).astype(np.int32)
+
+    # 2) fresh process analog: import the exported strategy, train with
+    # periodic checkpoints
+    cfg2 = FFConfig(batch_size=cfg.batch_size)
+    cfg2.import_strategy_file = strategy_path
+    model2 = build(cfg2)
+    model2.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    print(f"imported strategy: {model2.strategy.name}")
+    model2.fit(x, y, epochs=2, checkpoint_dir=ckpt_dir, checkpoint_every=1)
+
+    # 3) resume from the checkpoint and keep training
+    model3 = build(FFConfig(batch_size=cfg.batch_size))
+    model3.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    step = model3.restore_checkpoint(ckpt_dir)
+    print(f"resumed from step {step}")
+    hist = model3.fit(x, y, epochs=1)
+    print(f"final loss_sum {hist[-1]['loss_sum']:.4f}")
+    print("WORKFLOW OK")
+
+
+if __name__ == "__main__":
+    main()

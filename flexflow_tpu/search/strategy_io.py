@@ -140,6 +140,27 @@ def load_strategy(path: str, graph: PCGGraph, num_devices: int) -> Strategy:
             ) from None
         sites.append(cls(entry["kind"], guids))
 
+    if kind == "mixed":
+        # heterogeneous lowering: TP sites + full-width dp outside them
+        # (falling through to the uniform path would silently import a
+        # DIFFERENT strategy than was exported)
+        from flexflow_tpu.parallel.strategy import mixed_site_strategy
+
+        if dp * tp > num_devices:
+            raise ValueError(
+                f"mixed strategy file wants {dp * tp} devices, "
+                f"have {num_devices}"
+            )
+        s = mixed_site_strategy(
+            graph, num_devices, tp, sites, name_prefix=f"imported:{path}"
+        )
+        if "mixed" not in s.name:
+            raise ValueError(
+                f"strategy file {path!r} is a mixed strategy but the "
+                "current graph/device count cannot express it"
+            )
+        return s
+
     from flexflow_tpu.runtime.executor import MeshConfig
     from flexflow_tpu.search.auto import _MODEL_AXIS, _annotate_data_parallel
 

@@ -89,11 +89,16 @@ def main():
             row = {"seq": seq, "batch": b, "kernel": name}
             try:
                 tf = timed(f, qkv)
+                if not math.isfinite(tf):  # below the noise floor: NaN
+                    raise RuntimeError("measurement below noise floor")
                 # record fwd immediately: a bwd OOM must not discard it
                 row["fwd_ms"] = round(tf * 1e3, 3)
                 row["fwd_tflops"] = round(flops / tf / 1e12, 1)
                 tb = timed(grad_of(f), qkv)
-                row["fwdbwd_ms"] = round(tb * 1e3, 3)
+                if math.isfinite(tb):
+                    row["fwdbwd_ms"] = round(tb * 1e3, 3)
+                else:
+                    row["fwdbwd_error"] = "measurement below noise floor"
             except Exception as e:  # noqa: BLE001 — OOM etc: record, move on
                 row["error"] = repr(e)[:120]
             results[f"s{seq}_{name}"] = row

@@ -13,6 +13,7 @@ sys.path.insert(0, ROOT)
 
 EXAMPLES = [
     "alexnet",
+    "full_workflow",
     "bert_proxy",
     "candle_uno",
     "dlrm",
@@ -81,3 +82,9 @@ def test_nmt_runs_and_learns():
     for _ in range(60):
         params, state, loss = step(params, state, b)
     assert float(loss) < 2.0
+
+
+def test_full_workflow_runs(capsys):
+    """search -> export -> import -> train -> checkpoint -> resume."""
+    _run_main("full_workflow", ["-b", "64", "--budget", "10"])
+    assert "WORKFLOW OK" in capsys.readouterr().out

@@ -270,6 +270,27 @@ def test_mesh_engine_finds_mixed_candidate():
     assert "mixed" in s.name
 
 
+def test_mixed_strategy_export_import_roundtrip(tmp_path):
+    """--export-strategy / --import-strategy must preserve the MIXED
+    lowering (a fallthrough to the uniform path would silently train a
+    different strategy than was exported)."""
+    from flexflow_tpu.search.auto import optimize
+    from flexflow_tpu.search.strategy_io import (
+        load_strategy,
+        save_search_result,
+    )
+
+    m = _mlp_heavy_dlrm()
+    r = optimize(m.graph, 8, SPEC, budget=30)
+    assert r.kind == "mixed"
+    path = str(tmp_path / "strategy.json")
+    save_search_result(r, m.graph, path)
+    m2 = _mlp_heavy_dlrm()
+    s = load_strategy(path, m2.graph, 8)
+    assert "mixed" in s.name, s.name
+    assert s.mesh_config.axis_sizes == (8 // r.tp, r.tp)
+
+
 def test_embedding_site_apply_shapes():
     m = dlrm_like(n_tables=1)
     g = m.graph.copy()
