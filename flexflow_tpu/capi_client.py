@@ -1,0 +1,255 @@
+"""Alternative Python binding: a ctypes client over the C ABI.
+
+The reference ships TWO Python bindings over one C API — cffi
+(`python/flexflow/core/flexflow_cffi.py`) and pybind11
+(`python/bindings.cc`), selected by FF_USE_CFFI
+(`flexflow/config.py:19-30`). This module is the rebuild's second
+binding: instead of importing `flexflow_tpu` directly, it loads
+`libflexflow_c` (native/src/flexflow_c.cc) with ctypes and drives the
+same flat `flexflow_*` handle API a C program uses — proving the C ABI
+is complete enough to host a full Python client, and exercising it from
+Python tests without a C toolchain at test time (the library embeds
+CPython; inside an already-running interpreter `Py_IsInitialized()` is
+true and the host interpreter is reused).
+
+    from flexflow_tpu.capi_client import CModel
+    m = CModel(batch_size=64)
+    x = m.tensor([64, 32], name="x")
+    t = m.dense(x, 64, activation="relu")
+    m.dense(t, 4)
+    m.compile(loss="sparse_categorical_crossentropy", lr=0.05)
+    loss = m.fit(X, y, epochs=2)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+_ACTIVATIONS = {None: 0, "none": 0, "relu": 1, "sigmoid": 2, "tanh": 3, "gelu": 4}
+_DTYPES = {"float32": 0, "int32": 1, "int64": 2}
+
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _lib_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cands = [
+        os.path.join(root, "native", "build", "libflexflow_c.so"),
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "native",
+            "libflexflow_c.so",
+        ),  # packaged wheel location
+    ]
+    for c in cands:
+        if os.path.exists(c):
+            return c
+    raise FileNotFoundError(
+        f"libflexflow_c.so not found (looked in {cands}); build it with "
+        "`make -C native capi`"
+    )
+
+
+def load_library() -> ctypes.CDLL:
+    """Load + initialize libflexflow_c once per process.
+
+    PyDLL, not CDLL: every flexflow_* entry point runs CPython API calls
+    (the library embeds the interpreter; in-process it reuses ours), so
+    the GIL must stay HELD across the foreign call — CDLL would release
+    it and the first Py* call inside would segfault."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    lib = ctypes.PyDLL(_lib_path())
+    for destroy in (
+        "flexflow_model_destroy",
+        "flexflow_config_destroy",
+        "flexflow_tensor_destroy",
+    ):
+        getattr(lib, destroy).restype = None
+        getattr(lib, destroy).argtypes = [ctypes.c_void_p]
+    lib.flexflow_init.restype = ctypes.c_int
+    lib.flexflow_init.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+    lib.flexflow_config_create.restype = ctypes.c_void_p
+    lib.flexflow_config_create.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+    lib.flexflow_model_create.restype = ctypes.c_void_p
+    lib.flexflow_model_create.argtypes = [ctypes.c_void_p]
+    lib.flexflow_tensor_create_ex.restype = ctypes.c_void_p
+    lib.flexflow_tensor_create_ex.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+        ctypes.c_char_p,
+    ]
+    lib.flexflow_model_add_dense.restype = ctypes.c_void_p
+    lib.flexflow_model_add_dense.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.flexflow_model_add_embedding_ex.restype = ctypes.c_void_p
+    lib.flexflow_model_add_embedding_ex.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_void_p,
+    ]
+    lib.flexflow_model_compile.restype = ctypes.c_int
+    lib.flexflow_model_compile.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_double,
+    ]
+    lib.flexflow_model_fit.restype = ctypes.c_double
+    lib.flexflow_model_fit.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    import sys as _sys
+
+    # flexflow_init runs sys.path.insert(0, os.getcwd()) for the
+    # embedded-interpreter case; in-process that is a process-wide
+    # import-resolution mutation — undo it if it was not there before
+    before = list(_sys.path)
+    rc = lib.flexflow_init(0, None)
+    if rc != 0:
+        raise RuntimeError("flexflow_init failed")
+    if _sys.path != before and _sys.path[1:] == before:
+        _sys.path.pop(0)
+    _LIB = lib
+    return lib
+
+
+def _argv(args: Sequence[str]):
+    arr = (ctypes.c_char_p * (len(args) or 1))()
+    for i, a in enumerate(args):
+        arr[i] = a.encode()
+    return len(args), arr
+
+
+class CModel:
+    """Minimal FFModel mirror over the C ABI (the cffi-binding analog,
+    reference: flexflow_cffi.py:815 FFModel)."""
+
+    def __init__(self, batch_size: int = 64, extra_args: Sequence[str] = ()):
+        self.lib = load_library()
+        argc, argv = _argv(["capi_client", "-b", str(batch_size), *extra_args])
+        self.config = self.lib.flexflow_config_create(argc, argv)
+        if not self.config:
+            raise RuntimeError("flexflow_config_create failed")
+        self.model = self.lib.flexflow_model_create(self.config)
+        if not self.model:
+            raise RuntimeError("flexflow_model_create failed")
+        self._tensors = []
+
+    def close(self):
+        """Release the C handles (each is a new PyObject reference owned
+        by this client; a sweep building many CModels would otherwise
+        leak every model/config/tensor)."""
+        for t in self._tensors:
+            self.lib.flexflow_tensor_destroy(t)
+        self._tensors = []
+        if self.model:
+            self.lib.flexflow_model_destroy(self.model)
+            self.model = None
+        if self.config:
+            self.lib.flexflow_config_destroy(self.config)
+            self.config = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # interpreter teardown: lib may be gone
+            pass
+
+    def tensor(self, dims, dtype: str = "float32", name: Optional[str] = None):
+        arr = (ctypes.c_int * len(dims))(*dims)
+        t = self.lib.flexflow_tensor_create_ex(
+            self.model,
+            len(dims),
+            arr,
+            _DTYPES[dtype],
+            None if name is None else name.encode(),
+        )
+        if not t:
+            raise RuntimeError("tensor_create failed")
+        self._tensors.append(t)
+        return t
+
+    def dense(self, x, out_features: int, activation=None, use_bias=True):
+        t = self.lib.flexflow_model_add_dense(
+            self.model,
+            x,
+            out_features,
+            _ACTIVATIONS[activation],
+            int(use_bias),
+        )
+        if not t:
+            raise RuntimeError("add_dense failed")
+        self._tensors.append(t)
+        return t
+
+    def embedding(self, ids, num_entries: int, out_dim: int, aggr: int = 1):
+        t = self.lib.flexflow_model_add_embedding_ex(
+            self.model, ids, num_entries, out_dim, aggr, None
+        )
+        if not t:
+            raise RuntimeError("add_embedding failed")
+        self._tensors.append(t)
+        return t
+
+    def compile(
+        self,
+        loss: str = "sparse_categorical_crossentropy",
+        metrics: str = "accuracy",
+        lr: float = 0.01,
+    ):
+        rc = self.lib.flexflow_model_compile(
+            self.model, loss.encode(), metrics.encode(), lr
+        )
+        if rc != 0:
+            raise RuntimeError("compile failed")
+
+    def fit(self, x: np.ndarray, y: np.ndarray, epochs: int = 1) -> float:
+        x = np.ascontiguousarray(x, np.float32)
+        y_is_int = np.issubdtype(y.dtype, np.integer)
+        y = np.ascontiguousarray(y, np.int32 if y_is_int else np.float32)
+        xs = (ctypes.c_int64 * x.ndim)(*x.shape)
+        ys = (ctypes.c_int64 * y.ndim)(*y.shape)
+        loss = self.lib.flexflow_model_fit(
+            self.model,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            xs,
+            x.ndim,
+            y.ctypes.data_as(ctypes.c_void_p),
+            ys,
+            y.ndim,
+            int(y_is_int),
+            epochs,
+        )
+        if loss != loss:  # NaN
+            raise RuntimeError("fit failed")
+        return float(loss)

@@ -407,6 +407,8 @@ def fit_ptr(
     y_is_int: int,
     epochs: int,
 ) -> float:
+    # the C ABI's x is a single float buffer; FFModel._pack_dataset
+    # coerces each input to its declared dtype (int ids for embeddings)
     x = _array_from_ptr(x_addr, tuple(x_shape), np.float32)
     y = _array_from_ptr(
         y_addr, tuple(y_shape), np.int32 if y_is_int else np.float32

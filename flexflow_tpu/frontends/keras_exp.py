@@ -1,0 +1,66 @@
+"""`keras_exp` — the experimental Keras-frontend variant.
+
+The reference ships two Keras frontends: `flexflow.keras` (4.2k LoC) and
+`flexflow.keras_exp` (547 LoC), an experimental functional-API variant
+that traces `Model(inputs, outputs)` graphs eagerly instead of through
+the Sequential layer list (reference: python/flexflow/keras_exp/models/
+model.py). In this rebuild one implementation already serves both
+construction styles — `frontends.keras_api.Model` accepts functional
+(inputs/outputs Node graphs) AND Sequential construction — so this
+module is the keras_exp-compatible import surface over the same engine
+rather than a second tracer: the reference's two frontends exist because
+its Sequential path predated functional tracing, a split a fresh design
+does not need to reproduce.
+
+    from flexflow_tpu.frontends import keras_exp as keras
+    x = keras.Input(shape=(32,))
+    t = keras.Dense(64, activation="relu")(x)
+    out = keras.Dense(4)(t)
+    model = keras.Model(x, out)
+    model.compile(optimizer="sgd")
+    model.fit(X, y, epochs=2)
+"""
+
+from flexflow_tpu.frontends.keras_api import (  # noqa: F401
+    SGD,
+    Activation,
+    Adam,
+    Add,
+    AveragePooling2D,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    Layer,
+    LayerNormalization,
+    MaxPooling2D,
+    Model,
+    Multiply,
+    Sequential,
+)
+
+__all__ = [
+    "SGD",
+    "Activation",
+    "Adam",
+    "Add",
+    "AveragePooling2D",
+    "BatchNormalization",
+    "Concatenate",
+    "Conv2D",
+    "Dense",
+    "Dropout",
+    "Embedding",
+    "Flatten",
+    "Input",
+    "Layer",
+    "LayerNormalization",
+    "MaxPooling2D",
+    "Model",
+    "Multiply",
+    "Sequential",
+]

@@ -996,6 +996,20 @@ class FFModel:
                     f"model has {len(self._input_order)} inputs, got {len(xs)}"
                 )
             arrays = dict(zip(self._input_order, xs))
+        # coerce each input to its declared dtype (embedding ids arriving
+        # as floats from generic loaders / the C ABI's single float
+        # buffer, flexflow_c.h fit)
+        if self.executor is not None:
+            shapes = self.executor.input_shapes()
+            for name, arr in arrays.items():
+                want = shapes.get(name)
+                if want is None or want.dtype.value not in (
+                    "float32", "int32", "int64", "float64", "bool",
+                ):
+                    continue  # bf16/f16 inputs: numpy has no such dtype
+                np_dt = np.dtype(want.dtype.value)
+                if getattr(arr, "dtype", None) != np_dt:
+                    arrays[name] = np.asarray(arr).astype(np_dt)
         arrays["label"] = y
         return arrays
 

@@ -17,3 +17,31 @@ import jax  # noqa: E402
 # The axon TPU plugin overrides JAX_PLATFORMS; the config knob wins.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+import shutil
+import subprocess
+import sys as _sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def has_c_toolchain() -> bool:
+    return shutil.which("gcc") is not None and shutil.which("make") is not None
+
+
+def build_capi_lib():
+    """Build libflexflow_c once per session (shared by test_capi and
+    test_capi_client; keeping one make recipe avoids drift)."""
+    build = subprocess.run(
+        [
+            "make",
+            "-C",
+            os.path.join(_ROOT, "native"),
+            f"PYTHON={_sys.executable}",  # embed THIS interpreter's Python
+            "capi",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert build.returncode == 0, build.stderr

@@ -15,25 +15,12 @@ import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+from tests.conftest import build_capi_lib as _build_lib
+from tests.conftest import has_c_toolchain
+
 pytestmark = pytest.mark.skipif(
-    shutil.which("gcc") is None or shutil.which("make") is None,
-    reason="no C toolchain",
+    not has_c_toolchain(), reason="no C toolchain"
 )
-
-
-def _build_lib():
-    build = subprocess.run(
-        [
-            "make",
-            "-C",
-            os.path.join(ROOT, "native"),
-            f"PYTHON={sys.executable}",  # embed THIS interpreter's Python
-            "capi",
-        ],
-        capture_output=True,
-        text=True,
-    )
-    assert build.returncode == 0, build.stderr
 
 
 def _compile_and_run(tmp_path, source: str, exe_name: str) -> str:

@@ -306,3 +306,24 @@ def test_keras_same_padding_shapes():
     m.compile(optimizer="sgd", loss="mse", metrics=[], batch_size=4)
     sink = m.ffmodel.graph.nodes[m.ffmodel.graph.sinks()[0]]
     assert sink.output_shapes[0].logical_sizes == (4, 8, 8, 4)
+
+
+def test_keras_exp_functional_fit():
+    """keras_exp import surface (reference: flexflow/keras_exp — the
+    experimental functional-API twin) drives the same engine."""
+    import numpy as np
+
+    from flexflow_tpu.frontends import keras_exp as keras
+
+    x = keras.Input(shape=(12,))
+    t = keras.Dense(32, activation="relu")(x)
+    t2 = keras.Dense(32, activation="relu")(t)
+    merged = keras.Add()(t, t2)
+    out = keras.Dense(4)(merged)
+    model = keras.Model(x, out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.05), batch_size=16)
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 12).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int32)
+    hist = model.fit(X, y, epochs=2, verbose=False)
+    assert np.isfinite(hist[-1]["loss_sum"])
