@@ -25,6 +25,8 @@ from flexflow_tpu.models import build_alexnet  # noqa: E402
 
 def main():
     cfg = FFConfig.parse_args()
+    if cfg.dataset_path:  # -d/--dataset (reference: dataset_path)
+        os.environ["FF_DATASETS_DIR"] = cfg.dataset_path
     ff = FFModel(cfg)
     # CIFAR-10 images upscaled to the reference's 229x229 input
     # (alexnet.cc:58); NHWC layout.

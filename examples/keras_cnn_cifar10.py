@@ -41,6 +41,8 @@ def build(cfg: FFConfig):
 
 def main():
     cfg = FFConfig.parse_args()
+    if cfg.dataset_path:  # -d/--dataset (reference: dataset_path)
+        os.environ["FF_DATASETS_DIR"] = cfg.dataset_path
     n = cfg.batch_size * (cfg.iterations or 4)
     (x_train, y_train), _ = load_cifar10(n_train=n, n_test=max(cfg.batch_size, 1))
     x = (x_train.astype(np.float32) / 255.0)[:n]

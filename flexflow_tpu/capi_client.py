@@ -37,19 +37,14 @@ _LIB: Optional[ctypes.CDLL] = None
 
 def _lib_path() -> str:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    cands = [
-        os.path.join(root, "native", "build", "libflexflow_c.so"),
-        os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "native",
-            "libflexflow_c.so",
-        ),  # packaged wheel location
-    ]
-    for c in cands:
-        if os.path.exists(c):
-            return c
+    # the wheel ships only libffnative.so; the C API lib stays a
+    # `make -C native capi` target (setup.py), so a source checkout is
+    # the one supported location
+    path = os.path.join(root, "native", "build", "libflexflow_c.so")
+    if os.path.exists(path):
+        return path
     raise FileNotFoundError(
-        f"libflexflow_c.so not found (looked in {cands}); build it with "
+        f"libflexflow_c.so not found at {path}; build it with "
         "`make -C native capi`"
     )
 
@@ -155,6 +150,11 @@ class CModel:
     reference: flexflow_cffi.py:815 FFModel)."""
 
     def __init__(self, batch_size: int = 64, extra_args: Sequence[str] = ()):
+        # initialize handle slots BEFORE any C call: a failing create must
+        # leave close()/__del__ able to release what was allocated
+        self.config = None
+        self.model = None
+        self._tensors = []
         self.lib = load_library()
         argc, argv = _argv(["capi_client", "-b", str(batch_size), *extra_args])
         self.config = self.lib.flexflow_config_create(argc, argv)
@@ -163,7 +163,6 @@ class CModel:
         self.model = self.lib.flexflow_model_create(self.config)
         if not self.model:
             raise RuntimeError("flexflow_model_create failed")
-        self._tensors = []
 
     def close(self):
         """Release the C handles (each is a new PyObject reference owned

@@ -26,6 +26,13 @@ class FFConfig:
     learning_rate: float = 0.01
     weight_decay: float = 0.0001
     iterations: Optional[int] = None
+    # -p/--print-freq: metric print cadence in iterations (reference:
+    # FFConfig.printFreq, model.cc:3563; 0 = per-epoch only). Printing
+    # forces a device sync, so the loop only pays it on schedule.
+    print_freq: int = 0
+    # -d/--dataset: dataset directory (reference: dataset_path,
+    # model.cc:3567); keras_datasets honors it like FF_DATASETS_DIR
+    dataset_path: str = ""
 
     # sparse embedding-table updates (beyond-reference: the reference's
     # embedding bwd scatter-adds into a DENSE weight-grad region,
@@ -133,6 +140,10 @@ class FFConfig:
                 cfg.weight_decay = float(take())
             elif a in ("-i", "--iterations"):
                 cfg.iterations = int(take())
+            elif a in ("-p", "--print-freq"):
+                cfg.print_freq = int(take())
+            elif a in ("-d", "--dataset"):
+                cfg.dataset_path = take()
             elif a == "--budget" or a == "--search-budget":
                 cfg.search_budget = int(take())
             elif a == "--alpha" or a == "--search-alpha":

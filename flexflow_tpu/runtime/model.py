@@ -961,6 +961,15 @@ class FFModel:
                 else:
                     samples += len(next(iter(np_batch.values())))
                 step_results.append((loss, mets))
+                pf = self.config.print_freq
+                if verbose and pf > 0 and (it + 1) % pf == 0:
+                    # reference: metrics printed every printFreq iterations
+                    # (model.cc printFreq); float() syncs, so only paid on
+                    # the requested cadence
+                    print(
+                        f"iter {it + 1}/{loader.num_batches}: "
+                        f"loss = {float(loss):.4f}"
+                    )
             jax.block_until_ready(self.params)
             elapsed = time.perf_counter() - t0
             for loss, mets in step_results:

@@ -30,9 +30,13 @@ def has_c_toolchain() -> bool:
     return shutil.which("gcc") is not None and shutil.which("make") is not None
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
 def build_capi_lib():
-    """Build libflexflow_c once per session (shared by test_capi and
-    test_capi_client; keeping one make recipe avoids drift)."""
+    """Build libflexflow_c once per session (cached; shared by test_capi
+    and test_capi_client — keeping one make recipe avoids drift)."""
     build = subprocess.run(
         [
             "make",

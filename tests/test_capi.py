@@ -7,9 +7,7 @@ block trained with the reference's training-loop + dataloader + metrics
 verbs (capi_attention.c)."""
 
 import os
-import shutil
 import subprocess
-import sys
 
 import pytest
 

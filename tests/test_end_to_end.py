@@ -92,3 +92,24 @@ def test_conv_model_compiles_and_steps():
     y_data = rng.randint(0, 10, 32).astype(np.int32)
     hist = model.fit(x_data, y_data, epochs=1, verbose=False)
     assert hist[0]["iterations"] == 2
+
+
+def test_print_freq_prints_iteration_metrics(capsys):
+    """-p/--print-freq (reference: FFConfig.printFreq, model.cc:3563)."""
+    import numpy as np
+
+    from flexflow_tpu import LossType, SGDOptimizer
+
+    model = make_mlp()[0]
+    model.config.print_freq = 2
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 16).astype(np.float32)
+    y = rng.randint(0, 4, 128).astype(np.int32)
+    model.fit(x, y, epochs=1, verbose=True)
+    out = capsys.readouterr().out
+    assert "iter 2/" in out and "iter 4/" in out and "iter 3/" not in out
