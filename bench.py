@@ -42,7 +42,7 @@ def main():
         num_layers=layers,
     )
     batch = model.executor.shard_batch(synthetic_batch(batch_size, seq, hidden))
-    per_step = measure_train_step(model, batch)
+    per_step = measure_train_step(model, batch, reps=8, rep_sleep_s=2.0)
     thpt = batch_size / per_step
 
     print(

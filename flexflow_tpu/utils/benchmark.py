@@ -18,7 +18,9 @@ from __future__ import annotations
 import time
 
 
-def _adaptive_differenced(make_chain, run_args, n1, n2, reps, cap=20000):
+def _adaptive_differenced(
+    make_chain, run_args, n1, n2, reps, cap=20000, rep_sleep_s=0.0
+):
     """Differenced timing with the adaptive-window guard: grow the chain
     until the differenced window dominates the tunnel's per-call jitter
     (sub-ms steps — e.g. the sparse-embedding DLRM at ~26 us — sit below
@@ -32,6 +34,10 @@ def _adaptive_differenced(make_chain, run_args, n1, n2, reps, cap=20000):
         _ = float(np.asarray(r2(*run_args)))
         best = float("inf")
         for _i in range(reps):
+            if rep_sleep_s and _i:
+                # tunnel/chip contention comes in seconds-long bursts;
+                # spacing the reps lets min() catch a clean window
+                time.sleep(rep_sleep_s)
             t0 = time.perf_counter()
             _ = float(np.asarray(r1(*run_args)))
             t1 = time.perf_counter()
@@ -47,7 +53,10 @@ def _adaptive_differenced(make_chain, run_args, n1, n2, reps, cap=20000):
         n2 *= 10
 
 
-def measure_train_step(model, batch, n1: int = 5, n2: int = 20, reps: int = 6):
+def measure_train_step(
+    model, batch, n1: int = 5, n2: int = 20, reps: int = 6,
+    rep_sleep_s: float = 0.0,
+):
     """Differenced per-train-step seconds via on-device lax.scan chains.
 
     `batch` must already be sharded (executor.shard_batch)."""
@@ -71,7 +80,8 @@ def measure_train_step(model, batch, n1: int = 5, n2: int = 20, reps: int = 6):
         return run
 
     return _adaptive_differenced(
-        chain, (model.params, model.opt_state), n1, n2, reps
+        chain, (model.params, model.opt_state), n1, n2, reps,
+        rep_sleep_s=rep_sleep_s,
     )
 
 
