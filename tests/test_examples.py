@@ -19,6 +19,7 @@ EXAMPLES = [
     "dlrm",
     "inception",
     "keras_cnn_cifar10",
+    "longctx_transformer",
     "mlp",
     "moe",
     "mt5_encoder",
@@ -88,3 +89,9 @@ def test_full_workflow_runs(capsys):
     """search -> export -> import -> train -> checkpoint -> resume."""
     _run_main("full_workflow", ["-b", "64", "--budget", "10"])
     assert "WORKFLOW OK" in capsys.readouterr().out
+
+
+def test_longctx_transformer_runs_small():
+    """The long-context example at a CPU-suite-sized sequence (the real
+    seq-8192 run needs the chip; BASELINE.md records it)."""
+    _run_main("longctx_transformer", ["--seq", "256", "-b", "2", "-i", "1", "-e", "1"])
