@@ -150,7 +150,10 @@ def _auto_flash(batch, heads, sq, sk, ctx=None) -> bool:
             sq //= max(1, logical[1].degree)
             if rep:  # head-parallel replica degree shards the heads
                 heads //= max(1, rep[0].degree)
-    return batch * heads * sq * sk * 4 > _FLASH_SCORE_BYTES
+    # >= : a score tensor exactly AT the threshold must already
+    # take the streaming path (a 2 GiB materialization is the
+    # failure mode, not the last safe point)
+    return batch * heads * sq * sk * 4 >= _FLASH_SCORE_BYTES
 
 
 def _lower_mha(params):

@@ -1,0 +1,117 @@
+"""End-to-end CNN alignment vs torch through the fx importer — the
+conv-net counterpart of tests/test_mt5_alignment.py (reference: align/
+per-op harness; nothing in the reference aligns a COMPOSED conv net).
+Exercises the seams per-op checks cannot: the NCHW→NHWC boundary
+transpose, conv→bn→relu chains, a residual add across them, pooling,
+flatten back to NCHW-flat order, and the dense head — fwd and bwd."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+BATCH, C, HW, CLASSES = 2, 3, 16, 5
+
+
+class SmallResNet(nn.Module):
+    """conv-bn-relu stem, one residual block, pool, linear head."""
+
+    def __init__(self):
+        super().__init__()
+        self.stem = nn.Conv2d(C, 8, 3, stride=1, padding=1)
+        self.bn1 = nn.BatchNorm2d(8)
+        self.relu = nn.ReLU()
+        self.conv2 = nn.Conv2d(8, 8, 3, padding=1)
+        self.bn2 = nn.BatchNorm2d(8)
+        self.pool = nn.MaxPool2d(2, 2)
+        self.head = nn.Linear(8 * (HW // 2) * (HW // 2), CLASSES)
+
+    def forward(self, x):
+        t = self.relu(self.bn1(self.stem(x)))
+        r = self.bn2(self.conv2(t))
+        t = self.relu(t + r)  # residual across the conv-bn chain
+        t = self.pool(t)
+        t = torch.flatten(t, 1)
+        return self.head(t)
+
+
+@pytest.fixture(scope="module")
+def aligned():
+    torch.manual_seed(0)
+    # train() so torch BN uses BATCH statistics (this framework's BN has
+    # no running stats, matching the reference's training-mode math)
+    tm = SmallResNet().train()
+
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+    pm = PyTorchModel(tm)
+    ff = FFModel(FFConfig(batch_size=BATCH))
+    x = ff.create_tensor([BATCH, C, HW, HW], name="x")  # torch NCHW
+    out = pm.apply(ff, [x])
+    ff.compile(
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+        logits=out,
+    )
+    pm.copy_weights(ff)
+
+    rng = np.random.RandomState(0)
+    xin = rng.randn(BATCH, C, HW, HW).astype(np.float32)
+    labels = rng.randn(BATCH, CLASSES).astype(np.float32)
+    return tm, pm, ff, xin, labels
+
+
+def test_cnn_forward_alignment(aligned):
+    tm, pm, ff, xin, labels = aligned
+    got = np.asarray(ff.forward({"x": xin}))
+    want = tm(torch.from_numpy(xin)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_cnn_backward_alignment(aligned):
+    tm, pm, ff, xin, labels = aligned
+
+    tm.zero_grad()
+    t_out = tm(torch.from_numpy(xin))
+    loss = nn.functional.mse_loss(t_out, torch.from_numpy(labels))
+    loss.backward()
+
+    grads = ff.compute_gradients({"x": xin}, labels)
+    mods = dict(tm.named_modules())
+
+    checked = 0
+    for spec in pm.ops:
+        tgt = spec["params"].get("module")
+        if tgt is None or spec["name"] not in pm.node_map:
+            continue
+        m = mods[tgt]
+        g = grads[pm.node_map[spec["name"]]]
+        if spec["op"] == "conv2d":
+            np.testing.assert_allclose(
+                np.transpose(g[0], (3, 2, 0, 1)),  # HWIO -> OIHW
+                m.weight.grad.numpy(),
+                rtol=2e-3,
+                atol=1e-5,
+                err_msg=f"conv {tgt} weight grad",
+            )
+            checked += 1
+        elif spec["op"] == "batch_norm":
+            np.testing.assert_allclose(
+                g[0], m.weight.grad.numpy(), rtol=2e-3, atol=1e-5,
+                err_msg=f"bn {tgt} gamma grad",
+            )
+            np.testing.assert_allclose(
+                g[1], m.bias.grad.numpy(), rtol=2e-3, atol=1e-5,
+                err_msg=f"bn {tgt} beta grad",
+            )
+            checked += 1
+        elif spec["op"] == "linear":
+            np.testing.assert_allclose(
+                g[0].T, m.weight.grad.numpy(), rtol=2e-3, atol=1e-5,
+                err_msg=f"linear {tgt} weight grad",
+            )
+            checked += 1
+    assert checked >= 5  # 2 convs + 2 bns + head
