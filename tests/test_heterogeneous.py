@@ -306,3 +306,33 @@ def test_embedding_site_apply_shapes():
         n for n in g.nodes.values() if n.op_type == OperatorType.EMBEDDING
     )
     assert emb.weight_shapes[0].dims[1].degree == 4
+
+
+def test_mixed_strategy_checkpoint_restores_into_dp(tmp_path):
+    """Checkpoints written under the mixed heterogeneous strategy must
+    restore into a plain data-parallel compile (cross-strategy restore is
+    the round-1 checkpoint contract; mixed adds parallel-op nodes but
+    weight guids are stable)."""
+    from flexflow_tpu.parallel.strategy import data_parallel_strategy
+
+    data, y = _dlrm_batch()
+    m1 = dlrm_like()
+    m1.config.enable_substitution = False
+    strategy = result_to_strategy(_mixed_result(m1), m1.graph, 8)
+    _compile(m1, strategy)
+    m1.fit(data, y, epochs=1, verbose=False)
+    ckpt = str(tmp_path / "ckpt")
+    m1.save_checkpoint(ckpt, step=0)
+
+    m2 = dlrm_like()
+    m2.config.enable_substitution = False
+    _compile(m2, data_parallel_strategy(1, m2.graph))
+    m2.restore_checkpoint(ckpt)
+    for guid, ws in m1.params.items():
+        for i, w in enumerate(ws):
+            np.testing.assert_allclose(
+                np.asarray(w, np.float32),
+                np.asarray(m2.params[guid][i], np.float32),
+                rtol=1e-6,
+                err_msg=f"weight {guid}[{i}] after cross-strategy restore",
+            )

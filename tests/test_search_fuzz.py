@@ -62,7 +62,7 @@ def random_model(seed: int):
 
 
 @pytest.mark.parametrize("seed", range(8))
-@pytest.mark.parametrize("engine", ["mesh", "unity"])
+@pytest.mark.parametrize("engine", ["mesh", "unity", "mcmc"])
 def test_random_graph_survives_search_and_training(seed, engine):
     m, data, y = random_model(seed)
     m.config.search_budget = 8
