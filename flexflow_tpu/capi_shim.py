@@ -222,12 +222,11 @@ def optimizer_set_lr(handle: OptHandle, lr: float):
     handle.opt = dataclasses.replace(handle.opt, **{field: lr})
     for model in handle.models:
         if model.executor is not None:
-            # already compiled: swap the optimizer into the executor and
-            # drop the cached jitted step so the next iteration re-traces
-            # with the new LR (state structure is unchanged)
-            model.optimizer = handle.opt
-            model.executor.optimizer = handle.opt
-            model.executor._train_step = None
+            # already compiled: route through the one LR-mutation path
+            # (FFModel.set_learning_rate handles the field dispatch and
+            # jitted-step invalidation)
+            model.set_learning_rate(lr)
+            handle.opt = model.optimizer
 
 
 def model_set_optimizer(model, handle: OptHandle):

@@ -1162,6 +1162,25 @@ class FFModel:
             },
         )
 
+    def set_learning_rate(self, lr: float):
+        """Mid-training LR change (reference: SGDOptimizer::set_lr /
+        flexflow_sgd_optimizer_set_lr — the LR-decay pattern its examples
+        use between epochs). The optimizer dataclass is frozen, so the
+        model rebinds a replaced copy and drops the cached jitted step;
+        optimizer STATE (momentum, Adam moments) is structure-compatible
+        and survives."""
+        import dataclasses as _dc
+
+        from flexflow_tpu.runtime.optimizer import AdamOptimizer
+
+        if self.optimizer is None:
+            raise RuntimeError("call compile() before set_learning_rate()")
+        field = "alpha" if isinstance(self.optimizer, AdamOptimizer) else "lr"
+        self.optimizer = _dc.replace(self.optimizer, **{field: lr})
+        if self.executor is not None:
+            self.executor.optimizer = self.optimizer
+            self.executor._train_step = None
+
     def restore_checkpoint(self, directory: str, step: Optional[int] = None) -> int:
         """Load training state (latest step by default); returns the step.
 

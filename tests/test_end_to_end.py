@@ -113,3 +113,28 @@ def test_print_freq_prints_iteration_metrics(capsys):
     model.fit(x, y, epochs=1, verbose=True)
     out = capsys.readouterr().out
     assert "iter 2/" in out and "iter 4/" in out and "iter 3/" not in out
+
+
+def test_set_learning_rate_mid_training():
+    """reference: SGDOptimizer::set_lr — LR decay between epochs."""
+    import numpy as np
+
+    from flexflow_tpu import LossType, SGDOptimizer
+
+    model = make_mlp()[0]
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 16).astype(np.float32)
+    y = rng.randint(0, 4, 64).astype(np.int32)
+    model.fit(x, y, epochs=1, verbose=False)
+    before = {g: [np.asarray(w).copy() for w in ws] for g, ws in model.params.items()}
+    model.set_learning_rate(0.0)  # zero LR: weights must stop moving
+    assert model.optimizer.lr == 0.0
+    model.fit(x, y, epochs=1, verbose=False)
+    for g, ws in model.params.items():
+        for i, w in enumerate(ws):
+            np.testing.assert_array_equal(before[g][i], np.asarray(w))
