@@ -224,9 +224,9 @@ def optimizer_set_lr(handle: OptHandle, lr: float):
         if model.executor is not None:
             # already compiled: route through the one LR-mutation path
             # (FFModel.set_learning_rate handles the field dispatch and
-            # jitted-step invalidation)
+            # jitted-step invalidation); handle.opt was already updated
+            # above and stays authoritative
             model.set_learning_rate(lr)
-            handle.opt = model.optimizer
 
 
 def model_set_optimizer(model, handle: OptHandle):

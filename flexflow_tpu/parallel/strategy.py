@@ -130,9 +130,11 @@ def sequence_parallel_strategy(
         dp,
         sp,
         seq_axis,
-        # a real sequence dim has a trailing feature dim after it; plain
-        # [b, features] inputs must not be seq-sharded
-        lambda shape: shape.ndim > seq_axis + 1,
+        # a real sequence is rank-3 [batch, seq, features]; rank-4 images
+        # belong to the SPATIAL family (--enable-attribute-parallel), not
+        # here — without this split the search's "seq" candidates quietly
+        # shard image H dims and the two families double-count
+        lambda shape: shape.ndim == seq_axis + 2,
         f"dp{dp}xsp{sp}",
     )
 
@@ -309,6 +311,37 @@ def choose_strategy(model, num_devices: int) -> Strategy:
 
         return load_strategy(cfg.import_strategy_file, model.graph, num_devices)
     if cfg.only_data_parallel or cfg.search_budget <= 0:
+        if (
+            cfg.enable_parameter_parallel
+            and not cfg.only_data_parallel
+            and num_devices > 1
+        ):
+            # --enable-parameter-parallel without a search budget: shard
+            # the embedding tables over the devices deterministically
+            # (the reference's DLRM usage — embedding.cc weight sharding
+            # driven by the flag + strategy files, no search needed) and
+            # keep everything else full-width data-parallel
+            from flexflow_tpu.search.rewrites import (
+                EmbeddingSite,
+                find_tp_sites,
+            )
+
+            sites = [
+                s
+                for s in find_tp_sites(model.graph)
+                if isinstance(s, EmbeddingSite)
+                and s.divisible_by(model.graph, num_devices)
+            ]
+            if sites:
+                s = mixed_site_strategy(
+                    model.graph,
+                    num_devices,
+                    num_devices,
+                    sites,
+                    name_prefix="parameter-parallel",
+                )
+                if "mixed" in s.name:
+                    return s
         return data_parallel_strategy(num_devices, model.graph)
     from flexflow_tpu.search.auto import search_strategy
 

@@ -70,32 +70,56 @@ def _mesh_factorizations(num_devices: int) -> List[Tuple[int, int]]:
     return out
 
 
-def _seq_candidate(
-    base: PCGGraph, dp: int, sp: int, cm: CostModel, spec
+def _second_axis_candidate(
+    base: PCGGraph, strategy, dp: int, deg: int, cm: CostModel, spec
 ) -> Optional[GraphCost]:
-    """Cost a (dp, sp) sequence-parallel mesh: inputs' seq dim sharded on
-    axis 1; attention pays the ring-exchange term (CostModel.op_cost)."""
-    from flexflow_tpu.parallel.strategy import sequence_parallel_strategy
+    """Cost a (dp, <axis>) mesh strategy (seq or spatial): the second
+    axis must actually shard some input dim, else this is pure dp on a
+    bigger mesh (idle chips) — never profitable, skip."""
     from flexflow_tpu.runtime.executor import propagate_shapes
 
     g = base.copy()
     try:
-        sequence_parallel_strategy(dp, sp).apply(g)
+        strategy.apply(g)
         propagate_shapes(g)
     except (ValueError, KeyError):
         return None
-    # the seq axis must actually shard something, else this is pure dp
-    # on a bigger mesh (idle chips) — never profitable, skip
     sharded = any(
-        d.degree == sp and d.parallel_idx == 1
+        d.degree == deg and d.parallel_idx == 1
         for n in g.nodes.values()
         if n.op_type == OperatorType.INPUT
         for d in n.output_shapes[0].dims
     )
     if not sharded:
         return None
-    cost = estimate_graph_cost(g, cm, (dp, sp))
+    cost = estimate_graph_cost(g, cm, (dp, deg))
     return cost if cost.feasible(spec) else None
+
+
+def _seq_candidate(
+    base: PCGGraph, dp: int, sp: int, cm: CostModel, spec
+) -> Optional[GraphCost]:
+    """Cost a (dp, sp) sequence-parallel mesh: inputs' seq dim sharded on
+    axis 1; attention pays the ring-exchange term (CostModel.op_cost)."""
+    from flexflow_tpu.parallel.strategy import sequence_parallel_strategy
+
+    return _second_axis_candidate(
+        base, sequence_parallel_strategy(dp, sp), dp, sp, cm, spec
+    )
+
+
+def _spatial_candidate(
+    base: PCGGraph, dp: int, hp: int, cm: CostModel, spec
+) -> Optional[GraphCost]:
+    """Cost a (dp, spatial) mesh: image inputs' H dim sharded on axis 1;
+    convs ride GSPMD's windowed-op halo exchange (reference:
+    --enable-attribute-parallel, model.cc:3602 — partition non-sample
+    activation dims)."""
+    from flexflow_tpu.parallel.strategy import spatial_parallel_strategy
+
+    return _second_axis_candidate(
+        base, spatial_parallel_strategy(dp, hp), dp, hp, cm, spec
+    )
 
 
 def _pipeline_candidate(
@@ -191,7 +215,7 @@ def _mixed_candidate(
 
 class SearchResult:
     """One searched configuration. kind ∈ {"tp", "seq", "pipeline",
-    "mixed"}: which parallel axis family the second mesh axis carries
+    "mixed", "spatial"}: which parallel axis family the second mesh axis carries
     (VERDICT r1 item 2 — the search explores pp/sp/ep, not just dp×tp;
     ep rides the "tp" kind through ExpertParallelSite on the model axis;
     "mixed" is the heterogeneous per-op lowering, VERDICT r1 item 8)."""
@@ -217,6 +241,11 @@ class SearchResult:
             return (
                 f"mesh(data={self.dp}, seq={self.extra['sp']}), ring "
                 f"attention, simulated step {self.cost.step_time * 1e3:.3f} ms"
+            )
+        if self.kind == "spatial":
+            return (
+                f"mesh(data={self.dp}, spatial={self.extra['hp']}), "
+                f"simulated step {self.cost.step_time * 1e3:.3f} ms"
             )
         if self.kind == "pipeline":
             return (
@@ -244,6 +273,7 @@ def optimize(
     machine_model=None,
     mixed_precision: bool = False,
     calibration_file: str = "",
+    attribute_parallel: bool = False,
 ) -> SearchResult:
     """Run the search on a PCG; returns the best found configuration."""
     cm = CostModel(
@@ -349,6 +379,24 @@ def optimize(
         if best is None or cost.step_time < best.cost.step_time:
             best = cur
 
+    # attribute/spatial candidates: image H over the second axis
+    # (reference: --enable-attribute-parallel opt-in, model.cc:3602)
+    if attribute_parallel:
+        for dp, hp in _mesh_factorizations(num_devices):
+            if hp == 1:
+                continue
+            evals += 1
+            cost = _spatial_candidate(graph, dp, hp, cm, spec)
+            if cost is None:
+                continue
+            cur = SearchResult(
+                dp, 1, [], [], cost, kind="spatial", extra={"hp": hp}
+            )
+            if verbose:
+                print(f"[search] {cur.describe()}")
+            if best is None or cost.step_time < best.cost.step_time:
+                best = cur
+
     # pipeline candidates: (dp, pipe) meshes over a repeated-block trunk
     # (reference declares OP_PIPELINE only, ffconst.h:151)
     from flexflow_tpu.search.blocks import find_block_structure
@@ -423,6 +471,12 @@ def result_to_strategy(result: SearchResult, graph: PCGGraph) -> Strategy:
         )
     if result.kind == "seq":
         s = sequence_parallel_strategy(result.dp, result.extra["sp"], graph)
+        s.name = f"{prefix}: {s.name}"
+        return s
+    if result.kind == "spatial":
+        from flexflow_tpu.parallel.strategy import spatial_parallel_strategy
+
+        s = spatial_parallel_strategy(result.dp, result.extra["hp"], graph)
         s.name = f"{prefix}: {s.name}"
         return s
     if result.kind == "pipeline":
@@ -522,6 +576,7 @@ def search_strategy(model, num_devices: int) -> Strategy:
         mixed_precision=cfg.allow_mixed_precision,
         measure=cfg.measure_costs,
         calibration_file=cfg.calibration_file,
+        attribute_parallel=cfg.enable_attribute_parallel,
     )
     print(f"[flexflow_tpu] search: best strategy = {result.describe()}")
     if cfg.export_strategy_file:

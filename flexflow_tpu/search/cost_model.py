@@ -228,6 +228,29 @@ class CostModel:
         # elementwise backward re-reads the same bytes.
         bwd = 2.0 * fwd if node.op_type in _MXU_OPS else fwd
 
+        # conv halo exchange under a partitioned spatial dim (attribute
+        # parallelism): each shard trades (kernel-1)/2 boundary rows with
+        # both neighbors per step — GSPMD's windowed-op halo — fwd and
+        # again (twice) for the input/weight gradients. Without this term
+        # spatial splits cost exactly compute/degree and the search is
+        # biased toward them.
+        if node.op_type == OperatorType.CONV2D and input_shapes:
+            x0 = input_shapes[0]
+            kh = int(node.params.get("kernel_h", 1))
+            for i, d in enumerate(x0.dims):
+                if d.is_replica_dim or d.degree <= 1 or i == 0:
+                    continue
+                if i == 1 and x0.ndim == 4 and kh > 1:  # H dim sharded
+                    w_piece = x0.dims[2].piece_size
+                    c = x0.dims[3].size
+                    b_piece = x0.dims[0].piece_size
+                    halo_bytes = (
+                        2 * (kh // 2) * b_piece * w_piece * c
+                        * self.elem_bytes(x0)
+                    )
+                    fwd += self._ici_time(halo_bytes)
+                    bwd += 2.0 * self._ici_time(halo_bytes)
+
         # ring attention under a partitioned sequence dim: each device
         # passes its K/V block around the ring (sp-1) times forward and
         # roughly twice that backward (dK/dV return trip) — the TPU

@@ -77,6 +77,29 @@ def save_strategy(strategy: Strategy, path: str):
         json.dump(doc, f, indent=2)
 
 
+def _check_second_axis_shards(strategy, graph: PCGGraph, deg: int, path: str):
+    """An imported (dp x <axis>) strategy whose second axis shards NOTHING
+    on this graph would silently idle those chips — the search path has
+    this exact guard (auto._second_axis_candidate); imports need it too."""
+    from flexflow_tpu.runtime.executor import propagate_shapes
+
+    g = graph.copy()
+    strategy.apply(g)
+    propagate_shapes(g)
+    from flexflow_tpu.core.types import OperatorType
+
+    if not any(
+        d.degree == deg and d.parallel_idx == 1
+        for n in g.nodes.values()
+        if n.op_type == OperatorType.INPUT
+        for d in n.output_shapes[0].dims
+    ):
+        raise ValueError(
+            f"strategy file {path!r}: the second mesh axis (degree {deg}) "
+            "shards no input of this graph — the strategy does not apply"
+        )
+
+
 def load_strategy(path: str, graph: PCGGraph, num_devices: int) -> Strategy:
     """Rebuild a Strategy from JSON against the current graph
     (reference: load_strategies_from_file + compile-time map lookup)."""
@@ -98,6 +121,21 @@ def load_strategy(path: str, graph: PCGGraph, num_devices: int) -> Strategy:
                 f"strategy file wants {dp * sp} devices, have {num_devices}"
             )
         s = sequence_parallel_strategy(dp, sp, graph)
+        if sp > 1:
+            _check_second_axis_shards(s, graph, sp, path)
+        s.name = f"imported:{path}"
+        return s
+    if kind == "spatial":
+        from flexflow_tpu.parallel.strategy import spatial_parallel_strategy
+
+        hp = int(extra.get("hp", 1))
+        if dp * hp > num_devices:
+            raise ValueError(
+                f"strategy file wants {dp * hp} devices, have {num_devices}"
+            )
+        s = spatial_parallel_strategy(dp, hp, graph)
+        if hp > 1:
+            _check_second_axis_shards(s, graph, hp, path)
         s.name = f"imported:{path}"
         return s
     if kind == "pipeline":

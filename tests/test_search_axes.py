@@ -140,3 +140,64 @@ def test_tp_still_wins_where_it_should():
     m.dense(t, 8, name="head")
     result = optimize(m.graph, 8, SPEC, budget=5)
     assert result.kind == "tp"
+
+
+def test_enable_parameter_parallel_without_budget():
+    """--enable-parameter-parallel with NO search budget (the reference's
+    DLRM usage: table sharding from the flag alone, embedding.cc) shards
+    the embedding tables and keeps the MLPs full-width data-parallel."""
+    import numpy as np
+
+    from flexflow_tpu import DataType, FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.core.types import AggrMode, OperatorType
+
+    cfg = FFConfig(batch_size=64)
+    cfg.enable_parameter_parallel = True
+    cfg.enable_substitution = False
+    m = FFModel(cfg)
+    ids = m.create_tensor([64, 1], dtype=DataType.INT32, name="ids")
+    emb = m.embedding(ids, 100_000, 64, aggr=AggrMode.SUM)
+    dense_in = m.create_tensor([64, 16], name="dense_in")
+    t = m.dense(dense_in, 64)
+    t = m.concat([emb, t], axis=1)
+    m.dense(t, 2)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    assert "parameter-parallel" in m.strategy.name, m.strategy.name
+    for n in m.graph.nodes.values():
+        if n.op_type == OperatorType.EMBEDDING:
+            assert n.weight_shapes[0].dims[1].degree == 8
+    rng = np.random.RandomState(0)
+    data = {
+        "ids": rng.randint(0, 100_000, (64, 1)).astype(np.int32),
+        "dense_in": rng.randn(64, 16).astype(np.float32),
+    }
+    y = rng.randint(0, 2, (64,)).astype(np.int32)
+    hist = m.fit(data, y, epochs=1, verbose=False)
+    assert np.isfinite(hist[-1]["loss_sum"])
+
+
+def test_enable_attribute_parallel_spatial_candidates():
+    """--enable-attribute-parallel admits spatial (dp x hp) candidates:
+    with batch 4 on 8 devices pure dp idles half the chips, so the
+    search should pick a (4, 2) image-H split (reference: model.cc:3602)."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.auto import optimize, result_to_strategy
+
+    m = FFModel(FFConfig(batch_size=4))
+    x = m.create_tensor([4, 224, 224, 3], name="x")
+    t = m.conv2d(x, 64, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = m.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = m.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = m.pool2d(t, 8, 8, 8, 8)
+    t = m.flat(t)
+    m.dense(t, 10)
+    spec = MachineSpec(num_nodes=1, chips_per_node=8, chip="v5e")
+    r = optimize(m.graph, 8, spec, budget=8, attribute_parallel=True)
+    assert r.kind == "spatial", r.describe()
+    s = result_to_strategy(r, m.graph)
+    assert "hp" in s.name or "spatial" in s.name.lower(), s.name
