@@ -260,7 +260,10 @@ def mixed_site_strategy(
     tp = max(1, tp)
     dp = effective_dp_degree(graph, max(1, num_devices // tp))
     full = dp * tp
-    bracketable = {"linear_chain", "single_linear", "attention", "embedding"}
+    bracketable = {
+        "linear_chain", "single_linear", "attention", "embedding",
+        "conv_channel",
+    }
     if (
         tp == 1
         or effective_dp_degree(graph, full) != full

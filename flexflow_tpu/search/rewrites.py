@@ -162,12 +162,17 @@ class AttentionSite(Site):
 
 
 @dataclasses.dataclass(frozen=True)
-class SingleLinearSite(Site):
-    """A lone Linear: column-parallel, gather features after."""
+class _ColumnParallelSite(Site):
+    """Shared column-parallel bracket: Replicate the (single) input, let
+    the replica-dim protocol shard the op's width param over the model
+    axis, Combine gathers the last (feature/channel) output dim after.
+    Subclasses name the width param; one implementation means a protocol
+    fix lands everywhere at once."""
+
+    _WIDTH_PARAM = ""  # subclass sets
 
     def divisible_by(self, graph, tp):
-        node = graph.nodes[self.guids[0]]
-        return node.params["out_features"] % tp == 0
+        return graph.nodes[self.guids[0]].params[self._WIDTH_PARAM] % tp == 0
 
     def apply(self, graph, tp, axis):
         guid = self.guids[0]
@@ -180,7 +185,7 @@ class SingleLinearSite(Site):
             f"{node.name}.replicate",
             {"degree": tp, "parallel_idx": axis},
         )
-        # output feature dim comes out sharded (degree tp); Combine gathers it
+        # output feature/channel dim comes out sharded; Combine gathers it
         out_ndim = len(node.output_shapes[0].dims)
         _insert_after(
             graph,
@@ -192,36 +197,39 @@ class SingleLinearSite(Site):
 
 
 @dataclasses.dataclass(frozen=True)
-class EmbeddingSite(Site):
+class SingleLinearSite(_ColumnParallelSite):
+    """A lone Linear: column-parallel, gather features after."""
+
+    _WIDTH_PARAM = "out_features"
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvChannelSite(_ColumnParallelSite):
+    """One Conv2D: shard the OUTPUT-channel dim over the model axis
+    (reference: conv mapping xfers, create_mapping_xfers<Conv2D>,
+    substitution.cc:1789 — the conv analog of column-parallel Linear)."""
+
+    _WIDTH_PARAM = "out_channels"
+
+    def divisible_by(self, graph, tp):
+        node = graph.nodes[self.guids[0]]
+        groups = node.params.get("groups", 1)
+        # grouped convs: sharding across group boundaries is not
+        # partitionable (XLA SPMD aborts on it); tp must divide the groups
+        return (
+            node.params["out_channels"] % tp == 0
+            and (groups == 1 or groups % tp == 0)
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingSite(_ColumnParallelSite):
     """Model-parallel embedding: shard the table's embedding (out_dim)
     column dim over the model axis — the reference's key DLRM pattern
     ("embedding weight sharded or replicated", embedding.cc; DLRM
-    strategies shard tables while the MLPs stay data-parallel). Replicate
-    the ids, let the replica-dim protocol shard the table column-wise,
-    Combine gathers the feature dim after."""
+    strategies shard tables while the MLPs stay data-parallel)."""
 
-    def divisible_by(self, graph, tp):
-        return graph.nodes[self.guids[0]].params["out_dim"] % tp == 0
-
-    def apply(self, graph, tp, axis):
-        guid = self.guids[0]
-        node = graph.nodes[guid]
-        _insert_before(
-            graph,
-            guid,
-            node.inputs[0],
-            OperatorType.REPLICATE,
-            f"{node.name}.replicate",
-            {"degree": tp, "parallel_idx": axis},
-        )
-        out_ndim = len(node.output_shapes[0].dims)
-        _insert_after(
-            graph,
-            guid,
-            OperatorType.COMBINE,
-            f"{node.name}.combine",
-            {"axis": out_ndim - 1, "degree": tp},
-        )
+    _WIDTH_PARAM = "out_dim"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -271,6 +279,9 @@ def find_tp_sites(graph: PCGGraph) -> List[Site]:
             claimed.add(guid)
         elif node.op_type == OperatorType.EMBEDDING:
             sites.append(EmbeddingSite("embedding", (guid,)))
+            claimed.add(guid)
+        elif node.op_type == OperatorType.CONV2D:
+            sites.append(ConvChannelSite("conv_channel", (guid,)))
             claimed.add(guid)
         elif node.op_type == OperatorType.EXPERT_FFN:
             aggs = [

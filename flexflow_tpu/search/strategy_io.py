@@ -22,6 +22,7 @@ _SITE_KINDS = {}
 def _register_site_kinds():
     from flexflow_tpu.search.rewrites import (
         AttentionSite,
+        ConvChannelSite,
         EmbeddingSite,
         ExpertParallelSite,
         LinearChainSite,
@@ -31,6 +32,7 @@ def _register_site_kinds():
     _SITE_KINDS.update(
         {
             "attention": AttentionSite,
+            "conv_channel": ConvChannelSite,
             "embedding": EmbeddingSite,
             "expert_parallel": ExpertParallelSite,
             "linear_chain": LinearChainSite,

@@ -51,6 +51,7 @@ _CHANNEL_OPS = {
     OperatorType.LINEAR,
     OperatorType.MULTIHEAD_ATTENTION,
     OperatorType.EMBEDDING,
+    OperatorType.CONV2D,
 }
 
 
@@ -61,6 +62,8 @@ def _node_channel_size(node) -> Optional[int]:
         return node.params.get("num_heads")
     if node.op_type == OperatorType.EMBEDDING:
         return node.params.get("out_dim")
+    if node.op_type == OperatorType.CONV2D:
+        return node.params.get("out_channels")
     return None
 
 
@@ -237,6 +240,11 @@ class UnitySearch:
                     and params.get("out_dim", 0) % opt.ch == 0
                 ):
                     params["out_dim"] //= opt.ch
+                elif (
+                    node.op_type == OperatorType.CONV2D
+                    and params.get("out_channels", 0) % opt.ch == 0
+                ):
+                    params["out_channels"] //= opt.ch
                 else:
                     divide = opt.ch
             _, ws = infer_shapes(node.op_type, shard_ins, params)
@@ -269,9 +277,9 @@ class UnitySearch:
             data += sum(s.volume() * eb(s) for s in node.weight_shapes)
             t = self.cm._roofline(flops, data / n)
             if self.include_backward:
-                mxu = node.op_type in _CHANNEL_OPS or node.op_type in (
-                    OperatorType.CONV2D,
-                    OperatorType.BATCHMATMUL,
+                mxu = (
+                    node.op_type in _CHANNEL_OPS
+                    or node.op_type == OperatorType.BATCHMATMUL
                 )
                 t *= 3.0 if mxu else 2.0
         # gradient sync: weights are sharded ch ways and replicated across

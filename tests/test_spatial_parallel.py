@@ -80,3 +80,57 @@ def test_indivisible_spatial_dim_left_unsharded():
     strategy.apply(g)
     img = next(n for n in g.nodes.values() if n.name == "image")
     assert img.params["shape"].dims[1].degree == 1
+
+
+def test_conv_channel_site_numerics():
+    """Conv output-channel parallelism (ConvChannelSite — the conv analog
+    of column-parallel Linear, reference substitution.cc:1789): sharded
+    channels must reproduce the single-device math exactly."""
+    import numpy as np
+
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.core.types import OperatorType
+    from flexflow_tpu.parallel.strategy import mixed_site_strategy
+    from flexflow_tpu.search.rewrites import ConvChannelSite, find_tp_sites
+
+    def build():
+        cfg = FFConfig(batch_size=8, seed=3)
+        cfg.enable_substitution = False
+        m = FFModel(cfg)
+        x = m.create_tensor([8, 8, 8, 3], name="x")
+        t = m.conv2d(x, 16, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+        t = m.conv2d(t, 8, 3, 3, 1, 1, 1, 1)
+        t = m.flat(t)
+        m.dense(t, 4)
+        return m
+
+    rng = np.random.RandomState(0)
+    data = {"x": rng.randn(8, 8, 8, 3).astype(np.float32)}
+    y = rng.randint(0, 4, (8,)).astype(np.int32)
+
+    def compiled(strategy):
+        m = build()
+        m.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[],
+            strategy=strategy,
+        )
+        return m
+
+    base = build()
+    sites = [
+        s for s in find_tp_sites(base.graph) if isinstance(s, ConvChannelSite)
+    ]
+    assert len(sites) == 2  # both convs detected
+    m1 = compiled(None)  # data-parallel default
+    strategy = mixed_site_strategy(base.graph, 8, 4, sites)
+    m2 = compiled(strategy)
+    # kernels sharded on the out-channel dim
+    for n in m2.graph.nodes.values():
+        if n.op_type == OperatorType.CONV2D:
+            assert n.weight_shapes[0].dims[-1].degree == 4
+    h1 = m1.fit(data, y, epochs=2, verbose=False)
+    h2 = m2.fit(data, y, epochs=2, verbose=False)
+    for a, b in zip(h1, h2):
+        assert np.isclose(a["loss_sum"], b["loss_sum"], rtol=1e-4), (h1, h2)
