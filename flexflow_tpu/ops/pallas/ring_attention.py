@@ -43,7 +43,11 @@ def _local_ring_attention(q, k, v, axis_name: str, n_shards: int, causal: bool):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
-    q32 = q.astype(jnp.float32) * scale
+    # bf16 inputs keep bf16 MATMUL OPERANDS (MXU-native) with f32
+    # accumulation; f32 inputs stay f32 end-to-end for exactness (same
+    # scheme as the blockwise kernel, flash_attention.py)
+    cdt = q.dtype if q.dtype == jnp.bfloat16 else jnp.float32
+    qs = (q.astype(jnp.float32) * scale).astype(cdt)
     my_idx = lax.axis_index(axis_name)
     qpos = my_idx * sq + jnp.arange(sq)  # global query positions [sq]
 
@@ -58,7 +62,8 @@ def _local_ring_attention(q, k, v, axis_name: str, n_shards: int, causal: bool):
         src = jnp.mod(my_idx - t, n_shards)
         kpos = src * sk + jnp.arange(sk)  # global key positions [sk]
         logits = jnp.einsum(
-            "bqhd,bkhd->bhqk", q32, kc.astype(jnp.float32)
+            "bqhd,bkhd->bhqk", qs, kc.astype(cdt),
+            preferred_element_type=jnp.float32,
         )
         if causal:
             mask = kpos[None, None, None, :] <= qpos[None, None, :, None]
@@ -71,7 +76,8 @@ def _local_ring_attention(q, k, v, axis_name: str, n_shards: int, causal: bool):
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l = l * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
+            "bhqk,bkhd->bhqd", p.astype(cdt), vc.astype(cdt),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l, acc
 
