@@ -25,6 +25,13 @@ import numpy as np
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.core.types import ActiMode, DataType, LossType, MetricsType
+from flexflow_tpu.frontends import keras_callbacks as callbacks  # noqa: F401
+from flexflow_tpu.frontends.keras_callbacks import (  # noqa: F401
+    Callback,
+    EpochVerifyMetrics,
+    LearningRateScheduler,
+    VerifyMetrics,
+)
 from flexflow_tpu.runtime.model import FFModel
 from flexflow_tpu.runtime.optimizer import AdamOptimizer, SGDOptimizer
 
@@ -326,13 +333,26 @@ class Model:
             ],
         )
 
-    def fit(self, x, y, epochs=1, batch_size: Optional[int] = None, **kw):
+    def fit(self, x, y, epochs=1, batch_size: Optional[int] = None,
+            callbacks=None, **kw):
         if self.ffmodel is None:
             raise RuntimeError("call compile() first")
-        return self.ffmodel.fit(x, y, epochs=epochs, batch_size=batch_size, **kw)
+        for cb in callbacks or []:
+            # reference: base_model.py:374-377 — callbacks see the KERAS
+            # model (engine reachable as .ffmodel, keras/callbacks.py:69)
+            cb.set_model(self)
+        return self.ffmodel.fit(
+            x, y, epochs=epochs, batch_size=batch_size,
+            callbacks=callbacks, **kw,
+        )
 
-    def evaluate(self, x, y, batch_size: Optional[int] = None):
-        return self.ffmodel.evaluate(x, y, batch_size=batch_size)
+    def evaluate(self, x, y, batch_size: Optional[int] = None,
+                 callbacks=None):
+        for cb in callbacks or []:
+            cb.set_model(self)
+        return self.ffmodel.evaluate(
+            x, y, batch_size=batch_size, callbacks=callbacks
+        )
 
     def summary(self):
         if self.ffmodel is None:

@@ -1,0 +1,111 @@
+"""Keras training callbacks.
+
+Mirrors the reference's callback protocol (reference:
+python/flexflow/keras/callbacks.py:1-90 — Callback base with
+epoch/batch/train hooks, LearningRateScheduler driving
+optimizer.set_learning_rate per epoch, VerifyMetrics asserting final
+accuracy, EpochVerifyMetrics early-stopping when an accuracy target is
+reached) and the invocation points of BaseModel._train (reference:
+python/flexflow/keras/models/base_model.py:374-430 — set_model /
+on_train_begin / per-epoch / per-batch hooks, with a True return from
+on_epoch_end stopping training early).
+
+Callbacks work both through the keras frontend (`model` is the keras
+Model; the underlying engine is `model.ffmodel`) and directly on
+`FFModel.fit(callbacks=...)` (`model` IS the FFModel).
+"""
+
+from __future__ import annotations
+
+import numbers
+
+
+def _engine(model):
+    """The FFModel under a keras Model (or the FFModel itself)."""
+    return getattr(model, "ffmodel", None) or model
+
+
+class Callback:
+    """Hook protocol (reference: keras/callbacks.py:21-46)."""
+
+    def __init__(self):
+        self.validation_data = None
+        self.model = None
+        self.params = None
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, batch, logs=None):
+        pass
+
+    def on_batch_end(self, batch, logs=None):
+        pass
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+
+class LearningRateScheduler(Callback):
+    """Per-epoch LR schedule (reference: keras/callbacks.py:48-62 — the
+    schedule maps epoch -> float; non-float outputs are rejected)."""
+
+    def __init__(self, schedule):
+        super().__init__()
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        lr = self.schedule(epoch)
+        if isinstance(lr, bool) or not isinstance(lr, numbers.Real):
+            raise ValueError(
+                'The output of the "schedule" function should be float.'
+            )
+        eng = _engine(self.model)
+        eng.set_learning_rate(float(lr))
+        print("set learning rate ", float(lr))
+
+
+class VerifyMetrics(Callback):
+    """Assert the final training accuracy reaches a target (reference:
+    keras/callbacks.py:64-73). `accuracy` is a percentage or an enum with
+    a `.value` percentage (the reference's ModelAccuracy enums)."""
+
+    def __init__(self, accuracy):
+        super().__init__()
+        self.accuracy = getattr(accuracy, "value", accuracy)
+
+    def on_train_end(self, logs=None):
+        perf = _engine(self.model).get_perf_metrics()
+        accuracy = perf.get_accuracy()
+        assert accuracy >= self.accuracy, (
+            f"Accuracy is wrong: {accuracy:.2f} < {self.accuracy}"
+        )
+
+
+class EpochVerifyMetrics(Callback):
+    """Early-stop once an accuracy target is reached (reference:
+    keras/callbacks.py:75-90 — on_epoch_end returning True stops the
+    training loop, base_model.py:423-428)."""
+
+    def __init__(self, accuracy, early_stop=True):
+        super().__init__()
+        self.accuracy = getattr(accuracy, "value", accuracy)
+        self.early_stop = early_stop
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.early_stop:
+            return False
+        perf = _engine(self.model).get_perf_metrics()
+        return perf.get_accuracy() > self.accuracy

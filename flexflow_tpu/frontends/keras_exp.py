@@ -26,6 +26,11 @@ from flexflow_tpu.frontends.keras_api import (  # noqa: F401
     Activation,
     Adam,
     Add,
+    Callback,
+    EpochVerifyMetrics,
+    LearningRateScheduler,
+    VerifyMetrics,
+    callbacks,
     AveragePooling2D,
     BatchNormalization,
     Concatenate,

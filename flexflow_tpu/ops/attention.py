@@ -136,20 +136,82 @@ def _seq_parallel_axes(ctx):
 # switch is on PER-DEVICE score-tensor BYTES, not sequence length.
 _FLASH_SCORE_BYTES = 2 << 30
 
+# Below the flash threshold, dense attention is still kernel-bound by the
+# f32 score block's working set: on v5e the fwd+bwd goes superlinear once
+# [b, h, sq, sk] f32 exceeds ~VMEM (measured at the flagship shape
+# seq512/h16: bs8 0.997 ms -> bs16 2.66 ms -> bs32 5.16 ms monolithic,
+# vs 0.783 / 1.98 / 3.89 ms scanned over batch chunks whose score block
+# is ~67 MB; scripts/probe_attn_batch.py, probe_attn_chunked2.py). So the
+# dense path scans over batch chunks keeping the chunk's score block
+# under this cap. In the FULL train step (where XLA fuses attention with
+# its neighbors) the monolithic kernel still wins at bs8/134 MB
+# (interleaved A/B: 23.6 vs 25.7 ms — scripts/ab_attn_chunk.py), so the
+# scan only engages past _DENSE_MONO_SCORE_BYTES and then tiles to
+# chunks whose score block is <= _DENSE_CHUNK_SCORE_BYTES (the
+# measured-best 67 MB tile admits; the measured-worse 134 MB tile
+# rejects).
+_DENSE_MONO_SCORE_BYTES = 160 << 20
+_DENSE_CHUNK_SCORE_BYTES = 80 << 20
+
+
+def _dense_batch_chunk(batch, heads, sq, sk) -> int:
+    """Batch-chunk size for the dense path: `batch` (no scan) while the
+    monolithic score block stays under the mono cap, else the largest
+    divisor of `batch` whose per-chunk score block fits the chunk cap."""
+    if batch * heads * sq * sk * 4 <= _DENSE_MONO_SCORE_BYTES:
+        return batch
+    best = 1
+    for c in range(batch, 0, -1):
+        if batch % c == 0 and c * heads * sq * sk * 4 <= _DENSE_CHUNK_SCORE_BYTES:
+            best = c
+            break
+    return best
+
+
+def _chunked_dense_attention(q, k, v, causal, chunk):
+    """scaled_dot_product_attention scanned over batch chunks — bounds the
+    per-step f32 score working set (VMEM) without changing numerics."""
+    from jax import lax
+
+    b = q.shape[0]
+    n = b // chunk
+    qs = q.reshape(n, chunk, *q.shape[1:])
+    ks = k.reshape(n, chunk, *k.shape[1:])
+    vs = v.reshape(n, chunk, *v.shape[1:])
+
+    def body(_, blk):
+        qq, kk, vv = blk
+        return _, scaled_dot_product_attention(qq, kk, vv, causal=causal)
+
+    _, out = lax.scan(body, None, (qs, ks, vs))
+    return out.reshape(b, *q.shape[1:])
+
+
+def _q_degrees(ctx):
+    """Partition degrees of the q input's (batch, seq, heads) — heads via
+    the head-parallel replica dim. (1, 1, 1) when no parallel shape is
+    available. Under jit array shapes are GLOBAL; callers divide these out
+    to reason about per-device working sets."""
+    if ctx is None or not ctx.in_shapes:
+        return 1, 1, 1
+    qshape = ctx.in_shapes[0]
+    logical = [d for d in qshape.dims if not d.is_replica_dim]
+    rep = [d for d in qshape.dims if d.is_replica_dim]
+    if len(logical) != 3:
+        return 1, 1, 1
+    b_deg = max(1, logical[0].degree)
+    s_deg = max(1, logical[1].degree)
+    h_deg = max(1, rep[0].degree) if rep else 1
+    return b_deg, s_deg, h_deg
+
 
 def _auto_flash(batch, heads, sq, sk, ctx=None) -> bool:
-    # under jit the array shapes are GLOBAL; divide out the sharding so a
-    # data-parallel pod doesn't get blockwise where its per-chip slice is
-    # tiny (degrees come from the q input's parallel shape)
-    if ctx is not None and ctx.in_shapes:
-        qshape = ctx.in_shapes[0]
-        logical = [d for d in qshape.dims if not d.is_replica_dim]
-        rep = [d for d in qshape.dims if d.is_replica_dim]
-        if len(logical) == 3:
-            batch //= max(1, logical[0].degree)
-            sq //= max(1, logical[1].degree)
-            if rep:  # head-parallel replica degree shards the heads
-                heads //= max(1, rep[0].degree)
+    # divide out the sharding so a data-parallel pod doesn't get blockwise
+    # where its per-chip slice is tiny
+    b_deg, s_deg, h_deg = _q_degrees(ctx)
+    batch //= b_deg
+    sq //= s_deg
+    heads //= h_deg
     # >= : a score tensor exactly AT the threshold must already
     # take the streaming path (a 2 GiB materialization is the
     # failure mode, not the last safe point)
@@ -290,14 +352,33 @@ def _lower_mha(params):
                     use_lib=None if single else False,
                 )
             else:
-                attn = scaled_dot_product_attention(
-                    q,
-                    k,
-                    v,
-                    causal=causal,
-                    dropout_rate=dropout if dropping else 0.0,
-                    dropout_rng=ctx.rng if dropping else None,
+                # batch-chunked dense: only when the batch dim is unsharded
+                # (a scan cannot iterate a GSPMD-sharded leading axis) and
+                # no prob-dropout (keeps the rng path on the one-shot
+                # kernel); size the chunk by the PER-DEVICE score block, so
+                # seq/head sharding divides out like in _auto_flash
+                b_deg, s_deg, h_deg = _q_degrees(ctx)
+                chunk = (
+                    _dense_batch_chunk(
+                        q.shape[0],
+                        max(1, q.shape[2] // h_deg),
+                        max(1, seq // s_deg),
+                        k.shape[1],
+                    )
+                    if (b_deg == 1 and not dropping)
+                    else q.shape[0]
                 )
+                if chunk < q.shape[0]:
+                    attn = _chunked_dense_attention(q, k, v, causal, chunk)
+                else:
+                    attn = scaled_dot_product_attention(
+                        q,
+                        k,
+                        v,
+                        causal=causal,
+                        dropout_rate=dropout if dropping else 0.0,
+                        dropout_rng=ctx.rng if dropping else None,
+                    )
         attn_m, wo_m = mm_operands(ctx, attn, wo)
         y = jnp.einsum("bshd,hde->bse", attn_m, wo_m, **mm).astype(
             mm_out_dtype(ctx, dt)

@@ -84,12 +84,18 @@ class PerfMetrics:
         self.loss_sum += float(loss) * max(n, 1)
         self.iterations += 1
 
+    def get_accuracy(self) -> float:
+        """Training accuracy in percent (reference:
+        flexflow_per_metrics_get_accuracy, flexflow_cffi.py:2227 — the
+        value VerifyMetrics callbacks compare against their target)."""
+        return 100.0 * self.train_correct / max(self.train_all, 1)
+
     def report(self) -> str:
         n = max(self.train_all, 1)
         parts = [f"loss: {self.loss_sum / n:.4f}"]
         if self.train_correct:
             parts.append(
-                f"accuracy: {100.0 * self.train_correct / n:.2f}%"
+                f"accuracy: {self.get_accuracy():.2f}%"
                 f" ({int(self.train_correct)} / {n})"
             )
         if self.ce_loss:

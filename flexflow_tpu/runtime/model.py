@@ -912,14 +912,26 @@ class FFModel:
         verbose: bool = True,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
+        callbacks=None,
     ):
         """Training loop (reference: flexflow_cffi.py:1916-1958 fit —
         per-iter begin_trace; next_batch; forward; zero_gradients; backward;
-        update; end_trace. Here one jitted step does all of it)."""
+        update; end_trace. Here one jitted step does all of it). Callback
+        hooks follow the reference keras loop (base_model.py:374-430):
+        set_model, on_train_begin, per-epoch and per-batch hooks; a True
+        return from on_epoch_end stops training early."""
         if self.executor is None:
             raise RuntimeError("call compile() before fit()")
         epochs = epochs or self.config.epochs
         batch_size = batch_size or self.config.batch_size
+        callbacks = list(callbacks or [])
+        for cb in callbacks:
+            # the keras frontend pre-binds its own Model wrapper; direct
+            # FFModel.fit users get the FFModel itself
+            if getattr(cb, "model", None) is None:
+                cb.set_model(self)
+        for cb in callbacks:
+            cb.on_train_begin()
 
         arrays = self._pack_dataset(x, y)
         loader = SingleDataLoader(arrays, batch_size, shuffle=shuffle)
@@ -927,7 +939,14 @@ class FFModel:
 
         history = []
         warm = False
+        early_stop = False
         for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            # a LearningRateScheduler rebinds the optimizer and drops the
+            # cached jitted step; re-fetch so the new LR takes effect
+            if callbacks:
+                step = self.executor.train_step()
             perf = PerfMetrics()
             loader.reset()
             t0 = time.perf_counter()
@@ -935,6 +954,8 @@ class FFModel:
             step_results = []  # device arrays; converted once per epoch so
             # the loop stays async (no per-iteration host sync)
             for it in range(loader.num_batches):
+                for cb in callbacks:
+                    cb.on_batch_begin(it)
                 np_batch = loader.next_batch()
                 batch = self.executor.shard_batch(np_batch)
                 self._rng, key = jax.random.split(self._rng)
@@ -961,6 +982,8 @@ class FFModel:
                 else:
                     samples += len(next(iter(np_batch.values())))
                 step_results.append((loss, mets))
+                for cb in callbacks:
+                    cb.on_batch_end(it)
                 pf = self.config.print_freq
                 if verbose and pf > 0 and (it + 1) % pf == 0:
                     # reference: metrics printed every printFreq iterations
@@ -974,6 +997,7 @@ class FFModel:
             elapsed = time.perf_counter() - t0
             for loss, mets in step_results:
                 perf.update(jax.tree_util.tree_map(float, mets), float(loss))
+            self._perf_metrics = perf
             thpt = samples / elapsed if elapsed > 0 else 0.0
             history.append({"epoch": epoch, "throughput": thpt, **perf.__dict__})
             if verbose:
@@ -981,19 +1005,53 @@ class FFModel:
                 print(f"THROUGHPUT = {thpt:.2f} samples/s")
             if checkpoint_dir and (epoch + 1) % max(1, checkpoint_every) == 0:
                 self.save_checkpoint(checkpoint_dir, step=epoch)
+            for cb in callbacks:
+                if cb.on_epoch_end(epoch) is True:
+                    # reference: base_model.py:423-428 — accuracy target
+                    # reached, stop early
+                    if verbose:
+                        print(
+                            "Accuracy reaches, now early stop, "
+                            f"epoch: {epoch}"
+                        )
+                    early_stop = True
+            if early_stop:
+                break
+        for cb in callbacks:
+            cb.on_train_end()
         return history
 
-    def evaluate(self, x, y, batch_size: Optional[int] = None):
+    def evaluate(self, x, y, batch_size: Optional[int] = None, callbacks=None):
         batch_size = batch_size or self.config.batch_size
+        callbacks = list(callbacks or [])
+        for cb in callbacks:
+            if getattr(cb, "model", None) is None:
+                cb.set_model(self)
+        for cb in callbacks:
+            cb.on_train_begin()
         arrays = self._pack_dataset(x, y)
         loader = SingleDataLoader(arrays, batch_size)
         estep = self.executor.eval_step()
         perf = PerfMetrics()
-        for batch in loader:
+        for it, batch in enumerate(loader):
+            for cb in callbacks:
+                cb.on_batch_begin(it)
             b = self.executor.shard_batch(batch)
             loss, mets = estep(self.params, b)
             perf.update(jax.tree_util.tree_map(float, mets), float(loss))
+            for cb in callbacks:
+                cb.on_batch_end(it)
+        self._perf_metrics = perf
+        for cb in callbacks:
+            cb.on_train_end()
         return perf
+
+    def get_perf_metrics(self) -> PerfMetrics:
+        """Most recent epoch's accumulated metrics (reference:
+        FFModel::get_perf_metrics via flexflow_model_get_perf_metrics —
+        the handle VerifyMetrics callbacks read, flexflow_cffi.py:2221)."""
+        perf = getattr(self, "_perf_metrics", None)
+        return perf if perf is not None else PerfMetrics()
 
     def _pack_dataset(self, x, y) -> Dict[str, np.ndarray]:
         if isinstance(x, dict):
@@ -1176,6 +1234,9 @@ class FFModel:
         if self.optimizer is None:
             raise RuntimeError("call compile() before set_learning_rate()")
         field = "alpha" if isinstance(self.optimizer, AdamOptimizer) else "lr"
+        if getattr(self.optimizer, field) == lr:
+            return  # unchanged: keep the cached jitted step (a constant
+            # schedule must not retrace every epoch)
         self.optimizer = _dc.replace(self.optimizer, **{field: lr})
         if self.executor is not None:
             self.executor.optimizer = self.optimizer

@@ -1,0 +1,90 @@
+"""Interleaved A/B: monolithic vs batch-chunked dense attention, full
+flagship train step, same process (chip-state drift cancels)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax
+from jax import lax
+
+from examples.transformer import build_transformer, synthetic_batch
+from flexflow_tpu import FFConfig
+from flexflow_tpu.ops import attention as attn_mod
+
+
+def make_runner(model, batch, n):
+    step_fn = model.executor.train_step_fn()
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def run(p, o):
+        def body(c, _):
+            cp, co = c
+            p2, o2, loss, _ = step_fn(cp, co, batch, key)
+            return (p2, o2), loss
+
+        _, losses = lax.scan(body, (p, o), None, length=n)
+        return losses[-1]
+
+    return lambda: float(np.asarray(run(model.params, model.opt_state)))
+
+
+def build(bs, chunked):
+    saved = attn_mod._DENSE_CHUNK_SCORE_BYTES
+    if not chunked:
+        attn_mod._DENSE_CHUNK_SCORE_BYTES = 1 << 60
+    try:
+        cfg = FFConfig(batch_size=bs, learning_rate=0.01)
+        cfg.allow_mixed_precision = True
+        model, _ = build_transformer(
+            cfg, batch_size=bs, seq_len=512, hidden=1024,
+            num_heads=16, num_layers=12,
+        )
+        batch = model.executor.shard_batch(synthetic_batch(bs, 512, 1024))
+        n1, n2 = 5, 20
+        r = {n: make_runner(model, batch, n) for n in (n1, n2)}
+        for n in (n1, n2):
+            r[n]()  # compile (happens while patched)
+        return r, (n1, n2)
+    finally:
+        attn_mod._DENSE_CHUNK_SCORE_BYTES = saved
+
+
+def main():
+    sizes = [int(a) for a in sys.argv[1:]] or [8, 16, 32]
+    for bs in sizes:
+        runners = {}
+        for name, chunked in (("mono", False), ("chunk", True)):
+            runners[name], (n1, n2) = build(bs, chunked)
+        best = {"mono": float("inf"), "chunk": float("inf")}
+        for rep in range(5):
+            if rep:
+                time.sleep(2.0)
+            for name in ("mono", "chunk"):
+                r = runners[name]
+                t0 = time.perf_counter(); r[n1]()
+                t1 = time.perf_counter(); r[n2]()
+                t2 = time.perf_counter()
+                per = ((t2 - t1) - (t1 - t0)) / (n2 - n1)
+                best[name] = min(best[name], per)
+        print(
+            json.dumps(
+                {
+                    "bs": bs,
+                    "mono_ms": round(best["mono"] * 1e3, 2),
+                    "chunk_ms": round(best["chunk"] * 1e3, 2),
+                    "speedup": round(best["mono"] / best["chunk"], 3),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

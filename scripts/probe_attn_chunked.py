@@ -1,0 +1,81 @@
+"""Probe: batch-chunked dense attention vs monolithic at bs16/32.
+
+Follow-up to probe_attn_batch.py: dense attention fwd+bwd is superlinear
+from bs8 -> bs16 (0.997 -> 2.66 ms) while fwd alone is linear, and flash
+does NOT win at these sizes. Hypothesis: the fused score/softmax working
+set falls out of VMEM past bs8. If true, scanning the attention core over
+batch chunks of 8 should restore ~linear scaling (2 x 0.997 ~ 2.0 ms at
+bs16).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.ops.attention import scaled_dot_product_attention
+from flexflow_tpu.utils.benchmark import measure_fn
+
+
+def chunked_attention(q, k, v, chunk):
+    b = q.shape[0]
+    n = b // chunk
+    qs = q.reshape(n, chunk, *q.shape[1:])
+    ks = k.reshape(n, chunk, *k.shape[1:])
+    vs = v.reshape(n, chunk, *v.shape[1:])
+
+    def body(_, blk):
+        qq, kk, vv = blk
+        return _, scaled_dot_product_attention(qq, kk, vv, causal=False)
+
+    _, out = lax.scan(body, None, (qs, ks, vs))
+    return out.reshape(b, *q.shape[1:])
+
+
+def grad_of(fn):
+    def loss(q, k, v):
+        return fn(q, k, v).astype(jnp.float32).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+
+    def run(q, k, v):
+        gq, gk, gv = g(q, k, v)
+        return (
+            gq.astype(jnp.float32).sum()
+            + gk.astype(jnp.float32).sum()
+            + gv.astype(jnp.float32).sum()
+        )
+
+    return run
+
+
+def main():
+    h, d, s = 16, 64, 512
+    for bs in (16, 32):
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (bs, s, h, d), dtype=jnp.bfloat16)
+        k = jax.random.normal(kk, (bs, s, h, d), dtype=jnp.bfloat16)
+        v = jax.random.normal(kv, (bs, s, h, d), dtype=jnp.bfloat16)
+        row = {"bs": bs}
+        for chunk in (4, 8):
+            if bs % chunk:
+                continue
+            fn = lambda q, k, v: chunked_attention(q, k, v, chunk)  # noqa: E731
+            fwd = measure_fn(fn, (q, k, v), n1=4, n2=12, reps=3)
+            fb = measure_fn(grad_of(fn), (q, k, v), n1=4, n2=12, reps=3)
+            row[f"chunk{chunk}"] = {
+                "fwd_ms": round(fwd * 1e3, 3),
+                "fwdbwd_ms": round(fb * 1e3, 3),
+            }
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

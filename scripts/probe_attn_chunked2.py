@@ -1,0 +1,85 @@
+"""Probe round 2: chunk2 vs chunk4 vs query-dim chunking for dense attention."""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.ops.attention import scaled_dot_product_attention
+from flexflow_tpu.utils.benchmark import measure_fn
+
+
+def chunked_attention(q, k, v, chunk):
+    b = q.shape[0]
+    n = b // chunk
+    qs = q.reshape(n, chunk, *q.shape[1:])
+    ks = k.reshape(n, chunk, *k.shape[1:])
+    vs = v.reshape(n, chunk, *v.shape[1:])
+
+    def body(_, blk):
+        qq, kk, vv = blk
+        return _, scaled_dot_product_attention(qq, kk, vv, causal=False)
+
+    _, out = lax.scan(body, None, (qs, ks, vs))
+    return out.reshape(b, *q.shape[1:])
+
+
+def qchunked_attention(q, k, v, qchunk):
+    # split the QUERY sequence dim; keys/values stay whole (noncausal)
+    b, s, h, d = q.shape
+    n = s // qchunk
+    qs = jnp.moveaxis(q.reshape(b, n, qchunk, h, d), 1, 0)
+
+    def body(_, qq):
+        return _, scaled_dot_product_attention(qq, k, v, causal=False)
+
+    _, out = lax.scan(body, None, qs)
+    return jnp.moveaxis(out, 0, 1).reshape(b, s, h, d)
+
+
+def grad_of(fn):
+    def loss(q, k, v):
+        return fn(q, k, v).astype(jnp.float32).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+
+    def run(q, k, v):
+        gq, gk, gv = g(q, k, v)
+        return (
+            gq.astype(jnp.float32).sum()
+            + gk.astype(jnp.float32).sum()
+            + gv.astype(jnp.float32).sum()
+        )
+
+    return run
+
+
+def main():
+    h, d, s = 16, 64, 512
+    for bs in (8, 16, 32):
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (bs, s, h, d), dtype=jnp.bfloat16)
+        k = jax.random.normal(kk, (bs, s, h, d), dtype=jnp.bfloat16)
+        v = jax.random.normal(kv, (bs, s, h, d), dtype=jnp.bfloat16)
+        row = {"bs": bs}
+        cands = {}
+        if bs % 2 == 0:
+            cands["chunk2"] = lambda q, k, v: chunked_attention(q, k, v, 2)
+        if bs % 4 == 0:
+            cands["chunk4"] = lambda q, k, v: chunked_attention(q, k, v, 4)
+        cands["qchunk128"] = lambda q, k, v: qchunked_attention(q, k, v, 128)
+        for name, fn in cands.items():
+            fb = measure_fn(grad_of(fn), (q, k, v), n1=4, n2=12, reps=3)
+            row[name] = round(fb * 1e3, 3)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
