@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# conda-build entry (reference: conda/build.sh): build the native core,
+# then pip-install the package into the conda env being built.
+set -euo pipefail
+make -C native
+"${PYTHON}" -m pip install . -vv

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Build the two images (reference: docker/build.sh — environment image
+# first, framework image on top; BACKEND in {tpu, cpu}).
+set -euo pipefail
+BACKEND="${BACKEND:-tpu}"
+cd "$(dirname "$0")/.."
+
+docker build \
+    --build-arg "BACKEND=${BACKEND}" \
+    -t "flexflow-tpu-environment-${BACKEND}:latest" \
+    -f docker/flexflow-tpu-environment/Dockerfile \
+    docker/flexflow-tpu-environment
+
+docker build \
+    --build-arg "BACKEND=${BACKEND}" \
+    -t "flexflow-tpu-${BACKEND}:latest" \
+    -f docker/flexflow-tpu/Dockerfile \
+    .

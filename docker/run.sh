@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Run the framework container (reference: docker/run.sh). On a TPU VM the
+# TPU runtime needs privileged access to /dev/accel*.
+set -euo pipefail
+BACKEND="${BACKEND:-tpu}"
+EXTRA=()
+if [ "$BACKEND" = "tpu" ]; then
+    EXTRA+=(--privileged)
+fi
+exec docker run -it --rm "${EXTRA[@]}" "flexflow-tpu-${BACKEND}:latest" "$@"

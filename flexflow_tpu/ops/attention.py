@@ -170,7 +170,16 @@ def _dense_batch_chunk(batch, heads, sq, sk) -> int:
 
 def _chunked_dense_attention(q, k, v, causal, chunk):
     """scaled_dot_product_attention scanned over batch chunks — bounds the
-    per-step f32 score working set (VMEM) without changing numerics."""
+    per-step f32 score working set (VMEM) without changing numerics.
+
+    The chunk body is rematerialized: the backward recomputes each
+    chunk's scores/probs from its (VMEM-sized) inputs instead of
+    streaming stored probabilities from HBM. Measured on v5e at the
+    flagship shape (seq 512, 16 heads): bs16 full train step 56.96 ->
+    32.14 ms (1.77x, ~68% of bf16 peak), exactly-equal losses; neutral
+    at bs8 (scripts/ab_attn_remat.py, scripts/check_remat_sanity.py).
+    Remat of the MONOLITHIC kernel does not help — the win needs the
+    chunked working set."""
     from jax import lax
 
     b = q.shape[0]
@@ -179,9 +188,12 @@ def _chunked_dense_attention(q, k, v, causal, chunk):
     ks = k.reshape(n, chunk, *k.shape[1:])
     vs = v.reshape(n, chunk, *v.shape[1:])
 
+    @jax.checkpoint
+    def body_fn(qq, kk, vv):
+        return scaled_dot_product_attention(qq, kk, vv, causal=causal)
+
     def body(_, blk):
-        qq, kk, vv = blk
-        return _, scaled_dot_product_attention(qq, kk, vv, causal=causal)
+        return _, body_fn(*blk)
 
     _, out = lax.scan(body, None, (qs, ks, vs))
     return out.reshape(b, *q.shape[1:])

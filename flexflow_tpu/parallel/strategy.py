@@ -119,13 +119,18 @@ def _second_axis_strategy(
 
 
 def sequence_parallel_strategy(
-    dp: int, sp: int, graph: PCGGraph = None, seq_axis: int = 1
+    dp: int, sp: int, graph: PCGGraph = None, seq_axis: int = 1,
+    seq_mode: str = "ring",
 ) -> Strategy:
     """dp × sp mesh: inputs' batch dim on the "data" axis and sequence dim on
     the "seq" axis. Attention under the partitioned sequence dim runs the
-    ring-attention path (ops/pallas/ring_attention.py) — the long-context
-    capability the reference lacks (SURVEY §5)."""
-    return _second_axis_strategy(
+    ring-attention path (ops/pallas/ring_attention.py) or, with
+    seq_mode="ulysses", the all-to-all seq->heads reshard — whichever the
+    cost model picked (the long-context capability the reference lacks,
+    SURVEY §5)."""
+    if seq_mode not in ("ring", "ulysses"):
+        raise ValueError(f"seq_mode must be ring|ulysses, got {seq_mode!r}")
+    base = _second_axis_strategy(
         "seq",
         dp,
         sp,
@@ -135,7 +140,37 @@ def sequence_parallel_strategy(
         # here — without this split the search's "seq" candidates quietly
         # shard image H dims and the two families double-count
         lambda shape: shape.ndim == seq_axis + 2,
-        f"dp{dp}xsp{sp}",
+        f"dp{dp}xsp{sp}" + ("-ulysses" if seq_mode == "ulysses" else ""),
+    )
+    if seq_mode == "ring":
+        return base
+
+    base_apply = base.apply
+
+    def apply(g: PCGGraph):
+        base_apply(g)
+        for node in g.nodes.values():
+            if not ulysses_eligible(node, sp):
+                continue
+            node.params["seq_parallel"] = "ulysses"
+
+    return Strategy(base.mesh_config, apply, name=base.name)
+
+
+def ulysses_eligible(node, sp: int) -> bool:
+    """Whether a node can take the Ulysses seq->heads reshard: an MHA
+    whose heads divide sp, without attention-prob dropout (the reshard
+    path has no dropout support — ops/attention.py raises), and whose
+    seq_parallel the user left on auto (an explicit ring/none choice is
+    never clobbered)."""
+    if node.op_type != OperatorType.MULTIHEAD_ATTENTION:
+        return False
+    heads = int(node.params.get("num_heads", 0))
+    return (
+        heads > 0
+        and heads % sp == 0
+        and float(node.params.get("dropout", 0.0)) == 0.0
+        and node.params.get("seq_parallel", "auto") == "auto"
     )
 
 

@@ -446,8 +446,8 @@ class Executor:
         """Host→device transfer with each input's searched sharding
         (the TPU analog of the reference's SingleDataLoader index-launched
         shard copies, python/flexflow_dataloader.cc). On multi-host runs
-        each process passes its LOCAL rows and the global array is
-        assembled across hosts; one placement loop serves both paths
+        every process passes the SAME GLOBAL batch and materializes only
+        the shards its devices own; one placement loop serves both paths
         (runtime/multihost.place_batch)."""
         from flexflow_tpu.runtime.multihost import place_batch
 

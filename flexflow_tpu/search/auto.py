@@ -97,14 +97,21 @@ def _second_axis_candidate(
 
 
 def _seq_candidate(
-    base: PCGGraph, dp: int, sp: int, cm: CostModel, spec
+    base: PCGGraph, dp: int, sp: int, cm: CostModel, spec,
+    seq_mode: str = "ring",
 ) -> Optional[GraphCost]:
     """Cost a (dp, sp) sequence-parallel mesh: inputs' seq dim sharded on
-    axis 1; attention pays the ring-exchange term (CostModel.op_cost)."""
+    axis 1; attention pays the ring-exchange or Ulysses all-to-all term
+    per seq_mode (CostModel.op_cost reads the node's seq_parallel)."""
     from flexflow_tpu.parallel.strategy import sequence_parallel_strategy
 
     return _second_axis_candidate(
-        base, sequence_parallel_strategy(dp, sp), dp, sp, cm, spec
+        base,
+        sequence_parallel_strategy(dp, sp, seq_mode=seq_mode),
+        dp,
+        sp,
+        cm,
+        spec,
     )
 
 
@@ -238,8 +245,9 @@ class SearchResult:
                 f"step {self.cost.step_time * 1e3:.3f} ms"
             )
         if self.kind == "seq":
+            mode = self.extra.get("seq_mode", "ring")
             return (
-                f"mesh(data={self.dp}, seq={self.extra['sp']}), ring "
+                f"mesh(data={self.dp}, seq={self.extra['sp']}), {mode} "
                 f"attention, simulated step {self.cost.step_time * 1e3:.3f} ms"
             )
         if self.kind == "spatial":
@@ -366,18 +374,27 @@ def optimize(
     # sequence-parallel candidates: (dp, sp) meshes with ring attention
     # (beyond-reference axis; the reference's seq dim is shardable but no
     # substitution ever exploits it, SURVEY §2.4)
+    from flexflow_tpu.parallel.strategy import ulysses_eligible
+
     for dp, sp in _mesh_factorizations(num_devices):
         if sp == 1:
             continue
-        evals += 1
-        cost = _seq_candidate(graph, dp, sp, cm, spec)
-        if cost is None:
-            continue
-        cur = SearchResult(dp, 1, [], [], cost, kind="seq", extra={"sp": sp})
-        if verbose:
-            print(f"[search] {cur.describe()}")
-        if best is None or cost.step_time < best.cost.step_time:
-            best = cur
+        modes = ["ring"]
+        if any(ulysses_eligible(n, sp) for n in graph.nodes.values()):
+            modes.append("ulysses")
+        for seq_mode in modes:
+            evals += 1
+            cost = _seq_candidate(graph, dp, sp, cm, spec, seq_mode=seq_mode)
+            if cost is None:
+                continue
+            cur = SearchResult(
+                dp, 1, [], [], cost, kind="seq",
+                extra={"sp": sp, "seq_mode": seq_mode},
+            )
+            if verbose:
+                print(f"[search] {cur.describe()}")
+            if best is None or cost.step_time < best.cost.step_time:
+                best = cur
 
     # attribute/spatial candidates: image H over the second axis
     # (reference: --enable-attribute-parallel opt-in, model.cc:3602)
@@ -470,7 +487,12 @@ def result_to_strategy(result: SearchResult, graph: PCGGraph) -> Strategy:
             name_prefix=prefix,
         )
     if result.kind == "seq":
-        s = sequence_parallel_strategy(result.dp, result.extra["sp"], graph)
+        s = sequence_parallel_strategy(
+            result.dp,
+            result.extra["sp"],
+            graph,
+            seq_mode=result.extra.get("seq_mode", "ring"),
+        )
         s.name = f"{prefix}: {s.name}"
         return s
     if result.kind == "spatial":

@@ -251,10 +251,18 @@ class CostModel:
                     fwd += self._ici_time(halo_bytes)
                     bwd += 2.0 * self._ici_time(halo_bytes)
 
-        # ring attention under a partitioned sequence dim: each device
-        # passes its K/V block around the ring (sp-1) times forward and
-        # roughly twice that backward (dK/dV return trip) — the TPU
-        # sequence-parallel capability the reference lacks (SURVEY §5)
+        # attention under a partitioned sequence dim — two lowerings
+        # (ops/attention.py seq_parallel):
+        #   ring    — each device passes its K/V block around the ring
+        #             (sp-1) times fwd, ~2x bwd, each hop OVERLAPPED with
+        #             the previous block's score compute -> max(comp, comm)
+        #   ulysses — all-to-all the seq sharding onto heads before the
+        #             core and back after: 3 input pieces + 1 output piece
+        #             reshard fwd (mirrored bwd), BLOCKING -> added.
+        # The runtime's seq_parallel="auto" takes the ring path, so "auto"
+        # costs as ring; the search flips a node to "ulysses" only where
+        # this model says the blocking reshard beats the ring (short seq /
+        # many heads — comm-dominated) and heads divide sp.
         if (
             node.op_type == OperatorType.MULTIHEAD_ATTENTION
             and input_shapes
@@ -265,13 +273,17 @@ class CostModel:
                 if not d.is_replica_dim and i == 1 and d.degree > 1:
                     seq_deg = d.degree
             if seq_deg > 1:
-                kv_piece = 2 * x0.piece_volume() * self.elem_bytes(x0)
-                ring = (seq_deg - 1) * self._ici_time(kv_piece)
-                # the ring pipelines each K/V hop behind the previous
-                # block's score compute (ops/pallas/ring_attention.py), so
-                # the step costs max(compute, comm), not their sum
-                fwd = max(fwd, ring)
-                bwd = max(bwd, 2.0 * ring)
+                mode = node.params.get("seq_parallel", "auto")
+                if mode == "ulysses":
+                    x_piece = x0.piece_volume() * self.elem_bytes(x0)
+                    a2a_fwd = self.all_to_all(4.0 * x_piece, seq_deg)
+                    fwd += a2a_fwd
+                    bwd += a2a_fwd  # cotangents reshard the same way
+                else:
+                    kv_piece = 2 * x0.piece_volume() * self.elem_bytes(x0)
+                    ring = (seq_deg - 1) * self._ici_time(kv_piece)
+                    fwd = max(fwd, ring)
+                    bwd = max(bwd, 2.0 * ring)
         return OpCost(fwd, bwd, 0.0, mem)
 
     # -- measured mode ------------------------------------------------------

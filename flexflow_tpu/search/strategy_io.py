@@ -122,7 +122,9 @@ def load_strategy(path: str, graph: PCGGraph, num_devices: int) -> Strategy:
             raise ValueError(
                 f"strategy file wants {dp * sp} devices, have {num_devices}"
             )
-        s = sequence_parallel_strategy(dp, sp, graph)
+        s = sequence_parallel_strategy(
+            dp, sp, graph, seq_mode=extra.get("seq_mode", "ring")
+        )
         if sp > 1:
             _check_second_axis_shards(s, graph, sp, path)
         s.name = f"imported:{path}"
@@ -191,8 +193,11 @@ def load_strategy(path: str, graph: PCGGraph, num_devices: int) -> Strategy:
                 f"mixed strategy file wants {dp * tp} devices, "
                 f"have {num_devices}"
             )
+        # honor the FILE's device count (like the seq/spatial import
+        # paths): importing on a wider machine must not silently widen
+        # the data axis into a different strategy than was exported
         s = mixed_site_strategy(
-            graph, num_devices, tp, sites, name_prefix=f"imported:{path}"
+            graph, dp * tp, tp, sites, name_prefix=f"imported:{path}"
         )
         if "mixed" not in s.name:
             raise ValueError(

@@ -32,7 +32,7 @@ def _adaptive_differenced(
         r1, r2 = make_chain(n1), make_chain(n2)
         _ = float(np.asarray(r1(*run_args)))  # compile + warmup
         _ = float(np.asarray(r2(*run_args)))
-        best = float("inf")
+        best1 = best2 = float("inf")
         for _i in range(reps):
             if rep_sleep_s and _i:
                 # tunnel/chip contention comes in seconds-long bursts;
@@ -43,7 +43,13 @@ def _adaptive_differenced(
             t1 = time.perf_counter()
             _ = float(np.asarray(r2(*run_args)))
             t2 = time.perf_counter()
-            best = min(best, ((t2 - t1) - (t1 - t0)) / (n2 - n1))
+            # min each window SEPARATELY, then difference: min of the
+            # per-rep difference is biased LOW by contention spikes
+            # landing in the short chain (a spike in t1-t0 fakes a
+            # speedup), which min() then selects for
+            best1 = min(best1, t1 - t0)
+            best2 = min(best2, t2 - t1)
+        best = (best2 - best1) / (n2 - n1)
         window = best * (n2 - n1)
         if window >= 0.05:
             return best

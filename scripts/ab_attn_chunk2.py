@@ -1,0 +1,91 @@
+"""Interleaved A/B #2: chunk granularity in the FULL train step.
+
+Usage: ab_attn_chunk2.py <bs> <monoA,chunkA> <monoB,chunkB>  (caps in MB)
+e.g.   ab_attn_chunk2.py 16 160,80 160,40   (chunk4 vs chunk2 at bs16)
+       ab_attn_chunk2.py 8  160,80 1,40     (mono   vs chunk2 at bs8)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax
+from jax import lax
+
+from examples.transformer import build_transformer, synthetic_batch
+from flexflow_tpu import FFConfig
+from flexflow_tpu.ops import attention as attn_mod
+
+
+def make_runner(model, batch, n):
+    step_fn = model.executor.train_step_fn()
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def run(p, o):
+        def body(c, _):
+            cp, co = c
+            p2, o2, loss, _ = step_fn(cp, co, batch, key)
+            return (p2, o2), loss
+
+        _, losses = lax.scan(body, (p, o), None, length=n)
+        return losses[-1]
+
+    return lambda: float(np.asarray(run(model.params, model.opt_state)))
+
+
+def build(bs, mono_mb, chunk_mb):
+    saved = (attn_mod._DENSE_MONO_SCORE_BYTES, attn_mod._DENSE_CHUNK_SCORE_BYTES)
+    attn_mod._DENSE_MONO_SCORE_BYTES = mono_mb << 20
+    attn_mod._DENSE_CHUNK_SCORE_BYTES = chunk_mb << 20
+    try:
+        cfg = FFConfig(batch_size=bs, learning_rate=0.01)
+        cfg.allow_mixed_precision = True
+        model, _ = build_transformer(
+            cfg, batch_size=bs, seq_len=512, hidden=1024,
+            num_heads=16, num_layers=12,
+        )
+        batch = model.executor.shard_batch(synthetic_batch(bs, 512, 1024))
+        n1, n2 = 5, 20
+        r = {n: make_runner(model, batch, n) for n in (n1, n2)}
+        for n in (n1, n2):
+            r[n]()
+        return r, (n1, n2)
+    finally:
+        attn_mod._DENSE_MONO_SCORE_BYTES, attn_mod._DENSE_CHUNK_SCORE_BYTES = saved
+
+
+def main():
+    bs = int(sys.argv[1])
+    variants = []
+    for arg in sys.argv[2:]:
+        mono, chunk = (int(x) for x in arg.split(","))
+        variants.append((arg, mono, chunk))
+    runners = {}
+    for name, mono, chunk in variants:
+        runners[name], (n1, n2) = build(bs, mono, chunk)
+    best = {name: float("inf") for name, _, _ in variants}
+    for rep in range(5):
+        if rep:
+            time.sleep(2.0)
+        for name, _, _ in variants:
+            r = runners[name]
+            t0 = time.perf_counter(); r[n1]()
+            t1 = time.perf_counter(); r[n2]()
+            t2 = time.perf_counter()
+            best[name] = min(best[name], ((t2 - t1) - (t1 - t0)) / (n2 - n1))
+    print(
+        json.dumps(
+            {"bs": bs, **{n: round(v * 1e3, 2) for n, v in best.items()}}
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Interleaved A/B: plain chunk scan vs remat'd chunk body at bs16/32.
+
+Remat of the MONOLITHIC attention didn't help (round 2). This tests
+jax.checkpoint on the per-chunk scan body: backward recomputes the
+chunk's scores/probs from VMEM-sized inputs instead of streaming stored
+probs from HBM.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax
+from jax import lax
+
+from examples.transformer import build_transformer, synthetic_batch
+from flexflow_tpu import FFConfig
+from flexflow_tpu.ops import attention as attn_mod
+from flexflow_tpu.ops.attention import scaled_dot_product_attention
+
+
+def chunked_remat(q, k, v, causal, chunk):
+    b = q.shape[0]
+    n = b // chunk
+    qs = q.reshape(n, chunk, *q.shape[1:])
+    ks = k.reshape(n, chunk, *k.shape[1:])
+    vs = v.reshape(n, chunk, *v.shape[1:])
+
+    @jax.checkpoint
+    def body_fn(qq, kk, vv):
+        return scaled_dot_product_attention(qq, kk, vv, causal=causal)
+
+    def body(_, blk):
+        return _, body_fn(*blk)
+
+    _, out = lax.scan(body, None, (qs, ks, vs))
+    return out.reshape(b, *q.shape[1:])
+
+
+def make_runner(model, batch, n):
+    step_fn = model.executor.train_step_fn()
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def run(p, o):
+        def body(c, _):
+            cp, co = c
+            p2, o2, loss, _ = step_fn(cp, co, batch, key)
+            return (p2, o2), loss
+
+        _, losses = lax.scan(body, (p, o), None, length=n)
+        return losses[-1]
+
+    return lambda: float(np.asarray(run(model.params, model.opt_state)))
+
+
+def build(bs, remat, mono_mb=None):
+    saved = attn_mod._chunked_dense_attention
+    saved_mono = attn_mod._DENSE_MONO_SCORE_BYTES
+    if mono_mb is not None:
+        attn_mod._DENSE_MONO_SCORE_BYTES = mono_mb << 20
+    if remat:
+        attn_mod._chunked_dense_attention = chunked_remat
+    try:
+        cfg = FFConfig(batch_size=bs, learning_rate=0.01)
+        cfg.allow_mixed_precision = True
+        model, _ = build_transformer(
+            cfg, batch_size=bs, seq_len=512, hidden=1024,
+            num_heads=16, num_layers=12,
+        )
+        batch = model.executor.shard_batch(synthetic_batch(bs, 512, 1024))
+        n1, n2 = 5, 20
+        r = {n: make_runner(model, batch, n) for n in (n1, n2)}
+        for n in (n1, n2):
+            r[n]()
+        return r, (n1, n2)
+    finally:
+        attn_mod._chunked_dense_attention = saved
+        attn_mod._DENSE_MONO_SCORE_BYTES = saved_mono
+
+
+def main():
+    bs = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    mono_mb = int(sys.argv[2]) if len(sys.argv) > 2 else None
+    runners = {}
+    for name, remat in (("plain", False), ("remat", True)):
+        runners[name], (n1, n2) = build(bs, remat, mono_mb)
+    # min each chain length separately, then difference (min-of-difference
+    # is biased low by contention spikes landing in the short chain)
+    b1 = {"plain": float("inf"), "remat": float("inf")}
+    b2 = dict(b1)
+    for rep in range(6):
+        if rep:
+            time.sleep(2.0)
+        for name in ("plain", "remat"):
+            r = runners[name]
+            t0 = time.perf_counter(); r[n1]()
+            t1 = time.perf_counter(); r[n2]()
+            t2 = time.perf_counter()
+            b1[name] = min(b1[name], t1 - t0)
+            b2[name] = min(b2[name], t2 - t1)
+    out = {
+        name: round((b2[name] - b1[name]) / (n2 - n1) * 1e3, 2)
+        for name in b1
+    }
+    print(json.dumps({"bs": bs, **out}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

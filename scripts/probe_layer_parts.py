@@ -1,0 +1,75 @@
+"""Probe: transformer building blocks fwd+bwd at bs 8/16/32 (real chip).
+
+Finds where the flagship step's superlinear batch scaling lives beyond the
+attention core: FFN (1024->4096->1024), QKV+out projections, layernorm,
+and the full attention block, measured in isolation with bf16 operands.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.utils.benchmark import measure_fn
+
+E, S, H, D = 1024, 512, 16, 64
+
+
+def grad_of(fn, nargs):
+    def loss(*a):
+        return fn(*a).astype(jnp.float32).sum()
+
+    g = jax.grad(loss, argnums=tuple(range(nargs)))
+
+    def run(*a):
+        gs = g(*a)
+        return sum(x.astype(jnp.float32).sum() for x in gs)
+
+    return run
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    w1 = jax.random.normal(key, (E, 4 * E), jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(key, (4 * E, E), jnp.bfloat16) * 0.02
+    wqkv = jax.random.normal(key, (E, 3 * E), jnp.bfloat16) * 0.02
+    wo = jax.random.normal(key, (E, E), jnp.bfloat16) * 0.02
+    gamma = jnp.ones((E,), jnp.float32)
+    beta = jnp.zeros((E,), jnp.float32)
+
+    def ffn(x, w1, w2):
+        h = jnp.einsum("bse,ef->bsf", x, w1, preferred_element_type=jnp.float32)
+        h = jax.nn.relu(h).astype(x.dtype)
+        return jnp.einsum("bsf,fe->bse", h, w2, preferred_element_type=jnp.float32).astype(x.dtype)
+
+    def proj(x, wqkv, wo):
+        qkv = jnp.einsum("bse,ef->bsf", x, wqkv, preferred_element_type=jnp.float32).astype(x.dtype)
+        q = qkv[..., :E]
+        return jnp.einsum("bse,ef->bsf", q, wo, preferred_element_type=jnp.float32).astype(x.dtype)
+
+    def ln(x, gamma, beta):
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * gamma + beta).astype(x.dtype)
+
+    for bs in (8, 16, 32):
+        x = jax.random.normal(key, (bs, S, E), jnp.bfloat16)
+        row = {"bs": bs}
+        for name, fn, args in (
+            ("ffn", ffn, (x, w1, w2)),
+            ("proj", proj, (x, wqkv, wo)),
+            ("ln", ln, (x, gamma, beta)),
+        ):
+            fb = measure_fn(grad_of(fn, len(args)), args, n1=4, n2=12, reps=3)
+            row[name + "_ms"] = round(fb * 1e3, 3)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

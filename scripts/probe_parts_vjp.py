@@ -1,0 +1,83 @@
+"""Probe v2: proper-cotangent (vjp) fwd+bwd timing of flagship blocks.
+
+probe_layer_parts.py used sum() losses whose all-ones cotangents let XLA
+collapse backward matmuls into reductions — numbers came out above peak.
+Here each block is timed as fwd + vjp with a RANDOM cotangent, so every
+backward GEMM is real.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.ops.attention import (
+    _chunked_dense_attention,
+    scaled_dot_product_attention,
+)
+from flexflow_tpu.utils.benchmark import measure_fn
+
+E, S, H, D = 1024, 512, 16, 64
+
+
+def fwd_bwd(fn, out_shape_of):
+    """Returns g(*args, ct) computing fn fwd + vjp wrt all args."""
+
+    def run(ct, *args):
+        out, pull = jax.vjp(fn, *args)
+        gs = pull(ct)
+        return sum(x.astype(jnp.float32).sum() for x in gs) + out.astype(
+            jnp.float32
+        ).sum()
+
+    return run
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    w1 = jax.random.normal(key, (E, 4 * E), jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(key, (4 * E, E), jnp.bfloat16) * 0.02
+
+    def ffn(x, w1, w2):
+        h = jnp.einsum("bse,ef->bsf", x, w1, preferred_element_type=jnp.float32)
+        h = jax.nn.relu(h).astype(x.dtype)
+        return jnp.einsum(
+            "bsf,fe->bse", h, w2, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+
+    for bs in (8, 16, 32):
+        x = jax.random.normal(key, (bs, S, E), jnp.bfloat16)
+        q = jax.random.normal(key, (bs, S, H, D), jnp.bfloat16)
+        k = jax.random.normal(key, (bs, S, H, D), jnp.bfloat16)
+        v = jax.random.normal(key, (bs, S, H, D), jnp.bfloat16)
+        ct_x = jax.random.normal(key, (bs, S, E), jnp.bfloat16)
+        ct_q = jax.random.normal(key, (bs, S, H, D), jnp.bfloat16)
+
+        row = {"bs": bs}
+        t = measure_fn(fwd_bwd(ffn, None), (ct_x, x, w1, w2), n1=4, n2=12, reps=3)
+        row["ffn_ms"] = round(t * 1e3, 3)
+
+        def mono(q, k, v):
+            return scaled_dot_product_attention(q, k, v, causal=False)
+
+        t = measure_fn(fwd_bwd(mono, None), (ct_q, q, k, v), n1=4, n2=12, reps=3)
+        row["attn_mono_ms"] = round(t * 1e3, 3)
+        for c in (2, 4):
+            if bs % c:
+                continue
+
+            def ch(q, k, v, c=c):
+                return _chunked_dense_attention(q, k, v, False, c)
+
+            t = measure_fn(fwd_bwd(ch, None), (ct_q, q, k, v), n1=4, n2=12, reps=3)
+            row[f"attn_chunk{c}_ms"] = round(t * 1e3, 3)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -290,6 +290,13 @@ def test_mixed_strategy_export_import_roundtrip(tmp_path):
     assert "mixed" in s.name, s.name
     assert s.mesh_config.axis_sizes == (8 // r.tp, r.tp)
 
+    # importing on a WIDER machine keeps the file's dp*tp (silently
+    # widening the data axis would train a different strategy than was
+    # exported — the seq/spatial import paths already honor the file)
+    m3 = _mlp_heavy_dlrm()
+    s_wide = load_strategy(path, m3.graph, 16)
+    assert s_wide.mesh_config.axis_sizes == (8 // r.tp, r.tp)
+
 
 def test_embedding_site_apply_shapes():
     m = dlrm_like(n_tables=1)

@@ -1,8 +1,8 @@
 """Multi-host runtime support (runtime/multihost.py): the TPU-native
 replacement for the reference's GASNet/MPI bootstrap + per-view NCCL
 communicators (reference: multinode-test.yml:29-74, model.cc:3115-3153).
-Single-process here; the global-array assembly path is exercised directly
-(make_array_from_process_local_data works at process_count == 1)."""
+Single-process fast checks here; REAL 2-process execution (TCP
+coordinator, loss parity) is tests/test_multihost_2proc.py."""
 
 import numpy as np
 

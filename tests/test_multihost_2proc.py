@@ -1,0 +1,106 @@
+"""REAL 2-process multi-host execution (reference:
+.github/workflows/multinode-test.yml:29-74 — actual `mpirun -np 2` runs,
+not a fake backend; tests/multinode_helpers/mpi_wrapper1.sh:12).
+
+Spawns two separate Python processes, each with 4 virtual CPU devices,
+joined through a TCP coordinator by `multihost.initialize`. Both run the
+same dp=8 `fit()`; the parent asserts the distributed loss trajectory
+matches a single-process 8-device run of the identical model/data.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_HELPER = os.path.join(_ROOT, "tests", "multihost_helpers", "run_fit.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_losses():
+    """The same model/data as run_fit.py on this process's 8-device mesh."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+
+    batch, feat, classes = 16, 8, 4
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2 * batch, feat)).astype(np.float32)
+    y = rng.integers(0, classes, size=(2 * batch,)).astype(np.int32)
+
+    m = FFModel(FFConfig(batch_size=batch))
+    t = m.create_tensor([batch, feat], name="x")
+    t = m.dense(t, 16, activation=ActiMode.RELU)
+    m.dense(t, classes)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    history = m.fit(x, y, epochs=3, verbose=False)
+    return [h["loss_sum"] / max(h["train_all"], 1) for h in history]
+
+
+@pytest.mark.slow
+def test_two_process_fit_matches_single_process():
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("JAX_NUM_PROCESSES", None)
+    procs = []
+    for pid in range(2):
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    _HELPER,
+                    "--coordinator",
+                    coordinator,
+                    "--num-processes",
+                    "2",
+                    "--process-id",
+                    str(pid),
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=_ROOT,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"rank failed ({rc}):\n{out}\n{err}"
+    # rank 0 prints the losses
+    payload = None
+    for _, out, _ in outs:
+        for line in out.splitlines():
+            if line.startswith("{"):
+                payload = json.loads(line)
+    assert payload is not None, f"no JSON from ranks: {outs}"
+    assert payload["devices"] == 8
+
+    expected = _single_process_losses()
+    got = payload["losses"]
+    assert len(got) == len(expected) == 3
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+    # training actually progressed
+    assert got[-1] < got[0]
