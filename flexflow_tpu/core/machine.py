@@ -158,6 +158,9 @@ class MachineSpec:
     # mesh topology of the full slice, e.g. (4, 4, 2) for v4-32.
     torus: Optional[Tuple[int, ...]] = None
     dcn_bandwidth_gbps: float = 25.0  # per-host DCN GB/s
+    # override the chip's HBM capacity (search-without-hardware: probe
+    # feasibility against a hypothetical memory budget)
+    hbm_bytes_override: Optional[int] = None
 
     @property
     def num_chips(self) -> int:
@@ -173,6 +176,8 @@ class MachineSpec:
 
     @property
     def hbm_bytes(self) -> int:
+        if self.hbm_bytes_override is not None:
+            return self.hbm_bytes_override
         return int(CHIP_SPECS[self.chip][2] * (1 << 30))
 
     @property

@@ -198,12 +198,15 @@ def pipeline_strategy(
     pp: int,
     structure=None,
     num_microbatches: int = 4,
+    schedule: str = "gpipe",
     name_prefix: str = "pipeline",
 ) -> Strategy:
     """dp × pp strategy: batch on "data", the repeated trunk GPipe'd over
-    the "pipe" axis (the reference declares OP_PIPELINE but never
-    implements it, ffconst.h:151 — this closes that gap). `structure` is
-    a search.blocks.BlockStructure; detected here when omitted."""
+    the "pipe" axis with stage weights SHARDED over it (the reference
+    declares OP_PIPELINE but never implements it, ffconst.h:151 — this
+    closes that gap). `structure` is a search.blocks.BlockStructure;
+    detected here when omitted. schedule: "gpipe" | "1f1b"
+    (runtime.pipeline_executor.PipelineSpec)."""
     from flexflow_tpu.runtime.pipeline_executor import PipelineSpec
     from flexflow_tpu.search.blocks import find_block_structure
 
@@ -231,8 +234,9 @@ def pipeline_strategy(
         name=(
             f"{name_prefix}: mesh(data={dp}, pipe={pp}), "
             f"{structure.num_blocks} blocks"
+            + (f", {schedule}" if schedule != "gpipe" else "")
         ),
-        pipeline=PipelineSpec(pp, num_microbatches, structure),
+        pipeline=PipelineSpec(pp, num_microbatches, structure, schedule),
     )
 
 

@@ -151,13 +151,14 @@ class Executor:
 
     # -- parameters ----------------------------------------------------------
 
-    def init_params(self, rng) -> Dict[int, List[jnp.ndarray]]:
+    def init_params(self, rng, skip_guids=frozenset()) -> Dict[int, List[jnp.ndarray]]:
         """Initialize + shard all weights (reference: initializer tasks at
-        Op::init, SURVEY §2.1)."""
+        Op::init, SURVEY §2.1). skip_guids: nodes a subclass stores
+        differently (the pipelined executor's stacked trunk)."""
         params: Dict[int, List[jnp.ndarray]] = {}
         for guid in self.topo:
             node = self.graph.nodes[guid]
-            if not node.weight_shapes:
+            if not node.weight_shapes or guid in skip_guids:
                 continue
             ws = []
             inits = node.params.get("initializers")
@@ -175,13 +176,13 @@ class Executor:
         return params
 
     def place_params(
-        self, host_params: Dict[int, List[np.ndarray]]
+        self, host_params: Dict[int, List[np.ndarray]], skip_guids=frozenset()
     ) -> Dict[int, List[jnp.ndarray]]:
         """Re-shard host weights onto the mesh (checkpoint restore path)."""
         params: Dict[int, List[jnp.ndarray]] = {}
         for guid in self.topo:
             node = self.graph.nodes[guid]
-            if not node.weight_shapes:
+            if not node.weight_shapes or guid in skip_guids:
                 continue
             if guid not in host_params:
                 raise KeyError(
@@ -198,6 +199,46 @@ class Executor:
                 ws.append(jax.device_put(jnp.asarray(arr), self.sharding_for(wshape)))
             params[guid] = ws
         return params
+
+    def export_host_params(self, params):
+        """Params in the on-disk checkpoint layout (per-guid). The base
+        executor's storage IS that layout (copied, so callers can edit
+        without touching live state); the pipelined executor overrides to
+        unstack its pipe-sharded trunk."""
+        return {g: list(ws) for g, ws in params.items()}
+
+    def export_host_opt_state(self, opt_state):
+        """Optimizer state in the on-disk layout: subtrees that mirror
+        the params pytree (SGD velocity, Adam m/v) go through the same
+        per-guid conversion as the params themselves."""
+        out = {}
+        for k, v in opt_state.items():
+            out[k] = self.export_host_params(v) if isinstance(v, dict) else v
+        return out
+
+    def place_opt_state(self, host_state):
+        """Restore optimizer state saved by export_host_opt_state: mirror
+        subtrees re-place like weights (same shapes/shardings), scalars
+        pass through."""
+        out = {}
+        for k, v in host_state.items():
+            out[k] = (
+                self.place_params(v)
+                if isinstance(v, dict)
+                else jnp.asarray(v)
+            )
+        return out
+
+    def get_host_param(self, params, guid: int, idx: int):
+        """One weight, in its logical per-guid shape."""
+        return params[guid][idx]
+
+    def set_host_param(self, params, guid: int, idx: int, val):
+        """Write one weight in place (val already validated/dtyped)."""
+        node = self.graph.nodes[guid]
+        params[guid][idx] = jax.device_put(
+            val, self.sharding_for(node.weight_shapes[idx])
+        )
 
     # -- forward -------------------------------------------------------------
 

@@ -1189,16 +1189,32 @@ class FFModel:
         )
 
     def get_tensor(self, guid: int, idx: int = 0) -> np.ndarray:
-        """Pull a weight to host (reference: ParallelTensor get_tensor)."""
-        return np.asarray(self.params[self._live_guid(guid)][idx])
+        """Pull a weight to host (reference: ParallelTensor get_tensor).
+        Pipelined trunks read the one [block] slice of their pipe-sharded
+        stack (Executor.get_host_param) — never the whole export view."""
+        guid = self._live_guid(guid)
+        return np.asarray(
+            self.executor.get_host_param(self.params, guid, idx)
+        )
 
     def set_tensor(self, guid: int, idx: int, value: np.ndarray):
         guid = self._live_guid(guid)
         node = self.graph.nodes[guid]
-        sharding = self.executor.sharding_for(node.weight_shapes[idx])
-        self.params[guid][idx] = jax.device_put(
-            jnp.asarray(value, node.weight_shapes[idx].dtype.to_jnp()), sharding
+        val = jnp.asarray(value, node.weight_shapes[idx].dtype.to_jnp())
+        expect = tuple(
+            d.size
+            for d in node.weight_shapes[idx].dims
+            if not d.is_replica_dim
         )
+        if tuple(val.shape) != expect:
+            # validate BEFORE any mutation (a stacked [S, ...] write to a
+            # pipelined template guid must not silently replace the
+            # pipe-sharded stack; use checkpoint restore for bulk loads)
+            raise ValueError(
+                f"set_tensor for {node.name} expects shape {expect}, "
+                f"got {tuple(val.shape)}"
+            )
+        self.executor.set_host_param(self.params, guid, idx, val)
 
     # --------------------------------------------------------- checkpointing
     # The reference has no model checkpointing (SURVEY §5); this is the
@@ -1214,8 +1230,14 @@ class FFModel:
         mgr.save(
             step,
             {
-                "params": self.params,
-                "opt_state": self.opt_state,
+                # on-disk layout is always per-guid (the pipelined
+                # executor unstacks its pipe-sharded trunk), so
+                # checkpoints restore across strategies — optimizer
+                # state subtrees that mirror params convert the same way
+                "params": self.executor.export_host_params(self.params),
+                "opt_state": self.executor.export_host_opt_state(
+                    self.opt_state
+                ),
                 "rng": self._rng,
             },
         )
@@ -1256,9 +1278,9 @@ class FFModel:
         mgr = CheckpointManager(directory)
         step, state = mgr.restore(step)
         self.params = self.executor.place_params(state["params"])
-        self.opt_state = jax.tree_util.tree_map(
-            jnp.asarray, state["opt_state"]
-        )
+        # mirror subtrees (momentum/Adam moments) re-place like weights,
+        # so stateful optimizers survive cross-strategy restores too
+        self.opt_state = self.executor.place_opt_state(state["opt_state"])
         if "rng" in state:
             self._rng = jnp.asarray(state["rng"])
         return step

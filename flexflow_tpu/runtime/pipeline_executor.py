@@ -16,11 +16,16 @@ Execution model:
               runs S/pp consecutive blocks via an inner lax.scan;
   epilogue  — ordinary PCG walk on the pipeline output.
 
-v1 restrictions (documented, enforced):
-  * block weights are stored per-guid like every other executor weight
-    (optimizer/checkpoint machinery unchanged) and stacked inside the
-    step; storage is therefore replicated, the pipeline parallelizes
-    compute and activation memory, not weight storage;
+Weight storage (round 3): trunk weights are stored STACKED per template
+position — one [S, ...] array per weight, leading (block) axis sharded
+over the "pipe" mesh axis — so each stage holds only its S/pp blocks'
+weights plus optimizer state. This is the thing pipeline parallelism
+exists for at scale: a trunk too big for one chip fits sharded.
+Checkpoints stay per-block on disk (export_host_params unstacks,
+place_params re-stacks), so pipeline checkpoints restore into DP
+strategies and vice versa.
+
+Remaining v1 restrictions (documented, enforced):
   * no TP/SP inside a pipelined trunk (the search proposes pp only as a
     (dp, pp) mesh);
   * ops needing the mesh inside the trunk (ring attention) fall back to
@@ -34,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from flexflow_tpu.core.pcg import PCGGraph, TensorRef
 from flexflow_tpu.core.types import OperatorType
@@ -44,11 +50,22 @@ from flexflow_tpu.search.blocks import BlockStructure
 
 @dataclasses.dataclass
 class PipelineSpec:
-    """How compile() should pipeline the trunk."""
+    """How compile() should pipeline the trunk.
+
+    schedule: "gpipe" stores every block's internal activations for the
+    backward; "1f1b" rematerializes each block body, so stored residuals
+    shrink to the stage-boundary activations. In this SPMD lax.scan
+    formulation the reverse-mode schedule already interleaves one
+    microbatch backward per step (the autodiff of the scan), matching
+    1F1B's steady state and bubble count — what distinguishes 1F1B is
+    its BOUNDED per-stage activation memory, which the remat delivers
+    (see test_pipeline_sharded.py::test_1f1b_bounds_activation_memory).
+    """
 
     pp: int
     num_microbatches: int
     structure: BlockStructure
+    schedule: str = "gpipe"
 
     def validate(self, batch_per_replica: int):
         s = self.structure.num_blocks
@@ -60,6 +77,10 @@ class PipelineSpec:
             raise ValueError(
                 f"per-replica batch {batch_per_replica} not divisible by "
                 f"num_microbatches={self.num_microbatches}"
+            )
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be gpipe|1f1b, got {self.schedule!r}"
             )
 
 
@@ -86,23 +107,171 @@ class PipelinedExecutor(Executor):
         self.exit_guid = st.blocks[-1][-1]
         if "pipe" not in self.mesh_config.axis_names:
             raise ValueError("pipelined strategy needs a 'pipe' mesh axis")
+        # trunk guids beyond block 0 have no entry in params: their
+        # weights live in block 0's (template) stacked arrays
+        self._later_block_guids = {
+            g for blk in st.blocks[1:] for g in blk
+        }
+        # guid -> (block index, template position) for per-weight access
+        self._block_index = {
+            g: (bi, i)
+            for bi, blk in enumerate(st.blocks)
+            for i, g in enumerate(blk)
+        }
 
-    # -- trunk ---------------------------------------------------------------
+    # -- trunk weight storage ------------------------------------------------
+    #
+    # Canonical storage: params[template_guid][w] is the [S, ...] STACK of
+    # all blocks' weights for that template position, sharded over "pipe"
+    # on the leading axis — each stage's devices hold only their S/pp
+    # blocks (+ the optimizer state that follows the pytree). The search's
+    # memory model divides the trunk weight term by pp accordingly
+    # (search/auto.py:_pipeline_candidate).
 
-    def _stacked_trunk_params(self, params):
-        """[S, ...]-stacked weights per weight-bearing template position,
-        as a tuple-of-tuples pytree (stable structure for shard_map)."""
+    def _stack_sharding(self, wshape):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        ndim = sum(1 for d in wshape.dims if not d.is_replica_dim)
+        return NamedSharding(
+            self.mesh, PartitionSpec("pipe", *([None] * ndim))
+        )
+
+    def init_params(self, rng):
+        """Non-trunk weights as usual; trunk weights initialized INSIDE a
+        jitted builder with pipe-sharded out_shardings, so no chip (or
+        host transfer) ever materializes the full replicated stack. Each
+        block's slice uses the same fold_in key the plain executor would
+        give that block — a pipelined model starts bit-identical to its
+        DP lowering (the loss-parity tests rely on this)."""
+        from flexflow_tpu.runtime.initializer import (
+            default_weight_initializer,
+        )
+
+        params = super().init_params(
+            rng, skip_guids=self._later_block_guids | set(self.template)
+        )
         blocks = self.pspec.structure.blocks
-        stacked = []
+        for i, tguid in enumerate(self.template):
+            node = self.graph.nodes[tguid]
+            if not node.weight_shapes:
+                continue
+            ws = []
+            inits = node.params.get("initializers")
+            for w_idx, wshape in enumerate(node.weight_shapes):
+                init = (
+                    inits[w_idx]
+                    if inits is not None and inits[w_idx] is not None
+                    else default_weight_initializer(node.name, w_idx, wshape)
+                )
+
+                def build(init=init, w_idx=w_idx, i=i):
+                    return jnp.stack(
+                        [
+                            init.create(
+                                jax.random.fold_in(
+                                    rng, blk[i] * 131 + w_idx
+                                ),
+                                wshape,
+                            )
+                            for blk in blocks
+                        ]
+                    )
+
+                ws.append(
+                    jax.jit(
+                        build, out_shardings=self._stack_sharding(wshape)
+                    )()
+                )
+            params[tguid] = ws
+        return params
+
+    def place_params(self, host_params):
+        """Checkpoint-restore path. Accepts per-block host weights (the
+        on-disk format, shared with every other executor) or an
+        already-stacked [S, ...] layout, and re-shards over "pipe"."""
+        blocks = self.pspec.structure.blocks
+        S = len(blocks)
+        params = super().place_params(
+            host_params,
+            skip_guids=self._later_block_guids | set(self.template),
+        )
+        for i, tguid in enumerate(self.template):
+            node = self.graph.nodes[tguid]
+            if not node.weight_shapes:
+                continue
+            ws = []
+            for w_idx, wshape in enumerate(node.weight_shapes):
+                expect = tuple(
+                    d.size for d in wshape.dims if not d.is_replica_dim
+                )
+                if tguid in host_params and tuple(
+                    np.shape(host_params[tguid][w_idx])
+                ) == (S,) + expect:
+                    stacked = jnp.asarray(host_params[tguid][w_idx])
+                else:
+                    per_block = []
+                    for blk in blocks:
+                        if blk[i] not in host_params:
+                            raise KeyError(
+                                f"checkpoint missing weights for block "
+                                f"node {blk[i]} ({node.name})"
+                            )
+                        arr = host_params[blk[i]][w_idx]
+                        if tuple(np.shape(arr)) != expect:
+                            raise ValueError(
+                                f"checkpoint weight for {node.name} has "
+                                f"shape {tuple(np.shape(arr))}, model "
+                                f"expects {expect}"
+                            )
+                        per_block.append(jnp.asarray(arr))
+                    stacked = jnp.stack(per_block)
+                ws.append(
+                    jax.device_put(stacked, self._stack_sharding(wshape))
+                )
+            params[tguid] = ws
+        return params
+
+    def export_host_params(self, params):
+        """Unstack trunk storage into the per-block on-disk layout, so a
+        pipeline checkpoint restores into ANY strategy (and vice versa)."""
+        tmpl = set(self.template)
+        out = {
+            g: list(ws) for g, ws in params.items() if g not in tmpl
+        }
+        blocks = self.pspec.structure.blocks
         for i, tguid in enumerate(self.template):
             if not self.graph.nodes[tguid].weight_shapes:
                 continue
-            per_w = []
-            for w_idx in range(len(params[tguid])):
-                per_w.append(
-                    jnp.stack([params[blk[i]][w_idx] for blk in blocks])
-                )
-            stacked.append(tuple(per_w))
+            for bi, blk in enumerate(blocks):
+                out[blk[i]] = [w[bi] for w in params[tguid]]
+        return out
+
+    def get_host_param(self, params, guid: int, idx: int):
+        """One weight in its logical shape — trunk weights read their
+        single [bi] slice of the stack, not the whole export view."""
+        loc = self._block_index.get(guid)
+        if loc is None:
+            return params[guid][idx]
+        bi, i = loc
+        return params[self.template[i]][idx][bi]
+
+    def set_host_param(self, params, guid: int, idx: int, val):
+        loc = self._block_index.get(guid)
+        if loc is None:
+            return super().set_host_param(params, guid, idx, val)
+        bi, i = loc
+        tguid = self.template[i]
+        # .at[].set keeps the pipe sharding of the stacked storage
+        params[tguid][idx] = params[tguid][idx].at[bi].set(val)
+
+    def _stacked_trunk_params(self, params):
+        """The shard_map-ready tuple-of-tuples view of the trunk storage
+        (already stacked and pipe-sharded — a direct read)."""
+        stacked = []
+        for tguid in self.template:
+            if not self.graph.nodes[tguid].weight_shapes:
+                continue
+            stacked.append(tuple(params[tguid]))
         return tuple(stacked)
 
     def _block_fn(self, rng, train):
@@ -139,6 +308,13 @@ class PipelinedExecutor(Executor):
                 for o_idx, out in enumerate(outs):
                     values[(i, o_idx)] = out
             return values[(len(template_nodes) - 1, 0)]
+
+        if self.pspec.schedule == "1f1b":
+            # the reverse scan already interleaves microbatch backwards
+            # 1F1B-style (PipelineSpec docstring); remat'ing each block
+            # body delivers 1F1B's bounded activation memory — stored
+            # residuals shrink to stage-boundary activations
+            one_block = jax.checkpoint(one_block)
 
         def stage_fn(stage_params, x):
             bps = self.pspec.structure.num_blocks // self.pspec.pp

@@ -149,51 +149,83 @@ def _pipeline_candidate(
         return None
     block_guids = {gg for blk in structure.blocks for gg in blk}
     trunk = 0.0
+    trunk_fwd = 0.0
     rest = 0.0
     sync = 0.0
     update = 0.0
-    weight_bytes = 0.0
+    trunk_weight_bytes = 0.0
+    rest_weight_bytes = 0.0
     act_bytes = 0.0
+    trunk_act_bytes = 0.0
     for guid, node in g.nodes.items():
         if node.op_type == OperatorType.INPUT or node.is_parallel_op:
             continue
         in_shapes = [g.shape_of(r) for r in node.inputs]
         c = cm.op_cost(node, in_shapes)
         t = c.forward_time + c.backward_time
-        act_bytes += sum(s.piece_bytes() for s in node.output_shapes)
+        out_bytes = sum(s.piece_bytes() for s in node.output_shapes)
+        act_bytes += out_bytes
         if guid in block_guids:
             trunk += t
+            trunk_fwd += c.forward_time
+            trunk_act_bytes += out_bytes
         else:
             rest += t
         for w in node.weight_shapes:
-            # weights replicate over BOTH axes in v1 storage, but grads
-            # only need reducing over the dp replicas that computed them
-            weight_bytes += w.piece_bytes()
+            # grads only need reducing over the dp replicas that
+            # computed them
+            if guid in block_guids:
+                trunk_weight_bytes += w.piece_bytes()
+            else:
+                rest_weight_bytes += w.piece_bytes()
             if dp > 1:
                 sync += cm.all_reduce(cm.piece_bytes(w), dp)
             update += cm.update_cost(w)
     stage = trunk / pp
     stretch = (mb + pp - 1) / mb
     exit_shape = g.shape_of(TensorRef(structure.blocks[-1][-1], 0))
-    hop_bytes = exit_shape.piece_volume() * cm.elem_bytes(exit_shape) / mb
+    boundary_bytes = exit_shape.piece_volume() * cm.elem_bytes(exit_shape)
+    hop_bytes = boundary_bytes / mb
     hops = 2.0 * (mb + pp - 2) * cm._ici_time(hop_bytes) if pp > 1 else 0.0
     # compute and hop transfers overlap in the schedule (a stage sends
     # microbatch i while computing i+1): the trunk is bounded by whichever
     # resource saturates, not their sum
     trunk_time = max(stage * stretch, hops)
+    # trunk weights (+grads+opt state, the 3.0) are STACKED and sharded
+    # over the pipe axis (runtime/pipeline_executor.py storage), so each
+    # chip holds 1/pp of them; prologue/epilogue weights replicate.
+    weight_mem = rest_weight_bytes * 3.0 + trunk_weight_bytes * 3.0 / pp
+    # activation residuals: gpipe stores each block's internals; 1f1b
+    # remats block bodies, keeping only stage-boundary activations per
+    # in-flight microbatch (PipelineSpec.schedule)
+    mem_gpipe = int(weight_mem + act_bytes / pp)
+    mem_1f1b = int(
+        weight_mem
+        + (act_bytes - trunk_act_bytes) / pp
+        + boundary_bytes * (structure.num_blocks / pp)
+    )
+    schedule = "gpipe"
+    memory = mem_gpipe
+    if spec is not None:
+        probe = GraphCost(0, 0, 0, 0, 0, memory_per_chip=mem_gpipe)
+        if not probe.feasible(spec) and mem_1f1b < mem_gpipe:
+            schedule = "1f1b"
+            memory = mem_1f1b
+            # remat recomputes each block's forward during the backward
+            # (jax.checkpoint in pipeline_executor._block_fn) — the
+            # memory saving is not free
+            trunk_time = max((trunk + trunk_fwd) / pp * stretch, hops)
     cost = GraphCost(
         step_time=rest + trunk_time + sync + update,
         compute_time=rest + trunk,
         comm_time=hops,
         sync_time=sync,
         update_time=update,
-        # v1 pipeline storage REPLICATES weights on every chip
-        # (runtime/pipeline_executor.py) — the feasibility gate must see
-        # the full weight footprint, not a sharded one
-        memory_per_chip=int(weight_bytes * 3.0 + act_bytes / pp),
+        memory_per_chip=memory,
     )
     if spec is not None and not cost.feasible(spec):
         return None
+    cost.schedule = schedule
     return cost
 
 
@@ -256,11 +288,12 @@ class SearchResult:
                 f"simulated step {self.cost.step_time * 1e3:.3f} ms"
             )
         if self.kind == "pipeline":
+            sched = self.extra.get("schedule", "gpipe")
             return (
                 f"mesh(data={self.dp}, pipe={self.extra['pp']}), "
                 f"{self.extra['num_blocks']} blocks, "
-                f"{self.extra['mb']} microbatches, simulated step "
-                f"{self.cost.step_time * 1e3:.3f} ms"
+                f"{self.extra['mb']} microbatches ({sched}), simulated "
+                f"step {self.cost.step_time * 1e3:.3f} ms"
             )
         n_on = sum(self.on)
         return (
@@ -436,6 +469,7 @@ def optimize(
                         "pp": pp,
                         "mb": mb,
                         "num_blocks": structure.num_blocks,
+                        "schedule": getattr(cost, "schedule", "gpipe"),
                     },
                 )
                 if verbose:
@@ -507,6 +541,7 @@ def result_to_strategy(result: SearchResult, graph: PCGGraph) -> Strategy:
             result.dp,
             result.extra["pp"],
             num_microbatches=result.extra["mb"],
+            schedule=result.extra.get("schedule", "gpipe"),
             name_prefix=prefix,
         )
     sites = [s for s, enabled in zip(result.sites, result.on) if enabled]

@@ -155,6 +155,7 @@ def load_strategy(path: str, graph: PCGGraph, num_devices: int) -> Strategy:
             dp,
             pp,
             num_microbatches=int(extra.get("mb", 4)),
+            schedule=extra.get("schedule", "gpipe"),
             name_prefix=f"imported:{path}",
         )
 

@@ -1,0 +1,276 @@
+"""Pipeline weight sharding + 1F1B (VERDICT r2 item 2): trunk weights are
+stored stacked and sharded over the "pipe" axis, so each stage holds only
+its S/pp blocks — the capability pipeline parallelism exists for (a model
+too big for one chip fits sharded). Plus the 1f1b schedule (remat'd block
+bodies) bounding stored activations, and cross-strategy checkpoints."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MachineSpec,
+    SGDOptimizer,
+)
+from flexflow_tpu.parallel.strategy import pipeline_strategy
+
+
+def _deep_mlp(width=64, blocks=8, batch=16, compile_kw=None):
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, width], name="x")
+    t = x
+    for _ in range(blocks):
+        t = m.dense(t, width, activation=ActiMode.RELU, use_bias=False)
+    m.dense(t, 4, use_bias=False)
+    if compile_kw is not None:
+        m.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[],
+            **compile_kw,
+        )
+    return m
+
+
+def _data(batch=16, width=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(2 * batch, width)).astype(np.float32)
+    y = rng.integers(0, 4, size=(2 * batch,)).astype(np.int32)
+    return x, y
+
+
+def test_trunk_weights_sharded_over_pipe():
+    """Per-chip trunk weight bytes ~ total/pp under pp=8."""
+    m = _deep_mlp()
+    s = pipeline_strategy(m.graph, 1, 8, num_microbatches=4)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=s,
+    )
+    ex = m.executor
+    tguid = ex.template[0]
+    stacked = m.params[tguid][0]
+    assert stacked.shape[0] == 8  # S blocks on the leading axis
+    # each device holds exactly S/pp = 1 block's rows of the stack
+    shard_bytes = [
+        np.prod(sh.data.shape) * stacked.dtype.itemsize
+        for sh in stacked.addressable_shards
+    ]
+    total = np.prod(stacked.shape) * stacked.dtype.itemsize
+    assert len(set(shard_bytes)) == 1
+    assert shard_bytes[0] * 8 == total
+    # and the sharding really is over the pipe axis
+    spec = stacked.sharding.spec
+    assert spec[0] == "pipe"
+
+
+def test_pipeline_matches_dp_losses_with_sharded_storage():
+    x, y = _data()
+    m_pp = _deep_mlp()
+    s = pipeline_strategy(m_pp.graph, 1, 4, num_microbatches=4)
+    m_pp.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=s,
+    )
+    h_pp = m_pp.fit(x, y, epochs=3, verbose=False)
+    m_dp = _deep_mlp(compile_kw={})
+    h_dp = m_dp.fit(x, y, epochs=3, verbose=False)
+    np.testing.assert_allclose(
+        [h["loss_sum"] for h in h_pp],
+        [h["loss_sum"] for h in h_dp],
+        rtol=2e-4,
+    )
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_1f1b_trains_and_matches_gpipe(schedule):
+    x, y = _data()
+    m = _deep_mlp()
+    s = pipeline_strategy(
+        m.graph, 1, 4, num_microbatches=4, schedule=schedule
+    )
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=s,
+    )
+    h = m.fit(x, y, epochs=2, verbose=False)
+    if not hasattr(test_1f1b_trains_and_matches_gpipe, "_ref"):
+        test_1f1b_trains_and_matches_gpipe._ref = [
+            e["loss_sum"] for e in h
+        ]
+    else:
+        # remat must not change numerics
+        np.testing.assert_allclose(
+            [e["loss_sum"] for e in h],
+            test_1f1b_trains_and_matches_gpipe._ref,
+            rtol=1e-5,
+        )
+
+
+def test_1f1b_bounds_activation_memory():
+    """The 1f1b schedule's remat shrinks the train step's temp memory
+    (stored residuals) versus gpipe on the same model."""
+    import jax
+
+    def temp_bytes(schedule):
+        m = _deep_mlp(width=128, blocks=8, batch=32)
+        s = pipeline_strategy(
+            m.graph, 1, 4, num_microbatches=8, schedule=schedule
+        )
+        m.compile(
+            optimizer=SGDOptimizer(lr=0.05),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[],
+            strategy=s,
+        )
+        step = m.executor.train_step_fn()
+        batch = m.executor.shard_batch(
+            {
+                "x": np.zeros((32, 128), np.float32),
+                "label": np.zeros((32,), np.int32),
+            }
+        )
+        lowered = jax.jit(step).lower(
+            m.params, m.opt_state, batch, jax.random.PRNGKey(0)
+        )
+        ana = lowered.compile().memory_analysis()
+        return ana.temp_size_in_bytes
+
+    assert temp_bytes("1f1b") < temp_bytes("gpipe")
+
+
+def test_search_picks_pipeline_when_weights_fit_only_sharded():
+    """A trunk whose weights exceed per-chip memory replicated but fit at
+    1/pp must yield a feasible pipeline candidate (and an infeasible dp
+    one) — the search's memory model now matches the sharded storage."""
+    from flexflow_tpu.search.auto import optimize
+
+    m = _deep_mlp(width=256, blocks=8)
+    # trunk weights: 8 blocks x 256x256 f32 = 2 MB; pick a budget between
+    # full (replicated) and 1/8 (sharded)
+    spec = MachineSpec(
+        num_nodes=1, chips_per_node=8, hbm_bytes_override=int(1.1e6)
+    )
+    r = optimize(m.graph, 8, spec, budget=20)
+    assert r.kind == "pipeline", r.describe()
+    assert r.extra["pp"] >= 2
+
+
+def test_pipeline_checkpoint_restores_into_dp(tmp_path):
+    """Checkpoints written under pipeline (stacked, pipe-sharded) restore
+    into a plain DP compile — on-disk layout stays per-block."""
+    x, y = _data()
+    m = _deep_mlp()
+    s = pipeline_strategy(m.graph, 1, 4, num_microbatches=4)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=s,
+    )
+    m.fit(x, y, epochs=1, verbose=False)
+    ckpt = str(tmp_path / "ck")
+    m.save_checkpoint(ckpt, step=0)
+
+    m2 = _deep_mlp(compile_kw={})
+    m2.restore_checkpoint(ckpt)
+    # parity: evaluating both on the same batch gives the same loss
+    p1 = m.evaluate(x, y)
+    p2 = m2.evaluate(x, y)
+    assert np.isclose(
+        p1.loss_sum / max(p1.train_all, 1),
+        p2.loss_sum / max(p2.train_all, 1),
+        rtol=1e-4,
+    )
+
+    # and the reverse: a DP checkpoint restores into a pipelined compile
+    ckpt2 = str(tmp_path / "ck2")
+    m2.save_checkpoint(ckpt2, step=0)
+    m3 = _deep_mlp()
+    s3 = pipeline_strategy(m3.graph, 1, 4, num_microbatches=4)
+    m3.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=s3,
+    )
+    m3.restore_checkpoint(ckpt2)
+    p3 = m3.evaluate(x, y)
+    assert np.isclose(
+        p1.loss_sum / max(p1.train_all, 1),
+        p3.loss_sum / max(p3.train_all, 1),
+        rtol=1e-4,
+    )
+
+
+def test_get_set_tensor_through_stacked_trunk():
+    m = _deep_mlp()
+    s = pipeline_strategy(m.graph, 1, 4, num_microbatches=4)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=s,
+    )
+    blocks = m.executor.pspec.structure.blocks
+    g_mid = blocks[2][0]  # a block-2 dense node
+    w = m.get_tensor(g_mid)
+    assert w.shape == (64, 64)
+    new = np.full_like(w, 0.5)
+    m.set_tensor(g_mid, 0, new)
+    np.testing.assert_allclose(m.get_tensor(g_mid), new)
+    # template (block 0) reads its own slice, not the stack
+    w0 = m.get_tensor(blocks[0][0])
+    assert w0.shape == (64, 64)
+
+
+def test_momentum_state_survives_cross_strategy_restore(tmp_path):
+    """Stateful optimizers (velocity/Adam moments) restore across
+    strategies: the state subtrees convert through the same per-guid
+    layout as the params (review finding on export_host_opt_state)."""
+    x, y = _data()
+    m = _deep_mlp()
+    s = pipeline_strategy(m.graph, 1, 4, num_microbatches=4)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05, momentum=0.9),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=s,
+    )
+    m.fit(x, y, epochs=2, verbose=False)
+    ckpt = str(tmp_path / "ck")
+    m.save_checkpoint(ckpt, step=0)
+
+    m2 = _deep_mlp()
+    m2.compile(
+        optimizer=SGDOptimizer(lr=0.05, momentum=0.9),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+    )
+    m2.restore_checkpoint(ckpt)
+    # training continues WITH the restored velocity (structure matches)
+    h2 = m2.fit(x, y, epochs=1, verbose=False)
+    h1 = m.fit(x, y, epochs=1, verbose=False)
+    np.testing.assert_allclose(
+        h2[0]["loss_sum"], h1[0]["loss_sum"], rtol=1e-4
+    )
+
+
+def test_set_tensor_rejects_wrong_shape_without_corruption():
+    m = _deep_mlp(compile_kw={})
+    guid = next(
+        g for g, n in m.graph.nodes.items() if n.weight_shapes
+    )
+    before = m.get_tensor(guid)
+    with pytest.raises(ValueError, match="expects shape"):
+        m.set_tensor(guid, 0, np.zeros((3, 3), np.float32))
+    np.testing.assert_allclose(m.get_tensor(guid), before)
