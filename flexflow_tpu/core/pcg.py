@@ -274,3 +274,28 @@ class PCGGraph:
                 f"  {g} {n.op_type.name} '{n.name}' ({ins}) -> {outs}{mv}"
             )
         return "\n".join(lines)
+
+
+def trace_embedding_ids_input(graph: "PCGGraph", guid: int) -> Optional[TensorRef]:
+    """If `guid` is an EMBEDDING whose ids come (through layout-only
+    parallel ops) straight from a batch INPUT, return the TensorRef of
+    that input, else None.
+
+    This is THE sparse-embedding eligibility tracer — the single source
+    shared by the executor's fast path (Executor._sparse_embedding_guids,
+    runtime/executor.py) and the search's update costing
+    (search/simulator._sparse_embedding_rows), so the two can never
+    disagree about which tables take the touched-rows update."""
+    node = graph.nodes[guid]
+    if node.op_type != OperatorType.EMBEDDING:
+        return None
+    if len(node.weight_shapes) != 1 or len(node.inputs) != 1:
+        return None
+    ref = node.inputs[0]
+    src = graph.nodes[ref.guid]
+    while src.is_parallel_op and len(src.inputs) == 1:
+        ref = src.inputs[0]
+        src = graph.nodes[ref.guid]
+    if src.op_type != OperatorType.INPUT or src.inputs:
+        return None
+    return ref

@@ -303,9 +303,13 @@ class Executor:
     # -- compiled entry points ----------------------------------------------
 
     def _sparse_embedding_guids(self) -> List[int]:
-        """EMBEDDING nodes eligible for the sparse-update fast path: plain
-        SGD (no momentum / weight decay — lazy per-row state would change
-        semantics), ids read straight from a batch INPUT, unsharded table.
+        """EMBEDDING nodes eligible for the sparse-update fast path:
+        optimizer supports sparse rows (SGD incl. momentum/wd, Adam — the
+        stateful forms have LAZY semantics, Optimizer.sparse_row_update),
+        ids read straight from a batch INPUT. Sharded tables (the searched
+        model-parallel DLRM embeddings) are eligible: GSPMD partitions the
+        gather/scatter, validated vs the dense path on the 8-device mesh
+        (tests/test_sparse_embedding.py).
 
         Why it matters (beyond-reference): autodiff of jnp.take produces a
         DENSE [vocab, dim] cotangent and the optimizer walks the whole
@@ -314,29 +318,18 @@ class Executor:
         and scatter-applies the update to only the touched rows (the
         reference's embedding bwd scatter-adds into a dense grad region
         either way, embedding_kernels.cu:backward)."""
-        from flexflow_tpu.runtime.optimizer import SGDOptimizer
-
         opt = self.optimizer
-        if not self.sparse_embedding_update or not isinstance(
-            opt, SGDOptimizer
-        ):
+        if not self.sparse_embedding_update or opt is None:
             return []
-        if opt.momentum != 0.0 or opt.weight_decay != 0.0:
+        if not opt.supports_sparse():
             return []
-        out = []
-        for guid in self.topo:
-            node = self.graph.nodes[guid]
-            if node.op_type != OperatorType.EMBEDDING:
-                continue
-            if len(node.weight_shapes) != 1 or len(node.inputs) != 1:
-                continue
-            src = self.graph.nodes[node.inputs[0].guid]
-            if src.op_type != OperatorType.INPUT or src.inputs:
-                continue
-            if any(d.degree > 1 for d in node.weight_shapes[0].dims):
-                continue  # sharded tables keep the dense GSPMD path (v1)
-            out.append(guid)
-        return out
+        from flexflow_tpu.core.pcg import trace_embedding_ids_input
+
+        return [
+            guid
+            for guid in self.topo
+            if trace_embedding_ids_input(self.graph, guid) is not None
+        ]
 
     def train_step_fn(self):
         """(params, opt_state, batch, rng) -> (params, opt_state, loss, metrics)"""
@@ -360,8 +353,12 @@ class Executor:
         from flexflow_tpu.core.types import AggrMode
         from flexflow_tpu.ops.registry import LowerCtx
 
+        from flexflow_tpu.core.pcg import trace_embedding_ids_input
+
         ids_name = {
-            g: self.graph.nodes[self.graph.nodes[g].inputs[0].guid].name
+            g: self.graph.nodes[
+                trace_embedding_ids_input(self.graph, g).guid
+            ].name
             for g in sparse
         }
 
@@ -397,10 +394,15 @@ class Executor:
             (loss, mets), (gd, ga) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True
             )(dense, acts)
-            new_params, new_state = self.optimizer.update(
-                dense, gd, opt_state
+            # split out the tables' optimizer-state entries so the dense
+            # update's pytrees line up, then row-update each table with
+            # its slot (Optimizer.sparse_row_update: lazy momentum/Adam)
+            dense_state, slots = self.optimizer.split_state(
+                opt_state, sparse
             )
-            lr = self.optimizer.lr
+            new_params, new_state = self.optimizer.update(
+                dense, gd, dense_state
+            )
             for g in sparse:
                 node = self.graph.nodes[g]
                 table = params[g][0]
@@ -420,9 +422,17 @@ class Executor:
                     )
                 else:  # NONE: cotangent already one row per id
                     rows = gact
-                new_params[g] = [
-                    table.at[ids].add((-lr * rows).astype(table.dtype))
-                ]
+                dim = rows.shape[-1]
+                new_table, new_slot = self.optimizer.sparse_row_update(
+                    table,
+                    slots.get(g),
+                    ids.reshape(-1),
+                    rows.reshape(-1, dim).astype(table.dtype),
+                    new_state["step"],
+                )
+                new_params[g] = [new_table]
+                slots[g] = new_slot
+            new_state = self.optimizer.merge_state(new_state, slots)
             return new_params, new_state, loss, mets
 
         return sparse_step

@@ -37,6 +37,68 @@ class Optimizer:
     def update(self, params, grads, state):
         raise NotImplementedError
 
+    # -- sparse (touched-rows-only) updates ---------------------------------
+    #
+    # The executor's sparse-embedding fast path (executor.py
+    # _sparse_embedding_guids) updates only the rows a batch touched.
+    # With state (momentum / Adam moments) the semantics are LAZY, the
+    # standard sparse-optimizer contract (TF's LazyAdam / sparse momentum):
+    # untouched rows' state neither decays nor applies — exactly what
+    # keeps the update O(touched rows) instead of O(vocab).
+
+    def supports_sparse(self) -> bool:
+        return False
+
+    def split_state(self, state, keys):
+        """Remove `keys`' entries from params-mirroring subtrees so
+        update() can run on the dense params subset; returns
+        (dense_state, {key: {subtree_name: entry}})."""
+        keys = set(keys)
+        dense = {}
+        slots = {k: {} for k in keys}
+        for name, v in state.items():
+            if isinstance(v, dict) and keys & set(v):
+                dense[name] = {g: w for g, w in v.items() if g not in keys}
+                for k in keys & set(v):
+                    slots[k][name] = v[k]
+            else:
+                dense[name] = v
+        return dense, slots
+
+    def merge_state(self, state, slots):
+        out = dict(state)
+        for k, slot in slots.items():
+            for name, entry in slot.items():
+                out[name] = dict(out.get(name, {}))
+                out[name][k] = entry
+        return out
+
+    def sparse_row_update(self, w, slot, ids, rows, step):
+        """Apply the update to rows `ids` of `w` with cotangent `rows`
+        ([n, dim] aligned with flattened ids); `slot` is this weight's
+        state entry from split_state; `step` the post-increment step."""
+        raise NotImplementedError
+
+
+def _segment_sum_rows(ids, rows):
+    """Sum duplicate ids' rows (scatter-add linearity holds for the plain
+    gradient but NOT for stateful updates: a momentum/Adam row must see
+    the SUMMED gradient once, not one state transition per duplicate).
+    Returns (rep_ids, summed_rows, valid) where rep_ids[k] is segment k's
+    id for k < num_segments and `valid` masks the tail."""
+    n = ids.shape[0]
+    order = jnp.argsort(ids)
+    sorted_ids = ids[order]
+    sorted_rows = rows[order]
+    start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]]
+    )
+    seg = jnp.cumsum(start) - 1  # [n] segment index per row
+    summed = jnp.zeros_like(sorted_rows).at[seg].add(sorted_rows)
+    rep_ids = jnp.zeros_like(sorted_ids).at[seg].set(sorted_ids)
+    valid = jnp.arange(n) < seg[-1] + 1
+    return rep_ids, summed, valid
+
 
 @dataclasses.dataclass(frozen=True)
 class SGDOptimizer(Optimizer):
@@ -78,6 +140,40 @@ class SGDOptimizer(Optimizer):
         new_vel = jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs])
         return new_params, {"step": state["step"] + 1, "velocity": new_vel}
 
+    def supports_sparse(self) -> bool:
+        return True
+
+    def sparse_row_update(self, w, slot, ids, rows, step):
+        """Lazy sparse SGD: weight decay and momentum apply to TOUCHED
+        rows only (untouched velocities don't decay — the TF sparse-
+        momentum contract; dense SGD would keep moving untouched rows on
+        stale velocity, which is exactly the O(vocab) walk this path
+        removes)."""
+        if self.momentum == 0.0:
+            if not self.weight_decay:
+                # plain SGD: scatter-add is linear, duplicates just sum
+                return w.at[ids].add((-self.lr * rows).astype(w.dtype)), slot
+            # wd depends on w[ids]: dedup so each row applies wd once
+            rep, summed, valid = _segment_sum_rows(ids, rows)
+            g = summed + self.weight_decay * w[rep]
+            safe = jnp.where(valid, rep, w.shape[0])
+            return (
+                w.at[safe].add((-self.lr * g).astype(w.dtype), mode="drop"),
+                slot,
+            )
+
+        v = slot["velocity"][0]
+        rep, summed, valid = _segment_sum_rows(ids, rows)
+        g = summed
+        if self.weight_decay:
+            g = g + self.weight_decay * w[rep]
+        v_rows = self.momentum * v[rep] + g
+        upd = g + self.momentum * v_rows if self.nesterov else v_rows
+        safe = jnp.where(valid, rep, w.shape[0])
+        new_v = v.at[safe].set(v_rows.astype(v.dtype), mode="drop")
+        new_w = w.at[safe].add((-self.lr * upd).astype(w.dtype), mode="drop")
+        return new_w, {"velocity": [new_v]}
+
 
 @dataclasses.dataclass(frozen=True)
 class AdamOptimizer(Optimizer):
@@ -118,3 +214,31 @@ class AdamOptimizer(Optimizer):
         ]
         unf = lambda k: jax.tree_util.tree_unflatten(treedef, [o[k] for o in outs])
         return unf(0), {"step": step, "m": unf(1), "v": unf(2)}
+
+    def supports_sparse(self) -> bool:
+        return True
+
+    def sparse_row_update(self, w, slot, ids, rows, step):
+        """Lazy Adam (the standard sparse-Adam contract): moments of
+        touched rows update with the summed gradient; untouched rows'
+        moments are frozen. Bias correction uses the GLOBAL step, same
+        alpha_t as the dense update."""
+        t = step.astype(jnp.float32)
+        alpha_t = (
+            self.alpha
+            * jnp.sqrt(1.0 - jnp.power(self.beta2, t))
+            / (1.0 - jnp.power(self.beta1, t))
+        )
+        m, v = slot["m"][0], slot["v"][0]
+        rep, summed, valid = _segment_sum_rows(ids, rows)
+        g = summed
+        if self.weight_decay:
+            g = g + self.weight_decay * w[rep]
+        m_rows = self.beta1 * m[rep] + (1 - self.beta1) * g
+        v_rows = self.beta2 * v[rep] + (1 - self.beta2) * jnp.square(g)
+        upd = alpha_t * m_rows / (jnp.sqrt(v_rows) + self.epsilon)
+        safe = jnp.where(valid, rep, w.shape[0])
+        new_m = m.at[safe].set(m_rows.astype(m.dtype), mode="drop")
+        new_v = v.at[safe].set(v_rows.astype(v.dtype), mode="drop")
+        new_w = w.at[safe].add((-upd).astype(w.dtype), mode="drop")
+        return new_w, {"m": [new_m], "v": [new_v]}

@@ -315,6 +315,7 @@ def optimize(
     mixed_precision: bool = False,
     calibration_file: str = "",
     attribute_parallel: bool = False,
+    sparse_embedding: bool = True,
 ) -> SearchResult:
     """Run the search on a PCG; returns the best found configuration."""
     cm = CostModel(
@@ -323,6 +324,7 @@ def optimize(
         machine_model=machine_model,
         mixed_precision=mixed_precision,
         calibration_file=calibration_file,
+        sparse_embedding=sparse_embedding,
     )
     rng = random.Random(seed)
     evals = 0
@@ -634,6 +636,15 @@ def search_strategy(model, num_devices: int) -> Strategy:
         measure=cfg.measure_costs,
         calibration_file=cfg.calibration_file,
         attribute_parallel=cfg.enable_attribute_parallel,
+        # mirror the executor's full gate: flag AND an optimizer that
+        # implements sparse rows (Executor._sparse_embedding_guids)
+        sparse_embedding=(
+            cfg.sparse_embedding_update
+            and (
+                model.optimizer is None
+                or model.optimizer.supports_sparse()
+            )
+        ),
     )
     print(f"[flexflow_tpu] search: best strategy = {result.describe()}")
     if cfg.export_strategy_file:

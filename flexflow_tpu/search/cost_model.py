@@ -71,6 +71,7 @@ class CostModel:
         machine_model=None,
         mixed_precision: bool = False,
         calibration_file: str = "",
+        sparse_embedding: bool = True,
     ):
         """machine_model: an optional search.machine_model.MachineModel
         (Enhanced / Networked); when given, collectives are costed as ring
@@ -89,6 +90,9 @@ class CostModel:
         self.efficiency = efficiency
         self.machine_model = machine_model
         self.mixed_precision = mixed_precision
+        # mirror of FFConfig.sparse_embedding_update: eligible tables'
+        # optimizer traffic is touched-rows-sized (sparse_update_cost)
+        self.sparse_embedding = sparse_embedding
         # measured-mode cache: stable string key -> (fwd_s, bwd_s) | None
         # (reference: hash_to_operator_cost, simulator.cc:532-572). When
         # calibration_file is set the table persists across processes, so
@@ -520,6 +524,21 @@ class CostModel:
         is costed separately). Traffic ≈ read w + read g + r/w each state
         slot + write w = (2·state_factor − 1) × master-precision bytes."""
         traffic = (2.0 * state_factor - 1.0) * weight_shape.piece_bytes()
+        return traffic / (self.spec.hbm_gbps * 1e9 * self.efficiency)
+
+    def sparse_update_cost(
+        self,
+        weight_shape: ParallelTensorShape,
+        rows_per_step: float,
+        state_factor: float = 3.0,
+    ) -> float:
+        """Optimizer update of a sparse-eligible embedding table
+        (Executor._sparse_embedding_guids): only the batch's touched rows
+        move, so traffic is rows x dim, not vocab x dim — the term that
+        makes the measured 587x DLRM update win visible to the search."""
+        dim = weight_shape.dims[-1].piece_size
+        elem = self.elem_bytes(weight_shape)
+        traffic = (2.0 * state_factor - 1.0) * rows_per_step * dim * elem
         return traffic / (self.spec.hbm_gbps * 1e9 * self.efficiency)
 
     # -- calibration-table persistence --------------------------------------

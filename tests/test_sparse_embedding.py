@@ -17,21 +17,32 @@ from flexflow_tpu import (
 from flexflow_tpu.core.types import AggrMode
 
 
-def build(aggr=AggrMode.SUM, sparse=True, momentum=0.0, batch=32, bag=4):
+def build(
+    aggr=AggrMode.SUM,
+    sparse=True,
+    batch=32,
+    bag=4,
+    vocab=1000,
+    optimizer=None,
+    strategy=None,
+):
     cfg = FFConfig(batch_size=batch, seed=7)
     cfg.sparse_embedding_update = sparse
     cfg.enable_substitution = False
     m = FFModel(cfg)
     shape = [batch, bag] if aggr != AggrMode.NONE else [batch]
     ids = m.create_tensor(shape, dtype=DataType.INT32, name="ids")
-    t = m.embedding(ids, 1000, 16, aggr=aggr)
+    t = m.embedding(ids, vocab, 16, aggr=aggr)
     if aggr == AggrMode.NONE:
         t = m.reshape(t, [batch, 16])
     m.dense(t, 4)
+    if callable(strategy):  # derive the strategy from THIS model's graph
+        strategy = strategy(m.graph)
     m.compile(
-        optimizer=SGDOptimizer(lr=0.05, momentum=momentum),
+        optimizer=optimizer or SGDOptimizer(lr=0.05),
         loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
         metrics=[],
+        strategy=strategy,
     )
     return m
 
@@ -50,7 +61,15 @@ def batch_for(aggr, batch=32, bag=4, seed=0):
 def test_eligibility():
     assert build(sparse=True).executor._sparse_embedding_guids()
     assert not build(sparse=False).executor._sparse_embedding_guids()
-    assert not build(momentum=0.9).executor._sparse_embedding_guids()
+    # stateful optimizers are eligible since round 3 (lazy semantics)
+    assert build(
+        optimizer=SGDOptimizer(lr=0.05, momentum=0.9)
+    ).executor._sparse_embedding_guids()
+    from flexflow_tpu import AdamOptimizer
+
+    assert build(
+        optimizer=AdamOptimizer(alpha=0.01)
+    ).executor._sparse_embedding_guids()
 
 
 @pytest.mark.parametrize("aggr", [AggrMode.SUM, AggrMode.AVG, AggrMode.NONE])
@@ -84,3 +103,128 @@ def test_untouched_rows_unchanged():
     untouched = np.setdiff1d(np.arange(1000), touched)
     np.testing.assert_array_equal(before[untouched], after[untouched])
     assert not np.allclose(before[touched], after[touched])
+
+
+def full_coverage_batch(vocab=8, batch=32, bag=4, seed=0):
+    """Every vocab row appears in every batch — on such data the LAZY
+    stateful update coincides exactly with the dense optimizer (all rows
+    are 'touched'), giving a falsifiable equality test."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, (batch, bag)).astype(np.int32)
+    ids[:vocab, 0] = np.arange(vocab)  # guarantee coverage
+    y = rng.randint(0, 4, (batch,)).astype(np.int32)
+    return {"ids": ids}, y
+
+
+def _opt(name):
+    from flexflow_tpu import AdamOptimizer
+
+    return {
+        "momentum": SGDOptimizer(lr=0.05, momentum=0.9),
+        "nesterov": SGDOptimizer(lr=0.05, momentum=0.9, nesterov=True),
+        "wd": SGDOptimizer(lr=0.05, weight_decay=0.01),
+        "adam": AdamOptimizer(alpha=0.01),
+    }[name]
+
+
+@pytest.mark.parametrize("name", ["momentum", "nesterov", "wd", "adam"])
+def test_stateful_sparse_matches_dense_on_full_coverage(name):
+    """With every row touched every step, lazy == dense exactly; any
+    error in the segment-summed stateful row update shows up here
+    (duplicate ids are guaranteed by bag > vocab/batch)."""
+    data, y = full_coverage_batch()
+    ms = build(vocab=8, optimizer=_opt(name), sparse=True)
+    md = build(vocab=8, optimizer=_opt(name), sparse=False)
+    assert ms.executor._sparse_embedding_guids()
+    hs = ms.fit(data, y, epochs=3, verbose=False)
+    hd = md.fit(data, y, epochs=3, verbose=False)
+    for a, b in zip(hs, hd):
+        assert np.isclose(a["loss_sum"], b["loss_sum"], rtol=1e-4), (hs, hd)
+    g = ms.executor._sparse_embedding_guids()[0]
+    np.testing.assert_allclose(
+        np.asarray(ms.params[g][0]),
+        np.asarray(md.params[g][0]),
+        rtol=1e-4,
+        atol=1e-6,
+    )
+
+
+def test_lazy_momentum_leaves_untouched_rows_and_state():
+    """The documented LAZY semantics: untouched rows move under dense
+    momentum (stale velocity keeps pushing them) but must NOT move — and
+    their velocity must not decay — under the sparse path."""
+    ms = build(optimizer=SGDOptimizer(lr=0.05, momentum=0.9), sparse=True)
+    g = ms.executor._sparse_embedding_guids()[0]
+    before = np.asarray(ms.params[g][0]).copy()
+    data, y = batch_for(AggrMode.SUM)
+    ms.fit(data, y, epochs=3, verbose=False)
+    after = np.asarray(ms.params[g][0])
+    touched = np.unique(data["ids"])
+    untouched = np.setdiff1d(np.arange(1000), touched)
+    np.testing.assert_array_equal(before[untouched], after[untouched])
+    assert not np.allclose(before[touched], after[touched])
+    vel = np.asarray(ms.opt_state["velocity"][g][0])
+    assert np.all(vel[untouched] == 0.0)
+    assert np.any(vel[touched] != 0.0)
+
+
+@pytest.mark.parametrize("kind", ["dp", "mixed"])
+def test_sparse_matches_dense_sharded_tables(kind):
+    """Sharded execution (ADVICE r2 + VERDICT r2 item 4): the sparse
+    scatter must agree with the dense path when the batch is sharded over
+    the 8-device data axis (dp) and when the TABLE itself is
+    model-parallel (the searched DLRM mixed strategy)."""
+    from flexflow_tpu.parallel.strategy import mixed_site_strategy
+    from flexflow_tpu.search.rewrites import EmbeddingSite, find_tp_sites
+
+    data, y = batch_for(AggrMode.SUM)
+
+    def strategy_for(graph):
+        if kind == "dp":
+            return None  # default data-parallel over the mesh
+        sites = [
+            s for s in find_tp_sites(graph) if isinstance(s, EmbeddingSite)
+        ]
+        assert sites
+        return mixed_site_strategy(graph, 8, 4, sites)
+
+    def run(sparse):
+        m = build(aggr=AggrMode.SUM, sparse=sparse, strategy=strategy_for)
+        assert bool(m.executor._sparse_embedding_guids()) == sparse
+        h = m.fit(data, y, epochs=3, verbose=False)
+        g = next(
+            gg
+            for gg, n in m.graph.nodes.items()
+            if n.op_type.name == "EMBEDDING"
+        )
+        return [e["loss_sum"] for e in h], np.asarray(
+            m.executor.get_host_param(m.params, g, 0)
+        )
+
+    ls, ts = run(True)
+    ld, td = run(False)
+    np.testing.assert_allclose(ls, ld, rtol=1e-4)
+    np.testing.assert_allclose(ts, td, rtol=1e-4, atol=1e-6)
+
+
+def test_cost_model_sees_sparse_update():
+    """The simulator's optimizer-update term for a sparse-eligible table
+    must scale with TOUCHED ROWS, not vocab (VERDICT r2 item 4: the
+    search and the executor must agree about what an update costs)."""
+    from flexflow_tpu import MachineSpec
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.simulator import estimate_graph_cost
+
+    spec = MachineSpec(num_nodes=1, chips_per_node=8)
+    m = build(sparse=True, vocab=1_000_000)
+
+    def update_time(sparse):
+        cm = CostModel(spec, sparse_embedding=sparse)
+        return estimate_graph_cost(
+            m.graph, cm, (1,)
+        ).update_time
+
+    dense_t = update_time(False)
+    sparse_t = update_time(True)
+    # 1M-row table vs 32x4 touched rows: orders of magnitude apart
+    assert sparse_t < dense_t / 100, (sparse_t, dense_t)
