@@ -86,6 +86,8 @@ def _declare(lib: ctypes.CDLL):
     lib.ffn_unity_dp.argtypes = [
         ctypes.c_int32, ctypes.c_int32, i32p, i32p, f64p,  # edges
         i64p, i64p, f64p, f64p, f64p, f64p,  # per-node scalars
+        f64p, i32p,  # optimizer-update bytes basis + dp-scaling flags
+        ctypes.c_double,  # optimizer traffic factor (2*state_factor - 1)
         ctypes.c_int32, ctypes.c_int32,  # machine geometry
         ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
         ctypes.c_int32,  # sink
@@ -162,6 +164,9 @@ def unity_dp(
     ici_eff: float,
     ici_lat: float,
     sink: int,
+    ubytes=None,  # optimizer-update bytes basis (defaults to wbytes)
+    u_dp_scaled=None,  # per-node 1 where update traffic divides by dp
+    update_factor: float = 5.0,  # 2*state_factor - 1
 ):
     """Native Unity DP (native/src/unity_dp.cc — the reference's
     SearchHelper::graph_cost role). Returns (cost, dp[], ch[]) or None
@@ -179,12 +184,21 @@ def unity_dp(
     by = np.ascontiguousarray(bytes_moved, dtype=np.float64)
     w = np.ascontiguousarray(wbytes, dtype=np.float64)
     bm = np.ascontiguousarray(bwd_mult, dtype=np.float64)
+    ub = np.ascontiguousarray(
+        wbytes if ubytes is None else ubytes, dtype=np.float64
+    )
+    us = (
+        np.zeros(n, dtype=np.int32)
+        if u_dp_scaled is None
+        else np.ascontiguousarray(u_dp_scaled, dtype=np.int32)
+    )
     out_dp = np.empty(n, dtype=np.int32)
     out_ch = np.empty(n, dtype=np.int32)
     out_cost = np.empty(1, dtype=np.float64)
     rc = lib.ffn_unity_dp(
         n, len(edges), _i32p(esrc), _i32p(edst), _f64p(ebytes),
         _i64p(b), _i64p(c), _f64p(f), _f64p(by), _f64p(w), _f64p(bm),
+        _f64p(ub), _i32p(us), update_factor,
         machine_nodes, chips_per_node, peak_eff, hbm_eff, ici_eff, ici_lat,
         sink, _i32p(out_dp), _i32p(out_ch), _f64p(out_cost),
     )

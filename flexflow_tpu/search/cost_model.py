@@ -516,14 +516,20 @@ class CostModel:
 
     # -- optimizer update ----------------------------------------------------
 
+    def update_traffic_factor(self, state_factor: float = 3.0) -> float:
+        """Bytes multiplier of one optimizer update: read w + read g +
+        r/w each state slot + write w = 2·state_factor − 1. THE shared
+        constant — unity.py and native/src/unity_dp.cc receive it from
+        here so every engine prices updates identically."""
+        return 2.0 * state_factor - 1.0
+
     def update_cost(
         self, weight_shape: ParallelTensorShape, state_factor: float = 3.0
     ) -> float:
         """HBM time of one parameter's optimizer update (reference models
         update tasks in its task graph, simulator.cc:810+; the NCCL/PS sync
-        is costed separately). Traffic ≈ read w + read g + r/w each state
-        slot + write w = (2·state_factor − 1) × master-precision bytes."""
-        traffic = (2.0 * state_factor - 1.0) * weight_shape.piece_bytes()
+        is costed separately)."""
+        traffic = self.update_traffic_factor(state_factor) * weight_shape.piece_bytes()
         return traffic / (self.spec.hbm_gbps * 1e9 * self.efficiency)
 
     def sparse_update_cost(
@@ -538,7 +544,9 @@ class CostModel:
         makes the measured 587x DLRM update win visible to the search."""
         dim = weight_shape.dims[-1].piece_size
         elem = self.elem_bytes(weight_shape)
-        traffic = (2.0 * state_factor - 1.0) * rows_per_step * dim * elem
+        traffic = (
+            self.update_traffic_factor(state_factor) * rows_per_step * dim * elem
+        )
         return traffic / (self.spec.hbm_gbps * 1e9 * self.efficiency)
 
     # -- calibration-table persistence --------------------------------------

@@ -76,6 +76,7 @@ def mcmc_optimize(
     mixed_precision: bool = False,
     measure: bool = False,
     calibration_file: str = "",
+    sparse_embedding: bool = True,
 ) -> UnityResult:
     """reference: mcmc_optimize (model.cc:3271) — budget proposals, periodic
     reset to best every budget/10 non-improving steps."""
@@ -84,6 +85,7 @@ def mcmc_optimize(
         mixed_precision=mixed_precision,
         measure=measure,
         calibration_file=calibration_file,
+        sparse_embedding=sparse_embedding,
     )
     resource = search.resource
     rng = random.Random(seed)

@@ -123,6 +123,7 @@ class UnitySearch:
         mixed_precision: bool = False,
         measure: bool = False,
         calibration_file: str = "",
+        sparse_embedding: bool = True,
     ):
         self.graph = graph
         self.spec = spec
@@ -132,6 +133,7 @@ class UnitySearch:
             mixed_precision=mixed_precision,
             measure=measure,
             calibration_file=calibration_file,
+            sparse_embedding=sparse_embedding,
         )
         self.resource = resource or spec.resource()
         self.include_backward = include_backward
@@ -287,12 +289,46 @@ class UnitySearch:
         # ids of one replica group (ids are laid out (dp, ch) row-major, so
         # a group is every ch-th device — possibly crossing nodes)
         if self.include_backward and node.weight_shapes:
-            w_bytes = (
-                sum(s.volume() * eb(s) for s in node.weight_shapes) / opt.ch
+            ub, sparse_rows = self._update_bytes(guid, node)
+            if not sparse_rows:
+                # the sparse fast path never materializes a table-sized
+                # gradient, so eligible tables pay NO grad all-reduce —
+                # matching simulator.estimate_graph_cost's basis exactly
+                w_bytes = (
+                    sum(s.volume() * eb(s) for s in node.weight_shapes)
+                    / opt.ch
+                )
+                group = opt.view.device_ids()[:: opt.ch]
+                t += self.cm.all_reduce(w_bytes, opt.dp, chips=group)
+            # optimizer update traffic (same basis as CostModel.update_cost
+            # / estimate_graph_cost): without it the engines' absolute
+            # step times are not comparable to the mesh candidates and
+            # weight-heavy dp looks free (VERDICT r2 items 6/9)
+            per_chip = ub / opt.ch / (opt.dp if sparse_rows else 1)
+            t += self.cm.update_traffic_factor() * per_chip / (
+                self.cm.spec.hbm_gbps * 1e9 * self.cm.efficiency
             )
-            group = opt.view.device_ids()[:: opt.ch]
-            t += self.cm.all_reduce(w_bytes, opt.dp, chips=group)
         return t
+
+    def _update_bytes(self, guid: int, node) -> Tuple[float, bool]:
+        """(bytes basis, divides-by-dp) for the optimizer-update term:
+        full weight bytes normally; touched-rows bytes for tables on the
+        sparse fast path (core.pcg.trace_embedding_ids_input — rows
+        follow the batch sharding, hence the dp division)."""
+        from flexflow_tpu.core.pcg import trace_embedding_ids_input
+
+        eb = self.cm.elem_bytes
+        if self.cm.sparse_embedding:
+            ref = trace_embedding_ids_input(self.graph, guid)
+            if ref is not None:
+                ids_shape = self.graph.shape_of(ref)
+                w = node.weight_shapes[0]
+                dim = w.dims[-1].size
+                return float(ids_shape.volume() * dim * eb(w)), True
+        return (
+            float(sum(s.volume() * eb(s) for s in node.weight_shapes)),
+            False,
+        )
 
     def xfer_cost(self, ref, src: ViewOption, dst: ViewOption) -> float:
         """Re-layout cost of one tensor between views (reference:
@@ -342,6 +378,7 @@ class UnitySearch:
         guids = sorted(self.graph.nodes)
         index = {g: i for i, g in enumerate(guids)}
         batch, chan, flops, bytes_moved, wbytes, bwd = [], [], [], [], [], []
+        ubytes, u_dp_scaled = [], []
         edges = []
         eb = self.cm.elem_bytes  # byte counts reach the solver pre-scaled,
         # so the native path is dtype/mixed-precision aware for free and the
@@ -357,20 +394,37 @@ class UnitySearch:
                 bytes_moved.append(0.0)
                 wbytes.append(0.0)
                 bwd.append(0.0)
+                ubytes.append(0.0)
+                u_dp_scaled.append(0)
             else:
                 flops.append(op_flops(node.op_type, in_shapes, node.params))
                 data = sum(s.volume() * eb(s) for s in in_shapes)
                 data += sum(s.volume() * eb(s) for s in node.output_shapes)
                 data += sum(s.volume() * eb(s) for s in node.weight_shapes)
                 bytes_moved.append(data)
-                wbytes.append(
-                    sum(s.volume() * eb(s) for s in node.weight_shapes)
-                )
                 mxu = is_chan or node.op_type in (
                     OperatorType.CONV2D,
                     OperatorType.BATCHMATMUL,
                 )
                 bwd.append(3.0 if mxu else 2.0)
+                if node.weight_shapes:
+                    ub, sparse_rows = self._update_bytes(g, node)
+                    ubytes.append(ub)
+                    u_dp_scaled.append(1 if sparse_rows else 0)
+                    # sparse-eligible tables never materialize a grad:
+                    # no all-reduce term (wbytes drives sync in the
+                    # native op_cost, unity_dp.cc)
+                    wbytes.append(
+                        0.0
+                        if sparse_rows
+                        else sum(
+                            s.volume() * eb(s) for s in node.weight_shapes
+                        )
+                    )
+                else:
+                    ubytes.append(0.0)
+                    u_dp_scaled.append(0)
+                    wbytes.append(0.0)
             for r in node.inputs:
                 if r.guid in index:
                     shape = self.graph.shape_of(r)
@@ -396,6 +450,9 @@ class UnitySearch:
             self.spec.ici_gbps * 1e9 * EFF,
             LAT,
             index[sink],
+            ubytes=ubytes,
+            u_dp_scaled=u_dp_scaled,
+            update_factor=self.cm.update_traffic_factor(),
         )
         if out is None:
             return None

@@ -14,6 +14,7 @@
 // Semantics mirror unity.py exactly (equivalence-tested from Python):
 //   op cost   = max(flops/n / peak, bytes/n / hbm) * bwd_mult
 //             + ring_all_reduce(wbytes / ch, dp)
+//             + ufactor * (ubytes / ch [/ dp if u_dp_scaled]) / hbm  (optim.)
 //   xfer cost = 0 if views equal else all_to_all(bytes / ndst, max(ns, nd))
 //   views     = 1-D data views (n | block, batch % n == 0, block-tileable)
 //             + 2-D (dp, ch) grids for channel ops (chan % ch == 0)
@@ -33,6 +34,8 @@ struct Machine {
   double hbm;      // effective bytes/s
   double ici;      // effective bytes/s per link
   double lat;      // seconds per hop
+  double ufactor;  // optimizer bytes multiplier (2*state_factor - 1,
+                   // received from CostModel.update_traffic_factor)
 };
 
 struct Block {  // MachineResource
@@ -63,6 +66,11 @@ struct NodeInfo {
   int64_t chan;     // channel/head size (<=0: no 2-D views)
   double flops, bytes, wbytes;
   double bwd_mult;  // 3 for MXU ops, 2 elementwise, 0 input/parallel
+  double ubytes;    // optimizer-update bytes basis (== wbytes normally;
+                    // touched-rows bytes for sparse-eligible embeddings,
+                    // whose wbytes is then 0 — no grad all-reduce)
+  int u_dp_scaled;  // 1: update traffic divides by dp too (sparse rows
+                    // follow the batch sharding, not the weight layout)
 };
 
 struct Problem {
@@ -94,6 +102,12 @@ double op_cost(const Problem &p, int node, View v) {
   double t_m = (ni.bytes / n) / p.m.hbm;
   double t = (t_f > t_m ? t_f : t_m) * ni.bwd_mult;
   if (ni.wbytes > 0) t += ring_all_reduce(p.m, ni.wbytes / v.ch, v.dp);
+  if (ni.ubytes > 0) {
+    // optimizer update HBM traffic (CostModel.update_traffic_factor)
+    double per_chip = ni.ubytes / v.ch;
+    if (ni.u_dp_scaled) per_chip /= v.dp;
+    t += p.m.ufactor * per_chip / p.m.hbm;
+  }
   return t;
 }
 
@@ -571,17 +585,20 @@ int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
                  const int64_t *batch, const int64_t *chan,
                  const double *flops, const double *bytes_moved,
                  const double *wbytes, const double *bwd_mult,
+                 const double *ubytes, const int32_t *u_dp_scaled,
+                 double update_factor,
                  int machine_nodes, int chips_per_node, double peak_eff,
                  double hbm_eff, double ici_eff, double ici_lat, int sink,
                  int32_t *out_dp, int32_t *out_ch, double *out_cost) {
   if (n_nodes <= 0 || n_nodes > kMaxNodes) return 1;
   Problem p;
   p.n = n_nodes;
-  p.m = {machine_nodes, chips_per_node, peak_eff, hbm_eff, ici_eff, ici_lat};
+  p.m = {machine_nodes, chips_per_node, peak_eff, hbm_eff,
+         ici_eff, ici_lat, update_factor};
   p.nodes.resize(n_nodes);
   for (int i = 0; i < n_nodes; ++i)
     p.nodes[i] = {batch[i], chan[i], flops[i], bytes_moved[i], wbytes[i],
-                  bwd_mult[i]};
+                  bwd_mult[i], ubytes[i], u_dp_scaled[i]};
   p.preds.assign(n_nodes, {});
   p.succs.assign(n_nodes, {});
   p.in_edges.assign(n_nodes, {});

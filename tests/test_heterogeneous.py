@@ -50,9 +50,15 @@ def test_embedding_site_detected():
 def test_unity_assigns_mixed_views():
     """Big tables + small MLP: the DP search should shard the embedding
     channel dim (cutting the table grad all-reduce) while the small dense
-    ops stay pure data-parallel — per-op heterogeneity."""
+    ops stay pure data-parallel — per-op heterogeneity.
+
+    sparse_embedding=False pins the DENSE-update scenario this test was
+    written for (custom optimizers without sparse_row_update): with the
+    round-3 sparse-aware costing, eligible tables pay no sync and
+    touched-rows updates, so unity honestly keeps them data-parallel —
+    tested separately in test_sparse_costing_flips_unity_away_from_tp."""
     m = dlrm_like()
-    result = UnitySearch(m.graph, SPEC).optimize()
+    result = UnitySearch(m.graph, SPEC, sparse_embedding=False).optimize()
     by_name = {
         m.graph.nodes[g].name: v for g, v in result.views.items()
     }
@@ -69,8 +75,9 @@ def test_unity_assigns_mixed_views():
 
 
 def test_mixed_strategy_lowers_and_trains():
+    # dense-update scenario (see test_unity_assigns_mixed_views)
     m = dlrm_like()
-    result = UnitySearch(m.graph, SPEC).optimize()
+    result = UnitySearch(m.graph, SPEC, sparse_embedding=False).optimize()
     strategy = result_to_strategy(result, m.graph, 8)
     m.compile(
         optimizer=SGDOptimizer(lr=0.01),
@@ -343,3 +350,20 @@ def test_mixed_strategy_checkpoint_restores_into_dp(tmp_path):
                 rtol=1e-6,
                 err_msg=f"weight {guid}[{i}] after cross-strategy restore",
             )
+
+
+def test_sparse_costing_flips_unity_away_from_tp():
+    """With the sparse fast path on (the default), sharding a table no
+    longer dodges any sync (none exists) and the touched-rows update is
+    already tiny — unity keeps eligible tables data-parallel, matching
+    what the executor actually runs."""
+    m = dlrm_like()
+    result = UnitySearch(m.graph, SPEC, sparse_embedding=True).optimize()
+    by_name = {m.graph.nodes[g].name: v for g, v in result.views.items()}
+    emb_chs = [
+        v.ch for name, v in by_name.items() if name.startswith("embedding")
+    ]
+    assert all(ch == 1 for ch in emb_chs), by_name
+    # and its simulated step is cheaper than the dense-update scenario's
+    dense = UnitySearch(m.graph, SPEC, sparse_embedding=False).optimize()
+    assert result.cost < dense.cost
