@@ -523,14 +523,26 @@ class CostModel:
         here so every engine prices updates identically."""
         return 2.0 * state_factor - 1.0
 
+    def update_time_from_bytes(
+        self, weight_bytes: float, state_factor: float = 3.0
+    ) -> float:
+        """THE optimizer-update HBM-time formula, shared by every engine
+        (mesh estimator, unity Python DP; the native solver receives the
+        factor and the same effective bandwidth). weight_bytes are
+        MASTER-precision bytes — optimizer state and the update walk stay
+        f32 under mixed precision."""
+        traffic = self.update_traffic_factor(state_factor) * weight_bytes
+        return traffic / (self.spec.hbm_gbps * 1e9 * self.efficiency)
+
     def update_cost(
         self, weight_shape: ParallelTensorShape, state_factor: float = 3.0
     ) -> float:
         """HBM time of one parameter's optimizer update (reference models
         update tasks in its task graph, simulator.cc:810+; the NCCL/PS sync
         is costed separately)."""
-        traffic = self.update_traffic_factor(state_factor) * weight_shape.piece_bytes()
-        return traffic / (self.spec.hbm_gbps * 1e9 * self.efficiency)
+        return self.update_time_from_bytes(
+            weight_shape.piece_bytes(), state_factor
+        )
 
     def sparse_update_cost(
         self,
@@ -541,13 +553,13 @@ class CostModel:
         """Optimizer update of a sparse-eligible embedding table
         (Executor._sparse_embedding_guids): only the batch's touched rows
         move, so traffic is rows x dim, not vocab x dim — the term that
-        makes the measured 587x DLRM update win visible to the search."""
+        makes the measured 587x DLRM update win visible to the search.
+        Master-precision bytes, like update_cost."""
         dim = weight_shape.dims[-1].piece_size
-        elem = self.elem_bytes(weight_shape)
-        traffic = (
-            self.update_traffic_factor(state_factor) * rows_per_step * dim * elem
+        elem = weight_shape.dtype.size_bytes
+        return self.update_time_from_bytes(
+            rows_per_step * dim * elem, state_factor
         )
-        return traffic / (self.spec.hbm_gbps * 1e9 * self.efficiency)
 
     # -- calibration-table persistence --------------------------------------
 

@@ -80,6 +80,8 @@ struct Problem {
   std::vector<std::vector<int>> succs;   // consumers per node
   std::vector<std::vector<std::pair<int, double>>> in_edges;  // (src, bytes)
   Machine m;
+  int allow_subblock = 0;  // cost concurrent branches on resource
+                           // sub-blocks (unity.py allow_subblock_views)
 };
 
 double ring_all_reduce(const Machine &m, double bytes_per_chip, int g) {
@@ -543,7 +545,9 @@ struct Solver {
     }
 
     // concurrent two-way: {first} vs {rest} on vertical/horizontal splits
-    if (comps.size() >= 2) {
+    // (gated like unity.py: the one-mesh lowering runs branches
+    // sequentially, so sub-block placements cost what cannot execute)
+    if (p.allow_subblock && comps.size() >= 2) {
       std::vector<std::pair<Block, Block>> splits;
       for (int i = 1; i < block.nn; ++i)
         splits.push_back({{i, block.cpn, block.sn, block.sc},
@@ -586,7 +590,7 @@ int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
                  const double *flops, const double *bytes_moved,
                  const double *wbytes, const double *bwd_mult,
                  const double *ubytes, const int32_t *u_dp_scaled,
-                 double update_factor,
+                 double update_factor, int allow_subblock,
                  int machine_nodes, int chips_per_node, double peak_eff,
                  double hbm_eff, double ici_eff, double ici_lat, int sink,
                  int32_t *out_dp, int32_t *out_ch, double *out_cost) {
@@ -595,6 +599,7 @@ int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
   p.n = n_nodes;
   p.m = {machine_nodes, chips_per_node, peak_eff, hbm_eff,
          ici_eff, ici_lat, update_factor};
+  p.allow_subblock = allow_subblock;
   p.nodes.resize(n_nodes);
   for (int i = 0; i < n_nodes; ++i)
     p.nodes[i] = {batch[i], chan[i], flops[i], bytes_moved[i], wbytes[i],

@@ -1,0 +1,83 @@
+"""Search-cost / lowering agreement for branchy graphs (VERDICT r2 item 5).
+
+The reference executes per-op MachineViews on resource sub-blocks
+(reference: graph.cc:252-306 vertical/horizontal splits + mapper.cc
+per-point placement); this rebuild's v1 lowering collapses every view to
+ONE global mesh, which runs concurrent branches sequentially. The DP must
+therefore cost branchy graphs the way the lowering executes them: with
+the default allow_subblock_views=False, the returned optimal cost EQUALS
+the simulated cost of the views actually lowered. The sub-block
+recursion survives behind the flag for search-space studies."""
+
+import numpy as np
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.search.mcmc import simulate_config
+from flexflow_tpu.search.unity import UnitySearch
+
+SPEC = MachineSpec(num_nodes=1, chips_per_node=8, chip="v5e")
+
+
+def two_branch_model(width=512, depth=3, batch=32):
+    """Two heavy parallel dense branches joined by a concat — the shape
+    where concurrent sub-block placement beats sequential (per-branch
+    grad all-reduce over fewer chips)."""
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, width], name="x")
+    a, b = x, x
+    for i in range(depth):
+        a = m.dense(a, width, activation=ActiMode.RELU, name=f"a{i}")
+        b = m.dense(b, width, activation=ActiMode.RELU, name=f"b{i}")
+    t = m.concat([a, b], axis=1)
+    m.dense(t, 4, name="head")
+    return m
+
+
+def test_default_cost_equals_lowered_simulation():
+    """Done-criterion from the verdict: the DP's returned cost equals the
+    simulated cost of the strategy actually lowered (views summed on the
+    one mesh, branches sequential)."""
+    m = two_branch_model()
+    search = UnitySearch(m.graph, SPEC)
+    result = search.optimize()
+    simulated = simulate_config(search, result.views)
+    assert np.isclose(result.cost, simulated, rtol=1e-9), (
+        result.cost,
+        simulated,
+    )
+
+
+def test_subblock_views_reproduce_the_old_divergence():
+    """With the flag ON, the DP may return a cost predicated on
+    concurrent sub-block execution — strictly below what the one-mesh
+    lowering can deliver. This documents exactly the gap the default
+    closes (if the concurrent split never wins, the flag is moot and the
+    costs agree)."""
+    m = two_branch_model()
+    search = UnitySearch(m.graph, SPEC, allow_subblock_views=True)
+    result = search.optimize()
+    simulated = simulate_config(search, result.views)
+    assert result.cost <= simulated + 1e-12
+    honest = UnitySearch(m.graph, SPEC).optimize()
+    # the optimistic cost can only be <= the honest one
+    assert result.cost <= honest.cost + 1e-12
+
+
+def test_branchy_search_result_trains():
+    m = two_branch_model(width=64, depth=2, batch=16)
+    from flexflow_tpu.search.unity import result_to_strategy
+
+    result = UnitySearch(m.graph, SPEC).optimize()
+    strategy = result_to_strategy(result, m.graph, 8)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=strategy,
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 64).astype(np.float32)
+    y = rng.randint(0, 4, (16,)).astype(np.int32)
+    hist = m.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(hist[-1]["loss_sum"])
