@@ -89,6 +89,7 @@ def _declare(lib: ctypes.CDLL):
         f64p, i32p,  # optimizer-update bytes basis + dp-scaling flags
         ctypes.c_double,  # optimizer traffic factor (2*state_factor - 1)
         ctypes.c_int32,  # allow sub-block concurrent-branch views
+        ctypes.c_int32, i32p, i32p, i32p, f64p,  # measured-view LUT
         ctypes.c_int32, ctypes.c_int32,  # machine geometry
         ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
         ctypes.c_int32,  # sink
@@ -169,6 +170,7 @@ def unity_dp(
     u_dp_scaled=None,  # per-node 1 where update traffic divides by dp
     update_factor: float = 5.0,  # 2*state_factor - 1
     allow_subblock: bool = False,  # unity.py allow_subblock_views
+    measured=None,  # [(node_idx, dp, ch, cost_s)] replacing the roofline
 ):
     """Native Unity DP (native/src/unity_dp.cc — the reference's
     SearchHelper::graph_cost role). Returns (cost, dp[], ch[]) or None
@@ -201,6 +203,15 @@ def unity_dp(
         n, len(edges), _i32p(esrc), _i32p(edst), _f64p(ebytes),
         _i64p(b), _i64p(c), _f64p(f), _f64p(by), _f64p(w), _f64p(bm),
         _f64p(ub), _i32p(us), update_factor, int(allow_subblock),
+        len(measured or []),
+        _i32p(_as_i32([m[0] for m in measured or []])),
+        _i32p(_as_i32([m[1] for m in measured or []])),
+        _i32p(_as_i32([m[2] for m in measured or []])),
+        _f64p(
+            np.ascontiguousarray(
+                [m[3] for m in measured or []], dtype=np.float64
+            )
+        ),
         machine_nodes, chips_per_node, peak_eff, hbm_eff, ici_eff, ici_lat,
         sink, _i32p(out_dp), _i32p(out_ch), _f64p(out_cost),
     )

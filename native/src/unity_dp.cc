@@ -73,6 +73,11 @@ struct NodeInfo {
                     // follow the batch sharding, not the weight layout)
 };
 
+struct MeasuredView {
+  int dp, ch;
+  double cost;  // measured fwd(+bwd) seconds of the shard's real kernel
+};
+
 struct Problem {
   int n;
   std::vector<NodeInfo> nodes;
@@ -82,6 +87,11 @@ struct Problem {
   Machine m;
   int allow_subblock = 0;  // cost concurrent branches on resource
                            // sub-blocks (unity.py allow_subblock_views)
+  // measured-mode leaf costs, pre-resolved by unity.py (calibrated
+  // kernels, reference: simulator.cc:532): per-node (dp, ch) -> seconds
+  // replacing the analytic roofline term; nodes/views without an entry
+  // fall back to the roofline.
+  std::vector<std::vector<MeasuredView>> measured;
 };
 
 double ring_all_reduce(const Machine &m, double bytes_per_chip, int g) {
@@ -100,9 +110,18 @@ double op_cost(const Problem &p, int node, View v) {
   const NodeInfo &ni = p.nodes[node];
   if (ni.bwd_mult <= 0.0) return 0.0;
   int n = v.ndev();
-  double t_f = (ni.flops / n) / p.m.peak;
-  double t_m = (ni.bytes / n) / p.m.hbm;
-  double t = (t_f > t_m ? t_f : t_m) * ni.bwd_mult;
+  double t = -1.0;
+  if (!p.measured.empty())
+    for (const MeasuredView &mv : p.measured[node])
+      if (mv.dp == v.dp && mv.ch == v.ch) {
+        t = mv.cost;
+        break;
+      }
+  if (t < 0.0) {
+    double t_f = (ni.flops / n) / p.m.peak;
+    double t_m = (ni.bytes / n) / p.m.hbm;
+    t = (t_f > t_m ? t_f : t_m) * ni.bwd_mult;
+  }
   if (ni.wbytes > 0) t += ring_all_reduce(p.m, ni.wbytes / v.ch, v.dp);
   if (ni.ubytes > 0) {
     // optimizer update HBM traffic (CostModel.update_traffic_factor)
@@ -591,6 +610,9 @@ int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
                  const double *wbytes, const double *bwd_mult,
                  const double *ubytes, const int32_t *u_dp_scaled,
                  double update_factor, int allow_subblock,
+                 int n_measured, const int32_t *meas_node,
+                 const int32_t *meas_dp, const int32_t *meas_ch,
+                 const double *meas_cost,
                  int machine_nodes, int chips_per_node, double peak_eff,
                  double hbm_eff, double ici_eff, double ici_lat, int sink,
                  int32_t *out_dp, int32_t *out_ch, double *out_cost) {
@@ -600,6 +622,14 @@ int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
   p.m = {machine_nodes, chips_per_node, peak_eff, hbm_eff,
          ici_eff, ici_lat, update_factor};
   p.allow_subblock = allow_subblock;
+  if (n_measured > 0) {
+    p.measured.assign(n_nodes, {});
+    for (int i = 0; i < n_measured; ++i) {
+      int nd = meas_node[i];
+      if (nd < 0 || nd >= n_nodes) return 3;
+      p.measured[nd].push_back({meas_dp[i], meas_ch[i], meas_cost[i]});
+    }
+  }
   p.nodes.resize(n_nodes);
   for (int i = 0; i < n_nodes; ++i)
     p.nodes[i] = {batch[i], chan[i], flops[i], bytes_moved[i], wbytes[i],

@@ -113,21 +113,34 @@ def test_unmeasurable_op_falls_back_to_roofline():
 
 
 def test_unity_search_measured_mode():
-    """The DP search runs on measured leaf costs (Python leaves — the
-    native solver must not be dispatched) and still returns a strategy."""
+    """The DP search runs on measured leaf costs. Since round 3 the
+    measured table COMPOSES with the native solver: eligible graphs
+    pre-resolve every (node, view) with the calibrated kernels and hand
+    the LUT to the C++ DP (test_unity_native.py asserts python/native
+    answer parity on a shared table)."""
     m = FFModel(FFConfig(batch_size=16))
     x = m.create_tensor([16, 32], name="x")
     t = m.dense(x, 32, activation=ActiMode.RELU)
     m.dense(t, 8)
     search = UnitySearch(m.graph, SPEC, measure=True)
-    search._optimize_native = lambda sink: pytest.fail(
-        "measured mode must use the Python DP (per-view measured leaves)"
-    )
+    seen = {}
+    orig = search._optimize_native
+
+    def spy(sink, measured=None):
+        seen["lut"] = measured
+        return orig(sink, measured=measured)
+
+    search._optimize_native = spy
     result = search.optimize()
     assert result.cost > 0
     assert result.views
     # at least one MXU leaf actually came from measurement
     assert any(v is not None for v in search.cm._measured.values())
+    from flexflow_tpu import native as native_mod
+
+    if native_mod.get_lib() is not None:
+        # the native path received a non-empty measured LUT
+        assert seen.get("lut"), seen
 
 
 def test_compile_threads_measure_flag():
