@@ -93,13 +93,27 @@ class PipelinedExecutor(Executor):
         st = pipeline_spec.structure
         for blk in st.blocks:
             for g in blk:
-                if self.graph.nodes[g].op_type == OperatorType.CACHE:
+                node = self.graph.nodes[g]
+                if node.op_type == OperatorType.CACHE:
                     raise ValueError(
                         "cache ops inside a pipelined trunk are not "
                         "supported (the host memoizer needs the trunk-"
                         "internal activation, which the GPipe schedule "
                         "does not surface); place the cache in the "
                         "prologue/epilogue or use a non-pipeline strategy"
+                    )
+                if (
+                    node.op_type
+                    in (OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC)
+                    and float(node.params.get("lambda_bal", 0.0)) > 0.0
+                ):
+                    raise ValueError(
+                        "the MoE load-balance loss (lambda_bal > 0) inside "
+                        "a pipelined trunk is not supported: the balance "
+                        "term reads trunk-internal gate activations the "
+                        "GPipe schedule does not surface. Use "
+                        "lambda_bal=0.0 under pipeline strategies, or a "
+                        "non-pipeline strategy"
                     )
         self.template = st.blocks[0]
         self.block_pos = {g: i for i, g in enumerate(self.template)}

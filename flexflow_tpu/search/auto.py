@@ -141,6 +141,19 @@ def _pipeline_candidate(
 
     if structure.num_blocks % pp != 0:
         return None
+    # the executor rejects trunks with host/aux hooks (cache memoizer,
+    # MoE balance loss — PipelinedExecutor.__init__); don't propose
+    # candidates guaranteed to fail compile
+    for blk in structure.blocks:
+        for gg in blk:
+            n = base.nodes[gg]
+            if n.op_type == OperatorType.CACHE:
+                return None
+            if n.op_type in (
+                OperatorType.AGGREGATE,
+                OperatorType.AGGREGATE_SPEC,
+            ) and float(n.params.get("lambda_bal", 0.0)) > 0.0:
+                return None
     g = base.copy()
     try:
         _annotate_data_parallel(g, dp)
