@@ -58,6 +58,11 @@ def test_calibration_file_roundtrip(tmp_path):
     m, node, in_shapes = linear_node()
     cm1 = CostModel(SPEC, measure=True, calibration_file=path)
     c1 = cm1.op_cost(node, in_shapes)
+    if cm1._measured and all(v is None for v in cm1._measured.values()):
+        pytest.skip(
+            "measurement rejected by the noise-floor guard (loaded host) "
+            "— nothing to roundtrip"
+        )
     cm1.flush_calibration()  # saves are throttled; callers flush at the end
 
     cm2 = CostModel(SPEC, measure=True, calibration_file=path)
