@@ -573,10 +573,13 @@ class CostModel:
                 return max(per_iter, 1e-9)
 
             fwd = timed(jax.jit(fwd_chain))
-            if fwd > 1.0:
-                # no single-op shard at search scale runs for a second —
-                # this is tunnel contention (another process holding the
-                # device); don't poison the table
+            if fwd > 0.1:
+                # no single-op/chain shard at search scale runs 100 ms
+                # (the largest legit table entry is ~20 ms) — this is
+                # tunnel contention (another process holding the device);
+                # don't poison the table. A contended 119 ms conv+bn
+                # entry once multiplied into a 2.1 s ResNet prediction
+                # through shape-signature reuse.
                 return None
             if fwd < 1e-7:
                 # below the differencing noise floor: a negative or ~zero
@@ -586,7 +589,7 @@ class CostModel:
             if not fidx and not flat_ws:
                 return (fwd, fwd)  # nothing differentiable: estimate
             total = timed(jax.jit(bwd_chain))
-            if total > 1.0:
+            if total > 0.3:
                 return None  # contended during the backward window
             bwd = total - fwd
             if bwd < 0.5 * fwd:
