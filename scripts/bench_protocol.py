@@ -23,6 +23,40 @@ import tempfile
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def aggregate(runs):
+    """Median + spread per config over N invocation dicts (the pure core,
+    unit-tested in tests/test_bench_protocol.py)."""
+    results = {}
+    for name in runs[0]:
+        steps = [
+            r[name]["step_ms"]
+            for r in runs
+            if name in r and "step_ms" in r[name]
+        ]
+        if not steps:
+            results[name] = {"metric": name, "error": "no valid samples"}
+            continue
+        med = statistics.median(steps)
+        spread = (max(steps) - min(steps)) / med * 100.0
+        base = next(
+            r[name]
+            for r in runs
+            if name in r and "step_ms" in r[name]
+        )
+        bs = base["value"] * base["step_ms"] / 1e3  # samples per step
+        results[name] = {
+            "metric": name,
+            "protocol": f"median of {len(steps)} process invocations",
+            "step_ms_median": round(med, 3),
+            "step_ms_samples": [round(s, 3) for s in steps],
+            "spread_pct": round(spread, 1),
+            "value": round(bs / (med / 1e3), 2),
+            "unit": "samples/s",
+            "precision": base["precision"],
+        }
+    return results
+
+
 def main():
     args = sys.argv[1:]
     n = 5
@@ -44,31 +78,9 @@ def main():
         os.unlink(out)
         print(f"[protocol] invocation {rep + 1}/{n} done", flush=True)
 
-    results = {}
-    for name in runs[0]:
-        steps = [
-            r[name]["step_ms"]
-            for r in runs
-            if name in r and "step_ms" in r[name]
-        ]
-        if not steps:
-            results[name] = {"metric": name, "error": "no valid samples"}
-            continue
-        med = statistics.median(steps)
-        spread = (max(steps) - min(steps)) / med * 100.0
-        base = next(r[name] for r in runs if "step_ms" in r[name])
-        bs = base["value"] * base["step_ms"] / 1e3  # samples per step
-        results[name] = {
-            "metric": name,
-            "protocol": f"median of {len(steps)} process invocations",
-            "step_ms_median": round(med, 3),
-            "step_ms_samples": [round(s, 3) for s in steps],
-            "spread_pct": round(spread, 1),
-            "value": round(bs / (med / 1e3), 2),
-            "unit": "samples/s",
-            "precision": base["precision"],
-        }
-        print(json.dumps(results[name]), flush=True)
+    results = aggregate(runs)
+    for row in results.values():
+        print(json.dumps(row), flush=True)
     with open(os.path.join(ROOT, "BENCH_CONFIGS.json"), "w") as f:
         json.dump(results, f, indent=1)
         f.write("\n")
