@@ -143,14 +143,19 @@ _FLASH_SCORE_BYTES = 2 << 30
 # vs 0.783 / 1.98 / 3.89 ms scanned over batch chunks whose score block
 # is ~67 MB; scripts/probe_attn_batch.py, probe_attn_chunked2.py). So the
 # dense path scans over batch chunks keeping the chunk's score block
-# under this cap. In the FULL train step (where XLA fuses attention with
-# its neighbors) the monolithic kernel still wins at bs8/134 MB
-# (interleaved A/B: 23.6 vs 25.7 ms — scripts/ab_attn_chunk.py), so the
-# scan only engages past _DENSE_MONO_SCORE_BYTES and then tiles to
-# chunks whose score block is <= _DENSE_CHUNK_SCORE_BYTES (the
+# under this cap: the scan engages past _DENSE_MONO_SCORE_BYTES and
+# tiles to chunks whose score block is <= _DENSE_CHUNK_SCORE_BYTES (the
 # measured-best 67 MB tile admits; the measured-worse 134 MB tile
-# rejects).
-_DENSE_MONO_SCORE_BYTES = 160 << 20
+# rejects). The flagship bs8 config (134 MB scores) chunks too:
+# interleaved same-process A/B with the fixed difference-of-mins
+# estimator measures the full train step at 16.36 ms chunked vs
+# 23.82 ms monolithic (scripts/ab_attn_chunk2.py `8 160,80 1,80`), and a
+# chain-length ladder confirms 16.4 ms/step at every burst length
+# (scripts/probe_chain_lengths.py — earlier "mono wins at bs8" readings
+# came from a biased estimator and a measurement script that traced
+# AFTER its monkeypatch was restored). bs8/16/32 now scale linearly:
+# 16.4 / 32.1 / 66.7 ms.
+_DENSE_MONO_SCORE_BYTES = 96 << 20
 _DENSE_CHUNK_SCORE_BYTES = 80 << 20
 
 
@@ -175,11 +180,12 @@ def _chunked_dense_attention(q, k, v, causal, chunk):
     The chunk body is rematerialized: the backward recomputes each
     chunk's scores/probs from its (VMEM-sized) inputs instead of
     streaming stored probabilities from HBM. Measured on v5e at the
-    flagship shape (seq 512, 16 heads): bs16 full train step 56.96 ->
-    32.14 ms (1.77x, ~68% of bf16 peak), exactly-equal losses; neutral
-    at bs8 (scripts/ab_attn_remat.py, scripts/check_remat_sanity.py).
-    Remat of the MONOLITHIC kernel does not help — the win needs the
-    chunked working set."""
+    flagship shape (seq 512, 16 heads), full train step, exactly-equal
+    losses: bs8 23.8 -> 16.4 ms, bs16 56.96 -> 32.14 ms, bs32 111 ->
+    66.7 ms — linear in batch at ~66-70% of bf16 peak
+    (scripts/ab_attn_chunk2.py, scripts/probe_chain_lengths.py). Remat
+    of the MONOLITHIC kernel does not help — the win needs the chunked
+    working set."""
     from jax import lax
 
     b = q.shape[0]

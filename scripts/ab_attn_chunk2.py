@@ -69,8 +69,11 @@ def main():
     runners = {}
     for name, mono, chunk in variants:
         runners[name], (n1, n2) = build(bs, mono, chunk)
-    best = {name: float("inf") for name, _, _ in variants}
-    for rep in range(5):
+    # min each chain length separately, then difference (min-of-difference
+    # is biased low by contention spikes in the short chain)
+    b1 = {name: float("inf") for name, _, _ in variants}
+    b2 = dict(b1)
+    for rep in range(6):
         if rep:
             time.sleep(2.0)
         for name, _, _ in variants:
@@ -78,10 +81,17 @@ def main():
             t0 = time.perf_counter(); r[n1]()
             t1 = time.perf_counter(); r[n2]()
             t2 = time.perf_counter()
-            best[name] = min(best[name], ((t2 - t1) - (t1 - t0)) / (n2 - n1))
+            b1[name] = min(b1[name], t1 - t0)
+            b2[name] = min(b2[name], t2 - t1)
     print(
         json.dumps(
-            {"bs": bs, **{n: round(v * 1e3, 2) for n, v in best.items()}}
+            {
+                "bs": bs,
+                **{
+                    n: round((b2[n] - b1[n]) / (n2 - n1) * 1e3, 2)
+                    for n in b1
+                },
+            }
         ),
         flush=True,
     )

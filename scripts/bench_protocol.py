@@ -27,22 +27,22 @@ def aggregate(runs):
     """Median + spread per config over N invocation dicts (the pure core,
     unit-tested in tests/test_bench_protocol.py)."""
     results = {}
-    for name in runs[0]:
-        steps = [
-            r[name]["step_ms"]
-            for r in runs
-            if name in r and "step_ms" in r[name]
+    names = []
+    for r in runs:  # union of configs, first-seen order
+        for name in r:
+            if name not in names:
+                names.append(name)
+    for name in names:
+        valid = [
+            r[name] for r in runs if name in r and "step_ms" in r[name]
         ]
-        if not steps:
+        if not valid:
             results[name] = {"metric": name, "error": "no valid samples"}
             continue
+        steps = [v["step_ms"] for v in valid]
         med = statistics.median(steps)
         spread = (max(steps) - min(steps)) / med * 100.0
-        base = next(
-            r[name]
-            for r in runs
-            if name in r and "step_ms" in r[name]
-        )
+        base = valid[0]
         bs = base["value"] * base["step_ms"] / 1e3  # samples per step
         results[name] = {
             "metric": name,

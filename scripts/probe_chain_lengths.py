@@ -1,0 +1,73 @@
+"""Probe: per-step time vs on-device chain length (throttling check).
+
+Two fixed-methodology estimators disagree at bs8 (chains 5/20 -> 16.4 ms;
+chains 10/40 -> 23.8 ms). Hypothesis: sustained execution throttles the
+chip, so longer bursts run slower per step. Measure consecutive-pair
+differenced per-step times across a ladder of chain lengths."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax
+from jax import lax
+
+from examples.transformer import build_transformer, synthetic_batch
+from flexflow_tpu import FFConfig
+from flexflow_tpu.ops import attention as attn_mod
+
+
+def main():
+    bs = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    mono_mb = int(sys.argv[2]) if len(sys.argv) > 2 else 160
+    attn_mod._DENSE_MONO_SCORE_BYTES = mono_mb << 20
+    cfg = FFConfig(batch_size=bs, learning_rate=0.01)
+    cfg.allow_mixed_precision = True
+    model, _ = build_transformer(
+        cfg, batch_size=bs, seq_len=512, hidden=1024,
+        num_heads=16, num_layers=12,
+    )
+    batch = model.executor.shard_batch(synthetic_batch(bs, 512, 1024))
+    step_fn = model.executor.train_step_fn()
+    key = jax.random.PRNGKey(0)
+
+    def make(n):
+        @jax.jit
+        def run(p, o):
+            def body(c, _):
+                cp, co = c
+                p2, o2, loss, _ = step_fn(cp, co, batch, key)
+                return (p2, o2), loss
+
+            _, losses = lax.scan(body, (p, o), None, length=n)
+            return losses[-1]
+
+        return run
+
+    lengths = [5, 10, 20, 40, 80]
+    runners = {n: make(n) for n in lengths}
+    for n in lengths:  # compile + warmup
+        float(np.asarray(runners[n](model.params, model.opt_state)))
+    best = {n: float("inf") for n in lengths}
+    for rep in range(4):
+        if rep:
+            time.sleep(3.0)
+        for n in lengths:
+            t0 = time.perf_counter()
+            float(np.asarray(runners[n](model.params, model.opt_state)))
+            best[n] = min(best[n], time.perf_counter() - t0)
+    out = {"bs": bs, "wall_s": {n: round(best[n], 4) for n in lengths}}
+    pairs = {}
+    for a, b in zip(lengths, lengths[1:]):
+        pairs[f"{a}->{b}"] = round((best[b] - best[a]) / (b - a) * 1e3, 2)
+    out["per_step_ms"] = pairs
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,8 +43,11 @@ def test_chunked_matches_monolithic_fwd_and_grad(causal):
 
 def test_chunk_selection_thresholds():
     h, s = 16, 512
-    # flagship bs8: 134 MB score block — below the 160 MB mono cap
-    assert A._dense_batch_chunk(8, h, s, s) == 8
+    # flagship bs8: 134 MB score block — past the 96 MB mono cap, chunks
+    # to the measured-best 67 MB tile (full step 16.4 vs 23.8 ms on v5e)
+    assert A._dense_batch_chunk(8, h, s, s) == 4
+    # small models stay monolithic below the cap
+    assert A._dense_batch_chunk(4, h, s, s) == 4
     # bs16: 268 MB — chunks to the largest divisor fitting 80 MB (= 4)
     assert A._dense_batch_chunk(16, h, s, s) == 4
     assert A._dense_batch_chunk(32, h, s, s) == 4
