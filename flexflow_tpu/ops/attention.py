@@ -162,15 +162,23 @@ _DENSE_CHUNK_SCORE_BYTES = 80 << 20
 def _dense_batch_chunk(batch, heads, sq, sk) -> int:
     """Batch-chunk size for the dense path: `batch` (no scan) while the
     monolithic score block stays under the mono cap, else the largest
-    divisor of `batch` whose per-chunk score block fits the chunk cap."""
+    divisor of `batch` whose per-chunk score block fits the chunk cap.
+
+    When NO divisor fits (long-seq/small-batch: one sample's score block
+    already exceeds the cap), the scan degenerates to single-sample
+    chunks — 10-60% slower than the one-shot kernel in ISOLATION
+    (scripts/bench_longctx.py: 6.9 vs 6.3 ms at seq 2048, 26.5 vs
+    16.4 ms at seq 4096 fwd+bwd) but its remat stores NO probabilities:
+    a 24-layer model at seq 4096 would otherwise keep ~12 GB of bf16
+    probs resident for the backward and OOM a 16 GB chip. Memory safety
+    wins this band, the same reasoning that keeps the >=2 GiB flash
+    threshold despite dense beating blockwise just past it."""
     if batch * heads * sq * sk * 4 <= _DENSE_MONO_SCORE_BYTES:
         return batch
-    best = 1
     for c in range(batch, 0, -1):
         if batch % c == 0 and c * heads * sq * sk * 4 <= _DENSE_CHUNK_SCORE_BYTES:
-            best = c
-            break
-    return best
+            return c
+    return 1
 
 
 def _chunked_dense_attention(q, k, v, causal, chunk):

@@ -75,7 +75,29 @@ def main():
 
     from flexflow_tpu.utils.benchmark import measure_fn as timed
 
-    kernels = {"dense": dense, "block": block, "libpl": libpl}
+    # the production dense path since round 3: batch-chunked + remat'd
+    # (ops/attention._chunked_dense_attention); in the over-cap band the
+    # chunks degenerate to single samples — slower in isolation, kept
+    # for the backward-memory win (_dense_batch_chunk docstring)
+    from flexflow_tpu.ops.attention import (
+        _chunked_dense_attention,
+        _dense_batch_chunk,
+    )
+
+    def chunked(q, k, v):
+        c = _dense_batch_chunk(q.shape[0], q.shape[2], q.shape[1], k.shape[1])
+        if c >= q.shape[0]:
+            raise RuntimeError(
+                "selection is monolithic here (same as the dense row)"
+            )
+        return _chunked_dense_attention(q, k, v, False, c)
+
+    kernels = {
+        "dense": dense,
+        "chunked": chunked,
+        "block": block,
+        "libpl": libpl,
+    }
     results = {}
     for seq in (1024, 2048, 4096, 8192, 16384):
         b = max(1, 8192 // seq)  # keep total tokens ~constant
