@@ -1,0 +1,29 @@
+"""shard_map across JAX versions (one shim, shared by every caller).
+
+jax >= 0.8 exposes `jax.shard_map` with `check_vma`; older versions have
+`jax.experimental.shard_map.shard_map` with `check_rep`. pyproject pins
+no jax floor, so the compat choice lives here once (pipeline.py and
+submesh.py both consume it)."""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.8
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_CHECK_KW = (
+    {"check_vma": False}
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else {"check_rep": False}
+)
+
+
+def shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking off (our inner functions use
+    psum/all_gather collectives the checker cannot always see through)."""
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **_CHECK_KW
+    )

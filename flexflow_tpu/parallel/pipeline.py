@@ -133,24 +133,13 @@ def pipeline_apply(
         lambda _: PartitionSpec(axis_name), stacked_params
     )
     x_spec = PartitionSpec(data_axis) if data_axis else PartitionSpec()
-    import inspect
+    from flexflow_tpu.parallel._shardmap_compat import shard_map_unchecked
 
-    try:  # jax >= 0.8
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-    sig = inspect.signature(shard_map)
-    check = (
-        {"check_vma": False}
-        if "check_vma" in sig.parameters
-        else {"check_rep": False}
-    )
-    mapped = shard_map(
+    mapped = shard_map_unchecked(
         inner,
-        mesh=mesh,
+        mesh,
         in_specs=(p_spec, x_spec),
         out_specs=x_spec,
-        **check,
     )
     return mapped(stacked_params, x)
 

@@ -136,7 +136,11 @@ class UnitySearch:
         graphs). Default OFF: the returned cost equals the simulated
         cost of the strategy actually lowered
         (tests/test_branchy_cost.py). Turn on only for search-space
-        studies / strategy export."""
+        studies / strategy export. The executable primitive for
+        concurrent branches exists (parallel/submesh.concurrent_branches
+        — shard_map + lax.switch over a block axis, SPMD-restricted to
+        shape-unified branches); wiring it into the PCG lowering is
+        future work."""
         self.graph = graph
         self.allow_subblock_views = allow_subblock_views
         self.spec = spec
