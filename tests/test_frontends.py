@@ -327,3 +327,57 @@ def test_keras_exp_functional_fit():
     y = rng.randint(0, 4, 32).astype(np.int32)
     hist = model.fit(X, y, epochs=2, verbose=False)
     assert np.isfinite(hist[-1]["loss_sum"])
+
+
+def test_torch_bert_style_encoder_alignment():
+    """A BERT-style torch encoder — nn.MultiheadAttention blocks with
+    pre-/post-residual LayerNorm, GELU FFN, and a mean-pooled
+    classification head — imports through torch.fx and matches torch
+    end-to-end (extends the mT5 proof to the other canonical encoder
+    family; reference: examples/python/pytorch + align/mt5_encoder)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+    class BertBlock(nn.Module):
+        def __init__(self, d=64, h=4):
+            super().__init__()
+            self.att = nn.MultiheadAttention(d, h, batch_first=True)
+            self.ln1 = nn.LayerNorm(d)
+            self.ff1 = nn.Linear(d, 4 * d)
+            self.ff2 = nn.Linear(4 * d, d)
+            self.ln2 = nn.LayerNorm(d)
+
+        def forward(self, x):
+            a, _ = self.att(x, x, x, need_weights=False)
+            x = self.ln1(x + a)
+            f = self.ff2(torch.nn.functional.gelu(self.ff1(x)))
+            return self.ln2(x + f)
+
+    class TinyBert(nn.Module):
+        def __init__(self, d=64, L=2):
+            super().__init__()
+            self.blocks = nn.ModuleList([BertBlock(d) for _ in range(L)])
+            self.head = nn.Linear(d, 4)
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return self.head(x.mean(dim=1))
+
+    tm = TinyBert().eval()
+    pm = PyTorchModel(tm)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor([4, 16, 64], name="x")
+    out = pm.apply(ff, [x])
+    ff.compile(
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+        logits=out,
+    )
+    pm.copy_weights(ff)
+    xin = np.random.RandomState(0).randn(4, 16, 64).astype(np.float32)
+    got = np.asarray(ff.forward({"x": xin}))
+    want = tm(torch.from_numpy(xin)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
