@@ -44,7 +44,13 @@ def profile_operators(
             values[(guid, 0)] = sharded[node.name]
             continue
         ins = [values[(r.guid, r.out_idx)] for r in node.inputs]
-        ws = model.params.get(guid, [])
+        # per-weight accessor: pipelined trunks store weights stacked
+        # under their template guid (Executor.get_host_param slices out
+        # this block's weights; plain executors read params[guid] direct)
+        ws = [
+            ex.get_host_param(model.params, guid, i)
+            for i in range(len(node.weight_shapes))
+        ]
         # mirror Executor.forward_values' ctx so profiled shapes match the
         # real step (seq_length truncation included)
         ctx = LowerCtx(

@@ -82,3 +82,27 @@ def test_xla_cost_analysis():
     # backend-dependent accounting; the contract is a non-empty dict with
     # a positive flop count
     assert cost.get("flops", 0) > 0
+
+
+def test_profile_operators_on_pipelined_executor():
+    """Per-op profiling reads trunk weights through get_host_param, so it
+    works under pipeline strategies (stacked pipe-sharded storage)."""
+    import numpy as np
+
+    from flexflow_tpu import LossType, SGDOptimizer
+    from flexflow_tpu.parallel.strategy import pipeline_strategy
+    from tests.test_pipeline_sharded import _data, _deep_mlp
+
+    m = _deep_mlp()
+    s = pipeline_strategy(m.graph, 1, 4, num_microbatches=4)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=s,
+    )
+    x, y = _data()
+    rows = m.profile_operators(
+        {"x": x[:16], "label": y[:16]}, verbose=False
+    )
+    assert rows and all(np.isfinite(t) for _, t in rows)
