@@ -15,6 +15,13 @@ graph and re-runs compile() in place; here the builder graph is restored to
 its pre-strategy form before `alter_func` runs (strategy annotations and
 inserted parallel ops are compile artifacts, not user model structure), so
 the alter function sees the same graph shape the user built.
+
+Caveat: a recompile re-applies `model._compile_strategy` as-is. An
+EXPLICIT pipeline strategy carries its BlockStructure (block guids) from
+the original graph — valid across recompiles whose alter leaves the
+trunk intact (graph restore preserves guids), but an alter that adds or
+removes trunk blocks must pass a freshly built pipeline strategy to
+compile() itself; searched strategies re-derive automatically.
 """
 
 from __future__ import annotations
@@ -60,7 +67,11 @@ def recompile_on_condition(model, state: RecompileState) -> bool:
 
     host = {}
     ambiguous = set()
-    for guid, ws in model.params.items():
+    # per-guid EXPORT view, not raw storage: a pipelined executor keeps
+    # trunk weights stacked under the template guid only — harvesting
+    # model.params directly would drop every later block's weights and
+    # reinitialize the trunk on recompile
+    for guid, ws in model.executor.export_host_params(model.params).items():
         node = model.graph.nodes.get(guid)
         if node is None:
             continue
@@ -96,13 +107,18 @@ def recompile_on_condition(model, state: RecompileState) -> bool:
         strategy=model._compile_strategy,
     )
 
-    # carry over weights whose stable identity + shape survived the alteration
+    # carry over weights whose stable identity + shape survived the
+    # alteration — overlaid on the fresh params' export view and placed
+    # in ONE pass (per-weight set_tensor would rebuild a pipelined
+    # trunk's pipe-sharded stack per block: O(S^2) device copies)
     new_by_key = {}
     for guid, node in model.graph.nodes.items():
         if not node.weight_shapes:
             continue
         key = stable_key(node)
         new_by_key[key] = None if key in new_by_key else guid
+    current = model.executor.export_host_params(model.params)
+    changed = False
     for key, ws in host.items():
         guid = new_by_key.get(key)
         if guid is None:
@@ -116,9 +132,11 @@ def recompile_on_condition(model, state: RecompileState) -> bool:
             for arr, shape in zip(ws, node.weight_shapes)
         )
         if ok:
-            for i, arr in enumerate(ws):
-                model.set_tensor(guid, i, arr)
-    # opt_state from compile() stays valid: set_tensor preserves shapes,
+            current[guid] = list(ws)
+            changed = True
+    if changed:
+        model.params = model.executor.place_params(current)
+    # opt_state from compile() stays valid: placement preserves shapes,
     # and a recompile resets momenta by design (the reference re-inits
     # optimizer tasks after recompile too)
     return True

@@ -145,3 +145,30 @@ class TestRecompile:
             model.fit(xs, ys, epochs=1, verbose=False)
             model.recompile_on_condition(state)
         assert state.recompiled == 2
+
+
+def test_recompile_preserves_pipelined_trunk_weights():
+    """Recompile harvests weights through the per-guid EXPORT view: a
+    pipelined model's trunk (stacked under the template guid) must
+    survive, not silently reinitialize (round-3 regression)."""
+    from flexflow_tpu.parallel.strategy import pipeline_strategy
+    from tests.test_pipeline_sharded import _data, _deep_mlp
+
+    m = _deep_mlp()
+    s = pipeline_strategy(m.graph, 1, 4, num_microbatches=4)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        strategy=s,
+    )
+    x, y = _data()
+    m.fit(x, y, epochs=2, verbose=False)
+    g_mid = m.executor.pspec.structure.blocks[2][0]
+    before = m.get_tensor(g_mid).copy()
+    assert m.recompile_on_condition(
+        RecompileState(lambda model: True, lambda model: None)
+    )
+    np.testing.assert_allclose(m.get_tensor(g_mid), before)
+    h = m.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(h[-1]["loss_sum"])
