@@ -68,6 +68,59 @@ def _stack_branch_params(mesh: Mesh, axis_name: str, branch_params):
     return stacked, treedef
 
 
+def _run_block_mapped(mesh, axis_name, body, stacked, x):
+    """Shared shard_map harness for the block axis: `body(local_leaves,
+    xin)` runs with this block's parameter slices and the broadcast
+    input; outputs gather to a replicated [k, ...] stack."""
+    from flexflow_tpu.parallel._shardmap_compat import shard_map_unchecked
+
+    def inner(params_slices, xin):
+        out = body([p[0] for p in params_slices], xin)
+        return jax.tree_util.tree_map(
+            lambda o: jax.lax.all_gather(o, axis_name), out
+        )
+
+    specs_p = [
+        PartitionSpec(axis_name, *([None] * (s.ndim - 1))) for s in stacked
+    ]
+    fn = shard_map_unchecked(
+        inner,
+        mesh,
+        in_specs=(tuple(specs_p), PartitionSpec()),
+        out_specs=PartitionSpec(),
+    )
+    return fn(tuple(stacked), x)
+
+
+def concurrent_template_branches(
+    mesh: Mesh,
+    axis_name: str,
+    template_fn: Callable,
+    branch_params: Sequence,
+    x,
+):
+    """Template-identical special case of concurrent_branches: every
+    branch runs the SAME function with its own parameters (unity's
+    nonsequence splits over repeated structures — Inception towers,
+    per-expert stacks). No lax.switch needed: one program, per-block
+    weights, which XLA can overlap freely. Returns the [k, ...] stacked
+    outputs (branch i at index i, replicated)."""
+    k = len(branch_params)
+    if mesh.shape[axis_name] != k:
+        raise ValueError(
+            f"axis {axis_name!r} has size {mesh.shape[axis_name]}, "
+            f"need one block per branch ({k})"
+        )
+    stacked, treedef = _stack_branch_params(mesh, axis_name, branch_params)
+
+    def body(local_leaves, xin):
+        return template_fn(
+            jax.tree_util.tree_unflatten(treedef, local_leaves), xin
+        )
+
+    return _run_block_mapped(mesh, axis_name, body, stacked, x)
+
+
 def concurrent_branches(
     mesh: Mesh,
     axis_name: str,
@@ -93,9 +146,8 @@ def concurrent_branches(
         )
     stacked, treedef = _stack_branch_params(mesh, axis_name, branch_params)
 
-    def inner(params_slices, xin):
+    def body(local_leaves, xin):
         idx = jax.lax.axis_index(axis_name)
-        local = [p[0] for p in params_slices]  # this block's slice
 
         def make_branch(i):
             def run(args):
@@ -106,28 +158,11 @@ def concurrent_branches(
 
             return run
 
-        out = jax.lax.switch(
-            idx, [make_branch(i) for i in range(k)], (local, xin)
-        )
-        # surface every branch's output: all_gather over the block axis
-        # stacks each group's result at its index ([k, ...], replicated)
-        # — dtype-agnostic and each device contributes only its slice
-        return jax.tree_util.tree_map(
-            lambda o: jax.lax.all_gather(o, axis_name), out
+        return jax.lax.switch(
+            idx, [make_branch(i) for i in range(k)], (local_leaves, xin)
         )
 
-    specs_p = [
-        PartitionSpec(axis_name, *([None] * (s.ndim - 1))) for s in stacked
-    ]
-    from flexflow_tpu.parallel._shardmap_compat import shard_map_unchecked
-
-    fn = shard_map_unchecked(
-        inner,
-        mesh,
-        in_specs=(tuple(specs_p), PartitionSpec()),
-        out_specs=PartitionSpec(),
-    )
-    stacked_out = fn(tuple(stacked), x)
+    stacked_out = _run_block_mapped(mesh, axis_name, body, stacked, x)
     return [
         jax.tree_util.tree_map(lambda o: o[i], stacked_out)
         for i in range(k)

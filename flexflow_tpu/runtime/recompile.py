@@ -132,7 +132,13 @@ def recompile_on_condition(model, state: RecompileState) -> bool:
             for arr, shape in zip(ws, node.weight_shapes)
         )
         if ok:
-            current[guid] = list(ws)
+            # cast to the NEW node's declared dtype (an alter may rebuild
+            # a same-shape layer at a different precision; set_tensor
+            # used to guarantee this cast)
+            current[guid] = [
+                np.asarray(arr, dtype=shape.dtype.to_jnp())
+                for arr, shape in zip(ws, node.weight_shapes)
+            ]
             changed = True
     if changed:
         model.params = model.executor.place_params(current)
