@@ -97,11 +97,12 @@ def scaled_dot_product_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _seq_parallel_axes(ctx):
-    """If the q AND k/v sequence dims are partitioned the same way, return the
-    mesh axis names (seq_axis, batch_axis, head_axis) for the ring/Ulysses
-    paths; else None (the dense path handles mixed layouts via GSPMD). Head
-    sharding comes from a replica dim on q (the head-parallel rewrite)."""
+def _q_mesh_axes(ctx):
+    """Mesh axis names (batch_ax, seq_ax, head_ax) of the q input's
+    partitioned dims — head sharding comes from a replica dim on q (the
+    head-parallel rewrite). None per slot when unsharded; None overall
+    when no 3D parallel shape is available. THE one place the
+    ParallelDim→axis-name classification lives."""
     if ctx is None or ctx.mesh is None or not ctx.in_shapes:
         return None
     qshape = ctx.in_shapes[0]
@@ -110,8 +111,26 @@ def _seq_parallel_axes(ctx):
     if len(logical) != 3:
         return None
     b, s, _ = logical
-    if s.degree <= 1:
+    names = ctx.axis_names
+    batch_ax = names[b.parallel_idx] if b.degree > 1 else None
+    seq_ax = names[s.parallel_idx] if s.degree > 1 else None
+    head_ax = (
+        names[rep[0].parallel_idx] if rep and rep[0].degree > 1 else None
+    )
+    return batch_ax, seq_ax, head_ax
+
+
+def _seq_parallel_axes(ctx):
+    """If the q AND k/v sequence dims are partitioned the same way, return the
+    mesh axis names (seq_axis, batch_axis, head_axis) for the ring/Ulysses
+    paths; else None (the dense path handles mixed layouts via GSPMD)."""
+    axes = _q_mesh_axes(ctx)
+    if axes is None:
         return None
+    batch_ax, seq_ax, head_ax = axes
+    if seq_ax is None:
+        return None
+    s = [d for d in ctx.in_shapes[0].dims if not d.is_replica_dim][1]
     # cross-attention guard: the ring rotates K/V blocks, so the key/value
     # sequence dims must be sharded on the same axis with the same degree
     for kv in ctx.in_shapes[1:3]:
@@ -121,12 +140,6 @@ def _seq_parallel_axes(ctx):
         s_kv = kv_logical[1]
         if s_kv.degree != s.degree or s_kv.parallel_idx != s.parallel_idx:
             return None
-    names = ctx.axis_names
-    seq_ax = names[s.parallel_idx]
-    batch_ax = names[b.parallel_idx] if b.degree > 1 else None
-    head_ax = (
-        names[rep[0].parallel_idx] if rep and rep[0].degree > 1 else None
-    )
     return seq_ax, batch_ax, head_ax
 
 
@@ -157,6 +170,17 @@ _FLASH_SCORE_BYTES = 2 << 30
 # 16.4 / 32.1 / 66.7 ms.
 _DENSE_MONO_SCORE_BYTES = 96 << 20
 _DENSE_CHUNK_SCORE_BYTES = 80 << 20
+
+
+def set_dense_caps(mono_mb: int, chunk_mb: int) -> None:
+    """Install measured dense-attention working-set caps (the calibration
+    table's "attn_caps" entry, written by an on-chip probe). The built-in
+    defaults are the v5e-measured values; a table measured on another
+    chip generation replaces them at compile
+    (runtime/model.py compile())."""
+    global _DENSE_MONO_SCORE_BYTES, _DENSE_CHUNK_SCORE_BYTES
+    _DENSE_MONO_SCORE_BYTES = int(mono_mb) << 20
+    _DENSE_CHUNK_SCORE_BYTES = int(chunk_mb) << 20
 
 
 def _dense_batch_chunk(batch, heads, sq, sk) -> int:
@@ -244,6 +268,87 @@ def _auto_flash(batch, heads, sq, sk, ctx=None) -> bool:
     return batch * heads * sq * sk * 4 >= _FLASH_SCORE_BYTES
 
 
+def _tiled_flash_sharded(q, k, v, ctx, causal, specs):
+    """Run the hand-tiled Pallas kernel (flash_kernel.py) per device by
+    wrapping it in shard_map — the GSPMD-compatible way to place an
+    opaque pallas call inside a sharded step (jit alone has no
+    partitioning rule for it). `specs` is the PartitionSpec for q/k/v
+    and the output; GSPMD reshards inputs to match, so callers choose
+    the layout (e.g. Ulysses' seq→head all-to-all is exactly the
+    reshard this wrapper's in_specs induce). Returns None when the
+    per-device block doesn't tile."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    from flexflow_tpu.ops.pallas.flash_kernel import (
+        flash_attention_tpu,
+        supports,
+    )
+
+    if jax.default_backend() != "tpu":
+        return None
+    mesh = ctx.mesh
+
+    def deg(ax):
+        return mesh.shape[ax] if ax else 1
+
+    bs_ax, sq_ax, h_ax, _ = specs
+    if sq_ax is not None:
+        # a sharded seq dim inside shard_map would compute BLOCK-DIAGONAL
+        # attention (each device only its own keys) — that layout belongs
+        # to ring_attention, not this wrapper
+        return None
+    if bs_ax is None and h_ax is None:
+        # nothing to shard over: a fully-replicated shard_map would
+        # all-gather whatever sharding the inputs DO carry (e.g. a seq
+        # sharding this call was asked to densify) and recompute the
+        # whole attention on every device — let XLA partition the
+        # blockwise path instead
+        return None
+    h_loc = q.shape[2] // deg(h_ax)
+    if (
+        h_loc == 0
+        or q.shape[2] % max(1, deg(h_ax))
+        or not supports(q.shape[1], k.shape[1], q.shape[-1])
+    ):
+        return None
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(*specs)
+    fn = shard_map(
+        lambda a, b, c: flash_attention_tpu(a, b, c, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def _try_tiled(q, k, v, ctx, causal):
+    """The one dispatch point for the hand-tiled kernel outside the
+    seq-parallel paths: direct call on a single device, shard_map over the
+    batch/head axes on a mesh. None when the shape/backend doesn't take it
+    (callers fall back to dense/blockwise)."""
+    single = ctx is None or ctx.mesh is None or ctx.mesh.size == 1
+    if single:
+        if jax.default_backend() != "tpu":
+            return None
+        from flexflow_tpu.ops.pallas.flash_kernel import (
+            flash_attention_tpu,
+            supports,
+        )
+
+        if not supports(q.shape[1], k.shape[1], q.shape[-1]):
+            return None
+        return flash_attention_tpu(q, k, v, causal=causal)
+    axes = _q_mesh_axes(ctx)
+    b_ax, _, h_ax = axes if axes else (None, None, None)
+    return _tiled_flash_sharded(
+        q, k, v, ctx, causal, (b_ax, None, h_ax, None)
+    )
+
+
 def _lower_mha(params):
     causal = params.get("causal", False)
     use_flash = params.get("use_flash", "auto")
@@ -260,9 +365,29 @@ def _lower_mha(params):
 
     def _ulysses(q, k, v, ctx, seq_ax, batch_ax):
         # Ulysses: all-to-all the seq sharding onto the head dim, attend
-        # locally, all-to-all back — GSPMD emits the all-to-alls from the
-        # layout constraints.
+        # locally, all-to-all back. On TPU the local attend runs the
+        # hand-tiled Pallas kernel under shard_map (whose head-sharded
+        # in_specs themselves induce the seq→head all-to-all); otherwise
+        # GSPMD emits the all-to-alls from the layout constraints around
+        # a jnp core.
         from jax.sharding import NamedSharding, PartitionSpec
+
+        # use_flash=False is an explicit request for the dense core —
+        # don't override it with the tiled kernel (the "auto" policy DOES
+        # prefer tiled: measured on v5e it beats dense from seq 2048 up
+        # and the margin grows with sequence, scripts/bench_flash_kernel)
+        tiled = (
+            _tiled_flash_sharded(
+                q, k, v, ctx, causal, (batch_ax, None, seq_ax, None)
+            )
+            if use_flash is not False
+            else None
+        )
+        if tiled is not None:
+            seq_sp = NamedSharding(
+                ctx.mesh, PartitionSpec(batch_ax, seq_ax, None, None)
+            )
+            return jax.lax.with_sharding_constraint(tiled, seq_sp)
 
         head_spec = NamedSharding(
             ctx.mesh, PartitionSpec(batch_ax, None, seq_ax, None)
@@ -367,16 +492,17 @@ def _lower_mha(params):
             if flash:
                 from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
-                # the library Pallas kernel is single-device TPU only
-                # (no GSPMD partitioning rule); sharded meshes take the
-                # blockwise path, which XLA partitions over batch/heads.
-                # use_lib=None defers the backend/device check to
-                # flash_attention's auto mode
+                # the hand-tiled kernel wherever it takes the shape (direct
+                # single-device, shard_map over batch/head axes on a mesh);
+                # else the library kernel (single-device) or the jnp
+                # blockwise path, which XLA partitions over batch/heads
                 single = ctx is None or ctx.mesh is None or ctx.mesh.size == 1
-                attn = flash_attention(
-                    q, k, v, causal=causal,
-                    use_lib=None if single else False,
-                )
+                attn = _try_tiled(q, k, v, ctx, causal)
+                if attn is None:
+                    attn = flash_attention(
+                        q, k, v, causal=causal,
+                        use_lib=None if single else False,
+                    )
             else:
                 # batch-chunked dense: only when the batch dim is unsharded
                 # (a scan cannot iterate a GSPMD-sharded leading axis) and
@@ -394,7 +520,35 @@ def _lower_mha(params):
                     if (b_deg == 1 and not dropping)
                     else q.shape[0]
                 )
-                if chunk < q.shape[0]:
+                # when even ONE sample's score block overflows the chunk
+                # cap (seq ~2048-8192, small batch), the chunked scan
+                # degenerates to a stores-nothing single-sample remat —
+                # measured 10-60% SLOWER than one-shot dense in isolation.
+                # That band belongs to the hand-tiled kernel: 12.4 ms vs
+                # 21.8 dense / ~52 blockwise at seq 2048 bs8h16 on v5e
+                # (scripts/bench_flash_kernel.py). Below it, chunked dense
+                # keeps the full-step crown (19.0 vs 23.6 ms flagship
+                # A/B, scripts/ab_attn_tiled.py — the tiled kernel's
+                # per-call layout transposes eat its margin at seq 512).
+                single_fits = (
+                    max(1, q.shape[2] // h_deg)
+                    * max(1, seq // s_deg)
+                    * k.shape[1]
+                    * 4
+                    <= _DENSE_CHUNK_SCORE_BYTES
+                )
+                tiled = (
+                    _try_tiled(q, k, v, ctx, causal)
+                    if (
+                        not single_fits
+                        and not dropping
+                        and use_flash is not False
+                    )
+                    else None
+                )
+                if tiled is not None:
+                    attn = tiled
+                elif chunk < q.shape[0]:
                     attn = _chunked_dense_attention(q, k, v, causal, chunk)
                 else:
                     attn = scaled_dot_product_attention(

@@ -665,11 +665,24 @@ def _infer_concat(input_shapes, params):
     axis = params["axis"] % input_shapes[0].ndim
     base = input_shapes[0]
     total = 0
+    deg0 = base.dims[axis].degree
+    pidx0 = base.dims[axis].parallel_idx
     for s in input_shapes:
-        if s.dims[axis].degree > 1:
-            raise ValueError("concat: concat axis may not be partitioned")
-        total += s.dims[axis].size
-    out = base.with_dim(axis, ParallelDim(total))
+        d = s.dims[axis]
+        if d.degree != deg0 or (deg0 > 1 and d.parallel_idx != pidx0):
+            # a MIX of shardings on the concat axis is not representable;
+            # uniform sharding is (the combine-sink rewrite's inception
+            # pattern: channel-concat of channel-sharded branches — the
+            # executor lowers global arrays, GSPMD realizes the layout)
+            raise ValueError(
+                "concat: concat-axis sharding must match across inputs"
+            )
+        if deg0 > 1 and d.size % deg0 != 0:
+            raise ValueError(
+                "concat: sharded concat axis must divide evenly"
+            )
+        total += d.size
+    out = base.with_dim(axis, ParallelDim(total, deg0, pidx0))
     return (out,), ()
 
 

@@ -6,10 +6,14 @@ The reference's attention is one cudnnMultiHeadAttnForward call per shard
 upgrade: blockwise-tiled attention that never materializes the [s, s] score
 matrix, written with Pallas when running on TPU.
 
-Current status: the jnp blockwise formulation below is numerically exact
-(online-softmax over key blocks via lax.scan, fp32 accumulators) and XLA
-compiles it into a fused streaming loop; a hand-tiled Pallas kernel can
-replace `_blockwise_attention` without changing callers.
+Three lowerings, selected by `use_lib` / shape support:
+  * the hand-tiled Pallas kernel (flash_kernel.py — VMEM accumulators,
+    custom-VJP backward, lse output for ring merging) on TPU;
+  * the library `jax.experimental.pallas.ops.tpu.flash_attention` kernel,
+    kept as an A/B reference;
+  * the jnp blockwise formulation (online-softmax over key blocks via
+    lax.scan, fp32 accumulators) as the portable fallback — CPU tests and
+    shapes the tiled kernels cannot take.
 """
 
 from __future__ import annotations
@@ -112,19 +116,29 @@ def flash_attention(
 ):
     """q, k, v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim].
 
-    use_lib=None ("auto"): on SINGLE-device TPU the library Pallas kernel
-    is preferred; under a multi-device mesh the opaque pallas custom call
-    has no GSPMD partitioning rule (it would replicate or fail the
-    sharded compile), so the jnp blockwise formulation — which XLA shards
-    cleanly over batch/heads — is used instead. Callers inside a sharded
-    step (ops/attention.py) pass use_lib=False explicitly. `block_k`
-    tunes only the blockwise path; the library kernel uses its own block
-    sizes."""
+    use_lib=None ("auto"): on SINGLE-device TPU the hand-tiled kernel
+    (flash_kernel.py) runs when the shape tiles, with the library Pallas
+    kernel as the shape fallback (use_lib="library" forces it for A/B).
+    Under a multi-device mesh an opaque pallas custom call inside plain
+    jit has no GSPMD partitioning rule, so callers either wrap the tiled
+    kernel in shard_map themselves (ring/Ulysses, ops/attention.py) or
+    pass use_lib=False for the jnp blockwise formulation, which XLA
+    shards cleanly over batch/heads. `block_k` tunes only the blockwise
+    path; the tiled kernels use their own (calibratable) block sizes."""
     if use_lib is None:
         use_lib = (
             jax.default_backend() == "tpu" and jax.device_count() == 1
         )
     if use_lib:
+        from flexflow_tpu.ops.pallas.flash_kernel import (
+            flash_attention_tpu,
+            supports,
+        )
+
+        if use_lib != "library" and supports(
+            q.shape[1], k.shape[1], q.shape[-1]
+        ):
+            return flash_attention_tpu(q, k, v, causal=causal)
         try:
             return _lib_flash(q, k, v, causal)
         except Exception:  # noqa: BLE001 — trace-time shape/support errors

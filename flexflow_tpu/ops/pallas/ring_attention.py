@@ -101,6 +101,89 @@ def _local_ring_attention(q, k, v, axis_name: str, n_shards: int, causal: bool):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _local_ring_attention_pallas(
+    q, k, v, axis_name: str, n_shards: int, causal: bool
+):
+    """Per-device ring body where each visiting K/V block is consumed by
+    the hand-tiled Pallas flash kernel (flash_kernel.py) instead of jnp
+    einsums — the local compute runs MXU-tiled with VMEM accumulators.
+
+    Per-block partial results (o_t, lse_t) merge exactly by log-sum-exp
+    algebra; causality never needs dynamic offsets inside the kernel
+    because each visiting block is wholly before (visible), wholly after
+    (skipped — no kernel launch, no ICI-wasting compute), or exactly the
+    local diagonal block (the kernel's static causal mask)."""
+    from flexflow_tpu.ops.pallas.flash_kernel import flash_attention_tpu
+
+    b, sq, h, d = q.shape
+    my_idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def flash(qq, kk, vv, diag):
+        return flash_attention_tpu(
+            qq, kk, vv, causal=diag, return_lse=True
+        )
+
+    def skip(qq, kk, vv):
+        return (
+            jnp.zeros((b, sq, h, d), qq.dtype),
+            jnp.full((b, h, sq), -1e30, jnp.float32),
+        )
+
+    def attend(kc, vc, src):
+        if not causal:
+            return flash(q, kc, vc, False)
+        return lax.cond(
+            src == my_idx,
+            lambda: flash(q, kc, vc, True),
+            lambda: lax.cond(
+                src < my_idx,
+                lambda: flash(q, kc, vc, False),
+                lambda: skip(q, kc, vc),
+            ),
+        )
+
+    def merge(o_run, lse_run, o_t, lse_t):
+        # exact combine of partial attentions over disjoint key ranges:
+        # softmax(concat) = sum_i softmax_i * exp(lse_i - LSE)
+        m = jnp.maximum(lse_run, lse_t)
+        w_run = jnp.exp(lse_run - m)
+        w_t = jnp.exp(lse_t - m)
+        denom = w_run + w_t  # >= 1: the max's weight is exactly 1
+        a_run = (w_run / denom).transpose(0, 2, 1)[..., None]
+        a_t = (w_t / denom).transpose(0, 2, 1)[..., None]
+        o = o_run * a_run + o_t.astype(jnp.float32) * a_t
+        return o, m + jnp.log(denom)
+
+    o0, lse0 = attend(k, v, my_idx)
+
+    def body(carry, t):
+        o_run, lse_run, kc, vc = carry
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        src = jnp.mod(my_idx - t, n_shards)
+        o_t, lse_t = attend(kc, vc, src)
+        o_run, lse_run = merge(o_run, lse_run, o_t, lse_t)
+        return (o_run, lse_run, kc, vc), None
+
+    (o_run, _, _, _), _ = lax.scan(
+        body,
+        (o0.astype(jnp.float32), lse0, k, v),
+        jnp.arange(1, n_shards),
+    )
+    return o_run.astype(q.dtype)
+
+
+def _pallas_ok(q, k, n_shards: int) -> bool:
+    from flexflow_tpu.ops.pallas.flash_kernel import supports
+
+    if q.shape[1] % n_shards or k.shape[1] % n_shards:
+        return False
+    return supports(
+        q.shape[1] // n_shards, k.shape[1] // n_shards, q.shape[-1]
+    )
+
+
 def ring_attention(
     q,
     k,
@@ -110,18 +193,32 @@ def ring_attention(
     causal: bool = False,
     batch_axis: Optional[str] = None,
     head_axis: Optional[str] = None,
+    use_pallas: Optional[bool] = None,
 ):
     """Exact attention with q/k/v sequence-sharded over `mesh[seq_axis]`.
 
     q, k, v: global [b, s, h, d] arrays (sequence dim sharded on `seq_axis`;
     optionally batch on `batch_axis` and heads on `head_axis`). Returns the
     attention output with the same layout as q.
+
+    use_pallas=None (auto): on TPU, tileable per-device blocks run the
+    hand-tiled flash kernel per ring step (MXU-tiled, VMEM accumulators);
+    otherwise the jnp online-softmax body (which XLA still fuses well,
+    and which CPU tests exercise). True forces the kernel path (the
+    Pallas interpreter runs it off-TPU).
     """
     n_shards = mesh.shape[seq_axis]
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and _pallas_ok(
+            q, k, n_shards
+        )
+    body = (
+        _local_ring_attention_pallas if use_pallas else _local_ring_attention
+    )
     spec = P(batch_axis, seq_axis, head_axis, None)
     inner = shard_map(
         functools.partial(
-            _local_ring_attention,
+            body,
             axis_name=seq_axis,
             n_shards=n_shards,
             causal=causal,

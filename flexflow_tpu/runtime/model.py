@@ -692,6 +692,32 @@ class FFModel:
         self.loss_type = loss_type
         self.metric_types = tuple(metrics)
 
+        # measured flash-kernel tile sizes from the calibration table
+        # (scripts/calibrate.py --tune-flash) replace the built-in
+        # defaults for every attention lowering this compile produces
+        if self.config.calibration_file:
+            import json as _json
+            import os as _os
+
+            if _os.path.exists(self.config.calibration_file):
+                try:
+                    with open(self.config.calibration_file) as f:
+                        _doc = _json.load(f)
+                except (OSError, ValueError):
+                    _doc = {}
+                fb = _doc.get("flash_blocks") or {}
+                if fb.get("block_q") and fb.get("block_k"):
+                    from flexflow_tpu.ops.pallas.flash_kernel import (
+                        set_tuned_blocks,
+                    )
+
+                    set_tuned_blocks(fb["block_q"], fb["block_k"])
+                caps = _doc.get("attn_caps") or {}
+                if caps.get("mono_mb") and caps.get("chunk_mb"):
+                    from flexflow_tpu.ops.attention import set_dense_caps
+
+                    set_dense_caps(caps["mono_mb"], caps["chunk_mb"])
+
         if logits is None:
             sinks = self.graph.sinks()
             if len(sinks) != 1:

@@ -221,12 +221,90 @@ def rank_mode(names, calib):
         raise SystemExit(f"calibration ranking violated: {violations}")
 
 
+def tune_flash_mode(calib):
+    """Probe the hand-tiled flash kernel's (block_q, block_k) on-chip at a
+    long-sequence reference shape and persist the winner to the
+    calibration table's "flash_blocks" entry — the measured replacement
+    for one-chip hardcoded tile constants (the executor installs the
+    tuned blocks at compile when --calibration-file is set)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.pallas.flash_kernel import flash_attention_tpu
+    from flexflow_tpu.utils.benchmark import measure_fn
+
+    b, seq, h, d = 1, 4096, 16, 64
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(
+        rng.randn(b, seq, h, d).astype(np.float32), jnp.bfloat16
+    )
+    q, k, v = mk(), mk(), mk()
+
+    def step_for(bq, bk):
+        def loss(q, k, v):
+            o = flash_attention_tpu(
+                q, k, v, causal=False, block_q=bq, block_k=bk
+            )
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))
+
+        def step(q, k, v):
+            dq, dk, dv = g(q, k, v)
+            return jnp.sum(dq.astype(jnp.float32)) + jnp.sum(
+                dk.astype(jnp.float32)
+            ) + jnp.sum(dv.astype(jnp.float32))
+
+        return step
+
+    results = {}
+    for bq in (256, 512, 1024):
+        for bk in (256, 512, 1024):
+            try:
+                t = measure_fn(step_for(bq, bk), (q, k, v), reps=3)
+            except Exception as e:  # noqa: BLE001 — shape/VMEM rejections
+                print(f"[tune-flash] {bq}x{bk}: failed ({e})", flush=True)
+                continue
+            results[(bq, bk)] = t
+            print(f"[tune-flash] {bq}x{bk}: {t*1e3:.2f} ms", flush=True)
+    if not results:
+        print("[tune-flash] no configuration measured; table unchanged")
+        return
+    (bq, bk), best_t = min(results.items(), key=lambda kv: kv[1])
+    doc = {}
+    if os.path.exists(calib):
+        with open(calib) as f:
+            doc = json.load(f)
+    doc["flash_blocks"] = {
+        "block_q": bq,
+        "block_k": bk,
+        "measured_ms": round(best_t * 1e3, 3),
+        "shape": [b, seq, h, d],
+    }
+    tmp = calib + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, calib)
+    print(
+        json.dumps(
+            {
+                "metric": "flash_blocks",
+                "block_q": bq,
+                "block_k": bk,
+                "ms": round(best_t * 1e3, 3),
+            }
+        )
+    )
+
+
 def main():
     args = sys.argv[1:]
     calib = "calibration/v5e.json"
     batch_override = None
     names = []
     rank = False
+    tune_flash = False
     i = 0
     while i < len(args):
         if args[i] == "--calibration-file":
@@ -237,11 +315,16 @@ def main():
             batch_override = int(args[i])
         elif args[i] == "--rank":
             rank = True
+        elif args[i] == "--tune-flash":
+            tune_flash = True
         elif args[i] in WORKLOADS:
             names.append(args[i])
         i += 1
     names = names or list(WORKLOADS)
     os.makedirs(os.path.dirname(calib) or ".", exist_ok=True)
+    if tune_flash:
+        tune_flash_mode(calib)
+        return
     if rank:
         rank_mode(names, calib)
         return

@@ -41,7 +41,7 @@ def main():
             return flash_attention(q, k, v, causal=False, use_lib=False)
 
         def lib(q, k, v):
-            return flash_attention(q, k, v, causal=False, use_lib=True)
+            return flash_attention(q, k, v, causal=False, use_lib="library")
 
         def grad_of(fn):
             def loss(q, k, v):

@@ -1,0 +1,170 @@
+"""Correctness of the hand-tiled Pallas flash kernel (ops/pallas/
+flash_kernel.py) against dense attention — forward, lse, and the custom
+VJP — via the Pallas interpreter on CPU (the same kernel code the TPU
+path compiles; SURVEY §4 simulated-topology strategy)."""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.ops.pallas.flash_kernel import (
+    flash_attention_tpu,
+    supports,
+)
+
+B, H, D = 2, 2, 32
+BQ = BK = 128
+
+
+def _dense(q, k, v, causal):
+    d = q.shape[-1]
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype), logits
+
+
+def _rand(seq, dtype=jnp.float32):
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(
+        rng.randn(B, seq, H, D).astype(np.float32), dtype
+    )
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_dense(causal):
+    q, k, v = _rand(256)
+    out = flash_attention_tpu(
+        q, k, v, causal=causal, block_q=BQ, block_k=BK, interpret=True
+    )
+    ref, _ = _dense(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_lse_matches_dense():
+    q, k, v = _rand(256)
+    out, lse = flash_attention_tpu(
+        q, k, v, causal=True, block_q=BQ, block_k=BK,
+        return_lse=True, interpret=True,
+    )
+    _, logits = _dense(q, k, v, causal=True)
+    ref_lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    np.testing.assert_allclose(lse, ref_lse, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_dense(causal):
+    q, k, v = _rand(256)
+
+    def loss_flash(q, k, v):
+        o = flash_attention_tpu(
+            q, k, v, causal=causal, block_q=BQ, block_k=BK, interpret=True
+        )
+        return jnp.sum(o * jnp.cos(o.astype(jnp.float32)))
+
+    def loss_dense(q, k, v):
+        o, _ = _dense(q, k, v, causal)
+        return jnp.sum(o * jnp.cos(o.astype(jnp.float32)))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-4)
+
+
+def test_lse_cotangent():
+    """The with-lse VJP folds the lse cotangent through the delta shift;
+    compare against autodiff of the dense logsumexp."""
+    q, k, v = _rand(128)
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_tpu(
+            q, k, v, causal=False, block_q=BQ, block_k=BK,
+            return_lse=True, interpret=True,
+        )
+        return jnp.sum(o) + jnp.sum(jnp.sin(lse))
+
+    def loss_dense(q, k, v):
+        o, logits = _dense(q, k, v, False)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        return jnp.sum(o) + jnp.sum(jnp.sin(lse))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-4)
+
+
+def test_uneven_seq_blocks():
+    """kv longer than q (cross-attention-like), distinct block sizes."""
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 128, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 384, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 384, H, D).astype(np.float32))
+    out = flash_attention_tpu(
+        q, k, v, block_q=128, block_k=128, interpret=True
+    )
+    ref, _ = _dense(q, k, v, False)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_supports():
+    assert supports(4096, 4096, 64)
+    assert supports(256, 256, 64)
+    assert not supports(100, 100, 64)  # not lane-tileable
+
+
+def test_compile_installs_calibrated_tiles(tmp_path):
+    """compile() with --calibration-file installs the table's measured
+    flash block sizes and dense-attention caps (the per-platform
+    replacement for hardcoded constants)."""
+    import json
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.ops import attention as attn_mod
+    from flexflow_tpu.ops.pallas import flash_kernel as fk
+
+    calib = tmp_path / "chip.json"
+    calib.write_text(
+        json.dumps(
+            {
+                "flash_blocks": {"block_q": 256, "block_k": 1024},
+                "attn_caps": {"mono_mb": 48, "chunk_mb": 40},
+            }
+        )
+    )
+    saved_tuned = dict(fk._TUNED)
+    saved_caps = (
+        attn_mod._DENSE_MONO_SCORE_BYTES,
+        attn_mod._DENSE_CHUNK_SCORE_BYTES,
+    )
+    try:
+        cfg = FFConfig(batch_size=4)
+        cfg.calibration_file = str(calib)
+        m = FFModel(cfg)
+        x = m.create_tensor([4, 8], name="x")
+        m.dense(x, 4)
+        m.compile(
+            optimizer=SGDOptimizer(lr=0.1),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[],
+        )
+        assert fk._TUNED == {"block_q": 256, "block_k": 1024}
+        assert attn_mod._DENSE_MONO_SCORE_BYTES == 48 << 20
+        assert attn_mod._DENSE_CHUNK_SCORE_BYTES == 40 << 20
+    finally:
+        fk._TUNED.update(saved_tuned)
+        (
+            attn_mod._DENSE_MONO_SCORE_BYTES,
+            attn_mod._DENSE_CHUNK_SCORE_BYTES,
+        ) = saved_caps

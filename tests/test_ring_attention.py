@@ -186,3 +186,55 @@ def test_model_sequence_parallel_matches_single_device():
         sp_model.params, sp_model.opt_state, sharded, jax.random.PRNGKey(0)
     )
     assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_pallas_matches_dense(causal):
+    """The Pallas-kernel ring body (flash per ppermute step + log-sum-exp
+    merge) is exact vs dense — run via the Pallas interpreter on the CPU
+    mesh; on TPU the same path compiles to the hand-tiled kernel."""
+    mesh = _mesh(seq=4)
+    rng = np.random.RandomState(2)
+    b, s, h, d = 1, 512, 2, 32  # 128 rows per device: one kernel tile
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+
+    ref = scaled_dot_product_attention(q, k, v, causal=causal)
+    out = jax.jit(
+        lambda a, b_, c: ring_attention(
+            a, b_, c, mesh, "seq", causal=causal, use_pallas=True
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=5e-5, rtol=5e-5
+    )
+
+
+def test_ring_pallas_grads_match_jnp_ring():
+    """Autodiff through the Pallas ring (custom-VJP kernels inside
+    lax.cond inside lax.scan inside shard_map) equals the jnp ring."""
+    mesh = _mesh(seq=4)
+    rng = np.random.RandomState(3)
+    b, s, h, d = 1, 512, 2, 32
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    w = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+
+    def loss(use_pallas):
+        def f(q, k, v):
+            return jnp.sum(
+                ring_attention(
+                    q, k, v, mesh, "seq", causal=True,
+                    use_pallas=use_pallas,
+                ) * w
+            )
+        return f
+
+    g_jnp = jax.jit(jax.grad(loss(False), argnums=(0, 1, 2)))(q, k, v)
+    g_pl = jax.jit(jax.grad(loss(True), argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(g_jnp, g_pl):
+        np.testing.assert_allclose(
+            np.asarray(b_), np.asarray(a), atol=5e-5, rtol=5e-4
+        )
