@@ -257,6 +257,9 @@ def site_strategy(
         annotate_input_batch(g, dp)
         for site in sites:
             site.apply(g, tp, 1)  # model axis = 1
+        from flexflow_tpu.search.peephole import sink_combines
+
+        sink_combines(g)  # keep the lowered graph == the costed candidate
 
     mesh = (
         MeshConfig(("data", "model"), (dp, tp))
@@ -332,6 +335,9 @@ def mixed_site_strategy(
                 {"axis": 0, "degree": tp, "parallel_idx": 0},
             )
             site.apply(g, tp, 1)
+        from flexflow_tpu.search.peephole import sink_combines
+
+        sink_combines(g)
 
     return Strategy(
         MeshConfig(("data", "model"), (dp, tp)),

@@ -54,6 +54,12 @@ def _candidate_graph(
         for site, enabled in zip(sites, on):
             if enabled:
                 site.apply(g, tp, _MODEL_AXIS)
+        # partition-move peephole (create_partition_*_combine analogs):
+        # must run here AND in the strategy lowering (site_strategy) so
+        # the costed candidate is the executed graph
+        from flexflow_tpu.search.peephole import sink_combines
+
+        sink_combines(g)
         propagate_shapes(g)
     except (ValueError, KeyError):
         return None
@@ -448,8 +454,15 @@ def optimize(
     calibration_file: str = "",
     attribute_parallel: bool = False,
     sparse_embedding: bool = True,
+    _explore_fuse: bool = True,
 ) -> SearchResult:
-    """Run the search on a PCG; returns the best found configuration."""
+    """Run the search on a PCG; returns the best found configuration.
+
+    _explore_fuse: also search the activation-fused variant of the graph
+    (peephole.fuse_linear_activation — create_linear_relu_merge analog)
+    and keep whichever graph's best strategy wins; the winning result
+    carries extra={"fuse": True} so the lowering fuses before applying
+    sites (whose guids were found on the fused graph)."""
     cm = CostModel(
         spec,
         measure=measure,
@@ -536,6 +549,27 @@ def optimize(
         if cur.cost.step_time < best.cost.step_time:
             best = cur
 
+    # the fuse rewrite as a searched graph variant (reference: the
+    # create_linear_relu_merge xfer competes inside base_optimize)
+    if _explore_fuse:
+        from flexflow_tpu.search.peephole import fuse_linear_activation
+
+        fused = graph.copy()
+        if fuse_linear_activation(fused):
+            fbest = optimize(
+                fused, num_devices, spec, budget=budget, alpha=alpha,
+                measure=measure, seed=seed, verbose=verbose,
+                machine_model=machine_model,
+                mixed_precision=mixed_precision,
+                calibration_file=calibration_file,
+                attribute_parallel=attribute_parallel,
+                sparse_embedding=sparse_embedding,
+                _explore_fuse=False,
+            )
+            if fbest.cost.step_time < best.cost.step_time:
+                fbest.extra["fuse"] = True
+                best = fbest
+
     return best
 
 
@@ -548,6 +582,30 @@ def result_to_strategy(result: SearchResult, graph: PCGGraph) -> Strategy:
         sequence_parallel_strategy,
         site_strategy,
     )
+
+    if result.extra.get("fuse"):
+        # the winning strategy was found on the activation-fused graph:
+        # fuse first (guid-stable), then lower the rest of the result
+        from flexflow_tpu.search.peephole import fuse_linear_activation
+
+        inner = result_to_strategy(
+            SearchResult(
+                result.dp, result.tp, result.sites, result.on,
+                result.cost, result.kind,
+                {k: v for k, v in result.extra.items() if k != "fuse"},
+            ),
+            graph,
+        )
+        orig_apply = inner._apply
+
+        def apply(g):
+            fuse_linear_activation(g)
+            if orig_apply is not None:
+                orig_apply(g)
+
+        inner._apply = apply
+        inner.name = f"{inner.name} + fused activations"
+        return inner
 
     prefix = f"searched({result.cost.step_time * 1e3:.3f} ms)"
     if result.kind == "mixed":

@@ -65,6 +65,85 @@ def config_delta(
     return d
 
 
+# reference: FFModel constants (model.h:325-327). PROPAGATION_SIZE_WEIGHT
+# is 1.0 there, i.e. pure tensor-volume weighting of the walk edges.
+PROPAGATION_CHANCE = 0.25
+CONTINUE_PROPAGATION_CHANCE = 0.75
+
+
+def propagate_views(
+    search: UnitySearch,
+    views: Dict[int, ViewOption],
+    start: int,
+    rng: random.Random,
+) -> Dict[int, ViewOption]:
+    """Frontier propagation (reference: FFModel::propagate,
+    model.cc:3166-3246, FF_USE_PROPAGATE): walk a random path from `start`
+    over producer/consumer edges, weighted by edge-tensor volume, copying
+    the start node's CURRENT view onto each visited neighbor that can
+    adopt it (has an equal-key view among its valid options); continue
+    with probability CONTINUE_PROPAGATION_CHANCE. Returns the proposed
+    {guid: view} reassignments (empty when no neighbor is adoptable) —
+    the caller scores/accepts the whole move atomically."""
+    g = search.graph
+    assignments: Dict[int, ViewOption] = {}
+    seen = {start}
+    cur = start
+    view = views[start]
+
+    def volume(ref) -> float:
+        shape = g.shape_of(ref)
+        v = 1.0
+        for d in shape.dims:
+            if not d.is_replica_dim:
+                v *= d.size
+        return v
+
+    while True:
+        candidates = []
+        node = g.nodes[cur]
+        for ref in node.inputs:
+            n = ref.guid
+            if n in views and n not in seen:
+                candidates.append((n, volume(ref)))
+        for c in g.consumers(cur):
+            if c in views and c not in seen:
+                for ref in g.nodes[c].inputs:
+                    if ref.guid == cur:
+                        candidates.append((c, volume(ref)))
+                        break
+        adoptable = []
+        for n, vol in candidates:
+            match = next(
+                (
+                    v
+                    for v in search.valid_views(n, search.resource)
+                    if v.key() == view.key()
+                ),
+                None,
+            )
+            if match is not None:
+                adoptable.append((n, vol, match))
+        if not adoptable:
+            break
+        total = sum(vol for _, vol, _ in adoptable)
+        r = rng.random() * (total if total > 0 else len(adoptable))
+        acc = 0.0
+        chosen = adoptable[-1]
+        for item in adoptable:
+            acc += item[1] if total > 0 else 1.0
+            if r <= acc:
+                chosen = item
+                break
+        n, _, match = chosen
+        assignments[n] = match
+        seen.add(n)
+        cur = n
+        if rng.random() >= CONTINUE_PROPAGATION_CHANCE:
+            break
+    return assignments
+
+
 def mcmc_optimize(
     graph: PCGGraph,
     spec: MachineSpec,
@@ -77,6 +156,7 @@ def mcmc_optimize(
     measure: bool = False,
     calibration_file: str = "",
     sparse_embedding: bool = True,
+    use_propagation: bool = True,
 ) -> UnityResult:
     """reference: mcmc_optimize (model.cc:3271) — budget proposals, periodic
     reset to best every budget/10 non-improving steps."""
@@ -113,16 +193,31 @@ def mcmc_optimize(
     reset_every = max(budget // 10, 10)
 
     for it in range(budget):
-        g = rng.choice(guids)
-        cands = search.valid_views(g, resource)
-        nxt_view = rng.choice(cands)
-        if nxt_view.key() == cur[g].key():
-            continue
-        delta = config_delta(search, cur, g, nxt_view)
+        # reference: rewrite() (model.cc:3247-3269) — with probability
+        # PROPAGATION_CHANCE propose a frontier propagation instead of a
+        # single-op flip
+        if use_propagation and rng.random() < PROPAGATION_CHANCE:
+            g = rng.choice(guids)
+            assigns = propagate_views(search, cur, g, rng)
+            if not assigns:
+                continue
+            trial = dict(cur)
+            delta = 0.0
+            for n, v in assigns.items():
+                delta += config_delta(search, trial, n, v)
+                trial[n] = v
+        else:
+            g = rng.choice(guids)
+            cands = search.valid_views(g, resource)
+            nxt_view = rng.choice(cands)
+            if nxt_view.key() == cur[g].key():
+                continue
+            trial = dict(cur)
+            trial[g] = nxt_view
+            delta = config_delta(search, cur, g, nxt_view)
         scale = max(cur_cost, 1e-9)
         if delta < 0 or rng.random() < math.exp(-alpha * delta / scale):
-            cur = dict(cur)
-            cur[g] = nxt_view
+            cur = trial
             cur_cost += delta
         if cur_cost < best_cost:
             best, best_cost = dict(cur), cur_cost

@@ -1,0 +1,225 @@
+"""Graph peepholes: the TPU analogs of the reference's remaining
+hand-written GraphXfer generators (reference: src/runtime/substitution.cc
+generate_all_pcg_xfers, :1721-1862).
+
+Two passes:
+
+  * `fuse_linear_activation` — create_linear_relu_merge (:1830s): fold a
+    single-consumer RELU/SIGMOID/TANH/GELU node into the producing
+    Linear's `activation` param. Beyond the fused-kernel saving (which
+    XLA largely gets anyway), the searchable effect is PLACEMENT: under a
+    column-parallel site the fused activation runs on the sharded output
+    BEFORE the Combine gather, where the standalone node ran replicated
+    after it.
+  * `sink_combines` — the partition-move family
+    (create_partition_{add,relu,softmax,concat}_combine,
+    create_combine_concat / create_combine_inception, :1721-1827): move a
+    Combine gather DOWN through ops that commute with the combined axis —
+    elementwise unaries, matching-axis Adds, BatchNorm on its channel
+    axis, Softmax off its softmax axis, Concat when every input arrives
+    through a matching Combine. Each sink makes the downstream op compute
+    on 1/degree of the data; N sibling Combines below a Concat collapse
+    into one. Run after site application (parallel/strategy.py,
+    search/auto.py) so the costed candidate and the lowered graph agree.
+
+Both passes preserve guids of surviving nodes (pipeline templates and
+site tuples reference them).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from flexflow_tpu.core.pcg import PCGGraph, TensorRef
+from flexflow_tpu.core.types import ActiMode, OperatorType
+
+# standalone activation node -> Linear activation param
+_FUSABLE_ACTIVATIONS = {
+    OperatorType.RELU: ActiMode.RELU,
+    OperatorType.SIGMOID: ActiMode.SIGMOID,
+    OperatorType.TANH: ActiMode.TANH,
+    OperatorType.GELU: ActiMode.GELU,
+}
+
+# unary elementwise ops any Combine axis passes through
+_SINK_UNARY = {
+    OperatorType.RELU,
+    OperatorType.SIGMOID,
+    OperatorType.TANH,
+    OperatorType.ELU,
+    OperatorType.GELU,
+    OperatorType.IDENTITY,
+    OperatorType.EXP,
+    OperatorType.SIN,
+    OperatorType.COS,
+    OperatorType.POW,
+    OperatorType.RSQRT,
+    OperatorType.SCALAR_MULTIPLY,
+    OperatorType.SCALAR_ADD,
+    OperatorType.SCALAR_SUB,
+    OperatorType.SCALAR_TRUE_DIV,
+    OperatorType.DROPOUT,
+}
+
+
+def fuse_linear_activation(graph: PCGGraph) -> int:
+    """Fold standalone activation nodes into their producing Linear.
+    Mutates `graph`; returns the number of fusions."""
+    fused = 0
+    for guid in list(graph.topo_order()):
+        node = graph.nodes.get(guid)
+        if node is None or node.op_type not in _FUSABLE_ACTIVATIONS:
+            continue
+        if len(node.inputs) != 1:
+            continue
+        src = node.inputs[0]
+        prod = graph.nodes.get(src.guid)
+        if prod is None or prod.op_type != OperatorType.LINEAR:
+            continue
+        if prod.params.get("activation", ActiMode.NONE) != ActiMode.NONE:
+            continue
+        # the linear must feed ONLY this activation (otherwise other
+        # consumers would see activated values)
+        if graph.consumers(src.guid) != {guid}:
+            continue
+        prod.params["activation"] = _FUSABLE_ACTIVATIONS[node.op_type]
+        for c in list(graph.consumers(guid)):
+            graph.replace_input(c, TensorRef(guid, 0), src)
+        graph.remove_node(guid)
+        fused += 1
+    return fused
+
+
+def _single_combine_in(graph: PCGGraph, ref: TensorRef):
+    """The Combine node feeding `ref`, if `ref` is a Combine output with
+    no other consumers (safe to re-home)."""
+    node = graph.nodes.get(ref.guid)
+    if node is None or node.op_type != OperatorType.COMBINE:
+        return None
+    return node
+
+
+def _abs_axis(shape, logical_axis: int) -> int:
+    """Absolute index (into shape.dims, replica dims included) of the
+    logical axis — Combine params address dims absolutely."""
+    cnt = -1
+    for i, d in enumerate(shape.dims):
+        if not d.is_replica_dim:
+            cnt += 1
+            if cnt == logical_axis:
+                return i
+    return len(shape.dims) - 1
+
+
+def _sink_one(graph: PCGGraph, guid: int) -> bool:
+    """Try to sink the Combine(s) feeding node `guid` below it."""
+    node = graph.nodes.get(guid)
+    if node is None or node.op_type == OperatorType.COMBINE:
+        return False
+    combines = []
+    for ref in node.inputs:
+        c = _single_combine_in(graph, ref)
+        combines.append((ref, c))
+    live = [(r, c) for r, c in combines if c is not None]
+    if not live:
+        return False
+
+    def consumers_only_me(c_guid: int) -> bool:
+        return graph.consumers(c_guid) == {guid}
+
+    op = node.op_type
+    if op in _SINK_UNARY and len(node.inputs) == 1:
+        ref, comb = combines[0]
+        if not consumers_only_me(comb.guid):
+            return False
+        movers = [comb]
+    elif op == OperatorType.EW_ADD:
+        # both inputs must arrive through IDENTICAL combines
+        if len(combines) != 2 or any(c is None for _, c in combines):
+            return False
+        (r1, c1), (r2, c2) = combines
+        if (
+            c1.params.get("axis") != c2.params.get("axis")
+            or c1.params.get("degree") != c2.params.get("degree")
+            or not consumers_only_me(c1.guid)
+            or not consumers_only_me(c2.guid)
+        ):
+            return False
+        movers = [c1, c2]
+    elif op == OperatorType.SOFTMAX:
+        ref, comb = combines[0]
+        sm_dim = node.params.get("dim", -1)
+        in_shape = graph.shape_of(comb.inputs[0])
+        nd = len([d for d in in_shape.dims if not d.is_replica_dim])
+        if sm_dim < 0:
+            sm_dim += nd
+        if comb.params.get("axis") == _abs_axis(
+            in_shape, sm_dim
+        ) or not consumers_only_me(comb.guid):
+            return False
+        movers = [comb]
+    elif op == OperatorType.BATCHNORM:
+        # BN statistics are PER-CHANNEL: a channel-axis combine commutes
+        # (each shard owns whole channels); any other axis would split
+        # the reduction and does not
+        ref, comb = combines[0]
+        in_shape = graph.shape_of(comb.inputs[0])
+        if comb.params.get("axis") != len(
+            in_shape.dims
+        ) - 1 or not consumers_only_me(comb.guid):
+            return False
+        movers = [comb]
+    elif op == OperatorType.CONCAT:
+        # every input must arrive through a matching-degree combine on the
+        # SAME logical axis (create_combine_concat: N combines + concat ->
+        # concat + 1 combine)
+        if len(live) != len(combines) or not combines:
+            return False
+        axis0 = combines[0][1].params.get("axis")
+        deg0 = combines[0][1].params.get("degree")
+        for _, c in combines:
+            if (
+                c.params.get("axis") != axis0
+                or c.params.get("degree") != deg0
+                or not consumers_only_me(c.guid)
+            ):
+                return False
+        movers = [c for _, c in combines]
+    else:
+        return False
+
+    # rewire: node consumes the combines' inputs directly; one new Combine
+    # (same params as the first mover) takes the node's output; the old
+    # combine nodes disappear. Dedupe movers: add(y, y) feeds the SAME
+    # combine through both inputs and it must be removed exactly once.
+    movers = list({c.guid: c for c in movers}.values())
+    params = dict(movers[0].params)
+    for ref, comb in combines:
+        if comb is not None:
+            graph.replace_input(guid, ref, comb.inputs[0])
+    from flexflow_tpu.search.rewrites import _insert_after
+
+    _insert_after(
+        graph,
+        guid,
+        OperatorType.COMBINE,
+        f"{node.name}.combine_sunk",
+        params,
+    )
+    for comb in movers:
+        graph.remove_node(comb.guid)
+    return True
+
+
+def sink_combines(graph: PCGGraph, max_passes: int = 32) -> int:
+    """Repeatedly sink Combine nodes until fixpoint. Returns total sinks."""
+    total = 0
+    for _ in range(max_passes):
+        moved = False
+        for guid in list(graph.topo_order()):
+            if guid in graph.nodes and _sink_one(graph, guid):
+                moved = True
+                total += 1
+        if not moved:
+            break
+    return total
