@@ -348,11 +348,31 @@ def _lower_batchnorm(params):
         gamma, beta = ws
         axes = tuple(range(x.ndim - 1))
         # stats accumulate in f32 even when activations flow bf16 (mixed
-        # precision): bf16 mean/var over big reductions loses too much
+        # precision): bf16 mean/var over big reductions loses too much.
+        # One-pass moments: var = E[(x-c)^2] - E[x-c]^2 with a CHEAP
+        # per-channel anchor c (the first sample's mean). Both sums
+        # accumulate in a single pass over the activation, where the
+        # textbook E[(x-mean)^2] chains a second full HBM read behind the
+        # mean (measured on ResNet-50 bs16, one v5e, interleaved A/B with
+        # warmed alternating bursts: ~6% whole-step win,
+        # scripts/ab_resnet_bn.py). The raw E[x^2]-E[x]^2 form would
+        # cancel catastrophically for |mean| >> std inputs; anchoring at
+        # c (within a few std of the true mean for any data whose first
+        # sample resembles the batch) bounds the cancellation to
+        # ((mean-c)/std)^2 relative — exactness vs the two-pass form is
+        # pinned by tests/test_alignment.py and the large-offset case in
+        # test_bn_large_mean_numerics.
         xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
-        mean = jnp.mean(xf, axis=axes, keepdims=True)
-        var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
-        y = (xf - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+        c = jax.lax.stop_gradient(
+            jnp.mean(xf[:1], axis=axes[1:], keepdims=True)
+            if xf.ndim > 1
+            else jnp.zeros((1,) * xf.ndim, jnp.float32)
+        )
+        xs = xf - c
+        mean_s = jnp.mean(xs, axis=axes, keepdims=True)
+        ex2 = jnp.mean(jnp.square(xs), axis=axes, keepdims=True)
+        var = jnp.maximum(ex2 - jnp.square(mean_s), 0.0)
+        y = (xs - mean_s) * jax.lax.rsqrt(var + eps) * gamma + beta
         return [_apply_activation(y.astype(x.dtype), act)]
 
     return fn

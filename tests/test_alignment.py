@@ -421,3 +421,40 @@ def test_concat_transpose_reshape_alignment():
     close(out, out_t.detach().numpy())
     close(dx["a"], da_t)
     close(dx["b"], db_t)
+
+
+def test_bn_large_mean_numerics():
+    """One-pass anchored BN moments must survive |mean| >> std inputs
+    (the raw E[x^2]-E[x]^2 form cancels catastrophically at mean ~1e3,
+    std ~1 in f32): outputs match torch BN within f32 tolerance."""
+    import numpy as np
+    import torch
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+
+    rng = np.random.RandomState(0)
+    x = (1000.0 + rng.randn(8, 6, 6, 4)).astype(np.float32)
+
+    m = FFModel(FFConfig(batch_size=8))
+    xt = m.create_tensor([8, 6, 6, 4], name="x")
+    bn = m.batch_norm(xt, relu=False)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.0),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+    )
+    m.set_tensor(bn.ref.guid, 0, np.ones((4,), np.float32))  # gamma
+    m.set_tensor(bn.ref.guid, 1, np.zeros((4,), np.float32))  # beta
+    out = np.asarray(m.forward({"x": x}))
+
+    tb = torch.nn.BatchNorm2d(4, eps=1e-5, affine=True)
+    tb.weight.data.fill_(1.0)
+    tb.bias.data.fill_(0.0)
+    ref = (
+        tb(torch.from_numpy(x).permute(0, 3, 1, 2))
+        .permute(0, 2, 3, 1)
+        .detach()
+        .numpy()
+    )
+    np.testing.assert_allclose(out, ref, atol=5e-3)
+    assert np.std(out) > 0.5  # NOT collapsed by a zeroed variance
