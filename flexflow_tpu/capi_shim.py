@@ -144,6 +144,22 @@ class ParamHandle:
         self.idx = idx
 
 
+def _stage(model, name: str, arr):
+    """THE batch-staging point: every write that changes what the next
+    forward sees (inputs, labels, constants, dataloader batches) goes
+    through here so the cached activations/gradients are invalidated
+    together."""
+    staged = getattr(model, "_capi_batch", None) or {}
+    staged[name] = arr
+    model._capi_batch = staged
+    _invalidate(model)
+
+
+def _invalidate(model):
+    model._capi_values = None
+    model._capi_grads = None
+
+
 def tensor_owner_op(t):
     return OpHandle(t.model, t.ref.guid)
 
@@ -152,10 +168,7 @@ def tensor_attach_raw_ptr(model, t, addr, shape, is_int):
     arr = _array_from_ptr(
         addr, tuple(shape), np.int32 if is_int else np.float32
     )
-    name = model.graph.nodes[t.ref.guid].name
-    staged = getattr(model, "_capi_batch", None) or {}
-    staged[name] = arr
-    model._capi_batch = staged
+    _stage(model, model.graph.nodes[t.ref.guid].name, arr)
 
 
 def tensor_detach_raw_ptr(model, t):
@@ -466,6 +479,7 @@ def model_update(model):
     model.params, model.opt_state, loss, _ = pending
     model._capi_last_loss = float(np.asarray(loss))
     model._capi_pending = None
+    _invalidate(model)  # weights changed: cached activations/grads stale
 
 
 def model_last_loss(model):
@@ -627,9 +641,7 @@ class CApiDataLoader:
             self.index = 0
         sl = self.data[self.index : self.index + b]
         self.index += b
-        staged = getattr(self.model, "_capi_batch", None) or {}
-        staged[self.name] = sl
-        self.model._capi_batch = staged
+        _stage(self.model, self.name, sl)
 
 
 def dataloader_create(model, t, addr, shape, is_int):
@@ -661,3 +673,216 @@ def dataloader_reset(loader):
 
 def dataloader_next_batch(loader):
     loader.next_batch()
+
+
+# -- C API tail (reference parity, python/flexflow_c.h:59-669) ---------------
+
+_NP_TAG = {"f4": np.float32, "i4": np.int32, "i8": np.int64}
+
+
+def config_parse_args(cfg, argv: Sequence[str]):
+    """Re-parse reference-spelling flags into an EXISTING config handle
+    (reference: flexflow_config_parse_args)."""
+    parsed = FFConfig.parse_args(list(argv))
+    cfg.__dict__.update(vars(parsed))
+
+
+class LabelTensor:
+    """The compile()-created label tensor (reference:
+    flexflow_model_get_label_tensor returns the label ParallelTensor).
+    Tensor-protocol surface: dims/dtype plus staging under "label"."""
+
+    def __init__(self, model: FFModel):
+        self.model = model
+
+    @property
+    def _shape(self):
+        ex = self.model.executor
+        if ex is None or ex.label_shape is None:
+            raise RuntimeError("call compile() before get_label_tensor()")
+        return ex.label_shape
+
+    @property
+    def dims(self):
+        return [
+            d.size for d in self._shape.dims if not d.is_replica_dim
+        ]
+
+    @property
+    def dtype(self):
+        return self._shape.dtype
+
+
+class ParamTensor:
+    """A parameter exposed through the TENSOR protocol (reference:
+    flexflow_model_get_parameter_by_id returns a Tensor)."""
+
+    def __init__(self, model: FFModel, guid: int, idx: int = 0):
+        self.model = model
+        self.guid = guid
+        self.idx = idx
+
+    @property
+    def _shape(self):
+        return self.model.graph.nodes[self.guid].weight_shapes[self.idx]
+
+    @property
+    def dims(self):
+        return [
+            d.size for d in self._shape.dims if not d.is_replica_dim
+        ]
+
+    @property
+    def dtype(self):
+        return self._shape.dtype
+
+
+def model_get_label_tensor(model):
+    return LabelTensor(model)
+
+
+def model_get_parameter_by_id(model, layer_id: int):
+    guid = _layer_guids(model)[layer_id]
+    node = model.graph.nodes[guid]
+    if not node.weight_shapes:
+        raise ValueError(f"layer {layer_id} ({node.name}) has no parameters")
+    return ParamTensor(model, guid, 0)
+
+
+def constant_create(model, dims: Sequence[int], value: float, dtype: int):
+    """Constant-filled tensor: an input-protocol tensor whose staged value
+    is permanently the constant array (reference: flexflow_constant_create
+    maps and fills a Legion region; here the jitted step consumes the
+    staged array each step)."""
+    dt = _DTYPE.get(dtype, DataType.FLOAT)
+    t = model.create_tensor(list(dims), dtype=dt, name=None)
+    np_dt = {
+        DataType.FLOAT: np.float32,
+        DataType.INT32: np.int32,
+        DataType.INT64: np.int64,
+    }.get(dt, np.float32)
+    arr = np.full(tuple(dims), value, dtype=np_dt)
+    _stage(model, model.graph.nodes[t.ref.guid].name, arr)
+    return t
+
+
+def tensor_get_dim_legion(t, legion_axis: int):
+    """Single dim in the reference's Legion order (innermost first)."""
+    dims = list(t.dims)
+    return int(dims[len(dims) - 1 - legion_axis])
+
+
+def _staged_batch(model):
+    staged = getattr(model, "_capi_batch", None)
+    if not staged:
+        raise RuntimeError(
+            "no data staged: attach raw ptrs / run a dataloader batch first"
+        )
+    return staged
+
+
+def op_init(op: OpHandle, model):
+    """reference: flexflow_op_init launches the op's init task. Parameters
+    here materialize at compile() (functional runtime), so init is
+    intentionally a no-op that just validates the handle."""
+    _ = op.node
+    return 0
+
+
+def op_forward(op: OpHandle, model):
+    """reference: flexflow_op_forward runs one op's forward task. XLA
+    executes the whole fused program, so this evaluates the graph forward
+    on the staged batch and caches every activation; per-op reads go
+    through tensor_get_tensor."""
+    ex = model.executor
+    if ex is None:
+        raise RuntimeError("call compile() before op_forward()")
+    batch = ex.shard_batch(dict(_staged_batch(model)))
+    model._capi_values = ex.forward_values(
+        model.params, batch, train=False
+    )
+    return 0
+
+
+def tensor_set_tensor(model, t, dims: Sequence[int], addr: int, tag: str):
+    """Host->tensor write by handle (reference:
+    flexflow_tensor_set_tensor_*): parameters write weights; graph input
+    tensors stage batch data."""
+    arr = _array_from_ptr(addr, tuple(dims), _NP_TAG[tag]).copy()
+    if isinstance(t, ParamTensor):
+        model.set_tensor(t.guid, t.idx, arr)
+        _invalidate(model)  # activations depend on the weights too
+        return 0
+    if isinstance(t, LabelTensor):
+        _stage(model, "label", arr)
+        return 0
+    node = model.graph.nodes[t.ref.guid]
+    if node.inputs:
+        raise ValueError(
+            "set_tensor targets parameters, inputs, or the label tensor; "
+            f"{node.name} is an interior op output"
+        )
+    _stage(model, node.name, arr)
+    return 0
+
+
+def tensor_get_tensor(model, t, addr: int, tag: str, get_gradients: int):
+    """Tensor->host read by handle (reference:
+    flexflow_tensor_get_tensor_*). Parameters read weights (or their loss
+    gradient on the staged batch with get_gradients); interior tensors
+    read the activation cached by op_forward/model_forward."""
+    dt = _NP_TAG[tag]
+    if isinstance(t, ParamTensor):
+        if get_gradients:
+            grads = getattr(model, "_capi_grads", None)
+            if grads is None:
+                staged = _staged_batch(model)
+                if "label" not in staged:
+                    raise RuntimeError(
+                        "stage labels (set_tensor on the label tensor or "
+                        "a label dataloader batch) before reading "
+                        "gradients"
+                    )
+                xs = {k: v for k, v in staged.items() if k != "label"}
+                # ONE fwd+bwd serves every parameter read until the
+                # staged batch or a weight changes (_invalidate)
+                grads = model.compute_gradients(xs, staged["label"])
+                model._capi_grads = grads
+            arr = np.asarray(grads[t.guid][t.idx])
+        else:
+            arr = np.asarray(model.get_tensor(t.guid, t.idx))
+    elif isinstance(t, LabelTensor):
+        arr = np.asarray(_staged_batch(model)["label"])
+    else:
+        if get_gradients:
+            raise ValueError(
+                "activation gradients are not retained (functional "
+                "autodiff); read parameter gradients instead"
+            )
+        guid = t.ref.guid
+        node = model.graph.nodes.get(guid)
+        if node is not None and not node.inputs:
+            arr = np.asarray(_staged_batch(model)[node.name])
+        else:
+            values = getattr(model, "_capi_values", None)
+            if values is None or (guid, t.ref.out_idx) not in values:
+                op_forward(OpHandle(model, guid), model)
+                values = model._capi_values
+            arr = np.asarray(values[(guid, t.ref.out_idx)])
+    _array_to_ptr(np.ascontiguousarray(arr, dtype=dt), addr)
+    return 0
+
+
+def dataloader_create2(model, t, addr: int, num_samples: int, is_int: int):
+    """Raw-pointer dataloader (reference: create2): the per-sample shape
+    comes from the attached tensor; the leading dim is num_samples."""
+    sample_dims = list(t.dims)[1:]
+    data = _array_from_ptr(
+        addr,
+        tuple([int(num_samples)] + sample_dims),
+        np.int32 if is_int else np.float32,
+    )
+    if isinstance(t, LabelTensor):
+        return CApiDataLoader(model, "label", data)
+    name = model.graph.nodes[t.ref.guid].name
+    return CApiDataLoader(model, name, data)

@@ -353,6 +353,76 @@ int flexflow_single_dataloader_reset(flexflow_single_dataloader_t loader);
 int flexflow_single_dataloader_next_batch(flexflow_single_dataloader_t loader);
 void flexflow_single_dataloader_destroy(flexflow_single_dataloader_t loader);
 
+/* C API tail (reference parity; see docs/capi_parity.md) ---------------- */
+
+/* re-parse reference-spelling flags into an existing config */
+void flexflow_config_parse_args(flexflow_config_t config, char **argv,
+                                int argc);
+void flexflow_config_parse_args_default(flexflow_config_t config);
+
+/* the label tensor created by compile() (reference:
+ * flexflow_model_get_label_tensor); supports get_dims / attach /
+ * dataloader staging under the "label" slot */
+flexflow_tensor_t flexflow_model_get_label_tensor(flexflow_model_t model);
+
+/* layer_id'th layer's first parameter, as a tensor-like handle usable
+ * with flexflow_tensor_get/set_tensor_* */
+flexflow_tensor_t flexflow_model_get_parameter_by_id(flexflow_model_t model,
+                                                     int layer_id);
+
+/* constant-filled weight-less tensor (reference: flexflow_constant_create) */
+flexflow_tensor_t flexflow_constant_create(flexflow_model_t model,
+                                           int num_dims, const int *dims,
+                                           float value, int data_type);
+
+/* single dim, Legion axis order (innermost first — reference convention) */
+int flexflow_tensor_get_dim(flexflow_tensor_t tensor, int legion_axis);
+
+/* host tensor I/O by handle (reference: flexflow_tensor_get/set_tensor_*).
+ * set: stages input/constant data or writes a parameter; get: copies the
+ * tensor's current value (forward activations are evaluated on the staged
+ * batch; get_gradients returns the loss gradient instead for parameters).
+ * Returns 0 on success. */
+int flexflow_tensor_set_tensor_float(flexflow_tensor_t tensor,
+                                     flexflow_model_t model, int num_dim,
+                                     const int *dims, const float *data);
+int flexflow_tensor_get_tensor_float(flexflow_tensor_t tensor,
+                                     flexflow_model_t model, float *data,
+                                     int get_gradients);
+int flexflow_tensor_set_tensor_int(flexflow_tensor_t tensor,
+                                   flexflow_model_t model, int num_dim,
+                                   const int *dims, const int *data);
+int flexflow_tensor_get_tensor_int(flexflow_tensor_t tensor,
+                                   flexflow_model_t model, int *data,
+                                   int get_gradients);
+int flexflow_tensor_set_tensor_int64(flexflow_tensor_t tensor,
+                                     flexflow_model_t model, int num_dim,
+                                     const int *dims, const int64_t *data);
+int flexflow_tensor_get_tensor_int64(flexflow_tensor_t tensor,
+                                     flexflow_model_t model, int64_t *data,
+                                     int get_gradients);
+
+/* NULL initializer = "use the op's default" (reference parity) */
+flexflow_initializer_t flexflow_initializer_create_null(void);
+void flexflow_glorot_uniform_initializer_destroy(flexflow_initializer_t h);
+void flexflow_zero_initializer_destroy(flexflow_initializer_t h);
+void flexflow_uniform_initializer_destroy(flexflow_initializer_t h);
+void flexflow_norm_initializer_destroy(flexflow_initializer_t h);
+void flexflow_constant_initializer_destroy(flexflow_initializer_t h);
+
+/* per-op init/forward (reference: flexflow_op_init/forward). init is a
+ * no-op by design — parameters materialize at compile(); forward
+ * evaluates the graph on the staged batch so the op's output is
+ * readable via flexflow_tensor_get_tensor_* */
+void flexflow_op_init(flexflow_op_t op, flexflow_model_t model);
+void flexflow_op_forward(flexflow_op_t op, flexflow_model_t model);
+
+/* raw-pointer dataloader variant (reference: create2): per-sample shape
+ * comes from the attached tensor */
+flexflow_single_dataloader_t flexflow_single_dataloader_create2(
+    flexflow_model_t model, flexflow_tensor_t tensor,
+    const void *full_data_ptr, int num_samples, int is_int);
+
 /* handles -------------------------------------------------------------- */
 
 void flexflow_handle_destroy(void *handle);

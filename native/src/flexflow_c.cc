@@ -809,6 +809,124 @@ void flexflow_single_dataloader_destroy(flexflow_single_dataloader_t loader) {
   Py_XDECREF((PyObject *)loader);
 }
 
+/* C API tail (reference parity, flexflow_c.h:59-669) ---------------------- */
+
+void flexflow_config_parse_args(flexflow_config_t config, char **argv,
+                                int argc) {
+  PyObject *l = PyList_New(argc);
+  for (int i = 0; i < argc; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(argv[i]));
+  PyObject *out = shim_call(
+      "config_parse_args", Py_BuildValue("(ON)", (PyObject *)config, l));
+  Py_XDECREF(out);
+}
+
+void flexflow_config_parse_args_default(flexflow_config_t config) {
+  // reference: parse_args(default): re-reads the Legion command line;
+  // here the process argv was already consumed by flexflow_config_create,
+  // so the default parse is a no-op by design.
+  (void)config;
+}
+
+flexflow_tensor_t flexflow_model_get_label_tensor(flexflow_model_t model) {
+  return shim_call("model_get_label_tensor",
+                   Py_BuildValue("(O)", (PyObject *)model));
+}
+
+flexflow_tensor_t flexflow_model_get_parameter_by_id(flexflow_model_t model,
+                                                     int layer_id) {
+  return shim_call("model_get_parameter_by_id",
+                   Py_BuildValue("(Oi)", (PyObject *)model, layer_id));
+}
+
+flexflow_tensor_t flexflow_constant_create(flexflow_model_t model,
+                                           int num_dims, const int *dims,
+                                           float value, int data_type) {
+  return shim_call(
+      "constant_create",
+      Py_BuildValue("(ONdi)", (PyObject *)model, int_list(dims, num_dims),
+                    (double)value, data_type));
+}
+
+int flexflow_tensor_get_dim(flexflow_tensor_t tensor, int legion_axis) {
+  /* reference: Legion dim order is innermost-first; ours is row-major */
+  return (int)shim_call_long(
+      "tensor_get_dim_legion",
+      Py_BuildValue("(Oi)", (PyObject *)tensor, legion_axis), -1);
+}
+
+#define TENSOR_IO(suffix, ctype, np_tag)                                      \
+  int flexflow_tensor_set_tensor_##suffix(                                    \
+      flexflow_tensor_t tensor, flexflow_model_t model, int num_dim,          \
+      const int *dims, const ctype *data) {                                   \
+    return shim_call_status(                                                  \
+        "tensor_set_tensor",                                                  \
+        Py_BuildValue("(OONKs)", (PyObject *)model, (PyObject *)tensor,       \
+                      int_list(dims, num_dim),                                \
+                      (unsigned long long)(uintptr_t)data, np_tag));          \
+  }                                                                           \
+  int flexflow_tensor_get_tensor_##suffix(                                    \
+      flexflow_tensor_t tensor, flexflow_model_t model, ctype *data,          \
+      int get_gradients) {                                                    \
+    return shim_call_status(                                                  \
+        "tensor_get_tensor",                                                  \
+        Py_BuildValue("(OOKsi)", (PyObject *)model, (PyObject *)tensor,       \
+                      (unsigned long long)(uintptr_t)data, np_tag,            \
+                      get_gradients));                                        \
+  }
+TENSOR_IO(float, float, "f4")
+TENSOR_IO(int, int, "i4")
+TENSOR_IO(int64, int64_t, "i8")
+#undef TENSOR_IO
+
+flexflow_initializer_t flexflow_initializer_create_null(void) {
+  /* reference: a null initializer means "use the op's default" */
+  Py_RETURN_NONE;
+}
+
+/* the reference exposes per-type destroys; every handle here is a Python
+   object, so they all alias the generic decref */
+void flexflow_glorot_uniform_initializer_destroy(
+    flexflow_initializer_t handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+void flexflow_zero_initializer_destroy(flexflow_initializer_t handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+void flexflow_uniform_initializer_destroy(flexflow_initializer_t handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+void flexflow_norm_initializer_destroy(flexflow_initializer_t handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+void flexflow_constant_initializer_destroy(flexflow_initializer_t handle) {
+  Py_XDECREF((PyObject *)handle);
+}
+
+void flexflow_op_init(flexflow_op_t op, flexflow_model_t model) {
+  PyObject *out = shim_call(
+      "op_init", Py_BuildValue("(OO)", (PyObject *)op, (PyObject *)model));
+  Py_XDECREF(out);
+}
+
+void flexflow_op_forward(flexflow_op_t op, flexflow_model_t model) {
+  PyObject *out = shim_call(
+      "op_forward", Py_BuildValue("(OO)", (PyObject *)op, (PyObject *)model));
+  Py_XDECREF(out);
+}
+
+flexflow_single_dataloader_t flexflow_single_dataloader_create2(
+    flexflow_model_t model, flexflow_tensor_t tensor,
+    const void *full_data_ptr, int num_samples, int is_int) {
+  /* reference create2: raw pointer + sample count; the per-sample shape
+     comes from the attached tensor */
+  return shim_call(
+      "dataloader_create2",
+      Py_BuildValue("(OOKii)", (PyObject *)model, (PyObject *)tensor,
+                    (unsigned long long)(uintptr_t)full_data_ptr,
+                    num_samples, is_int));
+}
+
 /* handles ----------------------------------------------------------------- */
 
 void flexflow_handle_destroy(void *handle) {

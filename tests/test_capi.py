@@ -71,3 +71,12 @@ def test_capi_cnn_with_initializers_and_weight_roundtrip(tmp_path):
 def test_capi_attention_training_loop_verbs(tmp_path):
     out = _compile_and_run(tmp_path, "capi_attention.c", "capi_attention")
     assert "capi_attention ok" in out
+
+
+def test_capi_tail_reference_parity_entries(tmp_path):
+    """The round-4 parity tail: parse_args, label tensor, per-handle
+    tensor I/O (+ parameter gradients), parameter-by-id, constant_create,
+    legion-order get_dim, op_init/op_forward with interior activation
+    reads, create2 dataloader, null/typed initializer entries."""
+    out = _compile_and_run(tmp_path, "capi_tail.c", "capi_tail")
+    assert "capi_tail ok" in out
