@@ -1,0 +1,9 @@
+"""Reference-compatible `flexflow` namespace (migration shim).
+
+Scripts written against the reference's Python package (reference:
+python/flexflow/ — `from flexflow.keras.models import Model`,
+`import flexflow.core as ff`, `from flexflow.torch.model import
+PyTorchModel`) import unchanged; every symbol re-exports the
+flexflow_tpu implementation. See tests/test_reference_keras_examples.py
+for reference example scripts running through this namespace.
+"""

@@ -1,0 +1,20 @@
+"""reference: python/flexflow/core/__init__.py — the `flexflow.core as ff`
+surface reference scripts use."""
+
+from flexflow_tpu import *  # noqa: F401,F403
+from flexflow_tpu import (  # noqa: F401
+    ActiMode,
+    CompMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+)
+from flexflow_tpu.core.types import AggrMode, PoolType  # noqa: F401
+
+
+def init_flexflow_runtime(*args, **kwargs):
+    """reference: starts the Legion runtime; a no-op here (XLA needs no
+    runtime bring-up)."""
+    return None

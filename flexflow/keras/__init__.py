@@ -1,0 +1,7 @@
+from flexflow.keras import (  # noqa: F401
+    callbacks,
+    initializers,
+    losses,
+    metrics,
+    optimizers,
+)

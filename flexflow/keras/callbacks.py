@@ -1,0 +1,6 @@
+from flexflow_tpu.frontends.keras_callbacks import (  # noqa: F401
+    Callback,
+    EpochVerifyMetrics,
+    LearningRateScheduler,
+    VerifyMetrics,
+)

@@ -1,0 +1,1 @@
+from flexflow.keras.datasets import cifar10, cifar100, mnist, reuters  # noqa: F401

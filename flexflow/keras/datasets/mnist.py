@@ -1,0 +1,1 @@
+from flexflow_tpu.frontends.keras_datasets import load_mnist as load_data  # noqa: F401

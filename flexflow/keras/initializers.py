@@ -1,0 +1,8 @@
+from flexflow_tpu.frontends.keras_api import (  # noqa: F401
+    DefaultInitializer,
+    GlorotUniform,
+    Initializer,
+    RandomNormal,
+    RandomUniform,
+    Zeros,
+)

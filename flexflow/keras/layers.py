@@ -1,0 +1,27 @@
+from flexflow_tpu.frontends.keras_api import (  # noqa: F401
+    Activation,
+    Add,
+    AveragePooling2D,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    Layer,
+    LayerNormalization,
+    MaxPooling2D,
+    Multiply,
+    Permute,
+    Reshape,
+    Subtract,
+    add,
+    concatenate,
+    multiply,
+    subtract,
+)
+
+InputLayer = Input  # reference exports both names
+Pooling2D = MaxPooling2D

@@ -1,0 +1,6 @@
+from flexflow_tpu.frontends.keras_api import (  # noqa: F401
+    CategoricalCrossentropy,
+    Loss,
+    MeanSquaredError,
+    SparseCategoricalCrossentropy,
+)

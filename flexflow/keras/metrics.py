@@ -1,0 +1,15 @@
+from flexflow_tpu.frontends.keras_api import (  # noqa: F401
+    Accuracy,
+    MeanAbsoluteError,
+    Metric,
+    RootMeanSquaredError,
+)
+from flexflow_tpu.frontends.keras_api import (  # noqa: F401
+    MetricCategoricalCrossentropy as CategoricalCrossentropy,
+)
+from flexflow_tpu.frontends.keras_api import (  # noqa: F401
+    MetricMeanSquaredError as MeanSquaredError,
+)
+from flexflow_tpu.frontends.keras_api import (  # noqa: F401
+    MetricSparseCategoricalCrossentropy as SparseCategoricalCrossentropy,
+)

@@ -1,0 +1,1 @@
+from flexflow_tpu.frontends.keras_api import Model, Sequential  # noqa: F401

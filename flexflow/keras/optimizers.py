@@ -1,0 +1,1 @@
+from flexflow_tpu.frontends.keras_api import SGD, Adam  # noqa: F401

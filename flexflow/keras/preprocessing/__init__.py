@@ -1,0 +1,1 @@
+from flexflow.keras.preprocessing import sequence, text  # noqa: F401

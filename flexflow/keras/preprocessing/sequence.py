@@ -1,0 +1,5 @@
+from flexflow_tpu.frontends.keras_preprocessing import (  # noqa: F401
+    make_sampling_table,
+    pad_sequences,
+    skipgrams,
+)

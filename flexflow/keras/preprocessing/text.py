@@ -1,0 +1,5 @@
+from flexflow_tpu.frontends.keras_preprocessing import (  # noqa: F401
+    Tokenizer,
+    one_hot,
+    text_to_word_sequence,
+)

@@ -1,0 +1,1 @@
+from flexflow_tpu.frontends.onnx_model import ONNXModel  # noqa: F401

@@ -1,0 +1,4 @@
+from flexflow_tpu.frontends.torch_fx import (  # noqa: F401
+    PyTorchModel,
+    torch_to_flexflow,
+)

@@ -56,8 +56,121 @@ _METRIC = {
     "sparse_categorical_crossentropy": MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
     "categorical_crossentropy": MetricsType.CATEGORICAL_CROSSENTROPY,
     "mean_squared_error": MetricsType.MEAN_SQUARED_ERROR,
+    "root_mean_squared_error": MetricsType.ROOT_MEAN_SQUARED_ERROR,
     "mean_absolute_error": MetricsType.MEAN_ABSOLUTE_ERROR,
 }
+
+
+# -- initializers (reference: flexflow/keras/initializers.py) ---------------
+
+
+class Initializer:
+    """Maps to a runtime initializer (runtime/initializer.py); pass as
+    Dense/Conv2D kernel_initializer / bias_initializer."""
+
+    def _runtime(self):
+        raise NotImplementedError
+
+
+class DefaultInitializer(Initializer):
+    def _runtime(self):
+        return None  # op picks its default (glorot for kernels, zero bias)
+
+
+class Zeros(Initializer):
+    def _runtime(self):
+        from flexflow_tpu.runtime.initializer import ZeroInitializer
+
+        return ZeroInitializer()
+
+
+class GlorotUniform(Initializer):
+    def __init__(self, seed=0):
+        self.seed = seed
+
+    def _runtime(self):
+        from flexflow_tpu.runtime.initializer import GlorotUniform as G
+
+        return G(seed=self.seed)
+
+
+class RandomUniform(Initializer):
+    def __init__(self, seed=0, minval=-0.05, maxval=0.05):
+        self.seed, self.minval, self.maxval = seed, minval, maxval
+
+    def _runtime(self):
+        from flexflow_tpu.runtime.initializer import UniformInitializer
+
+        return UniformInitializer(
+            seed=self.seed, min_val=self.minval, max_val=self.maxval
+        )
+
+
+class RandomNormal(Initializer):
+    def __init__(self, seed=0, mean=0.0, stddev=0.05):
+        self.seed, self.mean, self.stddev = seed, mean, stddev
+
+    def _runtime(self):
+        from flexflow_tpu.runtime.initializer import NormInitializer
+
+        return NormInitializer(
+            seed=self.seed, mean=self.mean, stddev=self.stddev
+        )
+
+
+def _init_arg(init):
+    if init is None:
+        return None
+    if isinstance(init, Initializer):
+        return init._runtime()
+    return init  # a runtime initializer passed directly
+
+
+# -- losses / metrics objects (reference: keras/losses.py, keras/metrics.py)
+
+
+class Loss:
+    type = None
+
+
+class CategoricalCrossentropy(Loss):
+    type = "categorical_crossentropy"
+
+
+class SparseCategoricalCrossentropy(Loss):
+    type = "sparse_categorical_crossentropy"
+
+
+class MeanSquaredError(Loss):
+    type = "mean_squared_error"
+
+
+class Metric:
+    type = None
+
+
+class Accuracy(Metric):
+    type = "accuracy"
+
+
+class MetricCategoricalCrossentropy(Metric):
+    type = "categorical_crossentropy"
+
+
+class MetricSparseCategoricalCrossentropy(Metric):
+    type = "sparse_categorical_crossentropy"
+
+
+class MetricMeanSquaredError(Metric):
+    type = "mean_squared_error"
+
+
+class RootMeanSquaredError(Metric):
+    type = "root_mean_squared_error"
+
+
+class MeanAbsoluteError(Metric):
+    type = "mean_absolute_error"
 
 
 # -- optimizers (reference: flexflow/keras/optimizers.py) -------------------
@@ -86,7 +199,10 @@ class Layer:
         self.name = name
 
     def __call__(self, *inputs):
-        """Functional API: returns a Node wiring this layer after inputs."""
+        """Functional API: returns a Node wiring this layer after inputs.
+        Merge layers accept a single list (keras: Concatenate(axis)([a, b]))."""
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
         return Node(self, [n for n in inputs])
 
     def build(self, ff: FFModel, tensors):
@@ -119,20 +235,31 @@ def _resolve_act(name):
 
 
 class Dense(Layer):
-    def __init__(self, units, activation=None, use_bias=True, name=None):
+    def __init__(self, units, activation=None, use_bias=True, name=None,
+                 kernel_initializer=None, bias_initializer=None,
+                 input_shape=None):
         super().__init__(name)
         self.units = units
         self.activation = activation
         self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
+        # input_shape accepted for keras source compatibility; shapes come
+        # from the upstream node here
+        self.input_shape = input_shape
 
     def build(self, ff, ts):
         act = _resolve_act(self.activation)
-        if act == "softmax":
-            t = ff.dense(ts[0], self.units, use_bias=self.use_bias, name=self.name)
-            return ff.softmax(t)
-        return ff.dense(
-            ts[0], self.units, activation=act, use_bias=self.use_bias, name=self.name
+        kw = dict(
+            use_bias=self.use_bias,
+            name=self.name,
+            kernel_initializer=_init_arg(self.kernel_initializer),
+            bias_initializer=_init_arg(self.bias_initializer),
         )
+        if act == "softmax":
+            t = ff.dense(ts[0], self.units, **kw)
+            return ff.softmax(t)
+        return ff.dense(ts[0], self.units, activation=act, **kw)
 
 
 def _same_pad(in_size, kernel, stride):
@@ -147,7 +274,8 @@ class Conv2D(Layer):
     """channels_last (NHWC) — the TPU-native layout."""
 
     def __init__(self, filters, kernel_size, strides=(1, 1), padding="valid",
-                 activation=None, groups=1, use_bias=True, name=None):
+                 activation=None, groups=1, use_bias=True, name=None,
+                 kernel_initializer=None, bias_initializer=None):
         super().__init__(name)
         self.filters = filters
         k = kernel_size if isinstance(kernel_size, (tuple, list)) else (kernel_size,) * 2
@@ -157,6 +285,8 @@ class Conv2D(Layer):
         self.activation = activation
         self.groups = groups
         self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
 
     def build(self, ff, ts):
         if self.padding == "same":
@@ -172,6 +302,8 @@ class Conv2D(Layer):
             self.strides[0], self.strides[1], ph, pw,
             activation=ActiMode.NONE if softmax else act,
             groups=self.groups, use_bias=self.use_bias, name=self.name,
+            kernel_initializer=_init_arg(self.kernel_initializer),
+            bias_initializer=_init_arg(self.bias_initializer),
         )
         return ff.softmax(t) if softmax else t
 
@@ -262,6 +394,34 @@ class LayerNormalization(Layer):
         return ff.layer_norm(ts[0], eps=self.eps, name=self.name)
 
 
+class Reshape(Layer):
+    """reference: keras/layers/core.py Reshape — target_shape EXCLUDES the
+    batch dim (keras semantics)."""
+
+    def __init__(self, target_shape, name=None):
+        super().__init__(name)
+        self.target_shape = tuple(target_shape)
+
+    def build(self, ff, ts):
+        batch = ts[0].dims[0]
+        return ff.reshape(
+            ts[0], (batch,) + self.target_shape, name=self.name
+        )
+
+
+class Permute(Layer):
+    """reference: keras/layers/core.py Permute — dims are 1-indexed over
+    the non-batch axes (keras semantics); the batch axis stays first."""
+
+    def __init__(self, dims, name=None):
+        super().__init__(name)
+        self.dims = tuple(dims)
+
+    def build(self, ff, ts):
+        perm = (0,) + tuple(d for d in self.dims)
+        return ff.transpose(ts[0], perm, name=self.name)
+
+
 class Concatenate(Layer):
     def __init__(self, axis=-1, name=None):
         super().__init__(name)
@@ -276,9 +436,34 @@ class Add(Layer):
         return ff.add(ts[0], ts[1], name=self.name)
 
 
+class Subtract(Layer):
+    def build(self, ff, ts):
+        return ff.subtract(ts[0], ts[1], name=self.name)
+
+
 class Multiply(Layer):
     def build(self, ff, ts):
         return ff.multiply(ts[0], ts[1], name=self.name)
+
+
+# functional-style merge aliases (reference: keras/layers/merge.py exports
+# both the classes and lowercase functions)
+
+
+def concatenate(tensors, axis=-1, name=None):
+    return Concatenate(axis=axis, name=name)(*tensors)
+
+
+def add(tensors, name=None):
+    return Add(name=name)(*tensors)
+
+
+def subtract(tensors, name=None):
+    return Subtract(name=name)(*tensors)
+
+
+def multiply(tensors, name=None):
+    return Multiply(name=name)(*tensors)
 
 
 # -- models (reference: keras/models/base_model.py) -------------------------
@@ -323,6 +508,9 @@ class Model:
                 metrics=("accuracy",), batch_size: Optional[int] = None):
         if isinstance(optimizer, str):
             optimizer = {"sgd": SGD(), "adam": Adam()}[optimizer.lower()]
+        if isinstance(loss, Loss):  # reference keras.losses objects
+            loss = loss.type
+        metrics = [m.type if isinstance(m, Metric) else m for m in metrics]
         bs = batch_size or self.config.batch_size
         self.ffmodel = self._lower(bs)
         self.ffmodel.compile(
@@ -333,6 +521,20 @@ class Model:
             ],
         )
 
+    @staticmethod
+    def _squeeze_labels(y):
+        """keras sparse labels arrive as (n, 1) column vectors (the
+        reference examples reshape them so); the engine's sparse-CE takes
+        (n,)."""
+        y = np.asarray(y)
+        if (
+            y.ndim >= 2
+            and y.shape[-1] == 1
+            and np.issubdtype(y.dtype, np.integer)
+        ):
+            return y.reshape(y.shape[:-1])
+        return y
+
     def fit(self, x, y, epochs=1, batch_size: Optional[int] = None,
             callbacks=None, **kw):
         if self.ffmodel is None:
@@ -342,8 +544,8 @@ class Model:
             # model (engine reachable as .ffmodel, keras/callbacks.py:69)
             cb.set_model(self)
         return self.ffmodel.fit(
-            x, y, epochs=epochs, batch_size=batch_size,
-            callbacks=callbacks, **kw,
+            x, self._squeeze_labels(y), epochs=epochs,
+            batch_size=batch_size, callbacks=callbacks, **kw,
         )
 
     def evaluate(self, x, y, batch_size: Optional[int] = None,
@@ -351,8 +553,35 @@ class Model:
         for cb in callbacks or []:
             cb.set_model(self)
         return self.ffmodel.evaluate(
-            x, y, batch_size=batch_size, callbacks=callbacks
+            x, self._squeeze_labels(y), batch_size=batch_size,
+            callbacks=callbacks
         )
+
+    def __call__(self, *inputs):
+        """Functional composition (reference: keras models are callable —
+        func_mnist_mlp_concat.py builds submodels and applies them to a
+        shared input). Re-applies this model's layer graph to the given
+        input nodes. Layers are re-applied as specs: each call lowers to
+        fresh FFModel ops (no cross-call weight sharing)."""
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])  # keras list convention: model([a, b])
+        if len(inputs) != len(self._inputs):
+            raise ValueError(
+                f"model takes {len(self._inputs)} inputs, got {len(inputs)}"
+            )
+        mapping = {id(i): arg for i, arg in zip(self._inputs, inputs)}
+
+        def clone(node: Node):
+            if id(node) in mapping:
+                return mapping[id(node)]
+            if node.layer is None:
+                raise ValueError("model called with an unbound Input")
+            new = Node(node.layer, [clone(i) for i in node.inputs])
+            mapping[id(node)] = new
+            return new
+
+        outs = [clone(o) for o in self._outputs]
+        return outs[0] if len(outs) == 1 else outs
 
     def summary(self):
         if self.ffmodel is None:

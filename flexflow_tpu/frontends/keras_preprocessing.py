@@ -1,0 +1,185 @@
+"""Keras preprocessing utilities, implemented natively.
+
+The reference's `flexflow.keras.preprocessing` is a thin re-export of the
+external `keras_preprocessing` pip package (reference:
+python/flexflow/keras/preprocessing/sequence.py, text.py); this module
+provides the same surface without the dependency: `pad_sequences`,
+`make_sampling_table`, `skipgrams` (sequence.py) and a minimal
+`Tokenizer` / `one_hot` / `text_to_word_sequence` (text.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random as _random
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def pad_sequences(
+    sequences,
+    maxlen: Optional[int] = None,
+    dtype="int32",
+    padding: str = "pre",
+    truncating: str = "pre",
+    value=0.0,
+):
+    """keras_preprocessing.sequence.pad_sequences semantics."""
+    lengths = [len(s) for s in sequences]
+    if maxlen is None:
+        maxlen = max(lengths) if lengths else 0
+    out = np.full((len(sequences), maxlen), value, dtype=dtype)
+    for i, s in enumerate(sequences):
+        if not len(s):
+            continue
+        if truncating == "pre":
+            trunc = s[-maxlen:]
+        elif truncating == "post":
+            trunc = s[:maxlen]
+        else:
+            raise ValueError(f"truncating must be pre|post, got {truncating!r}")
+        trunc = np.asarray(trunc, dtype=dtype)
+        if padding == "post":
+            out[i, : len(trunc)] = trunc
+        elif padding == "pre":
+            out[i, -len(trunc):] = trunc
+        else:
+            raise ValueError(f"padding must be pre|post, got {padding!r}")
+    return out
+
+
+def make_sampling_table(size: int, sampling_factor: float = 1e-5):
+    """Zipf-based word-frequency sampling table (word2vec subsampling)."""
+    gamma = 0.577
+    rank = np.arange(size)
+    rank[0] = 1
+    inv_fq = rank * (np.log(rank) + gamma) + 0.5 - 1.0 / (12.0 * rank)
+    f = sampling_factor * inv_fq
+    return np.minimum(1.0, f / np.sqrt(f))
+
+
+def skipgrams(
+    sequence: Sequence[int],
+    vocabulary_size: int,
+    window_size: int = 4,
+    negative_samples: float = 1.0,
+    shuffle: bool = True,
+    sampling_table=None,
+    seed: Optional[int] = None,
+):
+    """(word, context) couples with labels, keras semantics."""
+    couples = []
+    labels = []
+    for i, wi in enumerate(sequence):
+        if not wi:
+            continue
+        if sampling_table is not None:
+            if sampling_table[wi] < _random.random():
+                continue
+        window_start = max(0, i - window_size)
+        window_end = min(len(sequence), i + window_size + 1)
+        for j in range(window_start, window_end):
+            if j == i:
+                continue
+            wj = sequence[j]
+            if not wj:
+                continue
+            couples.append([wi, wj])
+            labels.append(1)
+    if negative_samples > 0:
+        num_negative = int(len(labels) * negative_samples)
+        words = [c[0] for c in couples]
+        _random.shuffle(words)
+        couples += [
+            [words[i % len(words)], _random.randint(1, vocabulary_size - 1)]
+            for i in range(num_negative)
+        ]
+        labels += [0] * num_negative
+    if shuffle:
+        if seed is None:
+            seed = _random.randint(0, 10**6)
+        _random.Random(seed).shuffle(couples)
+        _random.Random(seed).shuffle(labels)
+    return couples, labels
+
+
+def text_to_word_sequence(
+    text: str,
+    filters='!"#$%&()*+,-./:;<=>?@[\\]^_`{|}~\t\n',
+    lower: bool = True,
+    split: str = " ",
+) -> List[str]:
+    if lower:
+        text = text.lower()
+    table = str.maketrans({c: split for c in filters})
+    return [w for w in text.translate(table).split(split) if w]
+
+
+def one_hot(text: str, n: int, **kw) -> List[int]:
+    """Hash each word into [1, n) (keras one_hot is hashing, not 1-hot)."""
+    words = text_to_word_sequence(text, **kw)
+    return [
+        1 + int(hashlib.md5(w.encode()).hexdigest(), 16) % (n - 1)
+        for w in words
+    ]
+
+
+class Tokenizer:
+    """Minimal keras Tokenizer: fit_on_texts + texts_to_sequences +
+    texts_to_matrix(binary/count)."""
+
+    def __init__(self, num_words: Optional[int] = None, oov_token=None, **kw):
+        self.num_words = num_words
+        self.oov_token = oov_token
+        self.word_counts: dict = {}
+        self.word_index: dict = {}
+
+    def fit_on_texts(self, texts):
+        for text in texts:
+            for w in text_to_word_sequence(text):
+                self.word_counts[w] = self.word_counts.get(w, 0) + 1
+        ordered = sorted(
+            self.word_counts.items(), key=lambda kv: (-kv[1], kv[0])
+        )
+        start = 1
+        self.word_index = {}
+        if self.oov_token is not None:
+            self.word_index[self.oov_token] = 1
+            start = 2
+        for i, (w, _) in enumerate(ordered):
+            self.word_index[w] = i + start
+
+    def texts_to_sequences(self, texts):
+        oov = (
+            self.word_index.get(self.oov_token)
+            if self.oov_token is not None
+            else None
+        )
+        out = []
+        for text in texts:
+            seq = []
+            for w in text_to_word_sequence(text):
+                idx = self.word_index.get(w, oov)
+                if idx is None:
+                    continue
+                if self.num_words and idx >= self.num_words:
+                    idx = oov
+                    if idx is None:
+                        continue
+                seq.append(idx)
+            out.append(seq)
+        return out
+
+    def texts_to_matrix(self, texts, mode: str = "binary"):
+        n = self.num_words or (len(self.word_index) + 1)
+        m = np.zeros((len(texts), n), dtype=np.float32)
+        for i, seq in enumerate(self.texts_to_sequences(texts)):
+            for idx in seq:
+                if mode == "binary":
+                    m[i, idx] = 1.0
+                elif mode == "count":
+                    m[i, idx] += 1.0
+                else:
+                    raise ValueError(f"mode must be binary|count, got {mode!r}")
+        return m
