@@ -1,10 +1,12 @@
+"""Reference layer namespace. Spatial layers default to
+channels_first here — the reference's native layout (its keras examples
+pass shape=(C, H, W)); the engine computes NHWC and transposes at layer
+boundaries (keras_api._SpatialLayer)."""
+
 from flexflow_tpu.frontends.keras_api import (  # noqa: F401
     Activation,
     Add,
-    AveragePooling2D,
-    BatchNormalization,
     Concatenate,
-    Conv2D,
     Dense,
     Dropout,
     Embedding,
@@ -12,7 +14,6 @@ from flexflow_tpu.frontends.keras_api import (  # noqa: F401
     Input,
     Layer,
     LayerNormalization,
-    MaxPooling2D,
     Multiply,
     Permute,
     Reshape,
@@ -22,6 +23,31 @@ from flexflow_tpu.frontends.keras_api import (  # noqa: F401
     multiply,
     subtract,
 )
+from flexflow_tpu.frontends.keras_api import (
+    AveragePooling2D as _AveragePooling2D,
+)
+from flexflow_tpu.frontends.keras_api import (
+    BatchNormalization as _BatchNormalization,
+)
+from flexflow_tpu.frontends.keras_api import Conv2D as _Conv2D
+from flexflow_tpu.frontends.keras_api import MaxPooling2D as _MaxPooling2D
+
+
+class Conv2D(_Conv2D):
+    data_format = "channels_first"
+
+
+class MaxPooling2D(_MaxPooling2D):
+    data_format = "channels_first"
+
+
+class AveragePooling2D(_AveragePooling2D):
+    data_format = "channels_first"
+
+
+class BatchNormalization(_BatchNormalization):
+    data_format = "channels_first"
+
 
 InputLayer = Input  # reference exports both names
 Pooling2D = MaxPooling2D

@@ -218,8 +218,24 @@ class Node:
         self.shape = shape  # only for Input nodes
 
 
-def Input(shape: Sequence[int], dtype: DataType = DataType.FLOAT, name=None):
+_STR_DTYPE = {
+    "float32": DataType.FLOAT,
+    "float16": DataType.HALF,
+    "bfloat16": DataType.BFLOAT16,
+    "int32": DataType.INT32,
+    "int64": DataType.INT64,
+}
+
+
+def Input(shape: Sequence[int], dtype=DataType.FLOAT, name=None):
     n = Node(None, [], shape=tuple(shape))
+    if isinstance(dtype, str):
+        if dtype not in _STR_DTYPE:
+            raise ValueError(
+                f"unsupported dtype {dtype!r}; supported: "
+                f"{sorted(_STR_DTYPE)}"
+            )
+        dtype = _STR_DTYPE[dtype]
     n.dtype = dtype
     n.name = name
     return n
@@ -270,12 +286,47 @@ def _same_pad(in_size, kernel, stride):
     return (total // 2, total - total // 2)
 
 
-class Conv2D(Layer):
-    """channels_last (NHWC) — the TPU-native layout."""
+def _resolve_pad(padding, dims_hw, kernel, strides):
+    """padding: "valid" | "same" | int | (ph, pw) (the reference keras
+    frontend takes explicit tuples — layers/convolutional.py)."""
+    if padding == "same":
+        h, w = dims_hw
+        return (
+            _same_pad(h, kernel[0], strides[0]),
+            _same_pad(w, kernel[1], strides[1]),
+        )
+    if padding == "valid" or padding is None:
+        return 0, 0
+    if isinstance(padding, int):
+        return padding, padding
+    ph, pw = padding
+    return ph, pw
 
-    def __init__(self, filters, kernel_size, strides=(1, 1), padding="valid",
-                 activation=None, groups=1, use_bias=True, name=None,
-                 kernel_initializer=None, bias_initializer=None):
+
+class _SpatialLayer(Layer):
+    """Shared channels_first/last handling: the engine computes in NHWC
+    (the TPU-native layout); channels_first inputs (the reference's
+    native layout) are transposed in and back out per layer — XLA elides
+    the adjacent inverse-transpose pairs between consecutive layers."""
+
+    data_format = "channels_last"
+
+    def _in(self, ff, t):
+        if self.data_format == "channels_first":
+            return ff.transpose(t, [0, 2, 3, 1])
+        return t
+
+    def _out(self, ff, t):
+        if self.data_format == "channels_first":
+            return ff.transpose(t, [0, 3, 1, 2])
+        return t
+
+
+class Conv2D(_SpatialLayer):
+    def __init__(self, filters, kernel_size=(3, 3), strides=(1, 1),
+                 padding="valid", activation=None, groups=1, use_bias=True,
+                 name=None, kernel_initializer=None, bias_initializer=None,
+                 input_shape=None, data_format=None):
         super().__init__(name)
         self.filters = filters
         k = kernel_size if isinstance(kernel_size, (tuple, list)) else (kernel_size,) * 2
@@ -287,49 +338,52 @@ class Conv2D(Layer):
         self.use_bias = use_bias
         self.kernel_initializer = kernel_initializer
         self.bias_initializer = bias_initializer
+        self.input_shape = input_shape  # keras source compat; unused
+        if data_format is not None:
+            self.data_format = data_format
 
     def build(self, ff, ts):
-        if self.padding == "same":
-            _, h, w, _ = ts[0].dims  # NHWC
-            ph = _same_pad(h, self.kernel[0], self.strides[0])
-            pw = _same_pad(w, self.kernel[1], self.strides[1])
-        else:
-            ph = pw = 0
+        x = self._in(ff, ts[0])
+        _, h, w, _ = x.dims  # NHWC
+        ph, pw = _resolve_pad(self.padding, (h, w), self.kernel, self.strides)
         act = _resolve_act(self.activation)
         softmax = act == "softmax"
         t = ff.conv2d(
-            ts[0], self.filters, self.kernel[0], self.kernel[1],
+            x, self.filters, self.kernel[0], self.kernel[1],
             self.strides[0], self.strides[1], ph, pw,
             activation=ActiMode.NONE if softmax else act,
             groups=self.groups, use_bias=self.use_bias, name=self.name,
             kernel_initializer=_init_arg(self.kernel_initializer),
             bias_initializer=_init_arg(self.bias_initializer),
         )
-        return ff.softmax(t) if softmax else t
+        if softmax:
+            t = ff.softmax(t)
+        return self._out(ff, t)
 
 
-class _Pool2D(Layer):
+class _Pool2D(_SpatialLayer):
     kind = "max"
 
-    def __init__(self, pool_size=(2, 2), strides=None, padding="valid", name=None):
+    def __init__(self, pool_size=(2, 2), strides=None, padding="valid",
+                 name=None, data_format=None):
         super().__init__(name)
         p = pool_size if isinstance(pool_size, (tuple, list)) else (pool_size,) * 2
         s = strides if strides is not None else p
         s = s if isinstance(s, (tuple, list)) else (s,) * 2
         self.pool, self.strides, self.padding = p, s, padding
+        if data_format is not None:
+            self.data_format = data_format
 
     def build(self, ff, ts):
-        if self.padding == "same":
-            _, h, w, _ = ts[0].dims  # NHWC
-            ph = _same_pad(h, self.pool[0], self.strides[0])
-            pw = _same_pad(w, self.pool[1], self.strides[1])
-        else:
-            ph = pw = 0
-        return ff.pool2d(
-            ts[0], self.pool[0], self.pool[1], self.strides[0], self.strides[1],
+        x = self._in(ff, ts[0])
+        _, h, w, _ = x.dims  # NHWC
+        ph, pw = _resolve_pad(self.padding, (h, w), self.pool, self.strides)
+        t = ff.pool2d(
+            x, self.pool[0], self.pool[1], self.strides[0], self.strides[1],
             ph, pw, pool_type=self.kind, count_include_pad=False,
             name=self.name,
         )
+        return self._out(ff, t)
 
 
 class MaxPooling2D(_Pool2D):
@@ -380,9 +434,16 @@ class Embedding(Layer):
         return ff.embedding(ts[0], self.input_dim, self.output_dim, name=self.name)
 
 
-class BatchNormalization(Layer):
+class BatchNormalization(_SpatialLayer):
+    def __init__(self, name=None, data_format=None):
+        super().__init__(name)
+        if data_format is not None:
+            self.data_format = data_format
+
     def build(self, ff, ts):
-        return ff.batch_norm(ts[0], relu=False, name=self.name)
+        x = self._in(ff, ts[0]) if len(ts[0].dims) == 4 else ts[0]
+        t = ff.batch_norm(x, relu=False, name=self.name)
+        return self._out(ff, t) if len(ts[0].dims) == 4 else t
 
 
 class LayerNormalization(Layer):
@@ -484,6 +545,18 @@ class Model:
     def _lower(self, batch_size: int) -> FFModel:
         ff = FFModel(self.config)
         built = {}
+        self._layers_by_name = {}
+        self._layer_order = []
+        registered: set = set()
+        counters: dict = {}
+
+        def auto_name(layer: Layer) -> str:
+            base = {"Flatten": "flat"}.get(
+                type(layer).__name__, type(layer).__name__.lower()
+            )
+            n = counters.get(base, 0)
+            counters[base] = n + 1
+            return base if n == 0 else f"{base}_{n}"
 
         def visit(node: Node):
             if id(node) in built:
@@ -494,15 +567,62 @@ class Model:
                     dtype=getattr(node, "dtype", DataType.FLOAT),
                     name=getattr(node, "name", None),
                 )
+                t.from_layer = None
+                t.to_layers = []
             else:
+                layer = node.layer
                 ins = [visit(i) for i in node.inputs]
-                t = node.layer.build(ff, ins)
+                t = layer.build(ff, ins)
+                # introspection surface (reference: keras tensors carry
+                # from_layer/to_layers, layers carry input/output_tensors
+                # — func_mnist_cnn.py reads them via model.get_layer).
+                # A layer object applied N times (weight-style sharing is
+                # NOT implied — each application lowers fresh ops)
+                # registers ONCE and ACCUMULATES its per-application
+                # tensors; duplicate explicit names are an error rather
+                # than a silent shadow.
+                if id(layer) not in registered:
+                    reg = layer.name or auto_name(layer)
+                    if reg in self._layers_by_name:
+                        raise ValueError(
+                            f"two layers named {reg!r}; layer names must "
+                            "be unique"
+                        )
+                    self._layers_by_name[reg] = layer
+                    self._layer_order.append(layer)
+                    registered.add(id(layer))
+                    layer.input_tensors = []
+                    layer.output_tensors = []
+                layer.input_tensors.extend(ins)
+                layer.output_tensors.append(t)
+                t.from_layer = layer
+                t.to_layers = []
+                for i in ins:
+                    if getattr(i, "to_layers", None) is not None:
+                        i.to_layers.append(layer)
             built[id(node)] = t
             return t
 
         for out in self._outputs:
             visit(out)
         return ff
+
+    def get_layer(self, name=None, index=None):
+        """reference: BaseModel.get_layer (keras/models/base_model.py) —
+        by registered name (explicit or auto: dense, dense_1, conv2d,
+        flat, ...) or by build order index."""
+        if self.ffmodel is None:
+            raise RuntimeError("call compile() first")
+        if name is not None:
+            if name not in self._layers_by_name:
+                raise ValueError(
+                    f"no layer named {name!r}; have "
+                    f"{sorted(self._layers_by_name)}"
+                )
+            return self._layers_by_name[name]
+        if index is not None:
+            return self._layer_order[index]
+        raise ValueError("pass name= or index=")
 
     def compile(self, optimizer=None, loss="sparse_categorical_crossentropy",
                 metrics=("accuracy",), batch_size: Optional[int] = None):

@@ -44,6 +44,23 @@ class ONNXModel:
             self.model = path_or_proto
         self.inits = {i.name for i in self.model.graph.initializer}
 
+    def _const_array(self, name: str, env: Dict):
+        """Static value of `name`: a graph initializer or a Constant/Range
+        node's numpy output recorded in env. None when neither (i.e. the
+        value is a runtime tensor). ONE lookup path for every handler
+        that needs a static operand (shape/axes/pads/...)."""
+        import numpy as np
+        from onnx import numpy_helper
+
+        init = next(
+            (i for i in self.model.graph.initializer if i.name == name),
+            None,
+        )
+        if init is not None:
+            return numpy_helper.to_array(init)
+        v = env.get(name)
+        return v if isinstance(v, np.ndarray) else None
+
     @staticmethod
     def _attrs(node) -> Dict:
         out = {}
@@ -138,12 +155,21 @@ class ONNXModel:
                 if len(x.dims) == 4 and not nchw.get(ins[0], True):
                     x = ffmodel.transpose(x, [0, 3, 1, 2])
                 env[out] = ffmodel.flat(x)
-            elif op == "Add":
-                env[out] = ffmodel.add(env[ins[0]], env[ins[1]])
-            elif op == "Sub":
-                env[out] = ffmodel.subtract(env[ins[0]], env[ins[1]])
-            elif op == "Mul":
-                env[out] = ffmodel.multiply(env[ins[0]], env[ins[1]])
+            elif op in ("Add", "Sub", "Mul"):
+                import numpy as np
+
+                xa, xb = env[ins[0]], env[ins[1]]
+                if isinstance(xa, np.ndarray) or isinstance(xb, np.ndarray):
+                    raise NotImplementedError(
+                        f"ONNX frontend: {op} with a static (Constant/"
+                        "Range) operand — materialize it as a graph input"
+                    )
+                fn2 = {
+                    "Add": ffmodel.add,
+                    "Sub": ffmodel.subtract,
+                    "Mul": ffmodel.multiply,
+                }[op]
+                env[out] = fn2(xa, xb)
             elif op == "Concat":
                 env[out] = ffmodel.concat([env[i] for i in ins], a.get("axis", 0))
             elif op == "Split":
@@ -157,16 +183,14 @@ class ONNXModel:
                     env[o] = t
                 continue
             elif op == "Reshape":
-                # shape comes from an initializer
                 import numpy as np
-                from onnx import numpy_helper
 
-                shape_init = next(
-                    i
-                    for i in self.model.graph.initializer
-                    if i.name == node.input[1]
-                )
-                shape = [int(v) for v in numpy_helper.to_array(shape_init)]
+                shape_arr = self._const_array(node.input[1], env)
+                if shape_arr is None:
+                    raise NotImplementedError(
+                        "ONNX frontend: Reshape with a runtime shape tensor"
+                    )
+                shape = [int(v) for v in shape_arr]
                 x = env[ins[0]]
                 if any(s == -1 for s in shape):
                     known = int(np.prod([s for s in shape if s != -1]))
@@ -183,6 +207,90 @@ class ONNXModel:
                 x = to_nhwc(ins[0])
                 env[out] = ffmodel.batch_norm(x, relu=False)
                 nchw[out] = False
+            elif op == "Cast":
+                # ONNX TensorProto dtype codes -> framework dtypes
+                # (reference: handleCast, flexflow/onnx/model.py)
+                import numpy as np
+
+                from flexflow_tpu.core.types import DataType
+
+                codes = {
+                    1: DataType.FLOAT,
+                    6: DataType.INT32,
+                    7: DataType.INT64,
+                    10: DataType.HALF,
+                    16: DataType.BFLOAT16,
+                }
+                code = int(a.get("to", 1))
+                if code not in codes:
+                    raise NotImplementedError(
+                        f"ONNX frontend: Cast to dtype code {code}"
+                    )
+                x = env[ins[0]]
+                if isinstance(x, np.ndarray):  # static (Constant/Range)
+                    env[out] = x.astype(codes[code].to_jnp())
+                else:
+                    env[out] = ffmodel.cast(x, codes[code])
+                    nchw[out] = nchw.get(ins[0], False)
+            elif op == "Unsqueeze":
+                # reference: handleUnsqueeze lowers to a reshape
+                import numpy as np
+
+                axes_l = a.get("axes")
+                if axes_l is None:  # opset>=13: axes is a tensor input
+                    axes_arr = self._const_array(node.input[1], env)
+                    if axes_arr is None:
+                        raise NotImplementedError(
+                            "ONNX frontend: Unsqueeze with runtime axes"
+                        )
+                    axes_l = [int(v) for v in axes_arr]
+                x = env[ins[0]]
+                if isinstance(x, np.ndarray):  # static (Range position ids)
+                    for ax in sorted(ax % (x.ndim + len(axes_l))
+                                     for ax in axes_l):
+                        x = np.expand_dims(x, ax)
+                    env[out] = x
+                else:
+                    shape = list(x.dims)
+                    nd = len(shape) + len(axes_l)
+                    for ax in sorted(ax % nd for ax in axes_l):
+                        shape.insert(ax, 1)
+                    env[out] = ffmodel.reshape(x, shape)
+            elif op == "Pad":
+                pads = a.get("pads")
+                if pads is None and len(node.input) > 1:
+                    pad_arr = self._const_array(node.input[1], env)
+                    if pad_arr is None:
+                        raise NotImplementedError(
+                            "ONNX frontend: Pad with a runtime pads tensor"
+                        )
+                    pads = [int(v) for v in pad_arr]
+                if pads is not None and not any(pads):
+                    env[out] = env[ins[0]]  # no-op pad
+                    nchw[out] = nchw.get(ins[0], False)
+                else:
+                    raise NotImplementedError(
+                        "ONNX frontend: non-zero Pad outside conv/pool "
+                        "attributes (fold pads into the consumer op)"
+                    )
+            elif op == "Constant":
+                # materialized at consumers (initializer-like); the
+                # reference records the numpy value (handleConstant)
+                from onnx import numpy_helper
+
+                for attr in node.attribute:
+                    if attr.name == "value":
+                        env[out] = numpy_helper.to_array(attr.t)
+            elif op == "Range":
+                # reference: handleRange builds the static index vector
+                import numpy as np
+
+                start, limit, delta = (env.get(i, i) for i in node.input)
+                env[out] = np.arange(
+                    float(np.asarray(start)),
+                    float(np.asarray(limit)),
+                    float(np.asarray(delta)),
+                )
             else:
                 raise NotImplementedError(f"ONNX frontend: op {op!r}")
 

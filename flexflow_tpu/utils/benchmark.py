@@ -61,11 +61,24 @@ def _adaptive_differenced(
 
 def measure_train_step(
     model, batch, n1: int = 5, n2: int = 20, reps: int = 6,
-    rep_sleep_s: float = 0.0,
+    rep_sleep_s: float = 0.0, estimates: int = 1,
 ):
     """Differenced per-train-step seconds via on-device lax.scan chains.
 
-    `batch` must already be sharded (executor.shard_batch)."""
+    `batch` must already be sharded (executor.shard_batch).
+
+    estimates > 1: run the whole adaptive differencing that many times
+    (spaced) and take the MEDIAN — independent in-process estimates
+    catch the seconds-long tunnel-contention bursts that otherwise
+    poison a whole invocation of the cross-process protocol (the
+    round-3 mT5 118% / DLRM 96% spreads were single contaminated
+    invocations). Median, not min: a burst landing selectively in one
+    estimate's SHORT chain biases that estimate LOW, and min() would
+    select exactly the contaminated one (the same asymmetry the
+    per-window-min rule in _adaptive_differenced exists to avoid)."""
+    import statistics
+    import time as _time
+
     import jax
     from jax import lax
 
@@ -85,10 +98,17 @@ def measure_train_step(
 
         return run
 
-    return _adaptive_differenced(
-        chain, (model.params, model.opt_state), n1, n2, reps,
-        rep_sleep_s=rep_sleep_s,
-    )
+    vals = []
+    for e in range(max(1, estimates)):
+        if e:
+            _time.sleep(3.0)
+        t = _adaptive_differenced(
+            chain, (model.params, model.opt_state), n1, n2, reps,
+            rep_sleep_s=rep_sleep_s,
+        )
+        if t == t:  # NaN-safe
+            vals.append(t)
+    return statistics.median(vals) if vals else float("nan")
 
 
 def measure_fn(fn, args, n1: int = 4, n2: int = 12, reps: int = 3):

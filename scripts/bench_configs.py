@@ -177,7 +177,8 @@ def main():
         from flexflow_tpu.utils.benchmark import measure_train_step
 
         per_step = measure_train_step(
-            model, model.executor.shard_batch(batch), reps=4, rep_sleep_s=2.0
+            model, model.executor.shard_batch(batch), reps=4,
+            rep_sleep_s=2.0, estimates=3,
         )
         import math as _math
 

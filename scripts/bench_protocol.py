@@ -42,6 +42,12 @@ def aggregate(runs):
         steps = [v["step_ms"] for v in valid]
         med = statistics.median(steps)
         spread = (max(steps) - min(steps)) / med * 100.0
+        ss = sorted(steps)
+        # interquartile-style confidence interval: the middle half of the
+        # draws (robust to one contended invocation, which the raw
+        # max-min spread is not)
+        lo = ss[len(ss) // 4]
+        hi = ss[-(len(ss) // 4) - 1]
         base = valid[0]
         bs = base["value"] * base["step_ms"] / 1e3  # samples per step
         results[name] = {
@@ -50,6 +56,7 @@ def aggregate(runs):
             "step_ms_median": round(med, 3),
             "step_ms_samples": [round(s, 3) for s in steps],
             "spread_pct": round(spread, 1),
+            "step_ms_iqr": [round(lo, 3), round(hi, 3)],
             "value": round(bs / (med / 1e3), 2),
             "unit": "samples/s",
             "precision": base["precision"],

@@ -1,0 +1,195 @@
+"""Round-4 keras frontend breadth (reference: python/flexflow/keras/):
+Reshape/Permute/Subtract, initializers, channels_first spatial layers,
+model introspection, callable models, native preprocessing."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.frontends import keras_api as keras
+from flexflow_tpu.frontends.keras_preprocessing import (
+    Tokenizer,
+    one_hot,
+    pad_sequences,
+    skipgrams,
+    text_to_word_sequence,
+)
+
+
+def _fit_once(model, x, y, bs):
+    model.compile(
+        optimizer=keras.SGD(0.05),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+        batch_size=bs,
+    )
+    hist = model.fit(x, y, epochs=1, batch_size=bs, verbose=False)
+    assert np.isfinite(hist[-1]["loss_sum"])
+    return model
+
+
+def test_reshape_permute_subtract_train():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 12).astype(np.float32)
+    y = rng.randint(0, 3, (16,)).astype(np.int32)
+    inp = keras.Input(shape=(12,))
+    t = keras.Reshape((3, 4))(inp)
+    t = keras.Permute((2, 1))(t)  # (4, 3)
+    t = keras.Reshape((12,))(t)
+    a = keras.Dense(8, activation="relu")(t)
+    b = keras.Dense(8)(t)
+    t = keras.Subtract()(a, b)
+    out = keras.Dense(3)(t)
+    _fit_once(keras.Model(inp, out), x, y, 16)
+
+
+def test_reshape_matches_numpy_semantics():
+    """Reshape's target excludes batch; Permute is 1-indexed non-batch."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 6).astype(np.float32)
+    inp = keras.Input(shape=(6,))
+    t = keras.Reshape((2, 3))(inp)
+    t = keras.Permute((2, 1))(t)
+    m = keras.Model(inp, t)
+    m.compile(
+        optimizer=keras.SGD(0.0), loss="mean_squared_error", metrics=[],
+        batch_size=4,
+    )
+    out = np.asarray(m.ffmodel.forward({"input": x}))
+    ref = x.reshape(4, 2, 3).transpose(0, 2, 1)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_channels_first_conv_matches_channels_last():
+    """The compat channels_first layers produce the same math as NHWC:
+    same weights -> transposed-identical outputs."""
+    rng = np.random.RandomState(2)
+    x_nchw = rng.randn(4, 3, 8, 8).astype(np.float32)
+    x_nhwc = x_nchw.transpose(0, 2, 3, 1).copy()
+
+    def build(fmt, x_shape):
+        inp = keras.Input(shape=x_shape)
+        conv = keras.Conv2D(
+            5, kernel_size=(3, 3), padding=(1, 1), data_format=fmt,
+            kernel_initializer=keras.GlorotUniform(seed=5), use_bias=True,
+        )
+        t = conv(inp)
+        t = keras.MaxPooling2D((2, 2), data_format=fmt)(t)
+        m = keras.Model(inp, t)
+        m.compile(
+            optimizer=keras.SGD(0.0), loss="mean_squared_error",
+            metrics=[], batch_size=4,
+        )
+        return m
+
+    m1 = build("channels_first", (3, 8, 8))
+    m2 = build("channels_last", (8, 8, 3))
+    # identical explicit weights (per-op init seeds fold in the guid, and
+    # the layout transposes shift guids between the two models)
+    from flexflow_tpu.core.types import OperatorType
+
+    w = rng.randn(3, 3, 3, 5).astype(np.float32) * 0.1
+    b = rng.randn(5).astype(np.float32) * 0.1
+    for m in (m1, m2):
+        conv_guid = next(
+            g
+            for g, n in m.ffmodel.graph.nodes.items()
+            if n.op_type == OperatorType.CONV2D
+        )
+        m.ffmodel.set_tensor(conv_guid, 0, w)
+        m.ffmodel.set_tensor(conv_guid, 1, b)
+    o1 = np.asarray(m1.ffmodel.forward({"input": x_nchw}))
+    o2 = np.asarray(m2.ffmodel.forward({"input": x_nhwc}))
+    assert o1.shape == (4, 5, 4, 4)  # NCHW out
+    assert o2.shape == (4, 4, 4, 5)  # NHWC out
+    assert np.any(o2 != 0.0)
+    np.testing.assert_allclose(
+        o1.transpose(0, 2, 3, 1), o2, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_model_introspection():
+    inp = keras.Input(shape=(10,))
+    t = keras.Dense(6, activation="relu")(inp)
+    t = keras.Flatten()(t)
+    out = keras.Dense(3)(t)
+    m = keras.Model(inp, out)
+    m.compile(
+        optimizer=keras.SGD(0.01), loss="sparse_categorical_crossentropy",
+        metrics=[], batch_size=4,
+    )
+    flat = m.get_layer(name="flat")
+    assert isinstance(flat, keras.Flatten)
+    t_out = flat.output_tensors[0]
+    t_in = flat.input_tensors[0]
+    assert t_out.from_layer is flat
+    assert flat in t_in.to_layers
+    # to_layers of the flat OUTPUT reaches the classifier dense
+    assert any(isinstance(l, keras.Dense) for l in t_out.to_layers)
+    assert m.get_layer(index=0) is m.get_layer(name="dense")
+
+
+def test_callable_model_list_convention():
+    inp1 = keras.Input(shape=(4,))
+    inner = keras.Model(inp1, keras.Dense(4)(inp1))
+    a = keras.Input(shape=(4,))
+    t = inner([a])  # keras list convention
+    m = keras.Model(a, keras.Dense(2)(t))
+    rng = np.random.RandomState(3)
+    _fit_once(
+        m,
+        rng.randn(8, 4).astype(np.float32),
+        rng.randint(0, 2, (8,)).astype(np.int32),
+        8,
+    )
+
+
+def test_initializers_produce_expected_stats():
+    inp = keras.Input(shape=(16,))
+    out = keras.Dense(
+        8, kernel_initializer=keras.Zeros(),
+        bias_initializer=keras.RandomNormal(seed=1, stddev=0.5),
+    )(inp)
+    m = keras.Model(inp, out)
+    m.compile(
+        optimizer=keras.SGD(0.0), loss="mean_squared_error", metrics=[],
+        batch_size=4,
+    )
+    dense = m.get_layer(name="dense")
+    guid = dense.output_tensors[0].ref.guid
+    w = m.ffmodel.get_tensor(guid, 0)
+    b = m.ffmodel.get_tensor(guid, 1)
+    assert np.all(w == 0.0)
+    assert 0.1 < np.std(b) < 1.5 and np.any(b != 0.0)
+
+
+# -- preprocessing (pure functions) ------------------------------------------
+
+
+def test_pad_sequences_semantics():
+    out = pad_sequences([[1, 2, 3], [4]], maxlen=2)
+    np.testing.assert_array_equal(out, [[2, 3], [0, 4]])  # pre/pre
+    out = pad_sequences(
+        [[1, 2, 3], [4]], maxlen=2, padding="post", truncating="post"
+    )
+    np.testing.assert_array_equal(out, [[1, 2], [4, 0]])
+
+
+def test_tokenizer_roundtrip():
+    tok = Tokenizer(num_words=10)
+    tok.fit_on_texts(["the cat sat", "the cat ran", "the dogs ran"])
+    seqs = tok.texts_to_sequences(["the cat", "dogs sat"])
+    assert all(0 < i < 10 for s in seqs for i in s)
+    assert tok.word_index["the"] == 1  # strictly most frequent (3 uses)
+    m = tok.texts_to_matrix(["the cat the"], mode="count")
+    assert m[0, tok.word_index["the"]] == 2.0
+
+
+def test_one_hot_and_skipgrams():
+    ids = one_hot("a b c a", 50)
+    assert len(ids) == 4 and all(1 <= i < 50 for i in ids)
+    assert ids[0] == ids[3]  # same word, same hash
+    couples, labels = skipgrams([1, 2, 3, 4], vocabulary_size=5,
+                                window_size=1, seed=0)
+    assert len(couples) == len(labels) > 0
+    assert set(labels) <= {0, 1}
+    assert text_to_word_sequence("Hello, World!") == ["hello", "world"]

@@ -52,6 +52,16 @@ _EDITS = {
             "model.fit(x_train, y_train, epochs=1)",
         ),
     ],
+    "func_mnist_cnn.py": [
+        (
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data()",
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data(256, 64)",
+        ),
+        (
+            "model.fit(x_train, y_train, epochs=5, callbacks=[VerifyMetrics(ModelAccuracy.MNIST_CNN), EpochVerifyMetrics(ModelAccuracy.MNIST_CNN)])",
+            "model.fit(x_train, y_train, epochs=1)",
+        ),
+    ],
     "func_mnist_mlp_concat.py": [
         (
             "(x_train, y_train), (x_test, y_test) = mnist.load_data()",
