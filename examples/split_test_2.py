@@ -1,0 +1,59 @@
+"""split_test_2: a strided conv stack driven through the graph search
+(reference: examples/cpp/split_test_2/split_test_2.cc — builds the conv
+tower, compiles, then runs GraphSearchHelper::graph_optimize with a
+budget of 10; here the same budget flows through --budget into compile).
+
+    python examples/split_test_2.py -b 16 --budget 10
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.common import run_training
+
+from flexflow_tpu import (  # noqa: E402
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+
+
+def main():
+    cfg = FFConfig.parse_args()
+    if not cfg.search_budget:
+        cfg.search_budget = 10  # split_test_2.cc:59 graph_optimize(10, ...)
+    ff = FFModel(cfg)
+    # reference input: {batch, 4, 32, 32} NCHW (split_test_2.cc:27);
+    # NHWC is the TPU-native layout
+    x = ff.create_tensor([cfg.batch_size, 32, 32, 4], name="x")
+    t = x
+    # the reference loops channels[] = {4, 8, 16} but passes channels[1]
+    # each time: three stride-2 valid convs of 8 output channels
+    for _ in range(3):
+        t = ff.conv2d(t, 8, 3, 3, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.relu(t)
+    ff.softmax(t)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[
+            MetricsType.ACCURACY,
+            MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        ],
+    )
+    n = cfg.batch_size * (cfg.iterations or 8)
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 32, 32, 4).astype(np.float32)
+    y = rng.randint(0, 10, size=n).astype(np.int32)
+    run_training(ff, {"x": X}, y, cfg)
+
+
+if __name__ == "__main__":
+    main()

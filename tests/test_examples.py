@@ -27,6 +27,7 @@ EXAMPLES = [
     "resnet",
     "resnext",
     "split_test",
+    "split_test_2",
     "torch_mlp_import",
     "transformer",
     "xdl",
@@ -50,6 +51,11 @@ def _run_main(mod_name, argv):
 
 def test_split_test_runs():
     _run_main("split_test", ["-b", "8", "-i", "2", "-e", "1"])
+
+
+def test_split_test_2_runs():
+    # budget 10 mirrors split_test_2.cc:59's graph_optimize(10, ...)
+    _run_main("split_test_2", ["-b", "8", "-i", "2", "-e", "1"])
 
 
 def test_candle_uno_runs():
