@@ -57,6 +57,78 @@ _MXU_OPS = {
 # badly mis-prices (the dominant cost of DLRM-class models)
 _MEASURED_OPS = _MXU_OPS | {OperatorType.EMBEDDING}
 
+# op family for the cross-family residual correction (calibrate.py
+# --fit-family): isolated-chain measurement over/under-counts what XLA
+# fuses across op boundaries by a FAMILY-shaped factor (conv towers fuse
+# BN/relu/residual epilogues the chain measurement only partially sees;
+# dense stacks fuse less). The fitted full-step residual per family is
+# persisted in the calibration table and divided out of measured costs.
+_OP_FAMILY = {
+    OperatorType.CONV2D: "conv",
+    OperatorType.LINEAR: "dense",
+    OperatorType.BATCHMATMUL: "dense",
+    OperatorType.MULTIHEAD_ATTENTION: "dense",
+    OperatorType.EMBEDDING: "embed",
+}
+
+
+def op_family(op_type) -> Optional[str]:
+    """Family key for the measured-mode residual correction; None for ops
+    that never take the measured path."""
+    return _OP_FAMILY.get(op_type)
+
+
+def update_calibration_doc(path: str, updates: dict, chip: str = ""):
+    """Read-merge-atomic-write of the calibration table — the ONE home for
+    this logic (CostModel flushes, calibrate.py --tune-flash/--fit-family
+    all write through here). Tolerates a missing/corrupt file; a doc
+    measured on a DIFFERENT chip is dropped, not relabeled (its ops/
+    family_scale/flash_blocks would silently mis-tune the new chip).
+    Dict-valued updates shallow-merge into the existing value so partial
+    writers (a one-family --fit-family run) don't wipe sibling entries."""
+    import json
+    import os
+
+    doc = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+    if chip and doc.get("chip") not in (None, chip):
+        # dropping a foreign-chip table is correct (its entries would
+        # mis-tune this chip) but must not be silent or unrecoverable:
+        # chip time went into it
+        import warnings
+
+        bak = f"{path}.foreign-{doc.get('chip')}.bak"
+        try:
+            with open(bak, "w") as f:
+                json.dump(doc, f, indent=1)
+        except OSError:
+            bak = "<backup failed>"
+        warnings.warn(
+            f"calibration table {path} was measured on chip "
+            f"{doc.get('chip')!r} but this write targets {chip!r}; "
+            f"dropping the foreign table (saved to {bak})",
+            stacklevel=2,
+        )
+        doc = {}
+    doc["version"] = 1
+    if chip:
+        doc["chip"] = chip
+    for key, val in updates.items():
+        if isinstance(val, dict) and isinstance(doc.get(key), dict):
+            doc[key].update(val)
+        else:
+            doc[key] = val
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return doc
+
 # collective latency floor per hop (ICI); dominates small messages
 _ICI_LATENCY_S = 1e-6
 _DEFAULT_EFFICIENCY = 0.6  # achievable fraction of peak (MXU and ICI alike)
@@ -72,6 +144,7 @@ class CostModel:
         mixed_precision: bool = False,
         calibration_file: str = "",
         sparse_embedding: bool = True,
+        family_correction: bool = True,
     ):
         """machine_model: an optional search.machine_model.MachineModel
         (Enhanced / Networked); when given, collectives are costed as ring
@@ -99,7 +172,16 @@ class CostModel:
         # one real-chip calibration run serves every later search.
         self._measured: Dict[str, Optional[Tuple[float, float]]] = {}
         self.calibration_file = calibration_file
-        self._unsaved = 0
+        # per-family full-step residual (predicted/measured) fitted by
+        # `calibrate.py --fit-family`; measured op costs are divided by
+        # their family's factor. family_correction=False is the fitting
+        # path itself (residuals must be computed without the correction).
+        self.family_correction = family_correction
+        self._family_scale: Dict[str, float] = {}
+        # measured seconds attributed per family across this instance's
+        # lifetime (fwd+bwd, post-correction) — calibrate.py --fit-family
+        # reads it to split a predicted step into family vs remainder
+        self.family_time: Dict[str, float] = {}
         if calibration_file:
             self._load_calibration()
 
@@ -232,6 +314,7 @@ class CostModel:
                 node.op_type, node.params, input_shapes, node.weight_shapes
             )
             if times is not None:
+                times = self.corrected_times(node.op_type, times)
                 return OpCost(times[0], times[1], 0.0, mem)
 
         fwd = self._roofline(flops, bytes_moved)
@@ -353,10 +436,29 @@ class CostModel:
             [(op_type, params, in_shapes, weight_shapes, 0)]
         )
 
+    def corrected_times(
+        self, op_type, times: Optional[Tuple[float, float]]
+    ) -> Optional[Tuple[float, float]]:
+        """Divide a measured (fwd, bwd) by the op's fitted family residual.
+        Callers that bypass op_cost (the simulator's epilogue-chain
+        measurement — the path the conv residual was fitted FOR) must
+        route their raw measurements through here too."""
+        if times is None:
+            return times
+        fam = op_family(op_type)
+        scale = 1.0
+        if self.family_correction and fam:
+            scale = self._family_scale.get(fam, 1.0) or 1.0
+        times = (times[0] / scale, times[1] / scale)
+        if fam:
+            self.family_time[fam] = (
+                self.family_time.get(fam, 0.0) + times[0] + times[1]
+            )
+        return times
+
     def flush_calibration(self):
         if self.calibration_file:
             self._save_calibration()
-            self._unsaved = 0
 
     def measure_shard_chain(self, specs) -> Optional[Tuple[float, float]]:
         """Measure a FUSED op chain as one jitted program — the epilogue
@@ -388,11 +490,12 @@ class CostModel:
         )
         self._measured[key] = times
         if self.calibration_file and times is not None:
-            # throttled persistence (full-file rewrite): every few keys,
-            # plus an explicit flush_calibration() for callers at the end
-            self._unsaved += 1
-            if self._unsaved >= 4:
-                self.flush_calibration()
+            # persist immediately: a measurement costs >= _MEASURE_MIN_DIFF_S
+            # so the full-file rewrite is noise, and the search engines
+            # construct throwaway CostModels that never reach an explicit
+            # flush_calibration() (only calibrate.py does) — a throttle
+            # here silently dropped their last few measured keys
+            self.flush_calibration()
         return times
 
     def _time_kernel(
@@ -673,21 +776,23 @@ class CostModel:
         for key, val in doc.get("ops", {}).items():
             if val:  # failed measurements (null) are never persisted/read
                 self._measured[key] = tuple(val)
+        for fam, scale in doc.get("family_scale", {}).items():
+            if isinstance(scale, (int, float)) and scale > 0:
+                self._family_scale[fam] = float(scale)
 
     def _save_calibration(self):
-        import json
-        import os
-
-        doc = {
-            "version": 1,
-            "chip": self.spec.chip,
-            "ops": {
-                key: list(val)
-                for key, val in self._measured.items()
-                if val is not None
+        # merged write (update_calibration_doc): other writers own sibling
+        # keys (flash_blocks from --tune-flash, family_scale from
+        # --fit-family) and a measured-search flush must not clobber them;
+        # a foreign-chip doc is dropped rather than relabeled
+        update_calibration_doc(
+            self.calibration_file,
+            {
+                "ops": {
+                    key: list(val)
+                    for key, val in self._measured.items()
+                    if val is not None
+                }
             },
-        }
-        tmp = self.calibration_file + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-        os.replace(tmp, self.calibration_file)
+            chip=self.spec.chip,
+        )

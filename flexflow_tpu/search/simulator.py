@@ -297,7 +297,7 @@ def estimate_graph_cost(
                         0,
                     )
                 )
-            mt = cm.measure_shard_chain(specs)
+            mt = cm.corrected_times(node.op_type, cm.measure_shard_chain(specs))
             if mt is None:
                 continue
             chain_cost[guid] = mt

@@ -268,7 +268,14 @@ class UnitySearch:
                 else:
                     divide = opt.ch
             _, ws = infer_shapes(node.op_type, shard_ins, params)
-            times = self.cm.measure_shard(node.op_type, params, shard_ins, ws)
+            # corrected_times: the fitted family residual must divide
+            # every raw measurement consumer, or unity/mcmc (and the
+            # native DP LUT built from this) would rank cross-family
+            # candidates with the bias the correction removes
+            times = self.cm.corrected_times(
+                node.op_type,
+                self.cm.measure_shard(node.op_type, params, shard_ins, ws),
+            )
             if times is None:
                 return None
             return (times[0] / divide, times[1] / divide)

@@ -52,7 +52,8 @@ def _measure_actual_step(model, data, n1=5, n2=25):
     return (t2 - t1) / (n2 - n1)
 
 
-def _predict_step(model, calibration_file, mixed_precision):
+def _predict_step(model, calibration_file, mixed_precision,
+                  family_correction=True, return_cm=False):
     from flexflow_tpu.core.machine import MachineSpec
     from flexflow_tpu.search.cost_model import CostModel
     from flexflow_tpu.search.simulator import estimate_graph_cost
@@ -63,12 +64,15 @@ def _predict_step(model, calibration_file, mixed_precision):
         measure=True,
         mixed_precision=mixed_precision,
         calibration_file=calibration_file,
+        family_correction=family_correction,
     )
     cost = estimate_graph_cost(model.graph, cm, (1,))
     cm.flush_calibration()
     measured_keys = sum(
         1 for v in cm._measured.values() if v is not None
     )
+    if return_cm:
+        return cost.step_time, measured_keys, cm
     return cost.step_time, measured_keys
 
 
@@ -143,6 +147,107 @@ WORKLOADS = {
     "resnet": (build_resnet_wl, 16),
     "dlrm": (build_dlrm_wl, 64),
 }
+
+
+# dominant measured-op family per workload (cost_model.op_family): the
+# full-step residual of each workload estimates its family's chain-
+# measurement bias
+WORKLOAD_FAMILY = {
+    "transformer": "dense",
+    "resnet": "conv",
+    "dlrm": "embed",
+}
+
+
+def fit_family_scales(rows):
+    """{family: geomean scale} over rows of (family, family_pred_s,
+    total_pred_s, measured_s) — the pure core of --fit-family
+    (unit-tested off-chip).
+
+    Per row the scale solves for a ZERO full-step residual given the
+    non-family remainder: corrected = (total - fam) + fam/s = measured
+    => s = fam / (measured - (total - fam)). Dividing the raw full-step
+    ratio out of only the family's ops would overcorrect whenever they
+    are < 100% of the predicted step. Rows whose measured step is
+    entirely explained by the remainder (denominator <= 0) carry no
+    family signal and are dropped. Geomean over a workload's batch
+    ladder damps the shape-dependence a single batch would bake in."""
+    import math
+
+    acc = {}
+    for fam, fam_pred, total_pred, meas in rows:
+        if not fam or not (fam_pred > 0) or not (meas > 0):
+            continue
+        target = meas - (total_pred - fam_pred)
+        if target <= 0:
+            continue
+        s = fam_pred / target
+        # a tiny positive denominator (remainder overprediction eating
+        # almost the whole measured step) implies an extreme scale that
+        # would divide the family toward zero in every later search; an
+        # implied bias beyond 5x in either direction is a broken
+        # measurement, not a fusion effect — treat as no-signal
+        if not (0.2 <= s <= 5.0):
+            continue
+        acc.setdefault(fam, []).append(math.log(s))
+    return {
+        fam: round(math.exp(sum(logs) / len(logs)), 4)
+        for fam, logs in acc.items()
+    }
+
+
+def fit_family_mode(names, calib):
+    """VERDICT r3 item 4: promote the cross-family prediction bias the
+    rank gate reports into a correction term. Measures each workload's
+    batch ladder, fits predicted/measured per family (correction
+    DISABLED during the fit — the residual must be raw), and persists
+    `family_scale` to the calibration table; measured-mode CostModel
+    divides it out (cost_model.py op_cost), so cross-family orderings
+    use bias-corrected predictions."""
+    rows = []
+    entries = []
+    for name in names:
+        build, default_batch = WORKLOADS[name]
+        fam = WORKLOAD_FAMILY.get(name)
+        for mult in (1, 2, 4):
+            batch = default_batch * mult
+            label = f"{name}@bs{batch}"
+            print(f"[fit-family] {label}...", flush=True)
+            model, data = build(batch)
+            predicted, _, cm = _predict_step(
+                model, calib, model.config.allow_mixed_precision,
+                family_correction=False, return_cm=True,
+            )
+            fam_pred = cm.family_time.get(fam, 0.0)
+            actual = _measure_actual_step(model, data)
+            rows.append((fam, fam_pred, predicted, actual))
+            entries.append(
+                {"config": label, "family": fam,
+                 "predicted_ms": round(predicted * 1e3, 3),
+                 "family_pred_ms": round(fam_pred * 1e3, 3),
+                 "measured_ms": round(actual * 1e3, 3),
+                 "residual": round(predicted / actual, 3)
+                 if actual > 0 else None}
+            )
+            print(
+                f"[fit-family] {label}: predicted {predicted*1e3:.3f} ms, "
+                f"measured {actual*1e3:.3f} ms",
+                flush=True,
+            )
+    scales = fit_family_scales(rows)
+    from flexflow_tpu.search.cost_model import update_calibration_doc
+
+    # merged write: a one-family refresh must not wipe sibling families
+    update_calibration_doc(calib, {"family_scale": scales}, chip=CHIP)
+    print(
+        json.dumps(
+            {
+                "metric": "family_scale_fit",
+                "entries": entries,
+                "family_scale": scales,
+            }
+        )
+    )
 
 
 def rank_mode(names, calib):
@@ -272,20 +377,20 @@ def tune_flash_mode(calib):
         print("[tune-flash] no configuration measured; table unchanged")
         return
     (bq, bk), best_t = min(results.items(), key=lambda kv: kv[1])
-    doc = {}
-    if os.path.exists(calib):
-        with open(calib) as f:
-            doc = json.load(f)
-    doc["flash_blocks"] = {
-        "block_q": bq,
-        "block_k": bk,
-        "measured_ms": round(best_t * 1e3, 3),
-        "shape": [b, seq, h, d],
-    }
-    tmp = calib + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f, indent=1)
-    os.replace(tmp, calib)
+    from flexflow_tpu.search.cost_model import update_calibration_doc
+
+    update_calibration_doc(
+        calib,
+        {
+            "flash_blocks": {
+                "block_q": bq,
+                "block_k": bk,
+                "measured_ms": round(best_t * 1e3, 3),
+                "shape": [b, seq, h, d],
+            }
+        },
+        chip=CHIP,
+    )
     print(
         json.dumps(
             {
@@ -305,6 +410,7 @@ def main():
     names = []
     rank = False
     tune_flash = False
+    fit_family = False
     i = 0
     while i < len(args):
         if args[i] == "--calibration-file":
@@ -317,6 +423,8 @@ def main():
             rank = True
         elif args[i] == "--tune-flash":
             tune_flash = True
+        elif args[i] == "--fit-family":
+            fit_family = True
         elif args[i] in WORKLOADS:
             names.append(args[i])
         i += 1
@@ -324,6 +432,9 @@ def main():
     os.makedirs(os.path.dirname(calib) or ".", exist_ok=True)
     if tune_flash:
         tune_flash_mode(calib)
+        return
+    if fit_family:
+        fit_family_mode(names, calib)
         return
     if rank:
         rank_mode(names, calib)

@@ -1,0 +1,240 @@
+"""Cross-family residual correction (VERDICT r3 item 4): the per-family
+full-step bias that `scripts/calibrate.py --fit-family` fits from the
+chip is divided out of measured-mode op costs, and the calibration-table
+writers preserve each other's keys.
+"""
+
+import json
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.search.cost_model import CostModel, op_family
+
+SPEC = MachineSpec(num_nodes=1, chips_per_node=4, chip="v4")
+
+
+def linear_node(batch=16, in_dim=32, out_dim=32):
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, in_dim], name="x")
+    m.dense(x, out_dim, activation=ActiMode.RELU)
+    from flexflow_tpu.runtime.executor import propagate_shapes
+
+    propagate_shapes(m.graph)
+    node = next(
+        n for n in m.graph.nodes.values()
+        if n.op_type == OperatorType.LINEAR
+    )
+    in_shapes = [m.graph.shape_of(r) for r in node.inputs]
+    return m, node, in_shapes
+
+
+def test_op_family_mapping():
+    assert op_family(OperatorType.CONV2D) == "conv"
+    assert op_family(OperatorType.LINEAR) == "dense"
+    assert op_family(OperatorType.MULTIHEAD_ATTENTION) == "dense"
+    assert op_family(OperatorType.EMBEDDING) == "embed"
+    assert op_family(OperatorType.RELU) is None
+
+
+def _write_calib(path, scales):
+    with open(path, "w") as f:
+        json.dump(
+            {"version": 1, "chip": "v4", "ops": {}, "family_scale": scales},
+            f,
+        )
+
+
+def test_family_scale_divides_measured_cost(tmp_path):
+    path = str(tmp_path / "calib.json")
+    _write_calib(path, {"dense": 2.0})
+    m, node, in_shapes = linear_node()
+
+    cm = CostModel(SPEC, measure=True, calibration_file=path)
+    cm._time_kernel = lambda *a, **k: (1e-3, 2e-3)
+    cost = cm.op_cost(node, in_shapes)
+    assert cost.forward_time == pytest.approx(0.5e-3)
+    assert cost.backward_time == pytest.approx(1e-3)
+
+    # the fitting path sees RAW measured costs
+    raw = CostModel(
+        SPEC, measure=True, calibration_file=path, family_correction=False
+    )
+    raw._time_kernel = lambda *a, **k: (1e-3, 2e-3)
+    cost_raw = raw.op_cost(node, in_shapes)
+    assert cost_raw.forward_time == pytest.approx(1e-3)
+
+    # a family without a fitted scale is untouched
+    other = CostModel(SPEC, measure=True, calibration_file=path)
+    other._family_scale = {"conv": 3.0}
+    other._time_kernel = lambda *a, **k: (1e-3, 2e-3)
+    assert other.op_cost(node, in_shapes).forward_time == pytest.approx(1e-3)
+
+
+def test_fit_family_scales_geomean():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ))
+    from calibrate import fit_family_scales
+
+    # rows: (family, family_pred, total_pred, measured)
+    rows = [
+        # family is the whole step: s = 2/1 = 2
+        ("conv", 2.0, 2.0, 1.0),
+        # family is HALF the predicted step (the overcorrection case the
+        # raw-ratio fit got wrong): remainder 1.0, s = 1.0/(1.5-1.0) = 2
+        # -> corrected total = 1.0 + 1.0/2 = 1.5 = measured, residual 1.0
+        ("conv", 1.0, 2.0, 1.5),
+        ("dense", 1.0, 1.0, 1.0),
+        # measured fully explained by the remainder: no family signal
+        ("embed", 0.5, 2.0, 1.0),
+        (None, 5.0, 5.0, 1.0),   # unknown family: dropped
+        # tiny positive denominator -> implied scale 50x: clamped out
+        ("embed", 5.0, 9.5, 4.6),
+    ]
+    scales = fit_family_scales(rows)
+    assert scales == {"conv": 2.0, "dense": 1.0}
+
+
+def test_unity_measured_times_corrected(tmp_path):
+    """Unity's DP recursion (and the native-solver LUT built from it)
+    must consume family-corrected measurements like the simulator."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.unity import UnitySearch
+
+    path = str(tmp_path / "calib.json")
+    spec = MachineSpec(num_nodes=1, chips_per_node=2, chip="v4")
+    m, node, in_shapes = linear_node()
+    costs = {}
+    for scale in (1.0, 2.0):
+        _write_calib(path, {"dense": scale})
+        s = UnitySearch(
+            m.graph, spec, measure=True, calibration_file=path
+        )
+        s.cm._time_kernel = lambda *a, **k: (1e-3, 2e-3)
+        mt = s._measured_times(
+            node, in_shapes, next(iter(s.valid_views(node.guid, s.resource)))
+        )
+        costs[scale] = mt[0] + mt[1]
+    assert costs[2.0] == pytest.approx(costs[1.0] / 2.0)
+
+
+def test_chain_measured_head_is_corrected(tmp_path):
+    """The simulator's epilogue-chain measurement (the path the conv
+    residual is fitted FOR) must route through the family correction
+    too, not only isolated op_cost."""
+    from flexflow_tpu.runtime.executor import propagate_shapes
+    from flexflow_tpu.search.simulator import estimate_graph_cost
+
+    path = str(tmp_path / "calib.json")
+    _write_calib(path, {"dense": 2.0})
+
+    def chained_model():
+        m = FFModel(FFConfig(batch_size=16))
+        x = m.create_tensor([16, 32], name="x")
+        m.dense(x, 32, activation=ActiMode.RELU)  # linear -> relu chain
+        propagate_shapes(m.graph)
+        return m
+
+    def fake_chain(self, specs):
+        return (1e-3, 2e-3)
+
+    costs = {}
+    for corrected in (False, True):
+        cm = CostModel(
+            SPEC, measure=True, calibration_file=path,
+            family_correction=corrected,
+        )
+        cm.measure_shard_chain = fake_chain.__get__(cm)
+        costs[corrected] = estimate_graph_cost(
+            chained_model().graph, cm, (1,)
+        ).step_time
+    assert costs[True] < costs[False]
+
+
+def test_foreign_chip_doc_dropped_not_relabeled(tmp_path):
+    """A flush over a table measured on a DIFFERENT chip must not keep
+    the foreign family_scale/flash_blocks under the new chip label."""
+    path = str(tmp_path / "calib.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "version": 1,
+                "chip": "v5e",
+                "ops": {"stale": [1.0, 2.0]},
+                "flash_blocks": {"block_q": 512},
+                "family_scale": {"conv": 1.4},
+            },
+            f,
+        )
+    cm = CostModel(SPEC, measure=True, calibration_file=path)  # v4 spec
+    assert cm._family_scale == {}  # mismatch: table ignored on load
+    cm._time_kernel = lambda *a, **k: (1e-3, 2e-3)
+    m, node, in_shapes = linear_node()
+    cm.op_cost(node, in_shapes)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["chip"] == "v4"
+    assert "flash_blocks" not in doc and "family_scale" not in doc
+    assert "stale" not in doc["ops"] and len(doc["ops"]) == 1
+    # the dropped foreign table was backed up, not destroyed
+    with open(path + ".foreign-v5e.bak") as f:
+        bak = json.load(f)
+    assert bak["family_scale"] == {"conv": 1.4}
+
+
+def test_family_time_attribution(tmp_path):
+    """corrected_times accumulates per-family measured seconds — the
+    split --fit-family's closed form needs."""
+    path = str(tmp_path / "calib.json")
+    _write_calib(path, {})
+    cm = CostModel(SPEC, measure=True, calibration_file=path)
+    cm._time_kernel = lambda *a, **k: (1e-3, 2e-3)
+    m, node, in_shapes = linear_node()
+    cm.op_cost(node, in_shapes)
+    assert cm.family_time["dense"] == pytest.approx(3e-3)
+
+
+def test_partial_fit_merges_families(tmp_path):
+    from flexflow_tpu.search.cost_model import update_calibration_doc
+
+    path = str(tmp_path / "calib.json")
+    update_calibration_doc(
+        path, {"family_scale": {"conv": 1.4, "dense": 1.1}}, chip="v4"
+    )
+    update_calibration_doc(path, {"family_scale": {"conv": 1.2}}, chip="v4")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["family_scale"] == {"conv": 1.2, "dense": 1.1}
+
+
+def test_save_calibration_preserves_sibling_keys(tmp_path):
+    path = str(tmp_path / "calib.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "version": 1,
+                "chip": "v4",
+                "ops": {},
+                "flash_blocks": {"block_q": 512, "block_k": 1024},
+                "family_scale": {"conv": 1.3},
+            },
+            f,
+        )
+    cm = CostModel(SPEC, measure=True, calibration_file=path)
+    cm._time_kernel = lambda *a, **k: (1e-3, 2e-3)
+    m, node, in_shapes = linear_node()
+    cm.op_cost(node, in_shapes)
+    cm.flush_calibration()
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["flash_blocks"] == {"block_q": 512, "block_k": 1024}
+    assert doc["family_scale"] == {"conv": 1.3}
+    assert len(doc["ops"]) == 1  # the measured linear was persisted
