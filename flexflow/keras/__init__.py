@@ -1,7 +1,9 @@
 from flexflow.keras import (  # noqa: F401
+    backend,
     callbacks,
     initializers,
     losses,
     metrics,
     optimizers,
+    utils,
 )

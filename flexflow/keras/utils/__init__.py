@@ -1,0 +1,5 @@
+from flexflow.keras.utils import np_utils  # noqa: F401
+from flexflow.keras.utils.np_utils import (  # noqa: F401
+    normalize,
+    to_categorical,
+)

@@ -1,0 +1,4 @@
+from flexflow_tpu.frontends.keras_preprocessing import (  # noqa: F401
+    normalize,
+    to_categorical,
+)

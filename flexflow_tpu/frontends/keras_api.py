@@ -56,8 +56,11 @@ _METRIC = {
     "sparse_categorical_crossentropy": MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
     "categorical_crossentropy": MetricsType.CATEGORICAL_CROSSENTROPY,
     "mean_squared_error": MetricsType.MEAN_SQUARED_ERROR,
+    "mse": MetricsType.MEAN_SQUARED_ERROR,
     "root_mean_squared_error": MetricsType.ROOT_MEAN_SQUARED_ERROR,
+    "rmse": MetricsType.ROOT_MEAN_SQUARED_ERROR,
     "mean_absolute_error": MetricsType.MEAN_ABSOLUTE_ERROR,
+    "mae": MetricsType.MEAN_ABSOLUTE_ERROR,
 }
 
 

@@ -1,0 +1,96 @@
+"""Keras backend functions over the functional API
+(reference: python/flexflow/keras/backend/ — batch_dot/sin/cos/exp/pow/
+sum built on the BatchMatmul/Sin/Cos/Exp/Pow/ReduceSum internal layers,
+backend_functions.py:25-45, internal.py:23-233).
+
+Same surface here: tiny Layer subclasses lowering to the FFModel builder
+ops, plus the functional wrappers and `backend()` reporting the backend
+name.
+"""
+
+from __future__ import annotations
+
+from flexflow_tpu.frontends.keras_api import Layer
+
+_BACKEND = "flexflow_tpu"
+
+
+def backend() -> str:
+    return _BACKEND
+
+
+class BatchMatmul(Layer):
+    """[b, n, k] x [b, k, m] -> [b, n, m] (internal.py:23 restricts to
+    3-d tensors; the builder op checks contraction sizes)."""
+
+    def build(self, ff, ts):
+        if len(ts) != 2:
+            raise ValueError(f"BatchMatmul expects 2 tensors, got {len(ts)}")
+        return ff.batch_matmul(ts[0], ts[1], name=self.name)
+
+
+class Sin(Layer):
+    def build(self, ff, ts):
+        return ff.sin(ts[0], name=self.name)
+
+
+class Cos(Layer):
+    def build(self, ff, ts):
+        return ff.cos(ts[0], name=self.name)
+
+
+class Exp(Layer):
+    def build(self, ff, ts):
+        return ff.exp(ts[0], name=self.name)
+
+
+class Pow(Layer):
+    def __init__(self, a, name=None):
+        super().__init__(name)
+        self.a = float(a)
+
+    def build(self, ff, ts):
+        return ff.pow(ts[0], self.a, name=self.name)
+
+
+class ReduceSum(Layer):
+    """axis None sums EVERY dim, batch included (internal.py:205-217
+    sets axis = range(0, ndims)); int or list axes pass through."""
+
+    def __init__(self, axis=None, keepdims=False, name=None):
+        super().__init__(name)
+        if isinstance(axis, int):
+            axis = [axis]
+        self.axis = None if axis is None else list(axis)
+        self.keepdims = bool(keepdims)
+
+    def build(self, ff, ts):
+        axes = self.axis
+        if axes is None:
+            axes = list(range(len(ts[0].dims)))
+        return ff.reduce_sum(ts[0], axes, keepdims=self.keepdims,
+                             name=self.name)
+
+
+def batch_dot(x, y):
+    return BatchMatmul()([x, y])
+
+
+def sin(x):
+    return Sin()(x)
+
+
+def cos(x):
+    return Cos()(x)
+
+
+def exp(x):
+    return Exp()(x)
+
+
+def pow(x, a):  # noqa: A001 — keras spells it `pow` (backend/__init__.py)
+    return Pow(a)(x)
+
+
+def sum(x, axis=None, keepdims=False):  # noqa: A001 — keras spelling
+    return ReduceSum(axis, keepdims)(x)

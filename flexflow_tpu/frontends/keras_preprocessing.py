@@ -183,3 +183,32 @@ class Tokenizer:
                 else:
                     raise ValueError(f"mode must be binary|count, got {mode!r}")
         return m
+
+
+# ---- np_utils (reference: python/flexflow/keras/utils/np_utils.py) ---------
+
+
+def to_categorical(y, num_classes: Optional[int] = None, dtype="float32"):
+    """Integer class vector -> one-hot matrix, classes axis last; a
+    trailing singleton dim is squeezed first (so shape [n, 1] labels
+    one-hot to [n, k] like flat ones). Scatter-indexed like the
+    reference (np_utils.py:45-55): a label >= num_classes raises
+    IndexError rather than silently emitting an all-zero row, and
+    negative labels index from the end (numpy semantics)."""
+    y = np.asarray(y, dtype="int64")
+    shape = y.shape
+    if len(shape) > 1 and shape[-1] == 1:
+        shape = shape[:-1]
+    flat = y.reshape(-1)
+    k = int(num_classes) if num_classes else int(flat.max()) + 1
+    out = np.zeros((flat.shape[0], k), dtype=dtype)
+    out[np.arange(flat.shape[0]), flat] = 1
+    return out.reshape(shape + (k,))
+
+
+def normalize(x, axis: int = -1, order: int = 2):
+    """Lp-normalize an array along `axis` (zero-norm slices pass through)."""
+    x = np.asarray(x)
+    norm = np.atleast_1d(np.linalg.norm(x, order, axis))
+    norm[norm == 0] = 1.0
+    return x / np.expand_dims(norm, axis)

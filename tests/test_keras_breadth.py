@@ -193,3 +193,61 @@ def test_one_hot_and_skipgrams():
     assert len(couples) == len(labels) > 0
     assert set(labels) <= {0, 1}
     assert text_to_word_sequence("Hello, World!") == ["hello", "world"]
+
+
+def test_np_utils_surface():
+    """reference: python/flexflow/keras/utils/np_utils.py — the
+    flexflow.keras.utils namespace carries to_categorical/normalize."""
+    import numpy as np
+
+    from flexflow.keras.utils import normalize, to_categorical
+    from flexflow.keras.utils.np_utils import to_categorical as tc2
+
+    assert tc2 is to_categorical
+    m = to_categorical([0, 2, 1, 2], num_classes=3)
+    assert m.shape == (4, 3) and m.dtype == np.float32
+    assert m.argmax(1).tolist() == [0, 2, 1, 2]
+    # column labels squeeze their singleton dim like flat ones
+    assert to_categorical([[1], [0]]).shape == (2, 2)
+    # default num_classes = max + 1
+    assert to_categorical([3]).shape == (1, 4)
+    # reference scatter semantics (np_utils.py:45-55): out-of-range
+    # raises, negatives index from the end
+    import pytest as _pytest
+
+    with _pytest.raises(IndexError):
+        to_categorical([5], num_classes=3)
+    assert to_categorical([-1], num_classes=3)[0].tolist() == [0.0, 0.0, 1.0]
+    n = normalize(np.array([[3.0, 4.0], [0.0, 0.0]]))
+    assert np.allclose(n[0], [0.6, 0.8]) and np.allclose(n[1], 0.0)
+
+
+def test_backend_functions_build_and_train():
+    """reference: python/flexflow/keras/backend/ — batch_dot/sin/cos/
+    exp/pow/sum compose into a trainable graph."""
+    import numpy as np
+
+    import flexflow.keras.backend as K
+    from flexflow_tpu.frontends.keras_api import Input, Model
+
+    assert K.backend() == "flexflow_tpu"
+    x = Input((4, 3))
+    y = Input((3, 5))
+    t = K.batch_dot(x, y)                      # [b, 4, 5]
+    t = K.pow(K.exp(K.cos(K.sin(t))), 2.0)
+    s = K.sum(t, axis=[1, 2])                  # per-sample scalar
+    m = Model([x, y], s)
+    m.compile(optimizer="sgd", loss="mse", metrics=["mse"])
+    rng = np.random.RandomState(0)
+    a = rng.randn(64, 4, 3).astype(np.float32)
+    b = rng.randn(64, 3, 5).astype(np.float32)
+    lbl = rng.randn(64, 1).astype(np.float32)
+    hist = m.fit([a, b], lbl, epochs=1, batch_size=16, verbose=False)
+    assert hist[0]["loss_sum"] > 0 and hist[0]["iterations"] > 0
+
+    # axis=None reduces EVERY dim, batch included (reference
+    # internal.py:205-217 sets axis = range(0, ndims))
+    from flexflow_tpu.frontends.keras_backend import ReduceSum
+
+    assert ReduceSum(axis=None).axis is None
+    assert ReduceSum(axis=2).axis == [2]
