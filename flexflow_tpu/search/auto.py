@@ -30,7 +30,12 @@ from flexflow_tpu.core.types import OperatorType
 from flexflow_tpu.parallel.strategy import Strategy, data_parallel_strategy
 from flexflow_tpu.search.cost_model import CostModel
 from flexflow_tpu.search.rewrites import Site, find_tp_sites
-from flexflow_tpu.search.simulator import GraphCost, estimate_graph_cost
+from flexflow_tpu.search.simulator import (
+    GraphCost,
+    _sparse_embedding_rows,
+    estimate_graph_cost,
+    sparse_embedding_node_cost,
+)
 
 _MODEL_AXIS = 1  # mesh axis index for tensor parallelism ("model")
 
@@ -180,7 +185,10 @@ def _pipeline_candidate(
         if node.op_type == OperatorType.INPUT or node.is_parallel_op:
             continue
         in_shapes = [g.shape_of(r) for r in node.inputs]
-        c = cm.op_cost(node, in_shapes)
+        c = sparse_embedding_node_cost(g, guid, node, cm)
+        sparse_table = c is not None
+        if c is None:
+            c = cm.op_cost(node, in_shapes)
         t = c.forward_time + c.backward_time
         out_bytes = sum(s.piece_bytes() for s in node.output_shapes)
         act_bytes += out_bytes
@@ -190,6 +198,7 @@ def _pipeline_candidate(
             trunk_act_bytes += out_bytes
         else:
             rest += t
+        sp_rows = _sparse_embedding_rows(g, guid) if sparse_table else None
         for w in node.weight_shapes:
             # grads only need reducing over the dp replicas that
             # computed them
@@ -197,6 +206,12 @@ def _pipeline_candidate(
                 trunk_weight_bytes += w.piece_bytes()
             else:
                 rest_weight_bytes += w.piece_bytes()
+            if sparse_table:
+                # no table-sized gradient ever materializes: no grad
+                # all-reduce, touched-rows update only (same basis as
+                # estimate_graph_cost's weight loop)
+                update += cm.sparse_update_cost(w, sp_rows)
+                continue
             if dp > 1:
                 sync += cm.all_reduce(cm.piece_bytes(w), dp)
             update += cm.update_cost(w)

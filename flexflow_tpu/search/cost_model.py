@@ -733,6 +733,24 @@ class CostModel:
             weight_shape.piece_bytes(), state_factor
         )
 
+    def sparse_embedding_op_cost(
+        self, weight_shape, rows_per_step: float
+    ) -> Tuple[float, float]:
+        """(fwd_s, bwd_s) of an embedding on the executor's sparse fast
+        path: forward gathers the batch's rows, backward builds only the
+        touched-row gradient (Executor._sparse_embedding_guids never
+        materializes a table-sized gradient). The measured-mode kernel
+        times the registry lowering's DENSE-gradient VJP instead — wrong
+        by the table/batch ratio (a 4x1M-table DLRM mis-predicts ~500x on
+        the measured basis), so sparse-eligible embeddings must take this
+        analytic path even in measured mode."""
+        dim = weight_shape.dims[-1].piece_size
+        elem = self.elem_bytes(weight_shape)
+        bytes_rw = rows_per_step * dim * elem
+        t = bytes_rw / (self.spec.hbm_gbps * 1e9 * self.efficiency)
+        # backward touches the same rows twice (zero-init + scatter-add)
+        return (t, 2.0 * t)
+
     def sparse_update_cost(
         self,
         weight_shape: ParallelTensorShape,

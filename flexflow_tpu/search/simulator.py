@@ -64,6 +64,28 @@ def _sparse_embedding_rows(graph: PCGGraph, guid: int):
     return graph.shape_of(ref).piece_volume()
 
 
+def sparse_embedding_node_cost(graph, guid, node, cm):
+    """OpCost for a SPARSE-eligible embedding (else None) — the ONE
+    compute-pricing site for the fast path, shared by estimate_graph_cost
+    and auto._pipeline_candidate (unity derives the same numbers through
+    _sparse_embedding_time). The executor's fast path gathers/scatters
+    touched rows only, so neither the measured dense-grad kernel nor the
+    table-sized roofline applies (the round-4 DLRM 490x finding)."""
+    if (
+        not cm.sparse_embedding
+        or node.op_type != OperatorType.EMBEDDING
+        or not node.weight_shapes
+    ):
+        return None
+    rows = _sparse_embedding_rows(graph, guid)
+    if rows is None:
+        return None
+    f, b = cm.sparse_embedding_op_cost(node.weight_shapes[0], rows)
+    mem = sum(cm.piece_bytes(s) for s in node.output_shapes)
+    mem += sum(cm.piece_bytes(s) for s in node.weight_shapes)
+    return OpCost(f, b, 0.0, int(mem))
+
+
 def _group_size(shape, mesh_sizes) -> int:
     """Mesh axes a tensor is NOT sharded over = its replication group."""
     used = set()
@@ -324,11 +346,13 @@ def estimate_graph_cost(
             )
             bwd_comm[guid] = b
         else:
-            # a chain-measured head must not ALSO pay the isolated kernel
-            # measurement it would immediately discard
-            cost = cm.op_cost(
-                node, in_shapes, skip_measure=guid in chain_cost
-            )
+            cost = sparse_embedding_node_cost(graph, guid, node, cm)
+            if cost is None:
+                # a chain-measured head must not ALSO pay the isolated
+                # kernel measurement it would immediately discard
+                cost = cm.op_cost(
+                    node, in_shapes, skip_measure=guid in chain_cost
+                )
             if guid in chain_cost:
                 # measured as one fused epilogue chain (the chain's
                 # members are in fused_free)

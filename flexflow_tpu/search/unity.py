@@ -282,6 +282,30 @@ class UnitySearch:
         except Exception:
             return None
 
+    def _sparse_embedding_time(self, guid, node, opt):
+        """Fwd(+bwd) seconds for a SPARSE-eligible embedding under `opt`,
+        else None. The executor's fast path gathers/scatters touched rows
+        only — neither the measured dense-grad kernel nor the table
+        roofline applies (same basis as simulator.estimate_graph_cost and
+        _update_bytes; the round-4 DLRM 490x finding). Shared by op_cost
+        and the native-solver LUT builder so both engines price it
+        identically."""
+        if node.op_type != OperatorType.EMBEDDING or not node.weight_shapes:
+            return None
+        _ub, sparse = self._update_bytes(guid)
+        if not sparse:
+            return None
+        rows = _ub / (
+            node.weight_shapes[0].dims[-1].size
+            * node.weight_shapes[0].dtype.size_bytes
+        )
+        # rows shard over dp (batch), the row dim over ch: the rows x dim
+        # product divides by dp*ch either way
+        f, b = self.cm.sparse_embedding_op_cost(
+            node.weight_shapes[0], rows / (opt.dp * opt.ch)
+        )
+        return f + (b if self.include_backward else 0.0)
+
     def op_cost(self, guid: int, opt: ViewOption) -> float:
         """Fwd(+bwd) seconds of the node's shard under `opt`: the real
         measured kernel when the cost model is in measured mode
@@ -292,8 +316,12 @@ class UnitySearch:
         n = opt.num_devices
         in_shapes = [self.graph.shape_of(r) for r in node.inputs]
         eb = self.cm.elem_bytes
-        t = None
-        if self.cm.measure:
+        # sparse-eligible embeddings price compute analytically but FALL
+        # THROUGH to the sync/update section below: the no-all-reduce and
+        # touched-rows-update terms there (and in the native solver's
+        # ubytes arrays) still apply
+        t = self._sparse_embedding_time(guid, node, opt)
+        if t is None and self.cm.measure:
             mt = self._measured_times(node, in_shapes, opt)
             if mt is not None:
                 t = mt[0] + (mt[1] if self.include_backward else 0.0)
@@ -430,6 +458,10 @@ class UnitySearch:
             in_shapes = [self.graph.shape_of(r) for r in node.inputs]
             entries = []
             for opt in self.valid_views(guid, full):
+                st = self._sparse_embedding_time(guid, node, opt)
+                if st is not None:
+                    entries.append((opt.dp, opt.ch, st))
+                    continue
                 mt = self._measured_times(node, in_shapes, opt)
                 if mt is None:
                     continue

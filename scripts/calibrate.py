@@ -20,7 +20,6 @@ The validation target (VERDICT): predicted within ~20% of measured.
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -29,27 +28,18 @@ import numpy as np
 CHIP = "v5e"  # the real chip behind the axon tunnel
 
 
-def _measure_actual_step(model, data, n1=5, n2=25):
-    """Differencing step-time of the jitted train step (bench.py method)."""
-    import jax
+def _measure_actual_step(model, data):
+    """PURE-DEVICE step time via the shared on-device lax.scan
+    differencing (utils/benchmark.measure_train_step — the bench.py /
+    bench_configs.py protocol). The old python-loop chain here included
+    ~0.3 ms/step of tunnel dispatch, which tracked the tunnel's day (it
+    masked a real dense-family over-prediction in the round-3 ratios and
+    unmasked it when the tunnel got faster); the prediction is pure
+    device time, so the measurement must be too."""
+    from flexflow_tpu.utils.benchmark import measure_train_step
 
-    step = model.executor.train_step()
     batch = model.executor.shard_batch(data)
-    params, opt_state = model.params, model.opt_state
-    key = jax.random.PRNGKey(0)
-
-    def chain(n, p, o):
-        t0 = time.perf_counter()
-        loss = None
-        for _ in range(n):
-            p, o, loss, _ = step(p, o, batch, key)
-        _ = float(np.asarray(loss))
-        return time.perf_counter() - t0, p, o
-
-    _, params, opt_state = chain(2, params, opt_state)  # compile + warmup
-    t1, params, opt_state = chain(n1, params, opt_state)
-    t2, params, opt_state = chain(n2, params, opt_state)
-    return (t2 - t1) / (n2 - n1)
+    return measure_train_step(model, batch, estimates=3, rep_sleep_s=1.0)
 
 
 def _predict_step(model, calibration_file, mixed_precision,

@@ -228,3 +228,48 @@ def test_cost_model_sees_sparse_update():
     sparse_t = update_time(True)
     # 1M-row table vs 32x4 touched rows: orders of magnitude apart
     assert sparse_t < dense_t / 100, (sparse_t, dense_t)
+
+
+def test_measured_mode_prices_sparse_path_not_dense_kernel():
+    """The round-4 DLRM 490x finding: measured mode timed the registry
+    lowering's DENSE-gradient embedding VJP (table-sized) while the
+    executor runs the touched-rows fast path. Sparse-eligible embeddings
+    must take CostModel.sparse_embedding_op_cost in BOTH engines."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.simulator import estimate_graph_cost
+    from flexflow_tpu.search.unity import UnitySearch
+
+    m = build(vocab=100000, batch=32, bag=1)
+    spec = MachineSpec(num_nodes=1, chips_per_node=2, chip="v4")
+
+    def poisoned(cm):
+        # a dense-grad kernel measurement would be table-sized: make it
+        # absurd so any consumer of it fails the bound below
+        cm._time_kernel = lambda *a, **k: (0.5, 1.0)
+        cm._time_kernel_chain = lambda specs: (0.5, 1.0)
+        return cm
+
+    cm = poisoned(CostModel(spec, measure=True))
+    cost = estimate_graph_cost(m.graph, cm, (1,))
+    # the linear still prices at the (absurd) measured 1.5 s, but the
+    # 100k x 16 table must not: sparse path is ~32 rows of traffic
+    assert cost.step_time < 10.0
+
+    us = UnitySearch(m.graph, spec, measure=True)
+    poisoned(us.cm)
+    from flexflow_tpu.core.types import OperatorType
+
+    emb = next(
+        g for g, n in m.graph.nodes.items()
+        if n.op_type == OperatorType.EMBEDDING
+    )
+    opt = next(iter(us.valid_views(emb, us.resource)))
+    t = us.op_cost(emb, opt)
+    assert t < 1e-3  # rows-sized, nowhere near the 1.5 s poison
+
+    # ineligible (dense-update) embeddings still use the measured kernel
+    m2 = build(vocab=100000, batch=32, bag=1, sparse=False)
+    cm2 = poisoned(CostModel(spec, measure=True, sparse_embedding=False))
+    cost2 = estimate_graph_cost(m2.graph, cm2, (1,))
+    assert cost2.step_time > 1.0
