@@ -87,6 +87,7 @@ def _declare(lib: ctypes.CDLL):
         ctypes.c_int32, ctypes.c_int32, i32p, i32p, f64p,  # edges
         i64p, i64p, f64p, f64p, f64p, f64p,  # per-node scalars
         f64p, i32p,  # optimizer-update bytes basis + dp-scaling flags
+        f64p,  # sparse touched-row sync bytes basis
         ctypes.c_double,  # optimizer traffic factor (2*state_factor - 1)
         ctypes.c_int32,  # allow sub-block concurrent-branch views
         ctypes.c_int32, i32p, i32p, i32p, f64p,  # measured-view LUT
@@ -168,6 +169,7 @@ def unity_dp(
     sink: int,
     ubytes=None,  # optimizer-update bytes basis (defaults to wbytes)
     u_dp_scaled=None,  # per-node 1 where update traffic divides by dp
+    sbytes=None,  # sparse touched-row sync bytes (all-gather over dp)
     update_factor: float = 5.0,  # 2*state_factor - 1
     allow_subblock: bool = False,  # unity.py allow_subblock_views
     measured=None,  # [(node_idx, dp, ch, cost_s)] replacing the roofline
@@ -196,13 +198,18 @@ def unity_dp(
         if u_dp_scaled is None
         else np.ascontiguousarray(u_dp_scaled, dtype=np.int32)
     )
+    sb = (
+        np.zeros(n, dtype=np.float64)
+        if sbytes is None
+        else np.ascontiguousarray(sbytes, dtype=np.float64)
+    )
     out_dp = np.empty(n, dtype=np.int32)
     out_ch = np.empty(n, dtype=np.int32)
     out_cost = np.empty(1, dtype=np.float64)
     rc = lib.ffn_unity_dp(
         n, len(edges), _i32p(esrc), _i32p(edst), _f64p(ebytes),
         _i64p(b), _i64p(c), _f64p(f), _f64p(by), _f64p(w), _f64p(bm),
-        _f64p(ub), _i32p(us), update_factor, int(allow_subblock),
+        _f64p(ub), _i32p(us), _f64p(sb), update_factor, int(allow_subblock),
         len(measured or []),
         _i32p(_as_i32([m[0] for m in measured or []])),
         _i32p(_as_i32([m[1] for m in measured or []])),

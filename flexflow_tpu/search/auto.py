@@ -209,8 +209,14 @@ def _pipeline_candidate(
             if sparse_table:
                 # no table-sized gradient ever materializes: no grad
                 # all-reduce, touched-rows update only (same basis as
-                # estimate_graph_cost's weight loop)
+                # estimate_graph_cost's weight loop) + the touched-row
+                # all-gather over the dp replicas (sparse_sync_cost)
                 update += cm.sparse_update_cost(w, sp_rows)
+                if dp > 1:
+                    sync += cm.sparse_sync_cost(
+                        sp_rows * w.dims[-1].piece_size * w.dtype.size_bytes,
+                        dp,
+                    )
                 continue
             if dp > 1:
                 sync += cm.all_reduce(cm.piece_bytes(w), dp)

@@ -751,6 +751,19 @@ class CostModel:
         # backward touches the same rows twice (zero-init + scatter-add)
         return (t, 2.0 * t)
 
+    def sparse_sync_cost(
+        self, row_bytes_per_chip: float, group_size: int, chips=None
+    ) -> float:
+        """Touched-row broadcast for a sparse-eligible table whose replicas
+        span `group_size` chips while the ids/cotangents are batch-sharded
+        across them: GSPMD lowers the scatter-update into an all-gather of
+        the (ids, rows) pairs so every replica applies the full scatter
+        (Executor.sparse_step's sparse_row_update under jit). Tiny next to
+        the table-sized all-reduce the fast path eliminates, but real —
+        without it, dp-replicated tables would look literally free to keep
+        consistent (round-5 reconciliation of the bba35f9 sparse pricing)."""
+        return self.all_gather(row_bytes_per_chip, group_size, chips=chips)
+
     def sparse_update_cost(
         self,
         weight_shape: ParallelTensorShape,

@@ -64,6 +64,19 @@ def _sparse_embedding_rows(graph: PCGGraph, guid: int):
     return graph.shape_of(ref).piece_volume()
 
 
+def _sparse_rows_shard_group(graph: PCGGraph, guid: int) -> int:
+    """How many distinct shards the touched-row stream is split into — the
+    group every table replica must all-gather over before applying the
+    scatter-update (CostModel.sparse_sync_cost). Equals the ids input's
+    total sharding degree; 1 (no sync) when the ids are replicated."""
+    from flexflow_tpu.core.pcg import trace_embedding_ids_input
+
+    ref = trace_embedding_ids_input(graph, guid)
+    if ref is None:
+        return 1
+    return graph.shape_of(ref).total_degree
+
+
 def sparse_embedding_node_cost(graph, guid, node, cm):
     """OpCost for a SPARSE-eligible embedding (else None) — the ONE
     compute-pricing site for the fast path, shared by estimate_graph_cost
@@ -417,6 +430,11 @@ def estimate_graph_cost(
             if cm.sparse_embedding
             else None
         )
+        sparse_group = (
+            _sparse_rows_shard_group(graph, guid)
+            if sparse_rows is not None
+            else 1
+        )
         for w in node.weight_shapes:
             weight_bytes += w.piece_bytes()
             if include_backward:
@@ -428,6 +446,22 @@ def estimate_graph_cost(
                     t_update += cm.sparse_update_cost(
                         w, sparse_rows, optimizer_state_factor
                     )
+                    # replicas must still see each other's touched rows:
+                    # batch-sharded ids scattering into a shared table cost
+                    # an all-gather of rows x dim over the id shards
+                    sg = sparse_group
+                    if sg > 1:
+                        row_b = (
+                            sparse_rows
+                            * w.dims[-1].piece_size
+                            * w.dtype.size_bytes
+                        )
+                        chips = (
+                            range(total_chips)
+                            if sg >= total_chips
+                            else _axis_group_chips(0, sg, mesh_sizes)
+                        )
+                        t_sync += cm.sparse_sync_cost(row_b, sg, chips=chips)
                     continue
                 g = _group_size(w, mesh_sizes)
                 chips = (

@@ -292,13 +292,9 @@ class UnitySearch:
         identically."""
         if node.op_type != OperatorType.EMBEDDING or not node.weight_shapes:
             return None
-        _ub, sparse = self._update_bytes(guid)
-        if not sparse:
+        _ub, rows = self._update_bytes(guid)
+        if rows is None:
             return None
-        rows = _ub / (
-            node.weight_shapes[0].dims[-1].size
-            * node.weight_shapes[0].dtype.size_bytes
-        )
         # rows shard over dp (batch), the row dim over ch: the rows x dim
         # product divides by dp*ch either way
         f, b = self.cm.sparse_embedding_op_cost(
@@ -343,7 +339,8 @@ class UnitySearch:
         # a group is every ch-th device — possibly crossing nodes)
         if self.include_backward and node.weight_shapes:
             ub, sparse_rows = self._update_bytes(guid)
-            if not sparse_rows:
+            group = opt.view.device_ids()[:: opt.ch]
+            if sparse_rows is None:
                 # the sparse fast path never materializes a table-sized
                 # gradient, so eligible tables pay NO grad all-reduce —
                 # matching simulator.estimate_graph_cost's basis exactly
@@ -351,29 +348,37 @@ class UnitySearch:
                     sum(s.volume() * eb(s) for s in node.weight_shapes)
                     / opt.ch
                 )
-                group = opt.view.device_ids()[:: opt.ch]
                 t += self.cm.all_reduce(w_bytes, opt.dp, chips=group)
+            else:
+                # the dp replicas must still exchange touched rows
+                # (batch-sharded ids scatter into a shared table): an
+                # all-gather of rows x dim over the dp group
+                t += self.cm.sparse_sync_cost(
+                    ub / (opt.dp * opt.ch), opt.dp, chips=group
+                )
             # optimizer update traffic (CostModel.update_time_from_bytes,
             # the same formula/basis as estimate_graph_cost): without it
             # the engines' absolute step times are not comparable to the
             # mesh candidates and weight-heavy dp looks free
-            per_chip = ub / opt.ch / (opt.dp if sparse_rows else 1)
+            per_chip = ub / opt.ch / (opt.dp if sparse_rows is not None else 1)
             t += self.cm.update_time_from_bytes(per_chip)
         return t
 
-    def _update_bytes(self, guid: int) -> Tuple[float, bool]:
-        """(bytes basis, divides-by-dp) for the optimizer-update term:
-        full MASTER-precision weight bytes normally (optimizer state is
-        f32 under mixed precision — matching CostModel.update_cost's
+    def _update_bytes(self, guid: int) -> Tuple[float, Optional[float]]:
+        """(bytes basis, touched rows | None) for the optimizer-update
+        term: full MASTER-precision weight bytes normally (optimizer state
+        is f32 under mixed precision — matching CostModel.update_cost's
         piece_bytes basis); touched-rows bytes for tables on the sparse
         fast path (core.pcg.trace_embedding_ids_input — rows follow the
-        batch sharding, hence the dp division). Per-guid constant,
-        cached."""
+        batch sharding, hence the dp division). The row count rides along
+        so consumers never invert the byte formula (ADVICE r4: one
+        formula, not a formula and its hand-written inverse). Per-guid
+        constant, cached."""
         hit = self._ubytes_cache.get(guid)
         if hit is not None:
             return hit
         node = self.graph.nodes[guid]
-        out: Tuple[float, bool]
+        out: Tuple[float, Optional[float]]
         ref = (
             trace_embedding_ids_input(self.graph, guid)
             if self.cm.sparse_embedding
@@ -382,11 +387,10 @@ class UnitySearch:
         if ref is not None:
             ids_shape = self.graph.shape_of(ref)
             w = node.weight_shapes[0]
+            rows = float(ids_shape.volume())
             out = (
-                float(
-                    ids_shape.volume() * w.dims[-1].size * w.dtype.size_bytes
-                ),
-                True,
+                rows * w.dims[-1].size * w.dtype.size_bytes,
+                rows,
             )
         else:
             out = (
@@ -396,7 +400,7 @@ class UnitySearch:
                         for s in node.weight_shapes
                     )
                 ),
-                False,
+                None,
             )
         self._ubytes_cache[guid] = out
         return out
@@ -488,7 +492,7 @@ class UnitySearch:
         guids = sorted(self.graph.nodes)
         index = {g: i for i, g in enumerate(guids)}
         batch, chan, flops, bytes_moved, wbytes, bwd = [], [], [], [], [], []
-        ubytes, u_dp_scaled = [], []
+        ubytes, u_dp_scaled, sbytes = [], [], []
         edges = []
         eb = self.cm.elem_bytes  # byte counts reach the solver pre-scaled,
         # so the native path is dtype/mixed-precision aware for free and the
@@ -506,6 +510,7 @@ class UnitySearch:
                 bwd.append(0.0)
                 ubytes.append(0.0)
                 u_dp_scaled.append(0)
+                sbytes.append(0.0)
             else:
                 flops.append(op_flops(node.op_type, in_shapes, node.params))
                 data = sum(s.volume() * eb(s) for s in in_shapes)
@@ -519,22 +524,27 @@ class UnitySearch:
                 bwd.append(3.0 if mxu else 2.0)
                 if node.weight_shapes:
                     ub, sparse_rows = self._update_bytes(g)
+                    sparse = sparse_rows is not None
                     ubytes.append(ub)
-                    u_dp_scaled.append(1 if sparse_rows else 0)
+                    u_dp_scaled.append(1 if sparse else 0)
                     # sparse-eligible tables never materialize a grad:
                     # no all-reduce term (wbytes drives sync in the
-                    # native op_cost, unity_dp.cc)
+                    # native op_cost, unity_dp.cc) — but the dp replicas
+                    # all-gather the touched rows (sbytes, same term as
+                    # op_cost's sparse_sync_cost)
                     wbytes.append(
                         0.0
-                        if sparse_rows
+                        if sparse
                         else sum(
                             s.volume() * eb(s) for s in node.weight_shapes
                         )
                     )
+                    sbytes.append(ub if sparse else 0.0)
                 else:
                     ubytes.append(0.0)
                     u_dp_scaled.append(0)
                     wbytes.append(0.0)
+                    sbytes.append(0.0)
             for r in node.inputs:
                 if r.guid in index:
                     shape = self.graph.shape_of(r)
@@ -562,6 +572,7 @@ class UnitySearch:
             index[sink],
             ubytes=ubytes,
             u_dp_scaled=u_dp_scaled,
+            sbytes=sbytes,
             update_factor=self.cm.update_traffic_factor(),
             allow_subblock=self.allow_subblock_views,
             measured=[

@@ -14,6 +14,7 @@
 // Semantics mirror unity.py exactly (equivalence-tested from Python):
 //   op cost   = max(flops/n / peak, bytes/n / hbm) * bwd_mult
 //             + ring_all_reduce(wbytes / ch, dp)
+//             + ring_all_gather(sbytes / (dp*ch), dp)   (sparse row sync)
 //             + ufactor * (ubytes / ch [/ dp if u_dp_scaled]) / hbm  (optim.)
 //   xfer cost = 0 if views equal else all_to_all(bytes / ndst, max(ns, nd))
 //   views     = 1-D data views (n | block, batch % n == 0, block-tileable)
@@ -71,6 +72,9 @@ struct NodeInfo {
                     // whose wbytes is then 0 — no grad all-reduce)
   int u_dp_scaled;  // 1: update traffic divides by dp too (sparse rows
                     // follow the batch sharding, not the weight layout)
+  double sbytes;    // sparse touched-row bytes basis: the dp replicas
+                    // all-gather rows x dim before the scatter-update
+                    // (unity.py CostModel.sparse_sync_cost)
 };
 
 struct MeasuredView {
@@ -106,6 +110,12 @@ double all_to_all(const Machine &m, double bytes_per_chip, int g) {
   return wire / m.ici + (g - 1) * m.lat;
 }
 
+double ring_all_gather(const Machine &m, double bytes_per_chip, int g) {
+  if (g <= 1 || bytes_per_chip <= 0) return 0.0;
+  double wire = (double)(g - 1) * bytes_per_chip;
+  return wire / m.ici + (g - 1) * m.lat;
+}
+
 double op_cost(const Problem &p, int node, View v) {
   const NodeInfo &ni = p.nodes[node];
   if (ni.bwd_mult <= 0.0) return 0.0;
@@ -123,6 +133,9 @@ double op_cost(const Problem &p, int node, View v) {
     t = (t_f > t_m ? t_f : t_m) * ni.bwd_mult;
   }
   if (ni.wbytes > 0) t += ring_all_reduce(p.m, ni.wbytes / v.ch, v.dp);
+  // sparse tables: touched-row all-gather over the dp replicas
+  if (ni.sbytes > 0)
+    t += ring_all_gather(p.m, ni.sbytes / (v.dp * v.ch), v.dp);
   if (ni.ubytes > 0) {
     // optimizer update HBM traffic (CostModel.update_traffic_factor)
     double per_chip = ni.ubytes / v.ch;
@@ -609,6 +622,7 @@ int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
                  const double *flops, const double *bytes_moved,
                  const double *wbytes, const double *bwd_mult,
                  const double *ubytes, const int32_t *u_dp_scaled,
+                 const double *sbytes,
                  double update_factor, int allow_subblock,
                  int n_measured, const int32_t *meas_node,
                  const int32_t *meas_dp, const int32_t *meas_ch,
@@ -633,7 +647,8 @@ int ffn_unity_dp(int n_nodes, int n_edges, const int32_t *esrc,
   p.nodes.resize(n_nodes);
   for (int i = 0; i < n_nodes; ++i)
     p.nodes[i] = {batch[i], chan[i], flops[i], bytes_moved[i], wbytes[i],
-                  bwd_mult[i], ubytes[i], u_dp_scaled[i]};
+                  bwd_mult[i], ubytes[i], u_dp_scaled[i],
+                  sbytes ? sbytes[i] : 0.0};
   p.preds.assign(n_nodes, {});
   p.succs.assign(n_nodes, {});
   p.in_edges.assign(n_nodes, {});
