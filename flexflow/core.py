@@ -14,6 +14,65 @@ from flexflow_tpu import (  # noqa: F401
 from flexflow_tpu.core.types import AggrMode, PoolType  # noqa: F401
 
 
+def _install_reference_enum_aliases():
+    """The reference's cffi scripts spell enum members with their C
+    prefixes (reference: python/flexflow/type.py — DT_FLOAT,
+    LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, METRICS_ACCURACY, ...). Attach
+    those spellings as aliases on the shared enums so reference
+    native-python examples run unchanged; installed only when the compat
+    namespace loads."""
+    for ref, ours in {
+        "DT_BOOLEAN": DataType.BOOL,
+        "DT_INT32": DataType.INT32,
+        "DT_INT64": DataType.INT64,
+        "DT_HALF": DataType.HALF,
+        "DT_FLOAT": DataType.FLOAT,
+        "DT_DOUBLE": DataType.DOUBLE,
+    }.items():
+        if not hasattr(DataType, ref):
+            setattr(DataType, ref, ours)
+    for member in LossType:
+        name = "LOSS_" + member.name
+        if not hasattr(LossType, name):
+            setattr(LossType, name, member)
+    for member in MetricsType:
+        name = "METRICS_" + member.name
+        if not hasattr(MetricsType, name):
+            setattr(MetricsType, name, member)
+
+
+_install_reference_enum_aliases()
+
+
+def _model_first(args, kwargs):
+    """reference cffi optimizer ctors take the ffmodel first
+    (flexflow_cffi.py SGDOptimizer(ffmodel, lr)); drop it (None is an
+    accepted model slot too, like the reference's nullable handle)."""
+    from flexflow_tpu import FFModel as _FFModel
+
+    if args and (args[0] is None or isinstance(args[0], _FFModel)):
+        return args[1:], kwargs
+    return args, kwargs
+
+
+def SGDOptimizer(*args, **kwargs):  # noqa: F811 — compat shadowing
+    from flexflow_tpu import SGDOptimizer as _SGD
+
+    args, kwargs = _model_first(args, kwargs)
+    names = ("lr", "momentum", "nesterov", "weight_decay")
+    kwargs.update(zip(names, args))
+    return _SGD(**kwargs)
+
+
+def AdamOptimizer(*args, **kwargs):  # noqa: F811 — compat shadowing
+    from flexflow_tpu import AdamOptimizer as _Adam
+
+    args, kwargs = _model_first(args, kwargs)
+    names = ("alpha", "beta1", "beta2", "weight_decay", "epsilon")
+    kwargs.update(zip(names, args))
+    return _Adam(**kwargs)
+
+
 def init_flexflow_runtime(*args, **kwargs):
     """reference: starts the Legion runtime; a no-op here (XLA needs no
     runtime bring-up)."""

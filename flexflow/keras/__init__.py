@@ -1,9 +1,12 @@
 from flexflow.keras import (  # noqa: F401
     backend,
     callbacks,
+    datasets,
     initializers,
+    layers,
     losses,
     metrics,
+    models,
     optimizers,
     utils,
 )

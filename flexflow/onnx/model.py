@@ -1,1 +1,4 @@
-from flexflow_tpu.frontends.onnx_model import ONNXModel  # noqa: F401
+from flexflow_tpu.frontends.onnx_model import (  # noqa: F401
+    ONNXModel,
+    ONNXModelKeras,
+)

@@ -114,6 +114,13 @@ class FFConfig:
 
         return len(jax.devices())
 
+    def get_current_time(self) -> float:
+        """reference: FFConfig.get_current_time (flexflow_cffi.py) —
+        microseconds; scripts compute 1e-6*(end-start) for seconds."""
+        import time
+
+        return time.perf_counter() * 1e6
+
     @staticmethod
     def parse_args(argv: Optional[Sequence[str]] = None) -> "FFConfig":
         """Parse the reference's CLI spellings (model.cc:3541-3697)."""

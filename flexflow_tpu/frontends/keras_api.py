@@ -211,6 +211,41 @@ class Layer:
     def build(self, ff: FFModel, tensors):
         raise NotImplementedError
 
+    def _weight_guid(self, ffmodel):
+        """PCG guid of this layer's (first) lowered op — set once the
+        model is compiled (output_tensors recorded by Model._lower)."""
+        outs = getattr(self, "output_tensors", None)
+        if not outs:
+            raise RuntimeError(
+                f"layer {self.name or type(self).__name__} has no lowered "
+                "op; compile the model first"
+            )
+        return outs[0].ref.guid
+
+    def get_weights(self, ffmodel):
+        """reference: Layer.get_weights(ffmodel) → per-weight numpy copies
+        (net2net teacher→student transfer,
+        examples/python/keras/func_mnist_mlp_net2net.py)."""
+        import numpy as _np
+
+        guid = self._weight_guid(ffmodel)
+        return tuple(_np.asarray(w) for w in ffmodel.params.get(guid, ()))
+
+    def set_weights(self, ffmodel, *weights):
+        """reference: Layer.set_weights(ffmodel, kernel[, bias])."""
+        import jax.numpy as _jnp
+
+        guid = self._weight_guid(ffmodel)
+        cur = ffmodel.params.get(guid, [])
+        if len(weights) != len(cur):
+            raise ValueError(
+                f"layer expects {len(cur)} weight arrays, got {len(weights)}"
+            )
+        ffmodel.params[guid] = [
+            _jnp.asarray(w, dtype=c.dtype).reshape(c.shape)
+            for w, c in zip(weights, cur)
+        ]
+
 
 class Node:
     """Functional-API handle: a layer applied to upstream nodes."""
@@ -608,6 +643,16 @@ class Model:
 
         for out in self._outputs:
             visit(out)
+        # fit()'s x list follows the DECLARED Model(inputs=[...]) order,
+        # which can differ from graph-discovery order (the engine's
+        # _input_order) when a later input is reached first — e.g.
+        # Multiply()([nx1, nx0]) (reference:
+        # examples/python/keras/elementwise_mul_broadcast.py)
+        self._input_names = [
+            ff.graph.nodes[built[id(node)].ref.guid].name
+            for node in self._inputs
+            if id(node) in built
+        ]
         return ff
 
     def get_layer(self, name=None, index=None):
@@ -658,6 +703,17 @@ class Model:
             return y.reshape(y.shape[:-1])
         return y
 
+    def _name_inputs(self, x):
+        """Zip a positional x list with the DECLARED input order (see
+        _lower's _input_names note)."""
+        names = getattr(self, "_input_names", None)
+        if not names or isinstance(x, dict):
+            return x
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        if len(xs) != len(names):
+            return x  # let the engine's arity error speak
+        return dict(zip(names, xs))
+
     def fit(self, x, y, epochs=1, batch_size: Optional[int] = None,
             callbacks=None, **kw):
         if self.ffmodel is None:
@@ -667,7 +723,7 @@ class Model:
             # model (engine reachable as .ffmodel, keras/callbacks.py:69)
             cb.set_model(self)
         return self.ffmodel.fit(
-            x, self._squeeze_labels(y), epochs=epochs,
+            self._name_inputs(x), self._squeeze_labels(y), epochs=epochs,
             batch_size=batch_size, callbacks=callbacks, **kw,
         )
 
@@ -676,8 +732,8 @@ class Model:
         for cb in callbacks or []:
             cb.set_model(self)
         return self.ffmodel.evaluate(
-            x, self._squeeze_labels(y), batch_size=batch_size,
-            callbacks=callbacks
+            self._name_inputs(x), self._squeeze_labels(y),
+            batch_size=batch_size, callbacks=callbacks
         )
 
     def __call__(self, *inputs):
@@ -708,7 +764,13 @@ class Model:
 
     def summary(self):
         if self.ffmodel is None:
-            raise RuntimeError("call compile() first")
+            # reference scripts print summaries BEFORE compile too
+            # (seq_mnist_cnn_nested.py) — describe the declared structure
+            layers = getattr(self, "layers", None) or self._outputs
+            return (
+                f"<{type(self).__name__}: {len(layers)} declared "
+                "layers (uncompiled)>"
+            )
         return repr(self.ffmodel.graph)
 
 
@@ -720,17 +782,52 @@ class Sequential(Model):
     def add(self, layer):
         self.layers.append(layer)
 
+    @staticmethod
+    def _declared_input_shape(layer):
+        """input_shape declared by a leading layer — directly (keras
+        idiom: Dense(512, input_shape=(784,)),
+        examples/python/keras/seq_mnist_mlp.py) or through a nested
+        Sequential's own first layer (seq_mnist_cnn_nested.py)."""
+        shape = getattr(layer, "input_shape", None)
+        if shape:
+            return shape
+        if isinstance(layer, Sequential) and layer.layers:
+            return Sequential._declared_input_shape(layer.layers[0])
+        return None
+
+    def _chain(self, node):
+        """Wire self.layers after `node`; nested Models/Sequentials are
+        applied as callables (reference: models are layers,
+        seq_mnist_cnn_nested.py builds Sequential([model1, model2]))."""
+        for layer in self.layers:
+            if isinstance(layer, Node):
+                continue  # a leading Input; the chain is already rooted
+            node = layer(node) if isinstance(layer, Model) else Node(
+                layer, [node]
+            )
+        return node
+
+    def __call__(self, *inputs):
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        if len(inputs) != 1:
+            raise ValueError("Sequential models take exactly one input")
+        return self._chain(inputs[0])
+
     def compile(self, *args, **kw):
         if not self.layers:
             raise ValueError("Sequential model has no layers")
         first = self.layers[0]
         if isinstance(first, Node):
-            node = first
-            rest = self.layers[1:]
+            inp = first
         else:
-            raise ValueError("first layer must be keras_api.Input(shape=...)")
-        for layer in rest:
-            node = Node(layer, [node])
-        self._inputs = [first]
-        self._outputs = [node]
+            shape = self._declared_input_shape(first)
+            if not shape:
+                raise ValueError(
+                    "first layer needs input_shape=(...) or an explicit "
+                    "keras_api.Input(shape=...)"
+                )
+            inp = Input(shape=tuple(shape))
+        self._inputs = [inp]
+        self._outputs = [self._chain(inp)]
         super().compile(*args, **kw)

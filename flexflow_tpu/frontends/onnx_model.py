@@ -296,3 +296,13 @@ class ONNXModel:
 
         outputs = [env[o.name] for o in self.model.graph.output if o.name in env]
         return outputs if len(outputs) != 1 else outputs[0]
+
+
+class ONNXModelKeras(ONNXModel):
+    """Keras-exported ONNX graphs (reference: flexflow/onnx/model.py:339 —
+    same replay, reference ctor spelling (filename, ffconfig, ffmodel);
+    keras exporters emit dense kernels as initializers the base replay
+    already resolves through _const_array)."""
+
+    def __init__(self, path_or_proto, ffconfig=None, ffmodel=None):
+        super().__init__(path_or_proto)

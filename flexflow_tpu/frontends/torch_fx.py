@@ -286,6 +286,26 @@ class PyTorchModel:
         # op name -> (guid, kind) for weight transfer
         self.node_map: Dict[str, object] = {}
 
+    def torch_to_file(self, filename: str):
+        """Reference-name spelling for the .ff export step
+        (reference: PyTorchModel.torch_to_file, flexflow/torch/model.py —
+        examples/python/pytorch/mnist_mlp_torch.py calls exactly this)."""
+        if self.module is None:
+            with open(filename, "w") as f:
+                json.dump(
+                    {"format": "flexflow_tpu.torch_fx.v1", "ops": self.ops},
+                    f,
+                    indent=1,
+                )
+            return self.ops
+        return torch_to_flexflow(self.module, filename)
+
+    @staticmethod
+    def file_to_ff(filename: str, ffmodel, input_tensors: Sequence):
+        """Reference-name spelling for the replay step (reference:
+        PyTorchModel.file_to_ff — examples/python/pytorch/mnist_mlp.py)."""
+        return PyTorchModel(filename).apply(ffmodel, input_tensors)
+
     def apply(self, ffmodel, input_tensors: Sequence):
         """input_tensors: FFModel Tensors matching placeholder order (image
         inputs in torch NCHW layout)."""
@@ -435,7 +455,14 @@ class PyTorchModel:
                 }[fn](env[ins[0]], name=name)
                 is_channels_first[name] = is_channels_first.get(ins[0], False)
             elif op == "softmax":
-                env[name] = ffmodel.softmax(env[ins[0]], dim=p.get("dim", -1), name=name)
+                dim = p.get("dim", -1)
+                if dim is None:
+                    # torch nn.Softmax(dim=None) legacy pick
+                    # (torch.nn.functional._get_softmax_dim): 0 for
+                    # 0/1/3-d inputs, else 1
+                    ndim = len(env[ins[0]].shape.logical_sizes)
+                    dim = 0 if ndim in (0, 1, 3) else 1
+                env[name] = ffmodel.softmax(env[ins[0]], dim=dim, name=name)
             elif op == "flatten":
                 x = env[ins[0]]
                 # restore torch's NCHW element order before collapsing:

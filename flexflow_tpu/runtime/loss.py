@@ -19,6 +19,11 @@ def compute_loss(loss_type: LossType, logits, labels, from_logits=True):
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         else:
             logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-12, 1.0))
+        if labels.ndim == logits.ndim and labels.shape[-1] == 1:
+            # the reference's label tensor is [batch, 1] (sparse class
+            # index per sample, loss_functions.cc) — native-python
+            # scripts reshape labels that way; squeeze to index form
+            labels = labels[..., 0]
         ll = jnp.take_along_axis(
             logp, labels.astype(jnp.int32)[..., None], axis=-1
         )[..., 0]

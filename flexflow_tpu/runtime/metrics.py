@@ -27,6 +27,15 @@ def compute_metrics(
     n = logits.shape[0] if logits.ndim > 0 else 1
     out["num_samples"] = jnp.asarray(n, jnp.float32)
 
+    if (
+        labels.ndim == logits.ndim
+        and labels.shape[-1] == 1
+        and logits.shape[-1] != 1
+    ):
+        # reference label tensors are [batch, 1] sparse class indices
+        # (loss_functions.cc) — squeeze so they aren't read as one-hot
+        labels = labels[..., 0]
+
     def _logp():
         x = jnp.asarray(logits, jnp.float32)
         if from_logits:

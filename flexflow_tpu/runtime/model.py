@@ -61,6 +61,23 @@ class Tensor:
         return f"Tensor(guid={self.ref.guid}, {self.shape})"
 
 
+class TensorDataLoader:
+    """Handle returned by FFModel.create_data_loader (reference:
+    SingleDataLoader, flexflow_cffi.py:2281 — the full dataset bound to
+    one tensor; fit() consumes these per-tensor handles)."""
+
+    def __init__(self, name: str, array):
+        self.name = name
+        self.array = np.asarray(array)
+        self.num_samples = int(self.array.shape[0])
+
+    def __repr__(self):
+        return (
+            f"TensorDataLoader({self.name!r}, {self.array.shape}, "
+            f"{self.array.dtype})"
+        )
+
+
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
         _ensure_registered()
@@ -685,7 +702,11 @@ class FFModel:
                 "pipeline_apply (GPipe over a 'pipe' mesh axis).",
                 stacklevel=2,
             )
-        self.optimizer = optimizer or SGDOptimizer(
+        # a pre-assigned `ffmodel.optimizer = ...` survives a compile()
+        # without an optimizer argument (reference native-python idiom,
+        # flexflow_cffi.py — examples/python/pytorch/mnist_mlp.py sets the
+        # attribute then calls compile(loss_type=..., metrics=...))
+        self.optimizer = optimizer or self.optimizer or SGDOptimizer(
             lr=self.config.learning_rate,
             weight_decay=self.config.weight_decay,
         )
@@ -1080,6 +1101,22 @@ class FFModel:
         return perf if perf is not None else PerfMetrics()
 
     def _pack_dataset(self, x, y) -> Dict[str, np.ndarray]:
+        # reference native-python scripts pass the handles returned by
+        # create_data_loader (flexflow_cffi.py fit(x=dataloader_input,
+        # y=dataloader_label)); unwrap them to the named arrays
+        if isinstance(x, TensorDataLoader):
+            x = {x.name: x.array}
+        elif isinstance(x, (list, tuple)) and any(
+            isinstance(v, TensorDataLoader) for v in x
+        ):
+            if not all(isinstance(v, TensorDataLoader) for v in x):
+                raise TypeError(
+                    "fit(x=[...]) mixes create_data_loader handles with "
+                    "raw arrays; pass all loaders or all arrays"
+                )
+            x = {v.name: v.array for v in x}
+        if isinstance(y, TensorDataLoader):
+            y = y.array
         if isinstance(x, dict):
             arrays = dict(x)
         else:
@@ -1105,6 +1142,40 @@ class FFModel:
                     arrays[name] = np.asarray(arr).astype(np_dt)
         arrays["label"] = y
         return arrays
+
+    # reference native-python dataloader surface (flexflow_cffi.py:2050
+    # create_data_loader → SingleDataLoader; the compat namespace's
+    # examples pass these handles straight into fit/evaluate)
+    def create_data_loader(self, tensor, array) -> "TensorDataLoader":
+        """reference: FFModel.create_data_loader(batch_tensor, numpy) —
+        binds a full dataset array to one input tensor; None (the
+        label_tensor handle) binds the label slot."""
+        if tensor is None:
+            return TensorDataLoader("label", array)
+        node = (
+            self.graph.nodes.get(tensor.ref.guid)
+            if getattr(tensor, "ref", None) is not None
+            else None
+        )
+        if node is None or node.op_type != OperatorType.INPUT:
+            raise ValueError(
+                "create_data_loader takes an INPUT tensor (or None for "
+                f"the label), got {tensor!r}"
+            )
+        return TensorDataLoader(node.name, array)
+
+    @property
+    def label_tensor(self):
+        """reference: flexflow_model_get_label_tensor — the label tensor
+        created at compile to match the final op's shape; here a named
+        handle create_data_loader recognizes."""
+        if self.executor is None:
+            raise RuntimeError("call compile() before label_tensor")
+        return None  # create_data_loader(None, y) binds the label slot
+
+    def init_layers(self):
+        """reference spelling of init_operators (flexflow_cffi.py)."""
+        return self.init_operators()
 
     # compat verbs (reference training loop: forward/zero_gradients/backward/
     # update — subsumed by the fused jitted step; provided for ported scripts)

@@ -67,7 +67,12 @@ _OP_FAMILY = {
     OperatorType.CONV2D: "conv",
     OperatorType.LINEAR: "dense",
     OperatorType.BATCHMATMUL: "dense",
-    OperatorType.MULTIHEAD_ATTENTION: "dense",
+    # attention gets its OWN family (round 5): the isolated chunked-scan
+    # measurement over-reads the in-context cost ~1.5x while plain dense
+    # stacks read ~0.9x — opposite biases one shared "dense" scale was
+    # splitting the difference on (scripts/probe_attn_pricing.py:
+    # attn-only 1.50, mlp-only 0.92, full flagship 1.43)
+    OperatorType.MULTIHEAD_ATTENTION: "attention",
     OperatorType.EMBEDDING: "embed",
 }
 
@@ -78,14 +83,60 @@ def op_family(op_type) -> Optional[str]:
     return _OP_FAMILY.get(op_type)
 
 
-def update_calibration_doc(path: str, updates: dict, chip: str = ""):
+def shard_batch(input_shapes) -> Optional[int]:
+    """Leading (sample) dim piece size of the first input — the batch key
+    for the per-regime family correction (family_scale_for)."""
+    for s in input_shapes:
+        for d in s.dims:
+            if not d.is_replica_dim:
+                return int(d.piece_size)
+    return None
+
+
+def update_calibration_doc(
+    path: str, updates: dict, chip: str = "", replace=(), ops_keep=None
+):
     """Read-merge-atomic-write of the calibration table — the ONE home for
     this logic (CostModel flushes, calibrate.py --tune-flash/--fit-family
     all write through here). Tolerates a missing/corrupt file; a doc
     measured on a DIFFERENT chip is dropped, not relabeled (its ops/
     family_scale/flash_blocks would silently mis-tune the new chip).
     Dict-valued updates shallow-merge into the existing value so partial
-    writers (a one-family --fit-family run) don't wipe sibling entries."""
+    writers (a one-family --fit-family run) don't wipe sibling entries;
+    keys named in `replace` are OVERWRITTEN instead. `ops_keep` (a set of
+    keys) filters the 'ops' table INSIDE the lock after merging —
+    calibrate.py --prune drops stale shape-signature formats and
+    abandoned configs without racing a concurrent writer's fresh keys (a
+    snapshot taken outside the lock could overwrite them).
+
+    Concurrent writers (two searches sharing one table) are serialized by
+    an fcntl lock on `path + ".lock"` around the read-merge-write, so
+    neither loses the other's freshly measured keys. Same-host only — the
+    lock does not protect a table on NFS."""
+    import json
+    import os
+
+    lock = None
+    try:
+        import fcntl
+
+        lock = open(path + ".lock", "w")
+        fcntl.flock(lock, fcntl.LOCK_EX)
+    except (ImportError, OSError):
+        if lock is not None:
+            lock.close()  # opened but unlockable (some network mounts)
+        lock = None  # non-POSIX: single-writer assumption applies
+
+    try:
+        return _update_calibration_doc_locked(
+            path, updates, chip, replace, ops_keep
+        )
+    finally:
+        if lock is not None:
+            lock.close()
+
+
+def _update_calibration_doc_locked(path, updates, chip, replace, ops_keep):
     import json
     import os
 
@@ -119,10 +170,18 @@ def update_calibration_doc(path: str, updates: dict, chip: str = ""):
     if chip:
         doc["chip"] = chip
     for key, val in updates.items():
-        if isinstance(val, dict) and isinstance(doc.get(key), dict):
+        if (
+            key not in replace
+            and isinstance(val, dict)
+            and isinstance(doc.get(key), dict)
+        ):
             doc[key].update(val)
         else:
             doc[key] = val
+    if ops_keep is not None:
+        doc["ops"] = {
+            k: v for k, v in doc.get("ops", {}).items() if k in ops_keep
+        }
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1)
@@ -314,7 +373,9 @@ class CostModel:
                 node.op_type, node.params, input_shapes, node.weight_shapes
             )
             if times is not None:
-                times = self.corrected_times(node.op_type, times)
+                times = self.corrected_times(
+                    node.op_type, times, batch=shard_batch(input_shapes)
+                )
                 return OpCost(times[0], times[1], 0.0, mem)
 
         fwd = self._roofline(flops, bytes_moved)
@@ -436,19 +497,47 @@ class CostModel:
             [(op_type, params, in_shapes, weight_shapes, 0)]
         )
 
+    def family_scale_for(self, fam: str, batch=None) -> float:
+        """Fitted residual scale for a family, optionally at a shard
+        batch size. A float entry is the constant (geomean) scale; a
+        dict entry is the per-batch-REGIME table
+        ({"8": s8, "16": s16, ..., "*": geomean}) fitted by
+        calibrate.py --fit-family: the conv/attention residual is
+        SHAPE-dependent (conv 1.01/1.63/0.82 across bs16/32/64,
+        attention 1.46/1.00/1.04 across bs8/16/32 — reproduced across
+        rounds 3-5), so a constant can only center the ladder; the
+        regime table zeroes each measured point and nearest-bucket
+        interpolates between (round-4 VERDICT weak #6 / ask #3)."""
+        entry = self._family_scale.get(fam, 1.0)
+        if isinstance(entry, dict):
+            star = entry.get("*", 1.0)
+            if batch is None:
+                return float(star) or 1.0
+            buckets = [
+                (abs(int(k) - batch), float(v))
+                for k, v in entry.items()
+                if k != "*" and float(v) > 0
+            ]
+            if not buckets:
+                return float(star) or 1.0
+            return min(buckets)[1]
+        return float(entry) or 1.0
+
     def corrected_times(
-        self, op_type, times: Optional[Tuple[float, float]]
+        self, op_type, times: Optional[Tuple[float, float]], batch=None
     ) -> Optional[Tuple[float, float]]:
-        """Divide a measured (fwd, bwd) by the op's fitted family residual.
-        Callers that bypass op_cost (the simulator's epilogue-chain
-        measurement — the path the conv residual was fitted FOR) must
-        route their raw measurements through here too."""
+        """Divide a measured (fwd, bwd) by the op's fitted family residual
+        (constant or batch-regime, family_scale_for). Callers that bypass
+        op_cost (the simulator's epilogue-chain measurement — the path
+        the conv residual was fitted FOR) must route their raw
+        measurements through here too, passing the shard batch when they
+        know it."""
         if times is None:
             return times
         fam = op_family(op_type)
         scale = 1.0
         if self.family_correction and fam:
-            scale = self._family_scale.get(fam, 1.0) or 1.0
+            scale = self.family_scale_for(fam, batch)
         times = (times[0] / scale, times[1] / scale)
         if fam:
             self.family_time[fam] = (
@@ -701,6 +790,10 @@ class CostModel:
                 bwd = (2.0 if op_type in _MXU_OPS else 1.0) * fwd
             return (fwd, bwd)
         except Exception:
+            import os
+
+            if os.environ.get("FFTPU_MEASURE_DEBUG"):
+                raise  # surface the real error instead of a None fallback
             return None
 
     # -- optimizer update ----------------------------------------------------
@@ -810,6 +903,15 @@ class CostModel:
         for fam, scale in doc.get("family_scale", {}).items():
             if isinstance(scale, (int, float)) and scale > 0:
                 self._family_scale[fam] = float(scale)
+            elif isinstance(scale, dict) and scale:
+                # per-batch-regime table (family_scale_for)
+                clean = {
+                    str(k): float(v)
+                    for k, v in scale.items()
+                    if isinstance(v, (int, float)) and v > 0
+                }
+                if clean:
+                    self._family_scale[fam] = clean
 
     def _save_calibration(self):
         # merged write (update_calibration_doc): other writers own sibling

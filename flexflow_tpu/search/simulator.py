@@ -332,7 +332,12 @@ def estimate_graph_cost(
                         0,
                     )
                 )
-            mt = cm.corrected_times(node.op_type, cm.measure_shard_chain(specs))
+            from flexflow_tpu.search.cost_model import shard_batch as _sb
+
+            mt = cm.corrected_times(
+                node.op_type, cm.measure_shard_chain(specs),
+                batch=_sb(head_ins),
+            )
             if mt is None:
                 continue
             chain_cost[guid] = mt

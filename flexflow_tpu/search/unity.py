@@ -272,9 +272,12 @@ class UnitySearch:
             # every raw measurement consumer, or unity/mcmc (and the
             # native DP LUT built from this) would rank cross-family
             # candidates with the bias the correction removes
+            from flexflow_tpu.search.cost_model import shard_batch
+
             times = self.cm.corrected_times(
                 node.op_type,
                 self.cm.measure_shard(node.op_type, params, shard_ins, ws),
+                batch=shard_batch(shard_ins),
             )
             if times is None:
                 return None

@@ -132,27 +132,82 @@ def build_dlrm_wl(batch):
     return ff, data
 
 
+def _build_stack_wl(batch, mode):
+    """Single-family transformer variants for --fit-family: the flagship
+    mixes attention (over-measured ~1.5x in isolation) with dense
+    (~0.9x), so fitting either family from the FULL step misattributes
+    the other's bias into the remainder term (fit_family_scales drops
+    such rows as no-signal). attention-only and mlp-only stacks give
+    each family a clean ladder (scripts/probe_attn_pricing.py)."""
+    from flexflow_tpu import (
+        ActiMode, FFConfig, FFModel, LossType, SGDOptimizer,
+    )
+
+    cfg = FFConfig(batch_size=batch, learning_rate=0.01)
+    cfg.chip = CHIP
+    cfg.allow_mixed_precision = True
+    model = FFModel(cfg)
+    x = model.create_tensor([batch, 512, 1024], name="x")
+    t = x
+    for _ in range(12):
+        if mode == "attn":
+            t = model.multihead_attention(t, t, t, 1024, 16)
+        else:
+            t = model.dense(t, 1024, activation=ActiMode.RELU, use_bias=False)
+            t = model.dense(t, 1024, use_bias=False)
+    t = model.dense(t, 1, use_bias=False)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+    )
+    rng = np.random.RandomState(0)
+    data = {
+        "x": rng.randn(batch, 512, 1024).astype(np.float32),
+        "label": rng.randn(batch, 512, 1).astype(np.float32),
+    }
+    return model, data
+
+
+def build_attention_wl(batch):
+    return _build_stack_wl(batch, "attn")
+
+
+def build_mlp_wl(batch):
+    return _build_stack_wl(batch, "mlp")
+
+
 WORKLOADS = {
     "transformer": (build_transformer_wl, 8),
     "resnet": (build_resnet_wl, 16),
     "dlrm": (build_dlrm_wl, 64),
+    "attention": (build_attention_wl, 8),
+    "mlp": (build_mlp_wl, 8),
 }
 
 
 # dominant measured-op family per workload (cost_model.op_family): the
 # full-step residual of each workload estimates its family's chain-
-# measurement bias
+# measurement bias. NOTE --fit-family should use the single-family
+# stacks (attention/mlp), not the mixed flagship: see _build_stack_wl.
+# dlrm is OMITTED from the default fit set — its sparse-eligible tables
+# price analytically (no measured kernel), so an embed scale can never
+# fit from it (fit_family_mode prints a no-signal notice if tried).
 WORKLOAD_FAMILY = {
-    "transformer": "dense",
+    "transformer": "attention",  # dominant family; fit prefers "attention"
     "resnet": "conv",
     "dlrm": "embed",
+    "attention": "attention",
+    "mlp": "dense",
 }
+
+FIT_FAMILY_DEFAULT = ["attention", "mlp", "resnet"]
 
 
 def fit_family_scales(rows):
-    """{family: geomean scale} over rows of (family, family_pred_s,
-    total_pred_s, measured_s) — the pure core of --fit-family
-    (unit-tested off-chip).
+    """{family: {"<batch>": scale, "*": geomean}} over rows of (family,
+    batch, family_pred_s, total_pred_s, measured_s) — the pure core of
+    --fit-family (unit-tested off-chip).
 
     Per row the scale solves for a ZERO full-step residual given the
     non-family remainder: corrected = (total - fam) + fam/s = measured
@@ -160,12 +215,17 @@ def fit_family_scales(rows):
     ratio out of only the family's ops would overcorrect whenever they
     are < 100% of the predicted step. Rows whose measured step is
     entirely explained by the remainder (denominator <= 0) carry no
-    family signal and are dropped. Geomean over a workload's batch
-    ladder damps the shape-dependence a single batch would bake in."""
+    family signal and are dropped.
+
+    The residual is SHAPE-dependent (conv 1.01/1.63/0.82 over its
+    ladder; attention 1.46/1.00/1.04), so each ladder point keeps its
+    own per-batch scale (CostModel.family_scale_for picks the nearest
+    bucket at costing time — round-4 VERDICT ask #3's batch-regime
+    term); "*" carries the geomean for off-ladder batches."""
     import math
 
     acc = {}
-    for fam, fam_pred, total_pred, meas in rows:
+    for fam, batch, fam_pred, total_pred, meas in rows:
         if not fam or not (fam_pred > 0) or not (meas > 0):
             continue
         target = meas - (total_pred - fam_pred)
@@ -179,11 +239,19 @@ def fit_family_scales(rows):
         # measurement, not a fusion effect — treat as no-signal
         if not (0.2 <= s <= 5.0):
             continue
-        acc.setdefault(fam, []).append(math.log(s))
-    return {
-        fam: round(math.exp(sum(logs) / len(logs)), 4)
-        for fam, logs in acc.items()
-    }
+        acc.setdefault(fam, {}).setdefault(
+            str(int(batch)), []
+        ).append(math.log(s))
+    out = {}
+    for fam, by_batch in acc.items():
+        table = {
+            b: round(math.exp(sum(logs) / len(logs)), 4)
+            for b, logs in by_batch.items()
+        }
+        all_logs = [v for logs in by_batch.values() for v in logs]
+        table["*"] = round(math.exp(sum(all_logs) / len(all_logs)), 4)
+        out[fam] = table
+    return out
 
 
 def fit_family_mode(names, calib):
@@ -209,8 +277,20 @@ def fit_family_mode(names, calib):
                 family_correction=False, return_cm=True,
             )
             fam_pred = cm.family_time.get(fam, 0.0)
+            if not fam_pred > 0:
+                # e.g. dlrm: sparse-eligible embeddings price analytically
+                # and never consume a measured kernel, so the ladder
+                # carries no family signal — skip the step measurement
+                # instead of burning chip time on a row the fitter would
+                # drop anyway (ADVICE r4)
+                print(
+                    f"[fit-family] {label}: no '{fam}' family signal "
+                    "(no measured kernels in this family) — skipped",
+                    flush=True,
+                )
+                continue
             actual = _measure_actual_step(model, data)
-            rows.append((fam, fam_pred, predicted, actual))
+            rows.append((fam, batch, fam_pred, predicted, actual))
             entries.append(
                 {"config": label, "family": fam,
                  "predicted_ms": round(predicted * 1e3, 3),
@@ -401,6 +481,7 @@ def main():
     rank = False
     tune_flash = False
     fit_family = False
+    prune = False
     i = 0
     while i < len(args):
         if args[i] == "--calibration-file":
@@ -415,11 +496,24 @@ def main():
             tune_flash = True
         elif args[i] == "--fit-family":
             fit_family = True
+        elif args[i] == "--prune":
+            prune = True
         elif args[i] in WORKLOADS:
             names.append(args[i])
         i += 1
-    names = names or list(WORKLOADS)
+    if fit_family and not names:
+        # single-family ladders only (see WORKLOAD_FAMILY note): the mixed
+        # flagship misattributes, dlrm carries no embed signal
+        names = list(FIT_FAMILY_DEFAULT)
+    names = names or ["transformer", "resnet", "dlrm"]
     os.makedirs(os.path.dirname(calib) or ".", exist_ok=True)
+    if prune and (tune_flash or fit_family or rank):
+        print(
+            "[calibrate] --prune only applies to the default calibration "
+            "mode (it keys liveness off that mode's measurements); "
+            "ignoring it here",
+            flush=True,
+        )
     if tune_flash:
         tune_flash_mode(calib)
         return
@@ -431,6 +525,7 @@ def main():
         return
 
     rows = []
+    _live_keys = set()
     for name in names:
         build, default_batch = WORKLOADS[name]
         batch = batch_override or default_batch
@@ -438,7 +533,10 @@ def main():
         model, data = build(batch)
         mixed = model.config.allow_mixed_precision
         print(f"[calibrate] measuring per-op kernels for {name}...", flush=True)
-        predicted, nkeys = _predict_step(model, calib, mixed)
+        predicted, nkeys, cm = _predict_step(
+            model, calib, mixed, return_cm=True
+        )
+        _live_keys |= set(cm._measured)
         print(
             f"[calibrate] {name}: {nkeys} measured op keys; "
             f"predicted step {predicted * 1e3:.3f} ms",
@@ -458,6 +556,20 @@ def main():
     for name, batch, p, a, r in rows:
         print(f"| {name} | {batch} | {p:.3f} | {a:.3f} | {r:.2f} |")
     print(f"\ncalibration table: {calib}")
+    if prune:
+        # drop ops keys THIS run didn't touch: stale shape-signature
+        # formats and abandoned configs otherwise accumulate forever
+        # (ADVICE r4). The filter runs inside update_calibration_doc's
+        # lock so a concurrent writer's fresh keys survive.
+        from flexflow_tpu.search.cost_model import update_calibration_doc
+
+        doc = update_calibration_doc(
+            calib, {}, chip=CHIP, ops_keep=_live_keys
+        )
+        print(
+            f"[calibrate] pruned ops table to {len(doc.get('ops', {}))} "
+            "live keys"
+        )
     print(
         json.dumps(
             {

@@ -1,0 +1,155 @@
+"""The cost model must price the attention lowering the executor actually
+runs (round-4 VERDICT: the search could mis-rank exactly the candidates
+that differ in attention regime if calibrate measured a different core
+than the step executes). measure_shard times ops.attention._lower_mha's
+FULL selection policy — these tests pin that the path traced during
+measurement is the path the executor's train step traces, per regime.
+
+The regimes (ops/attention.py selection, single device, seq unsharded):
+  mono    — monolithic dense below the 96 MB score cap
+  chunked — batch-chunked + remat dense past it
+  flash   — blockwise/tiled streaming at the >= 2 GiB band (forced here
+            by shrinking the threshold; the tiled kernel needs TPU and
+            falls back to the jnp blockwise path on CPU — in BOTH the
+            executor and the measurement, so parity still holds)
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu.ops.attention as A
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    SGDOptimizer,
+)
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.search.cost_model import CostModel
+
+
+class _Spy:
+    """Record which attention core runs; delegate to the real one."""
+
+    def __init__(self, monkeypatch):
+        self.calls = []
+        orig_mono = A.scaled_dot_product_attention
+        orig_chunk = A._chunked_dense_attention
+
+        def mono(*a, **k):
+            self.calls.append("mono")
+            return orig_mono(*a, **k)
+
+        def chunk(q, k_, v, causal, chunk_size):
+            self.calls.append("chunked")
+            return orig_chunk(q, k_, v, causal, chunk_size)
+
+        monkeypatch.setattr(A, "scaled_dot_product_attention", mono)
+        monkeypatch.setattr(A, "_chunked_dense_attention", chunk)
+        import flexflow_tpu.ops.pallas.flash_attention as FA
+
+        orig_flash = FA.flash_attention
+
+        def flash(*a, **k):
+            self.calls.append("flash")
+            return orig_flash(*a, **k)
+
+        monkeypatch.setattr(FA, "flash_attention", flash)
+
+    def regimes(self):
+        # the chunked scan calls the mono core inside its remat body; the
+        # blockwise flash core never routes through the spied functions'
+        # outer layer twice — classify by the strongest marker seen
+        s = set(self.calls)
+        if "flash" in s:
+            return "flash"
+        if "chunked" in s:
+            return "chunked"
+        if "mono" in s:
+            return "mono"
+        return "none"
+
+
+def _build(batch, seq, hidden, heads):
+    import jax
+
+    cfg = FFConfig(batch_size=batch, learning_rate=0.01)
+    model = FFModel(cfg)
+    x = model.create_tensor([batch, seq, hidden], name="x")
+    t = model.multihead_attention(x, x, x, hidden, heads)
+    t = model.dense(t, 1, use_bias=False)
+    # ONE device: the parity claim is per-shard — the search prices
+    # strategy-applied graphs whose piece sizes ARE the executed shard,
+    # so the apples-to-apples check compares unsharded shapes on an
+    # unsharded executor (on the conftest 8-device mesh a dp=8 compile
+    # correctly runs mono at 1/8th the batch while the global shape
+    # measures chunked — that is sharding, not divergence)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+        devices=jax.devices()[:1],
+    )
+    return model
+
+
+def _executor_regime(model, batch, seq, hidden, spy):
+    rng = np.random.RandomState(0)
+    data = {
+        "x": rng.randn(batch, seq, hidden).astype(np.float32),
+        "label": rng.randn(batch, seq, 1).astype(np.float32),
+    }
+    spy.calls.clear()
+    model.fit(data["x"], data["label"], epochs=1, verbose=False)
+    return spy.regimes()
+
+
+def _measured_regime(model, spy):
+    cm = CostModel(MachineSpec(1, 1, chip="v5e"), measure=True)
+    node = next(
+        n
+        for n in model.graph.nodes.values()
+        if n.op_type == OperatorType.MULTIHEAD_ATTENTION
+    )
+    in_shapes = [model.graph.shape_of(r) for r in node.inputs]
+    spy.calls.clear()
+    t = cm.measure_shard(node.op_type, node.params, in_shapes, node.weight_shapes)
+    assert t is not None, "attention must be measurable"
+    return spy.regimes()
+
+
+# CPU-sized shapes; the selection thresholds are shrunk via monkeypatch
+# so the same policy code routes at test-friendly sizes (the thresholds
+# are data, the routing is what must not diverge). score block at
+# (8, 256, h4) = 8 x 4 x 256^2 x 4B = 8 MB.
+# (mono_cap_bytes, chunk_cap_bytes, flash_threshold, expected)
+CASES = [
+    pytest.param(None, None, None, "mono", id="mono"),
+    # caps below the 8 MB block -> batch-chunked scan (chunk of 2 fits)
+    pytest.param(4 << 20, 2 << 20, None, "chunked", id="chunked"),
+    # flash threshold below the block -> streaming band (blockwise on CPU)
+    pytest.param(None, None, 1 << 20, "flash", id="flash"),
+]
+
+
+@pytest.mark.parametrize("mono_cap,chunk_cap,thresh,expected", CASES)
+def test_costed_lowering_matches_executed(
+    monkeypatch, mono_cap, chunk_cap, thresh, expected
+):
+    batch, seq, hidden, heads = 8, 256, 64, 4
+    if mono_cap is not None:
+        monkeypatch.setattr(A, "_DENSE_MONO_SCORE_BYTES", mono_cap)
+        monkeypatch.setattr(A, "_DENSE_CHUNK_SCORE_BYTES", chunk_cap)
+    if thresh is not None:
+        monkeypatch.setattr(A, "_FLASH_SCORE_BYTES", thresh)
+    spy = _Spy(monkeypatch)
+    model = _build(batch, seq, hidden, heads)
+    executed = _executor_regime(model, batch, seq, hidden, spy)
+    assert executed == expected, (executed, expected)
+    measured = _measured_regime(model, spy)
+    assert measured == executed, (
+        f"cost model measured the {measured!r} attention core but the "
+        f"executor runs {executed!r} at this shape"
+    )

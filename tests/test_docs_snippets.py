@@ -1,0 +1,51 @@
+"""Every ```python fenced block in docs/ executes (VERDICT r4 #8's
+done-criterion: docs with every snippet CI-executed). Blocks fenced as
+```text (shell lines, C snippets, pseudo-code) are exempt by
+construction — the convention documented in docs/index.md."""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(ROOT, "docs")
+
+_FENCE = re.compile(r"```python\n(.*?)```", re.DOTALL)
+
+
+def _snippets():
+    out = []
+    for fname in sorted(os.listdir(DOCS)):
+        if not fname.endswith(".md"):
+            continue
+        text = open(os.path.join(DOCS, fname)).read()
+        for i, m in enumerate(_FENCE.finditer(text)):
+            out.append(pytest.param(fname, i, m.group(1), id=f"{fname}#{i}"))
+    return out
+
+
+_SNIPPETS = _snippets()
+
+
+@pytest.mark.skipif(not _SNIPPETS, reason="no python snippets in docs/")
+@pytest.mark.parametrize("fname,idx,code", _SNIPPETS)
+def test_docs_snippet_runs(tmp_path, fname, idx, code):
+    path = tmp_path / f"snippet_{idx}.py"
+    path.write_text(code)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    run = subprocess.run(
+        [sys.executable, str(path)],
+        cwd=tmp_path,
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=600,
+    )
+    assert run.returncode == 0, (
+        f"{fname} snippet {idx} failed:\n{run.stdout}\n{run.stderr}"
+    )

@@ -38,7 +38,9 @@ def linear_node(batch=16, in_dim=32, out_dim=32):
 def test_op_family_mapping():
     assert op_family(OperatorType.CONV2D) == "conv"
     assert op_family(OperatorType.LINEAR) == "dense"
-    assert op_family(OperatorType.MULTIHEAD_ATTENTION) == "dense"
+    # attention measures with its own (batch-dependent) bias — round 5
+    # split it out of "dense" (scripts/probe_attn_pricing.py)
+    assert op_family(OperatorType.MULTIHEAD_ATTENTION) == "attention"
     assert op_family(OperatorType.EMBEDDING) == "embed"
     assert op_family(OperatorType.RELU) is None
 
@@ -84,23 +86,46 @@ def test_fit_family_scales_geomean():
     ))
     from calibrate import fit_family_scales
 
-    # rows: (family, family_pred, total_pred, measured)
+    # rows: (family, batch, family_pred, total_pred, measured)
     rows = [
         # family is the whole step: s = 2/1 = 2
-        ("conv", 2.0, 2.0, 1.0),
+        ("conv", 16, 2.0, 2.0, 1.0),
         # family is HALF the predicted step (the overcorrection case the
         # raw-ratio fit got wrong): remainder 1.0, s = 1.0/(1.5-1.0) = 2
         # -> corrected total = 1.0 + 1.0/2 = 1.5 = measured, residual 1.0
-        ("conv", 1.0, 2.0, 1.5),
-        ("dense", 1.0, 1.0, 1.0),
+        ("conv", 32, 1.0, 2.0, 1.5),
+        ("dense", 8, 1.0, 1.0, 1.0),
         # measured fully explained by the remainder: no family signal
-        ("embed", 0.5, 2.0, 1.0),
-        (None, 5.0, 5.0, 1.0),   # unknown family: dropped
+        ("embed", 64, 0.5, 2.0, 1.0),
+        (None, 8, 5.0, 5.0, 1.0),   # unknown family: dropped
         # tiny positive denominator -> implied scale 50x: clamped out
-        ("embed", 5.0, 9.5, 4.6),
+        ("embed", 64, 5.0, 9.5, 4.6),
     ]
     scales = fit_family_scales(rows)
-    assert scales == {"conv": 2.0, "dense": 1.0}
+    # per-batch regime table + "*" geomean (CostModel.family_scale_for)
+    assert scales == {
+        "conv": {"16": 2.0, "32": 2.0, "*": 2.0},
+        "dense": {"8": 1.0, "*": 1.0},
+    }
+
+
+def test_family_scale_regime_lookup(tmp_path):
+    """Per-batch regime entries pick the nearest bucket; a plain float
+    entry keeps the constant behavior."""
+    path = str(tmp_path / "calib.json")
+    _write_calib(
+        path,
+        {"conv": {"16": 1.0, "32": 1.6, "64": 0.8, "*": 1.1},
+         "dense": 2.0},
+    )
+    cm = CostModel(SPEC, measure=True, calibration_file=path)
+    assert cm.family_scale_for("conv", 16) == 1.0
+    assert cm.family_scale_for("conv", 32) == 1.6
+    assert cm.family_scale_for("conv", 40) == 1.6  # nearest bucket
+    assert cm.family_scale_for("conv", 256) == 0.8
+    assert cm.family_scale_for("conv", None) == 1.1  # no batch: geomean
+    assert cm.family_scale_for("dense", 999) == 2.0
+    assert cm.family_scale_for("embed", 8) == 1.0  # unfitted family
 
 
 def test_unity_measured_times_corrected(tmp_path):

@@ -1,0 +1,27 @@
+"""Execute every code cell of jupyter_notebook/quickstart.ipynb in order
+in one namespace (no jupyter/nbconvert dependency — the cells are plain
+Python). Keeps the notebook honest the same way test_docs_snippets.py
+keeps docs/ honest."""
+
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NB = os.path.join(ROOT, "jupyter_notebook", "quickstart.ipynb")
+
+
+def test_quickstart_notebook_cells_execute():
+    with open(NB) as f:
+        nb = json.load(f)
+    code_cells = [
+        "".join(c["source"])
+        for c in nb["cells"]
+        if c["cell_type"] == "code"
+    ]
+    assert len(code_cells) >= 4
+    ns = {"__name__": "__notebook__"}
+    for i, cell in enumerate(code_cells):
+        try:
+            exec(compile(cell, f"<cell {i}>", "exec"), ns)
+        except Exception as e:  # pragma: no cover - assertion detail
+            raise AssertionError(f"notebook cell {i} failed: {e}") from e

@@ -1,11 +1,14 @@
-"""Run REFERENCE Keras example scripts (reference:
-examples/python/keras/) against the `flexflow` compat namespace with a
-<=5-changed-line diff each (VERDICT r3 #8's done-criterion): the scripts'
-imports (`from flexflow.keras.models import Model`, datasets, losses,
+"""Run REFERENCE example scripts (reference: examples/python/) against
+the `flexflow` compat namespace with a <=5-changed-line diff each
+(VERDICT r3 #8 / r4 #6 done-criteria): the scripts' imports
+(`from flexflow.keras.models import Model`, `from flexflow.core import
+*`, `from flexflow.torch.model import PyTorchModel`, datasets, losses,
 metrics, callbacks) resolve to flexflow_tpu re-exports unchanged; the
 only edits shrink the workload for a 1-core CI host (sample count,
 epochs, and dropping the dataset-accuracy assertion callbacks, which
-synthetic fallback data cannot satisfy)."""
+synthetic fallback data cannot satisfy). Covers 12 keras scripts (2 of
+them zero-edit), the pytorch export->train pair, and the onnx importer
+surface."""
 
 import os
 import shutil
@@ -76,29 +79,110 @@ _EDITS = {
             "model.fit(x_train, y_train, epochs=1)",
         ),
     ],
+    "seq_mnist_mlp.py": [
+        (
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data()",
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data(512, 64)",
+        ),
+        (
+            "x_train = x_train.reshape(60000, 784)",
+            "x_train = x_train.reshape(512, 784)",
+        ),
+        (
+            "model.fit(x_train, y_train, epochs=20, callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP), EpochVerifyMetrics(ModelAccuracy.MNIST_MLP)])",
+            "model.fit(x_train, y_train, epochs=1)",
+        ),
+    ],
+    "func_mnist_mlp_concat2.py": [
+        (
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data()",
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data(512, 64)",
+        ),
+        (
+            "x_train = x_train.reshape(60000, 784)",
+            "x_train = x_train.reshape(512, 784)",
+        ),
+        (
+            "model.fit([x_train, x_train, x_train], y_train, epochs=10, callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP), EpochVerifyMetrics(ModelAccuracy.MNIST_MLP)])",
+            "model.fit([x_train, x_train, x_train], y_train, epochs=1)",
+        ),
+    ],
+    "func_mnist_mlp_net2net.py": [
+        (
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data()",
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data(512, 64)",
+        ),
+        (
+            "x_train = x_train.reshape(60000, 784)",
+            "x_train = x_train.reshape(512, 784)",
+        ),
+        (
+            "teacher_model.fit(x_train, y_train, epochs=10)",
+            "teacher_model.fit(x_train, y_train, epochs=1)",
+        ),
+        (
+            "student_model.fit(x_train, y_train, epochs=160, callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP), EpochVerifyMetrics(ModelAccuracy.MNIST_MLP)])",
+            "student_model.fit(x_train, y_train, epochs=1)",
+        ),
+    ],
+    "seq_mnist_cnn.py": [
+        (
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data()",
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data(256, 64)",
+        ),
+        (
+            "model.fit(x_train, y_train, epochs=5, callbacks=[VerifyMetrics(ModelAccuracy.MNIST_CNN), EpochVerifyMetrics(ModelAccuracy.MNIST_CNN)])",
+            "model.fit(x_train, y_train, epochs=1)",
+        ),
+    ],
+    "func_mnist_cnn_concat.py": [
+        (
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data()",
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data(256, 64)",
+        ),
+        (
+            "model.fit(x_train, y_train, epochs=5, callbacks=[VerifyMetrics(ModelAccuracy.MNIST_CNN), EpochVerifyMetrics(ModelAccuracy.MNIST_CNN)])",
+            "model.fit(x_train, y_train, epochs=1)",
+        ),
+    ],
+    "seq_mnist_cnn_nested.py": [
+        (
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data()",
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data(256, 64)",
+        ),
+        (
+            "model.fit(x_train, y_train, epochs=5, callbacks=[VerifyMetrics(ModelAccuracy.MNIST_CNN), EpochVerifyMetrics(ModelAccuracy.MNIST_CNN)])",
+            "model.fit(x_train, y_train, epochs=1)",
+        ),
+    ],
+    # zero-edit scripts: synthetic data, CI-sized as written
+    "reduce_sum.py": [],
+    "elementwise_mul_broadcast.py": [],
 }
 
 
-@pytest.mark.parametrize("script", sorted(_EDITS))
-def test_reference_keras_example_runs(tmp_path, script):
-    src = open(os.path.join(REF, script)).read()
+def _apply_edits(src_path, edits, dest):
+    src = open(src_path).read()
     changed = 0
     out_lines = []
-    edits = dict(_EDITS[script])
+    pending = dict(edits)
     for line in src.splitlines():
         stripped = line.strip()
-        if stripped in edits:
+        if stripped in pending:
             indent = line[: len(line) - len(line.lstrip())]
-            out_lines.append(indent + edits.pop(stripped))
+            out_lines.append(indent + pending.pop(stripped))
             changed += 1
         else:
             out_lines.append(line)
-    assert not edits, f"edit targets not found in {script}: {list(edits)}"
+    assert not pending, (
+        f"edit targets not found in {os.path.basename(src_path)}: "
+        f"{list(pending)}"
+    )
     assert changed <= 5
-    (tmp_path / script).write_text("\n".join(out_lines) + "\n")
-    # the scripts import the sibling accuracy.py helper verbatim
-    shutil.copy(os.path.join(REF, "accuracy.py"), tmp_path / "accuracy.py")
+    dest.write_text("\n".join(out_lines) + "\n")
 
+
+def _run_script(tmp_path, script):
     env = dict(os.environ)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
@@ -111,3 +195,93 @@ def test_reference_keras_example_runs(tmp_path, script):
         timeout=600,
     )
     assert run.returncode == 0, run.stdout + "\n" + run.stderr
+    return run
+
+
+@pytest.mark.parametrize("script", sorted(_EDITS))
+def test_reference_keras_example_runs(tmp_path, script):
+    _apply_edits(os.path.join(REF, script), _EDITS[script], tmp_path / script)
+    # the scripts import the sibling accuracy.py helper verbatim
+    shutil.copy(os.path.join(REF, "accuracy.py"), tmp_path / "accuracy.py")
+    _run_script(tmp_path, script)
+
+
+REF_PT = "/root/reference/examples/python/pytorch"
+
+_PT_EDITS = {
+    # the exporter (torch.fx trace -> mlp.ff) runs VERBATIM
+    "mnist_mlp_torch.py": [],
+    # the trainer shrinks the dataset for the CI host; everything else —
+    # flexflow.core star-import, DT_/LOSS_/METRICS_ enum spellings,
+    # SGDOptimizer(ffmodel, lr), create_data_loader/init_layers/
+    # label_tensor/fit(x=loader, y=loader) — runs as written
+    "mnist_mlp.py": [
+        (
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data()",
+            "(x_train, y_train), (x_test, y_test) = mnist.load_data(512, 64)",
+        ),
+        (
+            "x_train = x_train.reshape(60000, 784)",
+            "x_train = x_train.reshape(512, 784)",
+        ),
+    ],
+}
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(REF_PT), reason="reference tree not present"
+)
+def test_reference_pytorch_pair_runs(tmp_path):
+    """The reference torch export->train pair (VERDICT r4 #6):
+    mnist_mlp_torch.py writes mlp.ff via the fx tracer with ZERO edits,
+    then mnist_mlp.py replays it through flexflow.core and trains."""
+    for script, edits in _PT_EDITS.items():
+        _apply_edits(
+            os.path.join(REF_PT, script), edits, tmp_path / script
+        )
+    _run_script(tmp_path, "mnist_mlp_torch.py")
+    assert (tmp_path / "mlp.ff").exists()
+    run = _run_script(tmp_path, "mnist_mlp.py")
+    assert "THROUGHPUT" in run.stdout
+
+
+def test_reference_onnx_surface():
+    """The onnx example scripts' import surface resolves through the
+    compat namespace (ONNXModel + ONNXModelKeras, reference:
+    examples/python/onnx/mnist_mlp.py). The full scripts need the
+    `onnx` package (not in this image — the frontend is import-gated by
+    design) plus pre-exported .onnx files; with onnx absent, the gate
+    must raise the documented clear error, not an AttributeError."""
+    from flexflow.onnx.model import ONNXModel, ONNXModelKeras  # noqa: F401
+
+    try:
+        import onnx
+    except ImportError:
+        with pytest.raises(ImportError, match="ONNX frontend"):
+            ONNXModel("does_not_matter.onnx")
+        with pytest.raises(ImportError, match="ONNX frontend"):
+            ONNXModelKeras("does_not_matter.onnx")
+        return
+    # onnx present: exercise the positive path on a minimal Gemm graph
+    # (the mnist_mlp.py pattern without the pre-exported file)
+    import numpy as np
+    from onnx import TensorProto, helper, numpy_helper
+
+    from flexflow_tpu import FFConfig, FFModel
+
+    w = numpy_helper.from_array(
+        np.zeros((8, 4), np.float32), name="w"
+    )
+    node = helper.make_node("Gemm", ["x", "w"], ["y"], transB=0)
+    graph = helper.make_graph(
+        [node],
+        "g",
+        [helper.make_tensor_value_info("x", TensorProto.FLOAT, [2, 8])],
+        [helper.make_tensor_value_info("y", TensorProto.FLOAT, [2, 4])],
+        initializer=[w],
+    )
+    proto = helper.make_model(graph)
+    ffmodel = FFModel(FFConfig(batch_size=2))
+    x = ffmodel.create_tensor([2, 8], name="x")
+    out = ONNXModel(proto).apply(ffmodel, {"x": x})
+    assert out is not None
