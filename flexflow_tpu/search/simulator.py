@@ -335,7 +335,7 @@ def estimate_graph_cost(
             from flexflow_tpu.search.cost_model import shard_batch as _sb
 
             mt = cm.corrected_times(
-                node.op_type, cm.measure_shard_chain(specs),
+                node.op_type, cm.chain_times_floor_adjusted(specs),
                 batch=_sb(head_ins),
             )
             if mt is None:

@@ -276,7 +276,9 @@ class UnitySearch:
 
             times = self.cm.corrected_times(
                 node.op_type,
-                self.cm.measure_shard(node.op_type, params, shard_ins, ws),
+                self.cm.measured_times_floor_adjusted(
+                    node.op_type, params, shard_ins, ws
+                ),
                 batch=shard_batch(shard_ins),
             )
             if times is None:

@@ -6,8 +6,14 @@ trap):
   two_pass — the pre-round-4 lowering: mean, then E[(x-mean)^2], then
              normalize (3 activation passes + the conv write).
   one_pass — E[x^2] - E[x]^2: both sums accumulate in ONE pass over the
-             activation. Measured 11.71 -> 3.79 ms on the full ResNet-50
-             bs16 step (3.1x); adopted as core_ops._lower_batchnorm.
+             activation; adopted as core_ops._lower_batchnorm.
+
+The protocol-grade magnitude of the win is the ONE number recorded in
+BASELINE.md's round-5 section (the first run of this script read
+11.71 -> 3.79 ms under a biased estimator — a contention spike in the
+A window faked a 3.1x — and the corrected interleaved A/B measured
+5.41 -> 4.36 ms, ~19%; run this script for the current chip's number
+rather than quoting any of those).
 
 Usage: ab_resnet_bn.py [bs] [variantA] [variantB]
 """
