@@ -339,9 +339,14 @@ class CostModel:
 
     # -- compute ------------------------------------------------------------
 
-    def _roofline(self, flops: float, bytes_moved: float) -> float:
-        t_flops = flops / (self.spec.peak_tflops * 1e12 * self.efficiency)
-        t_mem = bytes_moved / (self.spec.hbm_gbps * 1e9 * self.efficiency)
+    def _roofline(
+        self, flops: float, bytes_moved: float, efficiency: float = None
+    ) -> float:
+        """efficiency=1.0 gives the TRUE lower bound (the measurement
+        clamp); the default self.efficiency gives the cost ESTIMATE."""
+        eff = self.efficiency if efficiency is None else efficiency
+        t_flops = flops / (self.spec.peak_tflops * 1e12 * eff)
+        t_mem = bytes_moved / (self.spec.hbm_gbps * 1e9 * eff)
         return max(t_flops, t_mem)
 
     def op_cost(
@@ -598,7 +603,10 @@ class CostModel:
         flops = op_flops(op_type, in_shapes, params) / degree
         data = sum(self.piece_bytes(s) for s in in_shapes)
         data += sum(self.piece_bytes(s) for s in weight_shapes)
-        f_roof = self._roofline(flops, data)
+        # TRUE lower bound, not the 0.6-efficiency cost ESTIMATE: a real
+        # kernel can beat the estimate (bf16 MXU at high utilization) and
+        # a clamp above the measurement would silently replace it
+        f_roof = self._roofline(flops, data, efficiency=1.0)
         return f_roof, (2.0 if op_type in _MXU_OPS else 1.0) * f_roof
 
     def chain_times_floor_adjusted(
