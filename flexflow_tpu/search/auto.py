@@ -255,8 +255,12 @@ def _pipeline_candidate(
             # (jax.checkpoint in pipeline_executor._block_fn) — the
             # memory saving is not free
             trunk_time = max((trunk + trunk_fwd) / pp * stretch, hops)
+    # one program launch per step, same basis as estimate_graph_cost's
+    # step_floor — without it pipeline candidates would carry a
+    # one-floor advantage over every simulator-priced candidate
+    step_floor = cm.dispatch_floor() if cm.measure else 0.0
     cost = GraphCost(
-        step_time=rest + trunk_time + sync + update,
+        step_time=rest + trunk_time + sync + update + step_floor,
         compute_time=rest + trunk,
         comm_time=hops,
         sync_time=sync,

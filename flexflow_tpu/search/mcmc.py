@@ -232,4 +232,8 @@ def mcmc_optimize(
                 f"[mcmc] iter {it}: current {cur_cost * 1e3:.3f} ms, "
                 f"best {best_cost * 1e3:.3f} ms"
             )
+    if search.cm.measure:
+        # one program launch per step (estimate_graph_cost's step_floor
+        # basis) — keeps the cross-engine gate comparable
+        best_cost += search.cm.dispatch_floor()
     return UnityResult(best_cost, best)

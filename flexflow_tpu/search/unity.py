@@ -429,6 +429,17 @@ class UnitySearch:
         (native/src/unity_dp.cc — SURVEY §7's prescription that the
         compute-bound tree search be native); everything else uses the
         Python recursion with identical semantics."""
+        result = self._optimize_inner()
+        if self.cm.measure:
+            # one program launch per step — the same basis term
+            # estimate_graph_cost adds, so the cross-engine gate in
+            # auto.search_strategy compares like with like
+            result = UnityResult(
+                result.cost + self.cm.dispatch_floor(), result.views
+            )
+        return result
+
+    def _optimize_inner(self) -> UnityResult:
         from flexflow_tpu import native as native_mod
 
         sinks = self.graph.sinks()

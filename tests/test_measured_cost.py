@@ -66,6 +66,12 @@ def test_calibration_file_roundtrip(tmp_path):
     cm1.flush_calibration()  # saves are throttled; callers flush at the end
 
     cm2 = CostModel(SPEC, measure=True, calibration_file=path)
+    # pin the dispatch floor: dispatch_floor() min-combines the table's
+    # value with a fresh probe BY DESIGN, and that probe would trip the
+    # no-remeasure guard below (the op key itself must come from the
+    # table). Match cm1's resolved floor so times compare equal.
+    cm2._dispatch_floor = cm1._dispatch_floor or 0.0
+    cm1._dispatch_floor = cm2._dispatch_floor
     cm2._time_kernel = lambda *a, **k: pytest.fail(
         "calibration table should have served this key"
     )
