@@ -73,13 +73,30 @@ def main():
         del args[i : i + 2]
     runs = []
     for rep in range(n):
-        with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
-            out = f.name
-        cmd = [sys.executable, "scripts/bench_configs.py", "--out", out] + args
-        r = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True)
-        if r.returncode != 0:
+        # the tunneled chip drops connections in transient bursts
+        # ("remote_compile: read body closed"); a blip must not discard
+        # the completed invocations — retry the failed one
+        for attempt in range(3):
+            with tempfile.NamedTemporaryFile(
+                suffix=".json", delete=False
+            ) as f:
+                out = f.name
+            cmd = [
+                sys.executable, "scripts/bench_configs.py", "--out", out,
+            ] + args
+            r = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True)
+            if r.returncode == 0:
+                break
+            os.unlink(out)  # failed attempt's temp file
             print(r.stdout[-2000:], r.stderr[-2000:], file=sys.stderr)
-            raise SystemExit(f"invocation {rep} failed")
+            tail = "retrying" if attempt < 2 else "giving up"
+            print(
+                f"[protocol] invocation {rep} attempt {attempt + 1} "
+                f"failed; {tail}",
+                flush=True,
+            )
+        else:
+            raise SystemExit(f"invocation {rep} failed 3 attempts")
         with open(out) as fh:
             runs.append(json.load(fh))
         os.unlink(out)
