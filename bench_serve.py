@@ -1,0 +1,162 @@
+"""Serving benchmark driver: continuous vs static batching throughput.
+
+Prints ONE JSON line in the bench.py protocol ({"metric", "value",
+"unit", "vs_baseline"} — extra serve-specific keys ride along):
+`value` is continuous-batching decode throughput in tokens/s and
+`vs_baseline` is the ratio over STATIC batching of the identical
+mixed-length request stream on the identical engine — the Orca win this
+subsystem exists for, so the baseline is the pre-Orca scheduler, not a
+training number. p50/p95 are per-request submit→finish latencies under
+continuous batching.
+
+The default workload is the flagship Transformer geometry (12 layers,
+hidden 1024, 16 heads — transformer.cc:79-85) recast as a decoder LM;
+`--smoke` shrinks it for CPU CI.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def run(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    reps: int = 2,
+):
+    import jax
+
+    from flexflow_tpu import (
+        DataType,
+        FFConfig,
+        FFModel,
+        LossType,
+        SGDOptimizer,
+    )
+    from flexflow_tpu.models import build_decoder_lm
+    from flexflow_tpu.serving import (
+        ContinuousBatchingScheduler,
+        Request,
+        ServeConfig,
+        StaticBatchingScheduler,
+        build_scheduler,
+        latency_percentiles,
+    )
+
+    cfg = FFConfig(batch_size=max_seqs)
+    model = FFModel(cfg)
+    tok = model.create_tensor(
+        [max_seqs, max_len], dtype=DataType.INT32, name="tokens"
+    )
+    build_decoder_lm(
+        model,
+        tok,
+        vocab_size=vocab,
+        hidden=hidden,
+        num_heads=heads,
+        num_layers=layers,
+        ff_dim=4 * hidden,
+    )
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        devices=jax.devices()[:1],
+    )
+
+    def requests():
+        # mixed-length stream: short and long continuations interleaved,
+        # the regime where request-level batching strands slots
+        short, long_ = max(2, max_len // 16), max(8, max_len // 2 - 8)
+        return [
+            Request(
+                rid=i,
+                prompt=[(i * 7 + j) % vocab for j in range(1 + i % 6)],
+                max_new_tokens=short if i % 2 == 0 else long_,
+            )
+            for i in range(num_requests)
+        ]
+
+    serve = ServeConfig(max_seqs=max_seqs, max_seq_len=max_len)
+    _, engine, _ = build_scheduler(model, serve)
+    for cls in (ContinuousBatchingScheduler, StaticBatchingScheduler):
+        cls(engine).run(requests()[: max_seqs + 1])  # warm jit signatures
+
+    best = {}
+    latencies = None
+    for name, cls in (
+        ("static", StaticBatchingScheduler),
+        ("continuous", ContinuousBatchingScheduler),
+    ):
+        runs = []
+        for _ in range(reps):
+            sched = cls(engine)
+            done = sched.run(requests())
+            runs.append(sched.stats)
+            if name == "continuous":
+                latencies = latency_percentiles(done, (50, 95))
+        best[name] = max(s.tokens_per_s for s in runs)
+
+    return {
+        "metric": (
+            f"serve_decoder_{layers}L_{hidden}h_continuous_throughput"
+        ),
+        "value": round(best["continuous"], 2),
+        "unit": "tokens/s",
+        # ratio over static batching of the same stream (>1 = Orca win)
+        "vs_baseline": round(best["continuous"] / best["static"], 3),
+        "static_tokens_per_s": round(best["static"], 2),
+        "p50_latency_ms": round(latencies[50] * 1e3, 2),
+        "p95_latency_ms": round(latencies[95] * 1e3, 2),
+    }
+
+
+_PRESETS = {
+    # flagship geometry (transformer.cc:79-85) as a decoder LM — the TPU
+    # target; CPU CI uses --smoke
+    "flagship": dict(
+        layers=12, hidden=1024, heads=16, vocab=32000,
+        max_seqs=8, max_len=512, num_requests=32,
+    ),
+    # mid-size config a CPU box can measure in minutes — the recorded
+    # BENCH_SERVE.json numbers come from here when no TPU is attached
+    "medium": dict(
+        layers=4, hidden=256, heads=8, vocab=2048,
+        max_seqs=4, max_len=128, num_requests=16,
+    ),
+    "smoke": dict(
+        layers=2, hidden=64, heads=4, vocab=128,
+        max_seqs=4, max_len=64, num_requests=8,
+    ),
+}
+
+
+def main():
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    args = dict(_PRESETS["flagship"])
+    argv = sys.argv[1:]
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--smoke":
+            args = dict(_PRESETS["smoke"])
+        elif a == "--preset":
+            i += 1
+            args = dict(_PRESETS[argv[i]])
+        elif a.startswith("--") and a[2:].replace("-", "_") in args:
+            i += 1
+            args[a[2:].replace("-", "_")] = int(argv[i])
+        else:
+            raise SystemExit(f"unknown flag {a!r}")
+        i += 1
+    print(json.dumps(run(**args)))
+
+
+if __name__ == "__main__":
+    main()

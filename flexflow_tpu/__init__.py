@@ -32,6 +32,7 @@ from flexflow_tpu.runtime.initializer import (
 from flexflow_tpu.runtime.model import FFModel, Tensor
 from flexflow_tpu.runtime.optimizer import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.runtime.recompile import RecompileState
+from flexflow_tpu.serving.api import ServeConfig
 
 __version__ = "0.2.0"
 
@@ -56,6 +57,7 @@ __all__ = [
     "SGDOptimizer",
     "AdamOptimizer",
     "RecompileState",
+    "ServeConfig",
     "GlorotUniform",
     "ZeroInitializer",
     "ConstantInitializer",

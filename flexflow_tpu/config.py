@@ -99,6 +99,15 @@ class FFConfig:
     # per-iteration dynamic config (reference: FFIterationConfig, config.h:160)
     seq_length: Optional[int] = None
 
+    # serving (flexflow_tpu.serving; upstream grew the same flags in
+    # FlexFlow Serve's RequestManager): KV-cache slots, cache length per
+    # slot, scheduler kind, EOS token (-1 = none). ServeConfig.from_config
+    # lifts these into the engine.
+    serve_max_seqs: int = 8
+    serve_max_seq_len: int = 256
+    serve_scheduler: str = "continuous"
+    serve_eos_token: int = -1
+
     @property
     def num_devices(self) -> int:
         import jax
@@ -209,6 +218,14 @@ class FFConfig:
                 cfg.workers_per_node = int(take())
             elif a == "--chip":
                 cfg.chip = take()
+            elif a == "--max-seqs":
+                cfg.serve_max_seqs = int(take())
+            elif a == "--max-seq-len":
+                cfg.serve_max_seq_len = int(take())
+            elif a == "--serve-scheduler":
+                cfg.serve_scheduler = take()
+            elif a == "--eos-token":
+                cfg.serve_eos_token = int(take())
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

@@ -13,6 +13,7 @@ from flexflow_tpu.models.vision import (
 )
 from flexflow_tpu.models.nlp import (
     build_bert_proxy,
+    build_decoder_lm,
     build_mt5_encoder,
     build_transformer_encoder,
 )
@@ -27,6 +28,7 @@ __all__ = [
     "build_inception_v3",
     "build_transformer_encoder",
     "build_bert_proxy",
+    "build_decoder_lm",
     "build_mt5_encoder",
     "build_dlrm",
     "build_xdl",

@@ -71,3 +71,30 @@ def build_mt5_encoder(
         m = ff.dense(m, hidden, use_bias=False)
         t = ff.add(t, m)
     return ff.layer_norm(t)
+
+
+def build_decoder_lm(
+    ff,
+    token_ids,
+    vocab_size: int = 256,
+    hidden: int = 64,
+    num_heads: int = 4,
+    num_layers: int = 2,
+    ff_dim: int = 128,
+):
+    """Decoder-only LM — the serving subsystem's workload (GPT-style
+    pre-LN blocks with causal self-attention; flexflow_tpu.serving needs
+    causal=True and a single token-id input to build its KV cache). Ends
+    in vocab logits, not softmax, so generate() argmaxes raw logits."""
+    t = ff.embedding(token_ids, vocab_size, hidden)
+    for _ in range(num_layers):
+        h = ff.layer_norm(t)
+        a = ff.multihead_attention(
+            h, h, h, hidden, num_heads, bias=False, causal=True
+        )
+        t = ff.add(t, a)
+        h = ff.layer_norm(t)
+        m = ff.dense(h, ff_dim, activation=ActiMode.GELU, use_bias=False)
+        m = ff.dense(m, hidden, use_bias=False)
+        t = ff.add(t, m)
+    return ff.dense(ff.layer_norm(t), vocab_size, use_bias=False)

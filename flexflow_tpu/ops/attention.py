@@ -97,6 +97,78 @@ def scaled_dot_product_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def mha_project_qkv(ins, ws, ctx, use_bias=True):
+    """Input projections of the MHA lowering: (xq, xk, xv) [b, s, e] ->
+    (q, k, v) [b, s, h, d]. Split out of _lower_mha so the serving engine
+    (flexflow_tpu.serving.engine) computes the exact same projections when
+    it swaps the attention core for the KV-cache decode path — projection
+    numerics must match training bit-for-bit or cache-equivalence breaks."""
+    xq, xk, xv = ins
+    wq, wk, wv = ws[0], ws[1], ws[2]
+    xq, xk, xv, wq, wk, wv = mm_operands(ctx, xq, xk, xv, wq, wk, wv)
+    # compute dtype: bf16 under mixed precision (softmax/accumulation
+    # stays f32 inside the attention core), else the input dtype
+    cdt = xq.dtype
+    mm = dict(preferred_element_type=jnp.float32)
+    q = jnp.einsum("bse,ehd->bshd", xq, wq, **mm).astype(cdt)
+    k = jnp.einsum("bse,ehd->bshd", xk, wk, **mm).astype(cdt)
+    v = jnp.einsum("bse,ehd->bshd", xv, wv, **mm).astype(cdt)
+    if use_bias:
+        bq, bk, bv = ws[4], ws[5], ws[6]
+        q = q + bq.astype(cdt)
+        k = k + bk.astype(cdt)
+        v = v + bv.astype(cdt)
+    return q, k, v
+
+
+def mha_project_out(attn, ws, ctx, out_dtype, use_bias=True):
+    """Output projection of the MHA lowering: attn [b, s, h, d] -> [b, s, e].
+    Shared with the serving engine like mha_project_qkv."""
+    attn_m, wo_m = mm_operands(ctx, attn, ws[3])
+    y = jnp.einsum(
+        "bshd,hde->bse", attn_m, wo_m, preferred_element_type=jnp.float32
+    ).astype(mm_out_dtype(ctx, out_dtype))
+    if use_bias:
+        y = y + ws[7].astype(y.dtype)
+    return y
+
+
+def _decode_pallas_hook(q, k_cache, v_cache, lengths):
+    """Seam for a hand-tiled TPU decode kernel (single-query flash against
+    the cache, analogous to flash_kernel.py for training). None routes
+    decode_attention to the dense jnp path — the kernel itself is a
+    ROADMAP open item; on CPU the dense path is the measured-fast choice
+    anyway (one query row, no [s, s] score tensor to fear)."""
+    return None
+
+
+def decode_attention(q, k_cache, v_cache, lengths):
+    """Serving decode regime: one-query attention against a preallocated
+    KV cache. q: [b, 1, h, d]; k_cache/v_cache: [b, max_len, h, d];
+    lengths: [b] int32, the cache position the current token was written
+    at — positions > lengths[i] (unwritten slots or another request's
+    stale rows) are masked out, so a fixed-shape cache serves variable
+    sequence lengths without recompiles.
+
+    fp32 score accumulation like scaled_dot_product_attention; the mask
+    uses the same -1e30 fill so decode softmax numerics line up with the
+    causal prefill path."""
+    out = _decode_pallas_hook(q, k_cache, v_cache, lengths)
+    if out is not None:
+        return out
+    d = q.shape[-1]
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
+    klen = k_cache.shape[1]
+    mask = jnp.arange(klen)[None, None, None, :] <= lengths[
+        :, None, None, None
+    ]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
 def _q_mesh_axes(ctx):
     """Mesh axis names (batch_ax, seq_ax, head_ax) of the q input's
     partitioned dims — head sharding comes from a replica dim on q (the
@@ -421,22 +493,8 @@ def _lower_mha(params):
         return jax.lax.with_sharding_constraint(attn, seq_spec)
 
     def fn(ins, ws, ctx):
-        xq, xk, xv = ins
-        wq, wk, wv, wo = ws[:4]
-        dt = xq.dtype
-        xq, xk, xv, wq, wk, wv = mm_operands(ctx, xq, xk, xv, wq, wk, wv)
-        # compute dtype: bf16 under mixed precision (softmax/accumulation
-        # stays f32 inside the attention core), else the input dtype
-        cdt = xq.dtype
-        mm = dict(preferred_element_type=jnp.float32)
-        q = jnp.einsum("bse,ehd->bshd", xq, wq, **mm).astype(cdt)
-        k = jnp.einsum("bse,ehd->bshd", xk, wk, **mm).astype(cdt)
-        v = jnp.einsum("bse,ehd->bshd", xv, wv, **mm).astype(cdt)
-        if use_bias:
-            bq, bk, bv = ws[4], ws[5], ws[6]
-            q = q + bq.astype(cdt)
-            k = k + bk.astype(cdt)
-            v = v + bv.astype(cdt)
+        dt = ins[0].dtype
+        q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
         seq = q.shape[1]
         dropping = dropout > 0.0 and ctx.train and ctx.rng is not None
         sp = None if seq_parallel == "none" else _seq_parallel_axes(ctx)
@@ -559,13 +617,7 @@ def _lower_mha(params):
                         dropout_rate=dropout if dropping else 0.0,
                         dropout_rng=ctx.rng if dropping else None,
                     )
-        attn_m, wo_m = mm_operands(ctx, attn, wo)
-        y = jnp.einsum("bshd,hde->bse", attn_m, wo_m, **mm).astype(
-            mm_out_dtype(ctx, dt)
-        )
-        if use_bias:
-            y = y + ws[7].astype(y.dtype)
-        return [y]
+        return [mha_project_out(attn, ws, ctx, dt, use_bias=use_bias)]
 
     return fn
 

@@ -242,17 +242,43 @@ class Executor:
 
     # -- forward -------------------------------------------------------------
 
-    def forward_values(self, params, batch, rng=None, train=True, injected=None):
+    def forward_values(
+        self,
+        params,
+        batch,
+        rng=None,
+        train=True,
+        injected=None,
+        op_hooks=None,
+        constrain=True,
+    ):
         """Evaluate the PCG; returns {(guid, out_idx): array}.
 
         injected: {guid: array} precomputed single-output node values
         (the sparse-embedding fast path differentiates wrt these
-        activations instead of the table weights)."""
+        activations instead of the table weights).
+
+        op_hooks: {OperatorType: fn(node, ins, ws, ctx) -> [outs]} —
+        per-op-type overrides of the registered lowering. The serving
+        engine (flexflow_tpu.serving.engine) re-executes the compiled PCG
+        with an attention hook that reads/writes the KV cache; everything
+        else runs the normal lowering, so serving reuses this machinery
+        instead of growing a second interpreter.
+
+        constrain=False skips the per-tensor sharding constraints — the
+        hook path feeds shapes (decode seq length 1) that differ from the
+        compiled training shapes, so the recorded PartitionSpecs no
+        longer describe the arrays; hooked callers shard their inputs
+        explicitly instead."""
         values: Dict[Tuple[int, int], jnp.ndarray] = {}
+
+        def _maybe_constrain(x, shape):
+            return self._constrain(x, shape) if constrain else x
+
         for guid in self.topo:
             node = self.graph.nodes[guid]
             if injected is not None and guid in injected:
-                values[(guid, 0)] = self._constrain(
+                values[(guid, 0)] = _maybe_constrain(
                     injected[guid], node.output_shapes[0]
                 )
                 continue
@@ -260,7 +286,7 @@ class Executor:
                 if node.name not in batch:
                     raise KeyError(f"batch missing input '{node.name}'")
                 x = batch[node.name]
-                x = self._constrain(x, node.output_shapes[0])
+                x = _maybe_constrain(x, node.output_shapes[0])
                 values[(guid, 0)] = x
                 continue
             ins = [values[(r.guid, r.out_idx)] for r in node.inputs]
@@ -274,9 +300,13 @@ class Executor:
                 bf16_matmul=self.mixed_precision,
                 seq_length=self.seq_length,
             )
-            outs = self._lowered[guid](ins, ws, ctx)
+            hook = op_hooks.get(node.op_type) if op_hooks else None
+            if hook is not None:
+                outs = hook(node, ins, ws, ctx)
+            else:
+                outs = self._lowered[guid](ins, ws, ctx)
             for i, out in enumerate(outs):
-                out = self._constrain(out, node.output_shapes[i])
+                out = _maybe_constrain(out, node.output_shapes[i])
                 values[(guid, i)] = out
         return values
 

@@ -1190,6 +1190,33 @@ class FFModel:
         b = self.executor.shard_batch(batch)
         return self.executor.forward_fn()(self.params, b)
 
+    def generate(
+        self,
+        prompts,
+        max_new_tokens: int = 16,
+        serve_config=None,
+        eos_token=None,
+    ):
+        """Autoregressive generation with continuous batching (the
+        FlexFlow Serve surface grafted onto the training FFModel): token-id
+        prompts in, generated token lists out, scheduled by
+        serving.scheduler over a preallocated KV cache. Greedy unless the
+        ServeConfig sets a temperature. The model must be compiled, take a
+        single int token input, and use causal self-attention."""
+        from flexflow_tpu.serving.api import ServeConfig, generate
+
+        if self.executor is None:
+            raise RuntimeError("call compile() before generate()")
+        if serve_config is None:
+            serve_config = ServeConfig.from_config(self.config)
+        return generate(
+            self,
+            prompts,
+            max_new_tokens=max_new_tokens,
+            serve=serve_config,
+            eos_token=eos_token,
+        )
+
     def zero_gradients(self):
         pass  # gradients are functional; nothing to zero
 

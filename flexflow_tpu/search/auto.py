@@ -677,6 +677,161 @@ def result_to_strategy(result: SearchResult, graph: PCGGraph) -> Strategy:
     )
 
 
+# -- serving (decode-regime) search -----------------------------------------
+#
+# The training search above minimizes one TRAIN step; a serving deployment
+# minimizes the per-token decode latency of flexflow_tpu.serving's engine,
+# which lives in the weight-bandwidth-bound regime CostModel.decode_op_cost
+# prices. The two regimes pick different strategies on the same model and
+# machine: at decode batch 1 a dp mesh leaves every chip but one idle while
+# TP over heads divides the dominant weight-read term, so TP wins — the
+# inverse of the training verdict, where dp's gradient all-reduce is cheap
+# next to the compute it parallelizes.
+
+# ops whose weights a serving candidate shards on the model axis, with the
+# divisibility rule the candidate must satisfy
+_DECODE_TP_OPS = {
+    OperatorType.LINEAR: lambda n: int(n.params["out_features"]),
+    OperatorType.MULTIHEAD_ATTENTION: lambda n: int(n.params["num_heads"]),
+    OperatorType.EMBEDDING: lambda n: int(n.params["out_dim"]),
+}
+
+
+class ServingSearchResult:
+    """One costed serving configuration (mesh + per-token step time)."""
+
+    def __init__(self, dp: int, tp: int, batch: int, kv_len: int, cost):
+        self.dp = dp
+        self.tp = tp
+        self.batch = batch
+        self.kv_len = kv_len
+        self.cost = cost
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.batch / self.cost.step_time if self.cost.step_time else 0.0
+
+    def describe(self) -> str:
+        return (
+            f"serving mesh(data={self.dp}, model={self.tp}), batch "
+            f"{self.batch}, kv {self.kv_len}: decode step "
+            f"{self.cost.step_time * 1e6:.1f} us, "
+            f"{self.tokens_per_s:.0f} tokens/s"
+        )
+
+
+def estimate_decode_step(
+    graph: PCGGraph, cm: CostModel, dp: int, tp: int, batch: int, kv_len: int
+) -> Optional[GraphCost]:
+    """Cost one decode iteration of the whole PCG under a (dp, tp) mesh;
+    None when infeasible (dp doesn't divide the batch, tp doesn't divide
+    some sharded op's heads/columns, or the footprint overflows HBM).
+
+    TP sync: each TP-sharded matmul chain resolves its partial sums with
+    an all-reduce of the [batch/dp, features] activation. We charge one
+    per attention node and one per linear node — an over-count of the
+    Megatron column→row pairing (which needs one per PAIR), acceptable
+    because decode activations are tiny and the verdict is driven by the
+    weight-read term; the over-count only biases AGAINST tp, so a tp
+    winner is a conservative conclusion."""
+    if batch % dp != 0:
+        return None
+    b_chip = batch // dp
+    compute = 0.0
+    sync = 0.0
+    mem = 0.0
+    for node in graph.nodes.values():
+        if node.op_type == OperatorType.INPUT or node.is_parallel_op:
+            continue
+        width = _DECODE_TP_OPS.get(node.op_type)
+        node_tp = tp
+        if width is not None and tp > 1:
+            if width(node) % tp != 0:
+                return None
+        elif width is None:
+            node_tp = 1
+        c = cm.decode_op_cost(node, b_chip, kv_len, tp=node_tp)
+        compute += c.forward_time
+        mem += c.memory
+        if node_tp > 1 and node.output_shapes:
+            out = node.output_shapes[0]
+            act = b_chip * out.logical_sizes[-1] * cm.elem_bytes(out)
+            sync += cm.all_reduce(float(act), node_tp)
+    cost = GraphCost(
+        step_time=compute + sync,
+        compute_time=compute,
+        sync_time=sync,
+        memory_per_chip=int(mem),
+    )
+    return cost
+
+
+def optimize_serving(
+    graph: PCGGraph,
+    num_devices: int,
+    spec: MachineSpec,
+    batch_size: int = 1,
+    kv_len: int = 1024,
+    mixed_precision: bool = False,
+    machine_model=None,
+    verbose: bool = False,
+) -> ServingSearchResult:
+    """Pick the decode-latency-optimal (dp, tp) mesh for serving
+    `batch_size` concurrent sequences at `kv_len` cache positions.
+    Enumerates every (dp, tp) with dp·tp dividing the chip count (idle
+    chips allowed, mirroring the training search's idle-dp candidates) and
+    keeps the feasible minimum-step-time one."""
+    cm = CostModel(
+        spec,
+        measure=False,  # the measured table times training shapes
+        machine_model=machine_model,
+        mixed_precision=mixed_precision,
+    )
+    best: Optional[ServingSearchResult] = None
+    for used in range(1, num_devices + 1):
+        if num_devices % used != 0:
+            continue
+        for dp, tp in _mesh_factorizations(used):
+            cost = estimate_decode_step(graph, cm, dp, tp, batch_size, kv_len)
+            if cost is None or not cost.feasible(spec):
+                continue
+            cur = ServingSearchResult(dp, tp, batch_size, kv_len, cost)
+            if verbose:
+                print(f"[serve-search] {cur.describe()}")
+            if best is None or cur.cost.step_time < best.cost.step_time:
+                best = cur
+    if best is None:
+        raise RuntimeError("serving search found no feasible strategy")
+    return best
+
+
+def search_serving_strategy(
+    model, batch_size: int = 1, kv_len: Optional[int] = None
+) -> ServingSearchResult:
+    """Model-level entry: cost the compiled builder graph's decode regime
+    on the config's machine (chip/nodes like the training search). kv_len
+    defaults to the config's serving cache length."""
+    cfg = model.config
+    n = cfg.num_devices if cfg.workers_per_node > 0 else None
+    if n is None:
+        import jax
+
+        n = len(jax.devices())
+    spec = MachineSpec(
+        num_nodes=max(1, cfg.num_nodes),
+        chips_per_node=max(1, n // max(1, cfg.num_nodes)),
+        chip=cfg.chip,
+    )
+    return optimize_serving(
+        model.graph,
+        n,
+        spec,
+        batch_size=batch_size,
+        kv_len=kv_len if kv_len is not None else cfg.serve_max_seq_len,
+        mixed_precision=cfg.allow_mixed_precision,
+    )
+
+
 def search_strategy(model, num_devices: int) -> Strategy:
     """compile()-time entry (reference: graph_optimize_task,
     graph.cc:1545-1613)."""

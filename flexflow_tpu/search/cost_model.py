@@ -452,6 +452,66 @@ class CostModel:
                     bwd = max(bwd, 2.0 * ring)
         return OpCost(fwd, bwd, 0.0, mem)
 
+    # -- decode (serving) cost family ---------------------------------------
+    #
+    # The autoregressive decode step the serving engine runs
+    # (flexflow_tpu.serving.engine) lives in a different cost regime than
+    # the training step this model was built for: one query token turns
+    # every matmul into a [b, 1, k]·[k, n] GEMV whose time is the WEIGHT
+    # bytes over HBM (re-read every generated token), and attention reads
+    # the slot's KV cache instead of materializing an [s, s] score block.
+    # That inversion is why the serving search (search/auto.py
+    # optimize_serving) picks a different strategy than training: TP over
+    # heads/columns divides the dominant weight-read term, while DP at
+    # batch 1 leaves chips idle. This family prices exactly that regime;
+    # it is analytic-only (the measured path times training shapes).
+
+    def decode_op_cost(
+        self, node, batch: int, kv_len: int, tp: int = 1
+    ) -> OpCost:
+        """Forward cost of ONE decode step of this op on one chip.
+
+        batch: in-flight sequences this chip serves (the dp shard of the
+        scheduler's active set); kv_len: cache positions attended (the
+        working sequence length); tp: model-axis degree sharding this
+        op's weights (heads for attention, columns for linear, rows for
+        embedding) — callers pass 1 for ops the candidate leaves
+        replicated. memory is the per-chip steady-state footprint the
+        feasibility check needs: weights/tp plus this op's KV-cache
+        block (serving holds no optimizer state)."""
+        tp = max(1, tp)
+        elem = lambda s: self.elem_bytes(s)  # noqa: E731
+        weight_bytes = sum(
+            s.volume() * elem(s) for s in node.weight_shapes
+        ) / tp
+        out = node.output_shapes[0] if node.output_shapes else None
+        feat = out.logical_sizes[-1] if out is not None else 1
+        out_elem = elem(out) if out is not None else 4
+        act_bytes = float(batch) * feat * out_elem / tp
+        flops = 2.0 * batch * sum(s.volume() for s in node.weight_shapes) / tp
+        mem = weight_bytes
+        bytes_moved = weight_bytes + act_bytes
+        if node.op_type == OperatorType.MULTIHEAD_ATTENTION:
+            heads = int(node.params["num_heads"]) // tp
+            head_dim = int(node.params["embed_dim"]) // max(
+                1, int(node.params["num_heads"])
+            )
+            cache_bytes = 2.0 * batch * kv_len * heads * head_dim * out_elem
+            bytes_moved += cache_bytes
+            mem += cache_bytes
+            flops += 4.0 * batch * kv_len * heads * head_dim
+        elif node.op_type == OperatorType.EMBEDDING:
+            # one row gather per sequence — the table is read sparsely,
+            # not streamed; weights count toward memory, not bandwidth
+            dim = int(node.params["out_dim"])
+            bytes_moved = float(batch) * dim * out_elem + act_bytes
+            flops = 0.0
+        return OpCost(
+            forward_time=self._roofline(flops, bytes_moved),
+            backward_time=0.0,
+            memory=int(mem),
+        )
+
     # -- measured mode ------------------------------------------------------
     #
     # The direct analog of the reference's inner_measure_operator_cost

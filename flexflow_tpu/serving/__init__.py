@@ -1,0 +1,39 @@
+"""flexflow_tpu.serving: continuous-batching inference on the trained PCG.
+
+The training side of this rebuild compiles a PCG into one jitted train
+step; this package is the inference mirror (upstream FlexFlow grew the
+same subsystem as FlexFlow Serve): a preallocated slot-addressed KV
+cache (kv_cache), prefill/decode step functions that re-execute the
+compiled graph with a cache-aware attention hook (engine), an Orca-style
+iteration-level scheduler (scheduler), and the `FFModel.generate` /
+ServeConfig surface (api). The decode regime also has its own cost
+family in search/cost_model.py so the auto-parallel search can pick a
+serving strategy (TP over heads at small batch) distinct from the
+training one.
+"""
+
+from flexflow_tpu.serving.api import ServeConfig, build_scheduler, generate
+from flexflow_tpu.serving.engine import GenerationEngine
+from flexflow_tpu.serving.kv_cache import KVCache, KVCacheSpec, default_buckets
+from flexflow_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    SchedulerStats,
+    StaticBatchingScheduler,
+    latency_percentiles,
+)
+
+__all__ = [
+    "ServeConfig",
+    "generate",
+    "build_scheduler",
+    "GenerationEngine",
+    "KVCache",
+    "KVCacheSpec",
+    "default_buckets",
+    "Request",
+    "ContinuousBatchingScheduler",
+    "StaticBatchingScheduler",
+    "SchedulerStats",
+    "latency_percentiles",
+]

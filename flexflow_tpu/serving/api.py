@@ -1,0 +1,108 @@
+"""User-facing serving surface: ServeConfig + generate().
+
+`FFModel.generate` (runtime/model.py) delegates here, mirroring how the
+reference grew FlexFlow Serve on top of the training FFModel. ServeConfig
+rides FFConfig flag parsing (`--max-seqs`, `--max-seq-len`,
+`--serve-scheduler`, `--eos-token`), so serving scripts configure the
+engine with the same CLI the training examples use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from flexflow_tpu.serving.engine import GenerationEngine
+from flexflow_tpu.serving.kv_cache import KVCache
+from flexflow_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    StaticBatchingScheduler,
+)
+
+_SCHEDULERS = {
+    "continuous": ContinuousBatchingScheduler,
+    "static": StaticBatchingScheduler,
+}
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Serving knobs (reference: RequestManager configuration in FlexFlow
+    Serve; Orca's max_batch_size / max_seq_len pair)."""
+
+    max_seqs: int = 8  # KV-cache slots = max in-flight requests
+    max_seq_len: int = 256  # cache length per slot (prompt + generation)
+    scheduler: str = "continuous"  # "continuous" | "static"
+    eos_token: Optional[int] = None
+    temperature: float = 0.0  # 0 = greedy
+    seed: int = 0
+    prefill_buckets: Tuple[int, ...] = ()  # () = powers of two
+
+    def __post_init__(self):
+        if self.scheduler not in _SCHEDULERS:
+            raise ValueError(
+                f"scheduler must be one of {sorted(_SCHEDULERS)}, "
+                f"got {self.scheduler!r}"
+            )
+        if self.max_seqs < 1 or self.max_seq_len < 2:
+            raise ValueError("max_seqs >= 1 and max_seq_len >= 2 required")
+
+    @staticmethod
+    def from_config(cfg) -> "ServeConfig":
+        """Lift the serve_* fields FFConfig.parse_args fills."""
+        return ServeConfig(
+            max_seqs=cfg.serve_max_seqs,
+            max_seq_len=cfg.serve_max_seq_len,
+            scheduler=cfg.serve_scheduler,
+            eos_token=(
+                cfg.serve_eos_token if cfg.serve_eos_token >= 0 else None
+            ),
+            seed=cfg.seed,
+        )
+
+
+def build_scheduler(model, serve: ServeConfig):
+    """(scheduler, engine, cache) wired to a compiled model — the pieces
+    generate() uses, exposed for callers that drive iterations themselves
+    (bench_serve.py, tests)."""
+    cache = KVCache.from_model(
+        model,
+        max_seqs=serve.max_seqs,
+        max_len=serve.max_seq_len,
+        buckets=serve.prefill_buckets or None,
+    )
+    engine = GenerationEngine(
+        model, cache, temperature=serve.temperature, seed=serve.seed
+    )
+    sched = _SCHEDULERS[serve.scheduler](engine)
+    return sched, engine, cache
+
+
+def generate(
+    model,
+    prompts: Sequence[Sequence[int]],
+    max_new_tokens: int = 16,
+    serve: Optional[ServeConfig] = None,
+    eos_token: Optional[int] = None,
+) -> List[List[int]]:
+    """Generate continuations for token-id prompts; returns the generated
+    tokens (prompt excluded) in the prompts' order. Greedy by default —
+    the cache-equivalence contract (tests/test_serving.py) holds for
+    greedy decoding."""
+    serve = serve or ServeConfig()
+    if eos_token is None:
+        eos_token = serve.eos_token
+    sched, _, _ = build_scheduler(model, serve)
+    reqs = [
+        Request(
+            rid=i,
+            prompt=list(map(int, p)),
+            max_new_tokens=max_new_tokens,
+            eos_token=eos_token,
+        )
+        for i, p in enumerate(prompts)
+    ]
+    done = sched.run(reqs)
+    by_rid = {r.rid: r for r in done}
+    return [by_rid[i].generated for i in range(len(reqs))]

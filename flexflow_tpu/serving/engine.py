@@ -1,0 +1,273 @@
+"""Prefill + single-token decode over a compiled FFModel.
+
+The engine re-executes the model's compiled PCG through
+`Executor.forward_values` with ONE op hook: MULTIHEAD_ATTENTION. The hook
+computes the exact training projections (ops/attention.mha_project_qkv /
+mha_project_out — shared code, not a reimplementation) and swaps only the
+attention core:
+
+  * **prefill**: causal dense attention over the (padded) prompt, exactly
+    the training forward — and captures each layer's K/V, scattered into
+    the cache rows of the admitted slots. The last valid position's
+    logits yield the first generated token, so admission itself produces
+    a token (Orca's iteration-level view: a prefill is just a fat
+    iteration).
+  * **decode**: one query position per slot. The new K/V row is written
+    at `lengths[slot]` via a per-row dynamic_update_slice, then
+    `ops.attention.decode_attention` runs masked one-query attention
+    against the cache (dense jnp path on CPU; `_decode_pallas_hook` is
+    the TPU-kernel seam).
+
+Both steps are jitted with static shapes: decode always runs at
+`[max_seqs, 1]`, prefill at `[max_seqs, bucket]` per length bucket, so
+compile count is 1 + #buckets for an entire serving session.
+
+Greedy argmax is the default (temperature 0); temperature sampling
+folds the serve seed into a per-step key so a fixed seed replays the
+same stream.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import numpy as np
+
+from flexflow_tpu.core.types import OperatorType
+
+
+class GenerationEngine:
+    """Step functions over (params, cache); all scheduling lives in
+    serving.scheduler."""
+
+    def __init__(self, model, cache, temperature: float = 0.0, seed: int = 0):
+        import jax
+
+        if model.executor is None:
+            raise RuntimeError("compile() the model before serving")
+        self.model = model
+        self.executor = model.executor
+        self.cache = cache
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        graph = model.graph
+        inputs = [
+            graph.nodes[g]
+            for g in self.executor.topo
+            if graph.nodes[g].op_type == OperatorType.INPUT
+            and not graph.nodes[g].inputs
+        ]
+        if len(inputs) != 1:
+            raise ValueError(
+                "serving needs a single token-id input tensor, model has "
+                f"{len(inputs)} inputs"
+            )
+        self.input_name = inputs[0].name
+        for g in cache.spec.layer_guids:
+            node = graph.nodes[g]
+            if not node.params.get("causal", False):
+                raise ValueError(
+                    f"attention node '{node.name}' is not causal; "
+                    "autoregressive serving needs causal=True"
+                )
+            refs = {(r.guid, r.out_idx) for r in node.inputs}
+            if len(refs) != 1:
+                raise ValueError(
+                    f"attention node '{node.name}' is cross-attention; "
+                    "the KV-cache engine supports self-attention only"
+                )
+        self._logits_ref = self.executor.logits_ref
+        # per-iteration dynamic seq truncation is a training knob; a stale
+        # value would truncate serving activations mid-stack
+        self.executor.set_seq_length(None)
+        self._decode_jit = jax.jit(self._decode_impl)
+        # one jitted prefill per length bucket (jit caches by shape anyway;
+        # the explicit dict makes the compile-count contract inspectable)
+        self._prefill_cache: Dict[int, object] = {}
+
+    # -- shared forward ------------------------------------------------------
+
+    def _forward_logits(self, params, tokens, hook):
+        values = self.executor.forward_values(
+            params,
+            {self.input_name: tokens},
+            rng=None,
+            train=False,
+            op_hooks={OperatorType.MULTIHEAD_ATTENTION: hook},
+            constrain=False,
+        )
+        return values[(self._logits_ref.guid, self._logits_ref.out_idx)]
+
+    def _pick(self, logits, step):
+        """logits [n, vocab] -> token ids [n]. Greedy at temperature 0,
+        else categorical with the serve seed folded by the step counter
+        (deterministic replay under a fixed seed)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
+        return jax.random.categorical(
+            key, logits.astype(jnp.float32) / self.temperature, axis=-1
+        ).astype(jnp.int32)
+
+    # -- prefill -------------------------------------------------------------
+
+    def _prefill_impl(self, params, tokens, slot_ids, prompt_lens, ck, cv, step):
+        """tokens [max_seqs, bucket] int32; slot_ids [max_seqs] (max_seqs
+        = out-of-bounds sentinel for padding rows — JAX drops OOB scatter
+        rows, so pad rows never touch live cache); prompt_lens [max_seqs]
+        (>=1; pad rows use 1). Returns (ck', cv', next_tokens, last_logits)."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            mha_project_qkv,
+            mha_project_out,
+            scaled_dot_product_attention,
+        )
+
+        captured_k: Dict[int, object] = {}
+        captured_v: Dict[int, object] = {}
+
+        def hook(node, ins, ws, ctx):
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            captured_k[node.guid] = k
+            captured_v[node.guid] = v
+            attn = scaled_dot_product_attention(q, k, v, causal=True)
+            return [
+                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
+            ]
+
+        logits = self._forward_logits(params, tokens, hook)
+        bucket = tokens.shape[1]
+        new_k, new_v = {}, {}
+        for g in self.cache.spec.layer_guids:
+            new_k[g] = ck[g].at[slot_ids, :bucket].set(
+                captured_k[g].astype(ck[g].dtype)
+            )
+            new_v[g] = cv[g].at[slot_ids, :bucket].set(
+                captured_v[g].astype(cv[g].dtype)
+            )
+        last = jnp.take_along_axis(
+            logits, (prompt_lens - 1)[:, None, None], axis=1
+        )[:, 0]
+        return new_k, new_v, self._pick(last, step), last
+
+    def prefill(
+        self,
+        params,
+        prompts: Sequence[Sequence[int]],
+        slots: Sequence[int],
+        step: int = 0,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Run one admission batch; writes the cache in place (commit) and
+        updates slot lengths. Returns (next_tokens [n], last_logits [n, V])
+        for the n real rows."""
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.cache.spec
+        n = len(prompts)
+        if n == 0:
+            raise ValueError("prefill needs at least one prompt")
+        if n > spec.max_seqs:
+            raise ValueError(f"{n} prompts > max_seqs {spec.max_seqs}")
+        bucket = spec.bucket(max(len(p) for p in prompts))
+        tokens = np.zeros((spec.max_seqs, bucket), dtype=np.int32)
+        slot_ids = np.full(spec.max_seqs, spec.max_seqs, dtype=np.int32)
+        plens = np.ones(spec.max_seqs, dtype=np.int32)
+        for i, (p, s) in enumerate(zip(prompts, slots)):
+            if not 0 < len(p) <= spec.max_len:
+                raise ValueError(
+                    f"prompt length {len(p)} outside (0, {spec.max_len}]"
+                )
+            tokens[i, : len(p)] = np.asarray(p, dtype=np.int32)
+            slot_ids[i] = s
+            plens[i] = len(p)
+        fn = self._prefill_cache.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._prefill_impl)
+            self._prefill_cache[bucket] = fn
+        new_k, new_v, nxt, last = fn(
+            params,
+            jnp.asarray(tokens),
+            jnp.asarray(slot_ids),
+            jnp.asarray(plens),
+            self.cache.k,
+            self.cache.v,
+            jnp.int32(step),
+        )
+        self.cache.commit(new_k, new_v)
+        for p, s in zip(prompts, slots):
+            self.cache.lengths[s] = len(p)
+        return np.asarray(nxt[:n]), np.asarray(last[:n])
+
+    # -- decode --------------------------------------------------------------
+
+    def _decode_impl(self, params, tokens, lengths, active, ck, cv, step):
+        """tokens [max_seqs, 1]; lengths [max_seqs] = cache position the
+        incoming token is written at; active [max_seqs] bool masks cache
+        writes for free slots."""
+        import jax
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            decode_attention,
+            mha_project_qkv,
+            mha_project_out,
+        )
+
+        new_k = dict(ck)
+        new_v = dict(cv)
+
+        def row_update(cache, new):
+            upd = jax.vmap(
+                lambda c, nrow, pos: jax.lax.dynamic_update_slice(
+                    c, nrow, (pos, 0, 0)
+                )
+            )(cache, new.astype(cache.dtype), lengths)
+            return jnp.where(active[:, None, None, None], upd, cache)
+
+        def hook(node, ins, ws, ctx):
+            g = node.guid
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            kc = row_update(ck[g], k)
+            vc = row_update(cv[g], v)
+            new_k[g] = kc
+            new_v[g] = vc
+            attn = decode_attention(q, kc, vc, lengths)
+            return [
+                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
+            ]
+
+        logits = self._forward_logits(params, tokens, hook)[:, -1, :]
+        return new_k, new_v, self._pick(logits, step), logits
+
+    def decode(
+        self,
+        params,
+        tokens: np.ndarray,
+        active_mask: np.ndarray,
+        step: int = 0,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One decode iteration over every slot. tokens [max_seqs] (last
+        emitted token per slot; free slots can carry anything), active_mask
+        [max_seqs] bool. Writes the cache, bumps active lengths, returns
+        (next_tokens [max_seqs], logits [max_seqs, V])."""
+        import jax.numpy as jnp
+
+        new_k, new_v, nxt, logits = self._decode_jit(
+            params,
+            jnp.asarray(tokens, dtype=jnp.int32)[:, None],
+            jnp.asarray(self.cache.lengths),
+            jnp.asarray(active_mask),
+            self.cache.k,
+            self.cache.v,
+            jnp.int32(step),
+        )
+        self.cache.commit(new_k, new_v)
+        self.cache.lengths[np.asarray(active_mask)] += 1
+        return np.asarray(nxt), np.asarray(logits)

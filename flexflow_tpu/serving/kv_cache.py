@@ -1,0 +1,204 @@
+"""Preallocated, slot-addressed KV cache for the serving engine.
+
+One pair of `[max_seqs, max_len, heads, head_dim]` arrays per attention
+layer (the FlexFlow Serve / vLLM "static" layout — a fixed HBM footprint
+the scheduler packs requests into, instead of per-request tensors that
+fragment and force recompiles). A *slot* is one row of the leading dim:
+admission allocates a slot, EOS/max-tokens frees it, and the decode step
+always runs at the full `[max_seqs, 1]` shape so there is exactly ONE
+compiled decode program regardless of how many requests are in flight.
+
+Prompt lengths are *bucketed*: prefill pads each admission batch's
+prompts up to the next bucket (powers of two by default), so the number
+of compiled prefill programs is bounded by the bucket count, not by the
+number of distinct prompt lengths the traffic happens to contain.
+
+Sharding: the cache derives its specs from the compiled model's
+ParallelTensor annotations — if the strategy shards attention heads (the
+head-parallel replica-dim rewrite, ops/attention.py), the cache's heads
+dim rides the same mesh axis, so TP-over-heads serving (the decode
+search's batch-1 winner, search/auto.py optimize_serving) keeps each
+chip's cache slice local.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flexflow_tpu.core.types import OperatorType
+
+
+def default_buckets(max_len: int, smallest: int = 16) -> Tuple[int, ...]:
+    """Powers of two from `smallest` up to (and including) max_len."""
+    out = []
+    b = smallest
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheSpec:
+    """Static geometry of the cache, derived from the compiled model."""
+
+    layer_guids: Tuple[int, ...]  # MHA node guids, topo order
+    max_seqs: int
+    max_len: int
+    num_heads: int
+    head_dim: int
+    buckets: Tuple[int, ...]
+
+    def bucket(self, length: int) -> int:
+        """Smallest bucket >= length (prefill pad target)."""
+        for b in self.buckets:
+            if b >= length:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds max_len {self.max_len}"
+        )
+
+    @property
+    def bytes_per_layer(self) -> int:
+        return 2 * 4 * self.max_seqs * self.max_len * self.num_heads * self.head_dim
+
+
+class KVCache:
+    """Device arrays + host-side slot bookkeeping.
+
+    The arrays are functional (each engine step returns fresh ones;
+    `commit` swaps them in); the slot free-list and per-slot lengths are
+    plain host state the scheduler mutates between steps.
+    """
+
+    def __init__(self, spec: KVCacheSpec, dtype, shardings=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.spec = spec
+        self.dtype = dtype
+        shape = (spec.max_seqs, spec.max_len, spec.num_heads, spec.head_dim)
+        self.k: Dict[int, object] = {}
+        self.v: Dict[int, object] = {}
+        for g in spec.layer_guids:
+            k = jnp.zeros(shape, dtype)
+            v = jnp.zeros(shape, dtype)
+            if shardings is not None:
+                k = jax.device_put(k, shardings)
+                v = jax.device_put(v, shardings)
+            self.k[g] = k
+            self.v[g] = v
+        # host bookkeeping: lengths[i] = tokens currently cached in slot i
+        self.lengths = np.zeros(spec.max_seqs, dtype=np.int32)
+        self._free: List[int] = list(range(spec.max_seqs - 1, -1, -1))
+        self._active: set = set()
+
+    # -- slot management (host side) ----------------------------------------
+
+    @property
+    def num_active(self) -> int:
+        return len(self._active)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def active_slots(self) -> List[int]:
+        return sorted(self._active)
+
+    def alloc(self) -> Optional[int]:
+        """Take a free slot (None when full). Lowest-index-last pop so slot
+        ids stay dense and deterministic under a fixed request stream."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._active.add(slot)
+        self.lengths[slot] = 0
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        self._active.remove(slot)
+        self.lengths[slot] = 0
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+
+    def commit(self, new_k: Dict[int, object], new_v: Dict[int, object]):
+        """Swap in the arrays a jitted step returned."""
+        self.k = dict(new_k)
+        self.v = dict(new_v)
+
+    # -- construction from a compiled model ---------------------------------
+
+    @staticmethod
+    def from_model(
+        model,
+        max_seqs: int,
+        max_len: int,
+        dtype=None,
+        buckets: Optional[Sequence[int]] = None,
+    ) -> "KVCache":
+        """Derive geometry + shardings from a compiled FFModel.
+
+        Every MULTIHEAD_ATTENTION node must agree on (heads, head_dim)
+        — one cache block size per model, like the reference serve stack.
+        The sharding comes from the Wq weight's head dim: if the chosen
+        strategy partitioned heads (parallel_idx -> mesh axis), the cache
+        heads dim shards on that axis; otherwise the cache is replicated.
+        """
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if model.executor is None:
+            raise RuntimeError("compile() the model before building a KVCache")
+        graph = model.graph
+        executor = model.executor
+        guids = [
+            g
+            for g in executor.topo
+            if graph.nodes[g].op_type == OperatorType.MULTIHEAD_ATTENTION
+        ]
+        if not guids:
+            raise ValueError("model has no attention layers to cache")
+        geom = set()
+        head_axis = None
+        for g in guids:
+            node = graph.nodes[g]
+            heads = int(node.params["num_heads"])
+            head_dim = int(node.params["embed_dim"]) // heads
+            geom.add((heads, head_dim))
+            wq = node.weight_shapes[0] if node.weight_shapes else None
+            if wq is not None and len(wq.dims) == 3:
+                hd = wq.dims[1]
+                if hd.degree > 1 and 0 <= hd.parallel_idx < len(
+                    executor.mesh_config.axis_names
+                ):
+                    head_axis = executor.mesh_config.axis_names[hd.parallel_idx]
+        if len(geom) != 1:
+            raise ValueError(
+                f"attention layers disagree on (heads, head_dim): {geom}"
+            )
+        heads, head_dim = geom.pop()
+        spec = KVCacheSpec(
+            layer_guids=tuple(guids),
+            max_seqs=max_seqs,
+            max_len=max_len,
+            num_heads=heads,
+            head_dim=head_dim,
+            buckets=tuple(buckets) if buckets else default_buckets(max_len),
+        )
+        # always place the cache on the mesh (replicated when heads are
+        # not sharded): uncommitted fresh zeros would give the first
+        # engine step a different jit signature than every later step
+        # (committed jit outputs) and buy a pointless recompile
+        shardings = NamedSharding(
+            executor.mesh, PartitionSpec(None, None, head_axis, None)
+        )
+        if dtype is None:
+            dtype = jnp.float32
+        return KVCache(spec, dtype, shardings=shardings)

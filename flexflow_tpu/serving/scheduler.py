@@ -1,0 +1,208 @@
+"""Iteration-level request scheduling (Orca, OSDI'22).
+
+The unit of scheduling is one model *iteration*, not one request: every
+iteration the scheduler (a) admits queued requests into free KV-cache
+slots — strictly FIFO, so admission is starvation-free by construction —
+running one prefill batch for the newcomers, then (b) runs one decode
+step over ALL in-flight slots. A request leaving (EOS or max-new-tokens)
+frees its slot at that same iteration boundary, so the next iteration's
+admission can refill it. That is the continuous-batching loop; the
+throughput win over request-level ("static") batching comes from never
+holding finished requests' slots hostage to the longest request in a
+batch.
+
+`StaticBatchingScheduler` is the deliberately-worse baseline the bench
+and the comparison test measure against: admit a batch, decode until the
+WHOLE batch finishes, only then admit the next batch (the reference
+FFModel::generate shape, and every pre-Orca serving stack).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. `generated` accumulates post-prompt tokens
+    (the first comes from the admission prefill itself)."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int = 16
+    eos_token: Optional[int] = None
+
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    submit_iter: int = -1
+    admit_iter: int = -1
+    finish_iter: int = -1
+    submit_time: float = 0.0
+    finish_time: float = 0.0
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_iter >= 0
+
+    @property
+    def latency_s(self) -> float:
+        return self.finish_time - self.submit_time
+
+    def _done_after(self, token: int) -> bool:
+        return (
+            self.eos_token is not None and token == self.eos_token
+        ) or len(self.generated) >= self.max_new_tokens
+
+
+@dataclasses.dataclass
+class SchedulerStats:
+    iterations: int = 0
+    decode_steps: int = 0
+    prefill_batches: int = 0
+    tokens_generated: int = 0
+    slot_steps: int = 0  # Σ over decode iterations of max_seqs (capacity)
+    busy_slot_steps: int = 0  # Σ of actually-active slots
+    elapsed_s: float = 0.0
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.tokens_generated / self.elapsed_s if self.elapsed_s else 0.0
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of decode slot-steps that carried a live request — the
+        metric continuous batching exists to push toward 1.0."""
+        return self.busy_slot_steps / self.slot_steps if self.slot_steps else 0.0
+
+
+class _SchedulerBase:
+    def __init__(self, engine, params=None):
+        self.engine = engine
+        self.cache = engine.cache
+        self.params = params if params is not None else engine.model.params
+        self.queue: deque = deque()
+        self.running: Dict[int, Request] = {}  # slot -> request
+        self.finished: List[Request] = []
+        self.stats = SchedulerStats()
+        self._iter = 0
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        if not request.prompt:
+            raise ValueError("empty prompt")
+        need = len(request.prompt) + request.max_new_tokens
+        if need > self.cache.spec.max_len:
+            raise ValueError(
+                f"request {request.rid}: prompt+max_new_tokens {need} "
+                f"exceeds cache max_len {self.cache.spec.max_len}"
+            )
+        request.submit_iter = self._iter
+        request.submit_time = time.perf_counter()
+        self.queue.append(request)
+
+    # -- shared pieces -------------------------------------------------------
+
+    def _admit(self, limit: Optional[int] = None) -> List[Request]:
+        """FIFO admission into free slots (never reorders the queue —
+        starvation-free) + ONE prefill batch for the admitted set."""
+        admitted: List[Request] = []
+        while self.queue and self.cache.num_free > 0:
+            if limit is not None and len(admitted) >= limit:
+                break
+            req = self.queue.popleft()
+            req.slot = self.cache.alloc()
+            req.admit_iter = self._iter
+            self.running[req.slot] = req
+            admitted.append(req)
+        if admitted:
+            nxt, _ = self.engine.prefill(
+                self.params,
+                [r.prompt for r in admitted],
+                [r.slot for r in admitted],
+                step=self._iter,
+            )
+            self.stats.prefill_batches += 1
+            for tok, req in zip(nxt, admitted):
+                self._emit(req, int(tok))
+        return admitted
+
+    def _emit(self, req: Request, token: int) -> None:
+        req.generated.append(token)
+        self.stats.tokens_generated += 1
+        if req._done_after(token):
+            self._retire(req)
+
+    def _retire(self, req: Request) -> None:
+        req.finish_iter = self._iter
+        req.finish_time = time.perf_counter()
+        self.cache.free(req.slot)
+        del self.running[req.slot]
+        self.finished.append(req)
+
+    def _decode_once(self) -> None:
+        spec = self.cache.spec
+        tokens = np.zeros(spec.max_seqs, dtype=np.int32)
+        active = np.zeros(spec.max_seqs, dtype=bool)
+        for slot, req in self.running.items():
+            tokens[slot] = req.generated[-1]
+            active[slot] = True
+        nxt, _ = self.engine.decode(
+            self.params, tokens, active, step=self._iter
+        )
+        self.stats.decode_steps += 1
+        self.stats.slot_steps += spec.max_seqs
+        self.stats.busy_slot_steps += int(active.sum())
+        for slot in [s for s, a in enumerate(active) if a]:
+            req = self.running.get(slot)
+            if req is not None:
+                self._emit(req, int(nxt[slot]))
+
+    def run(self, requests: Optional[Sequence[Request]] = None) -> List[Request]:
+        """Drain the queue (plus `requests`, submitted first) to completion;
+        returns finished requests in completion order."""
+        for r in requests or ():
+            self.submit(r)
+        t0 = time.perf_counter()
+        while self.queue or self.running:
+            self.step()
+        self.stats.elapsed_s += time.perf_counter() - t0
+        return self.finished
+
+
+class ContinuousBatchingScheduler(_SchedulerBase):
+    """Orca-style: every iteration joins new prefills with in-flight
+    decodes; slots recycle the moment a request retires."""
+
+    def step(self) -> None:
+        self._iter += 1
+        self.stats.iterations += 1
+        self._admit()
+        if self.running:
+            self._decode_once()
+
+
+class StaticBatchingScheduler(_SchedulerBase):
+    """Request-level batching baseline: a batch runs until every member
+    finishes; freed slots stay idle until the batch drains."""
+
+    def step(self) -> None:
+        self._iter += 1
+        self.stats.iterations += 1
+        if not self.running:
+            self._admit()
+        if self.running:
+            self._decode_once()
+
+
+def latency_percentiles(requests: Sequence[Request], pcts=(50, 95)):
+    """{pct: seconds} over finished requests' submit→finish latency."""
+    lats = [r.latency_s for r in requests if r.finished]
+    if not lats:
+        return {p: 0.0 for p in pcts}
+    return {p: float(np.percentile(lats, p)) for p in pcts}

@@ -26,6 +26,7 @@ EXAMPLES = [
     "nmt",
     "resnet",
     "resnext",
+    "serve_lm",
     "split_test",
     "split_test_2",
     "torch_mlp_import",
@@ -60,6 +61,10 @@ def test_split_test_2_runs():
 
 def test_candle_uno_runs():
     _run_main("candle_uno", ["-b", "8", "-i", "2", "-e", "1"])
+
+
+def test_serve_lm_runs():
+    _run_main("serve_lm", ["-b", "4", "--max-seqs", "2", "--max-seq-len", "32"])
 
 
 def test_nmt_runs_and_learns():

@@ -1,0 +1,336 @@
+"""Serving subsystem (flexflow_tpu.serving): cache-equivalence of KV-cache
+decode against full-prefill recompute, scheduler invariants under a
+mixed-length request stream (no slot leak, FIFO starvation-freedom, EOS
+frees slots, determinism), the continuous-vs-static batching win, and the
+decode-regime strategy search. All CPU-fast (tier 1)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu import (
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_decoder_lm
+from flexflow_tpu.serving import (
+    ContinuousBatchingScheduler,
+    GenerationEngine,
+    KVCache,
+    Request,
+    ServeConfig,
+    StaticBatchingScheduler,
+    build_scheduler,
+)
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 50
+
+
+def _lm(seed=0, devices=None, causal=True, batch=4, seq=32):
+    cfg = FFConfig(batch_size=batch, seed=seed)
+    model = FFModel(cfg)
+    tok = model.create_tensor([batch, seq], dtype=DataType.INT32, name="tokens")
+    build_decoder_lm(
+        model, tok, vocab_size=VOCAB, hidden=32, num_heads=4, num_layers=2,
+        ff_dim=64,
+    ) if causal else _non_causal_lm(model, tok)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        devices=devices if devices is not None else jax.devices()[:1],
+    )
+    return model
+
+
+def _non_causal_lm(model, tok):
+    t = model.embedding(tok, VOCAB, 32)
+    t = model.multihead_attention(t, t, t, 32, 4, bias=False)  # causal=False
+    return model.dense(t, VOCAB, use_bias=False)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _lm()
+
+
+def _ref_generate(model, prompt, n):
+    """Recomputed full-prefill forward per emitted token — the oracle the
+    KV-cache decode path must reproduce."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = np.asarray(
+            model.forward({"tokens": np.asarray([toks], dtype=np.int32)})
+        )
+        t = int(np.argmax(logits[0, len(toks) - 1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+# -- cache equivalence -------------------------------------------------------
+
+
+def test_cache_equivalence_mixed_length_stream(lm):
+    """Greedy generate() through the KV cache, with more requests than
+    slots (forced eviction/reuse), matches per-step full-prefill forward
+    recompute token-for-token."""
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 3, 1, 2], [7], [11, 12]]
+    out = lm.generate(
+        prompts,
+        max_new_tokens=6,
+        serve_config=ServeConfig(max_seqs=2, max_seq_len=32),
+    )
+    for p, got in zip(prompts, out):
+        assert got == _ref_generate(lm, p, 6)
+
+
+def test_decode_logits_match_full_forward(lm):
+    """One prefill + one decode: the decode step's logits agree with the
+    full forward's logits at the same position (numeric, not just argmax)."""
+    sched, engine, cache = build_scheduler(
+        lm, ServeConfig(max_seqs=2, max_seq_len=32)
+    )
+    prompt = [3, 1, 4, 1, 5]
+    slot = cache.alloc()
+    nxt, last = engine.prefill(lm.params, [prompt], [slot])
+    full = np.asarray(
+        lm.forward({"tokens": np.asarray([prompt], dtype=np.int32)})
+    )
+    # prefill logits at the last prompt position ARE the forward logits
+    np.testing.assert_allclose(last[0], full[0, len(prompt) - 1], atol=1e-5)
+    # decode the emitted token and compare against the extended forward
+    tokens = np.zeros(cache.spec.max_seqs, dtype=np.int32)
+    active = np.zeros(cache.spec.max_seqs, dtype=bool)
+    tokens[slot] = int(nxt[0])
+    active[slot] = True
+    _, dec_logits = engine.decode(lm.params, tokens, active)
+    ext = prompt + [int(nxt[0])]
+    full2 = np.asarray(
+        lm.forward({"tokens": np.asarray([ext], dtype=np.int32)})
+    )
+    np.testing.assert_allclose(
+        dec_logits[slot], full2[0, len(ext) - 1], atol=1e-4
+    )
+
+
+def test_generate_on_default_multichip_mesh():
+    """The serving path also runs on a model compiled with the default
+    8-virtual-device data-parallel mesh (replicated weights) and produces
+    the same tokens as the single-device compile."""
+    single = _lm(devices=jax.devices()[:1])
+    multi = _lm(devices=None if len(jax.devices()) == 1 else jax.devices())
+    prompts = [[2, 4, 6], [1, 3, 5, 7]]
+    sc = ServeConfig(max_seqs=2, max_seq_len=32)
+    assert single.generate(
+        prompts, max_new_tokens=4, serve_config=sc
+    ) == multi.generate(prompts, max_new_tokens=4, serve_config=sc)
+
+
+# -- scheduler invariants ----------------------------------------------------
+
+
+def _requests(spec):
+    return [
+        Request(rid=i, prompt=[(i * 7 + j) % VOCAB + 1 for j in range(1 + i % 5)],
+                max_new_tokens=n)
+        for i, n in enumerate(spec)
+    ]
+
+
+def test_no_slot_leak_and_all_finish(lm):
+    sched, engine, cache = build_scheduler(
+        lm, ServeConfig(max_seqs=3, max_seq_len=32)
+    )
+    reqs = _requests([2, 9, 4, 1, 7, 3, 5, 8, 2, 6])
+    done = sched.run(reqs)
+    assert len(done) == len(reqs)
+    assert cache.num_active == 0
+    assert cache.num_free == cache.spec.max_seqs
+    assert np.all(cache.lengths == 0)
+    for r in done:
+        assert len(r.generated) == r.max_new_tokens
+
+
+def test_fifo_admission_is_starvation_free(lm):
+    sched, _, _ = build_scheduler(lm, ServeConfig(max_seqs=2, max_seq_len=32))
+    reqs = _requests([6] * 9)
+    sched.run(reqs)
+    admits = [r.admit_iter for r in sorted(sched.finished, key=lambda r: r.rid)]
+    # strictly FIFO: a later arrival is never admitted before an earlier one
+    assert admits == sorted(admits)
+    assert all(a >= 0 for a in admits)
+
+
+def test_eos_frees_slot_early(lm):
+    """Pick the token an unconstrained run emits mid-stream as the EOS and
+    re-run: generation must stop AT the eos and the slot must recycle."""
+    base = lm.generate(
+        [[1, 2, 3]], max_new_tokens=8,
+        serve_config=ServeConfig(max_seqs=1, max_seq_len=32),
+    )[0]
+    eos = base[3]
+    cut = base.index(eos)  # first occurrence may be before position 3
+    sched, _, cache = build_scheduler(
+        lm, ServeConfig(max_seqs=1, max_seq_len=32)
+    )
+    done = sched.run(
+        [
+            Request(rid=0, prompt=[1, 2, 3], max_new_tokens=8, eos_token=eos),
+            Request(rid=1, prompt=[5, 6], max_new_tokens=2),
+        ]
+    )
+    r0 = next(r for r in done if r.rid == 0)
+    assert r0.generated == base[: cut + 1]  # truncated at eos, eos included
+    assert cache.num_free == 1
+    r1 = next(r for r in done if r.rid == 1)
+    assert len(r1.generated) == 2  # the freed slot served the next request
+
+
+def test_deterministic_under_fixed_seed(lm):
+    prompts = [[1, 2], [3, 4, 5], [6]]
+    sc = dict(max_seqs=2, max_seq_len=32)
+    a = lm.generate(prompts, 5, serve_config=ServeConfig(**sc))
+    b = lm.generate(prompts, 5, serve_config=ServeConfig(**sc))
+    assert a == b
+    s1 = lm.generate(
+        prompts, 5, serve_config=ServeConfig(temperature=0.8, seed=7, **sc)
+    )
+    s2 = lm.generate(
+        prompts, 5, serve_config=ServeConfig(temperature=0.8, seed=7, **sc)
+    )
+    assert s1 == s2
+
+
+def test_prefill_bucketing_bounds_compiles(lm):
+    cache = KVCache.from_model(lm, max_seqs=2, max_len=32)
+    engine = GenerationEngine(lm, cache)
+    sched = ContinuousBatchingScheduler(engine)
+    sched.run(_requests([2, 2, 2, 2]))  # prompt lengths 1..5 — one bucket
+    assert list(engine._prefill_cache) == [16]
+
+
+def test_non_causal_model_rejected():
+    model = _lm(causal=False, batch=2, seq=8)
+    with pytest.raises(ValueError, match="causal"):
+        model.generate([[1, 2]], max_new_tokens=2)
+
+
+def test_serve_config_from_flags():
+    cfg = FFConfig.parse_args(
+        [
+            "--max-seqs", "4", "--max-seq-len", "64",
+            "--serve-scheduler", "static", "--eos-token", "7",
+        ]
+    )
+    sc = ServeConfig.from_config(cfg)
+    assert (sc.max_seqs, sc.max_seq_len) == (4, 64)
+    assert sc.scheduler == "static"
+    assert sc.eos_token == 7
+
+
+# -- continuous vs static batching -------------------------------------------
+
+
+def _mixed_workload():
+    # extremes of per-request decode length: static batching pays the max
+    # of each batch while continuous recycles the short requests' slots
+    return _requests([4, 40, 4, 40, 4, 40, 4, 40])
+
+
+def test_continuous_batching_beats_static(lm):
+    """The acceptance microbench: same mixed-length request set, same
+    engine (so identical jitted programs). Continuous batching must
+    (a) run strictly fewer decode iterations at higher occupancy
+    (deterministic, the structural win) and (b) beat static tokens/s with
+    a conservative margin. Wall-clock uses the repo's min-over-reps
+    methodology (best of 2 runs each, jits pre-warmed) — the measured
+    ratio here is ~1.5x, asserted at 1.15x."""
+    serve = ServeConfig(max_seqs=4, max_seq_len=64, prefill_buckets=(8, 64))
+    _, engine, _ = build_scheduler(lm, serve)
+    for cls in (ContinuousBatchingScheduler, StaticBatchingScheduler):
+        cls(engine).run(_requests([2] * 6))  # warm every jit signature
+    stats = {}
+    best_tps = {}
+    for name, cls in (
+        ("static", StaticBatchingScheduler),
+        ("continuous", ContinuousBatchingScheduler),
+    ):
+        runs = []
+        for _ in range(2):
+            timed = cls(engine)
+            timed.run(_mixed_workload())
+            runs.append(timed.stats)
+        stats[name] = runs[0]
+        best_tps[name] = max(s.tokens_per_s for s in runs)
+    cont, stat = stats["continuous"], stats["static"]
+    assert cont.tokens_generated == stat.tokens_generated == 4 * (4 + 40)
+    assert cont.decode_steps < stat.decode_steps
+    assert cont.occupancy > stat.occupancy
+    assert best_tps["continuous"] > 1.15 * best_tps["static"], (
+        f"continuous {best_tps['continuous']:.1f} tok/s vs "
+        f"static {best_tps['static']:.1f} tok/s "
+        f"(steps {cont.decode_steps} vs {stat.decode_steps})"
+    )
+
+
+# -- decode-regime strategy search -------------------------------------------
+
+
+def test_serving_search_picks_tp_at_batch_1():
+    """The decode cost family's headline verdict: at decode batch 1 the
+    weight-read term dominates and TP over heads wins; the training search
+    on the SAME graph and machine picks a dp-dominant mesh."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.auto import (
+        estimate_decode_step,
+        optimize,
+        optimize_serving,
+    )
+    from flexflow_tpu.search.cost_model import CostModel
+
+    cfg = FFConfig(batch_size=64)
+    m = FFModel(cfg)
+    tok = m.create_tensor([64, 128], dtype=DataType.INT32, name="tokens")
+    build_decoder_lm(
+        m, tok, vocab_size=512, hidden=1024, num_heads=16, num_layers=4,
+        ff_dim=4096,
+    )
+    spec = MachineSpec(num_nodes=1, chips_per_node=8, chip="v5e")
+    serve = optimize_serving(m.graph, 8, spec, batch_size=1, kv_len=1024)
+    assert serve.dp == 1  # dp cannot split a single sequence
+    assert serve.tp > 1  # sharded weights beat an idle-chip dp mesh
+    cm = CostModel(spec)
+    dp_only = estimate_decode_step(m.graph, cm, 1, 1, 1, 1024)
+    assert serve.cost.step_time < dp_only.step_time
+    train = optimize(m.graph, 8, spec, budget=4)
+    assert train.dp > 1  # the training regime's verdict differs
+    assert (train.dp, train.tp) != (serve.dp, serve.tp)
+
+
+def test_decode_cost_scales_with_kv_len():
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.cost_model import CostModel
+
+    cfg = FFConfig(batch_size=4)
+    m = FFModel(cfg)
+    tok = m.create_tensor([4, 32], dtype=DataType.INT32, name="tokens")
+    build_decoder_lm(m, tok, vocab_size=128, hidden=64, num_heads=4)
+    cm = CostModel(MachineSpec(num_nodes=1, chips_per_node=1, chip="v5e"))
+    mha = next(
+        n for n in m.graph.nodes.values()
+        if n.op_type.name == "MULTIHEAD_ATTENTION"
+    )
+    short = cm.decode_op_cost(mha, batch=1, kv_len=128)
+    long = cm.decode_op_cost(mha, batch=1, kv_len=8192)
+    assert long.forward_time > short.forward_time  # cache read term
+    assert long.memory > short.memory
+    sharded = cm.decode_op_cost(mha, batch=1, kv_len=8192, tp=4)
+    assert sharded.forward_time < long.forward_time
