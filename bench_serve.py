@@ -1,13 +1,22 @@
-"""Serving benchmark driver: continuous vs static batching throughput.
+"""Serving benchmark driver: continuous vs static batching throughput,
+and (--paged) the paged-vs-slot KV cache comparison.
 
 Prints ONE JSON line in the bench.py protocol ({"metric", "value",
-"unit", "vs_baseline"} — extra serve-specific keys ride along):
-`value` is continuous-batching decode throughput in tokens/s and
-`vs_baseline` is the ratio over STATIC batching of the identical
-mixed-length request stream on the identical engine — the Orca win this
-subsystem exists for, so the baseline is the pre-Orca scheduler, not a
-training number. p50/p95 are per-request submit→finish latencies under
-continuous batching.
+"unit", "vs_baseline"} — extra serve-specific keys ride along).
+
+Default mode: `value` is continuous-batching decode throughput in
+tokens/s and `vs_baseline` is the ratio over STATIC batching of the
+identical mixed-length request stream on the identical engine — the
+Orca win this subsystem exists for, so the baseline is the pre-Orca
+scheduler, not a training number. p50/p95 are per-request submit→finish
+latencies under continuous batching.
+
+--paged mode (writes BENCH_PAGED.json): at the SAME cache byte budget
+(max_seqs * max_len rows), how many concurrent short requests
+(prompt + generation ≪ max_len) the paged layout admits vs the slot
+layout — the PagedAttention capacity win — plus CPU decode throughput
+parity of the paged path against the slot path at EQUAL batch (the
+gather must not tax the dense path).
 
 The default workload is the flagship Transformer geometry (12 layers,
 hidden 1024, 16 heads — transformer.cc:79-85) recast as a decoder LM;
@@ -17,6 +26,7 @@ hidden 1024, 16 heads — transformer.cc:79-85) recast as a decoder LM;
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 
@@ -30,16 +40,6 @@ def run(
     num_requests: int,
     reps: int = 2,
 ):
-    import jax
-
-    from flexflow_tpu import (
-        DataType,
-        FFConfig,
-        FFModel,
-        LossType,
-        SGDOptimizer,
-    )
-    from flexflow_tpu.models import build_decoder_lm
     from flexflow_tpu.serving import (
         ContinuousBatchingScheduler,
         Request,
@@ -49,26 +49,7 @@ def run(
         latency_percentiles,
     )
 
-    cfg = FFConfig(batch_size=max_seqs)
-    model = FFModel(cfg)
-    tok = model.create_tensor(
-        [max_seqs, max_len], dtype=DataType.INT32, name="tokens"
-    )
-    build_decoder_lm(
-        model,
-        tok,
-        vocab_size=vocab,
-        hidden=hidden,
-        num_heads=heads,
-        num_layers=layers,
-        ff_dim=4 * hidden,
-    )
-    model.compile(
-        optimizer=SGDOptimizer(lr=0.01),
-        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
-        metrics=[],
-        devices=jax.devices()[:1],
-    )
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
 
     def requests():
         # mixed-length stream: short and long continuations interleaved,
@@ -117,6 +98,153 @@ def run(
     }
 
 
+def _build_lm(layers, hidden, heads, vocab, max_seqs, max_len):
+    import jax
+
+    from flexflow_tpu import (
+        DataType,
+        FFConfig,
+        FFModel,
+        LossType,
+        SGDOptimizer,
+    )
+    from flexflow_tpu.models import build_decoder_lm
+
+    cfg = FFConfig(batch_size=max_seqs)
+    model = FFModel(cfg)
+    tok = model.create_tensor(
+        [max_seqs, max_len], dtype=DataType.INT32, name="tokens"
+    )
+    build_decoder_lm(
+        model,
+        tok,
+        vocab_size=vocab,
+        hidden=hidden,
+        num_heads=heads,
+        num_layers=layers,
+        ff_dim=4 * hidden,
+    )
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        devices=jax.devices()[:1],
+    )
+    return model
+
+
+def run_paged(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    reps: int = 2,
+):
+    """Paged-vs-slot comparison at a FIXED cache byte budget
+    (max_seqs * max_len rows per layer).
+
+    Capacity: a stream of short requests (prompt + generation ≪
+    max_len) saturates both layouts; `peak_in_flight` is how many the
+    admission gate let run concurrently. The slot layout caps at
+    max_seqs (each slot pins max_len rows); the paged layout packs
+    ceil(need / page_size) pages per request from the same pool.
+
+    Throughput parity: the default-geometry paged engine (identical
+    capacity AND identical admission schedule to slot) against the slot
+    engine on the standard mixed stream at EQUAL batch — the block-table
+    gather must cost < 10% on CPU decode throughput."""
+    from flexflow_tpu.serving import (
+        ContinuousBatchingScheduler,
+        Request,
+        ServeConfig,
+        build_scheduler,
+        default_page_size,
+    )
+
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+    page_size = default_page_size(max_len)
+    budget_pages = max_seqs * max_len // page_size
+
+    # short-request profile: prompt 1-4 tokens, generation max_len // 16
+    gen = max(2, max_len // 16)
+    need_pages = -(-(4 + gen) // page_size)
+    paged_seqs = max(max_seqs, budget_pages // need_pages)
+
+    def short_requests(n):
+        return [
+            Request(
+                rid=i,
+                prompt=[(i * 5 + j) % vocab for j in range(1 + i % 4)],
+                max_new_tokens=gen,
+            )
+            for i in range(n)
+        ]
+
+    def mixed_requests():
+        short, long_ = max(2, max_len // 16), max(8, max_len // 2 - 8)
+        return [
+            Request(
+                rid=i,
+                prompt=[(i * 7 + j) % vocab for j in range(1 + i % 6)],
+                max_new_tokens=short if i % 2 == 0 else long_,
+            )
+            for i in range(num_requests)
+        ]
+
+    # -- capacity at a fixed byte budget ------------------------------------
+    peak = {}
+    n_short = 2 * paged_seqs
+    for name, serve in (
+        ("slot", ServeConfig(max_seqs=max_seqs, max_seq_len=max_len,
+                             kv_layout="slot")),
+        ("paged", ServeConfig(max_seqs=paged_seqs, max_seq_len=max_len,
+                              kv_layout="paged", kv_page_size=page_size,
+                              kv_pages=budget_pages)),
+    ):
+        sched, _, _ = build_scheduler(model, serve)
+        sched.run(short_requests(n_short))
+        peak[name] = sched.stats.peak_in_flight
+    capacity_ratio = peak["paged"] / peak["slot"]
+
+    # -- decode throughput parity at equal batch ----------------------------
+    tps = {}
+    for name in ("slot", "paged"):
+        serve = ServeConfig(
+            max_seqs=max_seqs, max_seq_len=max_len, kv_layout=name
+        )
+        _, engine, _ = build_scheduler(model, serve)
+        ContinuousBatchingScheduler(engine).run(
+            mixed_requests()[: max_seqs + 1]
+        )  # warm jit signatures
+        best = 0.0
+        for _ in range(reps):
+            sched = ContinuousBatchingScheduler(engine)
+            sched.run(mixed_requests())
+            best = max(best, sched.stats.tokens_per_s)
+        tps[name] = best
+
+    return {
+        "metric": f"serve_paged_capacity_{layers}L_{hidden}h",
+        "value": round(capacity_ratio, 3),
+        "unit": "x_concurrent_short_requests_vs_slot",
+        # capacity over the slot layout at the same byte budget
+        # (acceptance floor: 1.5x)
+        "vs_baseline": round(capacity_ratio, 3),
+        "page_size": page_size,
+        "num_pages": budget_pages,
+        "paged_peak_in_flight": peak["paged"],
+        "slot_peak_in_flight": peak["slot"],
+        "paged_tokens_per_s": round(tps["paged"], 2),
+        "slot_tokens_per_s": round(tps["slot"], 2),
+        # paged/slot CPU decode throughput at equal batch (parity
+        # target: >= 0.9)
+        "throughput_ratio": round(tps["paged"] / tps["slot"], 3),
+    }
+
+
 _PRESETS = {
     # flagship geometry (transformer.cc:79-85) as a decoder LM — the TPU
     # target; CPU CI uses --smoke
@@ -140,12 +268,15 @@ _PRESETS = {
 def main():
     sys.path.insert(0, __file__.rsplit("/", 1)[0])
     args = dict(_PRESETS["flagship"])
+    paged = False
     argv = sys.argv[1:]
     i = 0
     while i < len(argv):
         a = argv[i]
         if a == "--smoke":
             args = dict(_PRESETS["smoke"])
+        elif a == "--paged":
+            paged = True
         elif a == "--preset":
             i += 1
             args = dict(_PRESETS[argv[i]])
@@ -155,7 +286,17 @@ def main():
         else:
             raise SystemExit(f"unknown flag {a!r}")
         i += 1
-    print(json.dumps(run(**args)))
+    if paged:
+        result = run_paged(**args)
+        out = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_PAGED.json"
+        )
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    else:
+        result = run(**args)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -52,7 +52,13 @@ def main():
     cfg = FFConfig.parse_args()
     model = build_lm(cfg)
     serve = ServeConfig.from_config(cfg)
-    sched, _, _ = build_scheduler(model, serve)
+    sched, _, cache = build_scheduler(model, serve)
+    if cache.paged:
+        print(
+            f"paged KV cache: {cache.spec.num_pages} pages of "
+            f"{cache.spec.page_size} tokens "
+            f"(try --kv-page-size / --kv-pages / --kv-layout slot)"
+        )
     requests = [
         Request(
             rid=i,
@@ -69,7 +75,7 @@ def main():
     print(
         f"[{serve.scheduler}] {s.tokens_generated} tokens, "
         f"{s.decode_steps} decode steps, occupancy {s.occupancy:.2f}, "
-        f"{s.tokens_per_s:.0f} tokens/s"
+        f"peak in-flight {s.peak_in_flight}, {s.tokens_per_s:.0f} tokens/s"
     )
 
 

@@ -107,6 +107,12 @@ class FFConfig:
     serve_max_seq_len: int = 256
     serve_scheduler: str = "continuous"
     serve_eos_token: int = -1
+    # paged KV cache geometry (PagedAttention): layout "paged" | "slot",
+    # page size in tokens (0 = auto) and pool pages (0 = derived from
+    # max_seqs * max_seq_len so default capacity matches the slot layout)
+    serve_kv_layout: str = "paged"
+    serve_kv_page_size: int = 0
+    serve_kv_pages: int = 0
 
     @property
     def num_devices(self) -> int:
@@ -224,6 +230,12 @@ class FFConfig:
                 cfg.serve_max_seq_len = int(take())
             elif a == "--serve-scheduler":
                 cfg.serve_scheduler = take()
+            elif a == "--kv-layout":
+                cfg.serve_kv_layout = take()
+            elif a == "--kv-page-size":
+                cfg.serve_kv_page_size = int(take())
+            elif a == "--kv-pages":
+                cfg.serve_kv_pages = int(take())
             elif a == "--eos-token":
                 cfg.serve_eos_token = int(take())
             # silently accept remaining legion-style flags with one value

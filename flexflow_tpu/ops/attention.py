@@ -169,6 +169,45 @@ def decode_attention(q, k_cache, v_cache, lengths):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
 
 
+def _paged_decode_pallas_hook(q, k_pool, v_pool, block_tables, lengths):
+    """Seam for a hand-tiled TPU paged-decode kernel (single-query flash
+    that walks the block table page by page instead of gathering the
+    pages into a contiguous [b, max_len] view first — the PagedAttention
+    kernel shape). None routes paged_decode_attention to the dense
+    gather path below; the kernel itself is a ROADMAP open item and
+    should follow the flash_kernel.py pattern (a supports() gate on the
+    page/head geometry, calibration-table tile sizes), like
+    _decode_pallas_hook for the contiguous layout."""
+    return None
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths):
+    """Serving decode against a block-paged KV cache. q: [b, 1, h, d];
+    k_pool/v_pool: [num_pages, page_size, h, d]; block_tables:
+    [b, max_pages_per_seq] int32 page ids (sentinel num_pages for
+    unallocated entries); lengths: [b] int32, the cache position the
+    current token was written at.
+
+    The dense path gathers each sequence's pages into a contiguous
+    [b, max_pages_per_seq * page_size, h, d] view and runs the exact
+    decode_attention math, so paged serving is token-identical to the
+    slot layout: sentinel/unwritten pages land at positions > lengths
+    and the same -1e30 mask drops them before softmax. (The gather is a
+    per-step temp the size of ONE dense cache view; the capacity win is
+    in the persistent pool allocation, not this working set.)"""
+    out = _paged_decode_pallas_hook(q, k_pool, v_pool, block_tables, lengths)
+    if out is not None:
+        return out
+    b = q.shape[0]
+    num_pages, page_size, heads, d = k_pool.shape
+    # sentinel entries are clamped to a real page; whatever that page
+    # holds sits at masked positions, so the clamp is numerically inert
+    tbl = jnp.minimum(block_tables, num_pages - 1)
+    k = k_pool[tbl].reshape(b, -1, heads, d)
+    v = v_pool[tbl].reshape(b, -1, heads, d)
+    return decode_attention(q, k, v, lengths)
+
+
 def _q_mesh_axes(ctx):
     """Mesh axis names (batch_ax, seq_ax, head_ax) of the q input's
     partitioned dims — head sharding comes from a replica dim on q (the

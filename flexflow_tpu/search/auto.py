@@ -698,30 +698,127 @@ _DECODE_TP_OPS = {
 
 
 class ServingSearchResult:
-    """One costed serving configuration (mesh + per-token step time)."""
+    """One costed serving configuration (mesh + per-token step time).
 
-    def __init__(self, dp: int, tp: int, batch: int, kv_len: int, cost):
+    `max_in_flight` (filled when the caller supplies a prompt/generation
+    length distribution) is the capacity estimate: how many concurrent
+    sequences of that profile the per-chip cache byte budget holds under
+    the priced KV layout — the number the paged cache exists to raise."""
+
+    def __init__(
+        self,
+        dp: int,
+        tp: int,
+        batch: int,
+        kv_len: int,
+        cost,
+        page_size: int = 0,
+        max_in_flight: Optional[int] = None,
+    ):
         self.dp = dp
         self.tp = tp
         self.batch = batch
         self.kv_len = kv_len
         self.cost = cost
+        self.page_size = page_size
+        self.max_in_flight = max_in_flight
 
     @property
     def tokens_per_s(self) -> float:
         return self.batch / self.cost.step_time if self.cost.step_time else 0.0
 
     def describe(self) -> str:
+        layout = f", pages of {self.page_size}" if self.page_size else ""
+        fit = (
+            f", ~{self.max_in_flight} seqs fit"
+            if self.max_in_flight is not None
+            else ""
+        )
         return (
             f"serving mesh(data={self.dp}, model={self.tp}), batch "
-            f"{self.batch}, kv {self.kv_len}: decode step "
+            f"{self.batch}, kv {self.kv_len}{layout}: decode step "
             f"{self.cost.step_time * 1e6:.1f} us, "
-            f"{self.tokens_per_s:.0f} tokens/s"
+            f"{self.tokens_per_s:.0f} tokens/s{fit}"
         )
 
 
+def _serving_cache_geometry(graph: PCGGraph):
+    """(mha_guids, heads, head_dim) of the graph's attention layers —
+    the cache geometry the capacity estimate needs."""
+    guids, geom = [], set()
+    for g, node in graph.nodes.items():
+        if node.op_type != OperatorType.MULTIHEAD_ATTENTION:
+            continue
+        guids.append(g)
+        heads = int(node.params["num_heads"])
+        geom.add((heads, int(node.params["embed_dim"]) // heads))
+    if len(geom) != 1:
+        raise ValueError(
+            f"attention layers disagree on (heads, head_dim): {geom or '∅'}"
+        )
+    heads, head_dim = geom.pop()
+    return tuple(guids), heads, head_dim
+
+
+def estimate_max_in_flight(
+    graph: PCGGraph,
+    cache_bytes: int,
+    mean_prompt_len: int,
+    mean_gen_len: int,
+    max_len: int,
+    page_size: int = 0,
+    tp: int = 1,
+    itemsize: int = 4,
+) -> int:
+    """How many concurrent sequences with the measured length profile
+    (mean_prompt_len + mean_gen_len cached tokens each) fit in a
+    per-chip KV byte budget.
+
+    Prices the layout through KVCacheSpec.total_bytes (one-sequence
+    spec): the slot layout charges every sequence max_len rows; the
+    paged layout charges ceil((prompt + gen) / page_size) whole pages —
+    the per-request footprint difference that lets paging admit more
+    short requests at the same budget. TP over heads divides the
+    per-chip row size, so a TP mesh fits proportionally more."""
+    from flexflow_tpu.serving.kv_cache import KVCacheSpec
+
+    guids, heads, head_dim = _serving_cache_geometry(graph)
+    heads_chip = max(1, heads // max(1, tp))
+    seq_len = min(max_len, int(mean_prompt_len) + int(mean_gen_len))
+    if page_size > 0:
+        one = KVCacheSpec(
+            layer_guids=guids,
+            max_seqs=1,
+            max_len=max_len,
+            num_heads=heads_chip,
+            head_dim=head_dim,
+            buckets=(max_len,),
+            page_size=page_size,
+            num_pages=-(-seq_len // page_size),
+            itemsize=itemsize,
+        )
+    else:
+        one = KVCacheSpec(
+            layer_guids=guids,
+            max_seqs=1,
+            max_len=max_len,
+            num_heads=heads_chip,
+            head_dim=head_dim,
+            buckets=(max_len,),
+            itemsize=itemsize,
+        )
+    per_seq = one.total_bytes
+    return int(cache_bytes // per_seq) if per_seq else 0
+
+
 def estimate_decode_step(
-    graph: PCGGraph, cm: CostModel, dp: int, tp: int, batch: int, kv_len: int
+    graph: PCGGraph,
+    cm: CostModel,
+    dp: int,
+    tp: int,
+    batch: int,
+    kv_len: int,
+    page_size: int = 0,
 ) -> Optional[GraphCost]:
     """Cost one decode iteration of the whole PCG under a (dp, tp) mesh;
     None when infeasible (dp doesn't divide the batch, tp doesn't divide
@@ -750,7 +847,9 @@ def estimate_decode_step(
                 return None
         elif width is None:
             node_tp = 1
-        c = cm.decode_op_cost(node, b_chip, kv_len, tp=node_tp)
+        c = cm.decode_op_cost(
+            node, b_chip, kv_len, tp=node_tp, page_size=page_size
+        )
         compute += c.forward_time
         mem += c.memory
         if node_tp > 1 and node.output_shapes:
@@ -775,12 +874,24 @@ def optimize_serving(
     mixed_precision: bool = False,
     machine_model=None,
     verbose: bool = False,
+    page_size: int = 0,
+    mean_prompt_len: Optional[int] = None,
+    mean_gen_len: Optional[int] = None,
+    max_len: Optional[int] = None,
 ) -> ServingSearchResult:
     """Pick the decode-latency-optimal (dp, tp) mesh for serving
     `batch_size` concurrent sequences at `kv_len` cache positions.
     Enumerates every (dp, tp) with dp·tp dividing the chip count (idle
     chips allowed, mirroring the training search's idle-dp candidates) and
-    keeps the feasible minimum-step-time one."""
+    keeps the feasible minimum-step-time one.
+
+    page_size > 0 prices the paged KV layout (per-sequence reads round
+    up to whole pages). When a measured length profile is supplied
+    (mean_prompt_len + mean_gen_len), the winner also carries
+    `max_in_flight`: how many such sequences fit in the winning mesh's
+    leftover HBM (chip capacity minus its weight shard, through
+    KVCacheSpec.total_bytes) — the "how many sequences fit" answer that
+    turns page geometry into a capacity verdict."""
     cm = CostModel(
         spec,
         measure=False,  # the measured table times training shapes
@@ -792,26 +903,65 @@ def optimize_serving(
         if num_devices % used != 0:
             continue
         for dp, tp in _mesh_factorizations(used):
-            cost = estimate_decode_step(graph, cm, dp, tp, batch_size, kv_len)
+            cost = estimate_decode_step(
+                graph, cm, dp, tp, batch_size, kv_len, page_size=page_size
+            )
             if cost is None or not cost.feasible(spec):
                 continue
-            cur = ServingSearchResult(dp, tp, batch_size, kv_len, cost)
+            cur = ServingSearchResult(
+                dp, tp, batch_size, kv_len, cost, page_size=page_size
+            )
             if verbose:
                 print(f"[serve-search] {cur.describe()}")
             if best is None or cur.cost.step_time < best.cost.step_time:
                 best = cur
     if best is None:
         raise RuntimeError("serving search found no feasible strategy")
+    if mean_prompt_len is not None and mean_gen_len is not None:
+        horizon = max_len if max_len is not None else kv_len
+        weight_bytes = 0.0
+        for node in graph.nodes.values():
+            if node.op_type == OperatorType.INPUT or node.is_parallel_op:
+                continue
+            node_tp = best.tp if _DECODE_TP_OPS.get(node.op_type) else 1
+            weight_bytes += (
+                sum(s.volume() * cm.elem_bytes(s) for s in node.weight_shapes)
+                / node_tp
+            )
+        budget = max(0, spec.hbm_bytes - int(weight_bytes))
+        best.max_in_flight = estimate_max_in_flight(
+            graph,
+            budget,
+            mean_prompt_len,
+            mean_gen_len,
+            horizon,
+            page_size=page_size,
+            tp=best.tp,
+        )
     return best
 
 
 def search_serving_strategy(
-    model, batch_size: int = 1, kv_len: Optional[int] = None
+    model,
+    batch_size: int = 1,
+    kv_len: Optional[int] = None,
+    mean_prompt_len: Optional[int] = None,
+    mean_gen_len: Optional[int] = None,
 ) -> ServingSearchResult:
     """Model-level entry: cost the compiled builder graph's decode regime
     on the config's machine (chip/nodes like the training search). kv_len
-    defaults to the config's serving cache length."""
+    defaults to the config's serving cache length; the KV layout and page
+    geometry come from the config's --kv-layout/--kv-page-size flags, and
+    a supplied length profile fills the winner's max_in_flight capacity
+    estimate."""
+    from flexflow_tpu.serving.kv_cache import default_page_size
+
     cfg = model.config
+    page_size = 0
+    if getattr(cfg, "serve_kv_layout", "paged") == "paged":
+        page_size = cfg.serve_kv_page_size or default_page_size(
+            cfg.serve_max_seq_len
+        )
     n = cfg.num_devices if cfg.workers_per_node > 0 else None
     if n is None:
         import jax
@@ -829,6 +979,10 @@ def search_serving_strategy(
         batch_size=batch_size,
         kv_len=kv_len if kv_len is not None else cfg.serve_max_seq_len,
         mixed_precision=cfg.allow_mixed_precision,
+        page_size=page_size,
+        mean_prompt_len=mean_prompt_len,
+        mean_gen_len=mean_gen_len,
+        max_len=cfg.serve_max_seq_len,
     )
 
 

@@ -467,7 +467,7 @@ class CostModel:
     # it is analytic-only (the measured path times training shapes).
 
     def decode_op_cost(
-        self, node, batch: int, kv_len: int, tp: int = 1
+        self, node, batch: int, kv_len: int, tp: int = 1, page_size: int = 0
     ) -> OpCost:
         """Forward cost of ONE decode step of this op on one chip.
 
@@ -478,7 +478,15 @@ class CostModel:
         embedding) — callers pass 1 for ops the candidate leaves
         replicated. memory is the per-chip steady-state footprint the
         feasibility check needs: weights/tp plus this op's KV-cache
-        block (serving holds no optimizer state)."""
+        block (serving holds no optimizer state).
+
+        page_size > 0 prices the block-paged cache layout
+        (serving/kv_cache.PagedKVCache): a sequence at kv_len positions
+        holds (and the decode step streams) ceil(kv_len / page_size)
+        whole pages, so the KV term rounds UP to page granularity — the
+        per-sequence rounding waste paging pays for its pool-level
+        packing win, which optimize_serving's max-in-flight estimate
+        prices on the other side."""
         tp = max(1, tp)
         elem = lambda s: self.elem_bytes(s)  # noqa: E731
         weight_bytes = sum(
@@ -496,7 +504,10 @@ class CostModel:
             head_dim = int(node.params["embed_dim"]) // max(
                 1, int(node.params["num_heads"])
             )
-            cache_bytes = 2.0 * batch * kv_len * heads * head_dim * out_elem
+            kv_rows = kv_len
+            if page_size > 0:
+                kv_rows = -(-kv_len // page_size) * page_size
+            cache_bytes = 2.0 * batch * kv_rows * heads * head_dim * out_elem
             bytes_moved += cache_bytes
             mem += cache_bytes
             flops += 4.0 * batch * kv_len * heads * head_dim

@@ -2,8 +2,10 @@
 
 The training side of this rebuild compiles a PCG into one jitted train
 step; this package is the inference mirror (upstream FlexFlow grew the
-same subsystem as FlexFlow Serve): a preallocated slot-addressed KV
-cache (kv_cache), prefill/decode step functions that re-execute the
+same subsystem as FlexFlow Serve): a block-paged KV cache with a
+host-side page allocator and block tables (kv_cache; the PR-1
+slot-contiguous layout remains as the kv_layout="slot" baseline),
+prefill/decode step functions that re-execute the
 compiled graph with a cache-aware attention hook (engine), an Orca-style
 iteration-level scheduler (scheduler), and the `FFModel.generate` /
 ServeConfig surface (api). The decode regime also has its own cost
@@ -14,7 +16,13 @@ training one.
 
 from flexflow_tpu.serving.api import ServeConfig, build_scheduler, generate
 from flexflow_tpu.serving.engine import GenerationEngine
-from flexflow_tpu.serving.kv_cache import KVCache, KVCacheSpec, default_buckets
+from flexflow_tpu.serving.kv_cache import (
+    KVCache,
+    KVCacheSpec,
+    PagedKVCache,
+    default_buckets,
+    default_page_size,
+)
 from flexflow_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
     Request,
@@ -30,7 +38,9 @@ __all__ = [
     "GenerationEngine",
     "KVCache",
     "KVCacheSpec",
+    "PagedKVCache",
     "default_buckets",
+    "default_page_size",
     "Request",
     "ContinuousBatchingScheduler",
     "StaticBatchingScheduler",

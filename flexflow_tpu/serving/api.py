@@ -13,7 +13,7 @@ import dataclasses
 from typing import List, Optional, Sequence, Tuple
 
 from flexflow_tpu.serving.engine import GenerationEngine
-from flexflow_tpu.serving.kv_cache import KVCache
+from flexflow_tpu.serving.kv_cache import KVCache, PagedKVCache
 from flexflow_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
     Request,
@@ -32,12 +32,18 @@ class ServeConfig:
     Serve; Orca's max_batch_size / max_seq_len pair)."""
 
     max_seqs: int = 8  # KV-cache slots = max in-flight requests
-    max_seq_len: int = 256  # cache length per slot (prompt + generation)
+    max_seq_len: int = 256  # max tokens per sequence (prompt + generation)
     scheduler: str = "continuous"  # "continuous" | "static"
     eos_token: Optional[int] = None
     temperature: float = 0.0  # 0 = greedy
     seed: int = 0
     prefill_buckets: Tuple[int, ...] = ()  # () = powers of two
+    # KV-cache layout (PagedAttention, SOSP'23): "paged" pools pages and
+    # routes them through block tables; "slot" is the PR-1 contiguous
+    # [max_seqs, max_len] layout, kept as the equivalence/bench baseline.
+    kv_layout: str = "paged"
+    kv_page_size: int = 0  # 0 = auto (vLLM-style 16, halved to divide max_len)
+    kv_pages: int = 0  # 0 = max_seqs * max_seq_len / page_size (same capacity)
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -47,6 +53,17 @@ class ServeConfig:
             )
         if self.max_seqs < 1 or self.max_seq_len < 2:
             raise ValueError("max_seqs >= 1 and max_seq_len >= 2 required")
+        if self.kv_layout not in ("paged", "slot"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'slot', got {self.kv_layout!r}"
+            )
+        if self.kv_page_size < 0 or self.kv_pages < 0:
+            raise ValueError("kv_page_size and kv_pages must be >= 0")
+        if self.kv_page_size and self.max_seq_len % self.kv_page_size:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} is not divisible by "
+                f"kv_page_size {self.kv_page_size}"
+            )
 
     @staticmethod
     def from_config(cfg) -> "ServeConfig":
@@ -59,6 +76,9 @@ class ServeConfig:
                 cfg.serve_eos_token if cfg.serve_eos_token >= 0 else None
             ),
             seed=cfg.seed,
+            kv_layout=cfg.serve_kv_layout,
+            kv_page_size=cfg.serve_kv_page_size,
+            kv_pages=cfg.serve_kv_pages,
         )
 
 
@@ -66,12 +86,22 @@ def build_scheduler(model, serve: ServeConfig):
     """(scheduler, engine, cache) wired to a compiled model — the pieces
     generate() uses, exposed for callers that drive iterations themselves
     (bench_serve.py, tests)."""
-    cache = KVCache.from_model(
-        model,
-        max_seqs=serve.max_seqs,
-        max_len=serve.max_seq_len,
-        buckets=serve.prefill_buckets or None,
-    )
+    if serve.kv_layout == "paged":
+        cache = PagedKVCache.from_model(
+            model,
+            max_seqs=serve.max_seqs,
+            max_len=serve.max_seq_len,
+            buckets=serve.prefill_buckets or None,
+            page_size=serve.kv_page_size,
+            num_pages=serve.kv_pages,
+        )
+    else:
+        cache = KVCache.from_model(
+            model,
+            max_seqs=serve.max_seqs,
+            max_len=serve.max_seq_len,
+            buckets=serve.prefill_buckets or None,
+        )
     engine = GenerationEngine(
         model, cache, temperature=serve.temperature, seed=serve.seed
     )

@@ -18,9 +18,25 @@ attention core:
     against the cache (dense jnp path on CPU; `_decode_pallas_hook` is
     the TPU-kernel seam).
 
+The engine serves BOTH cache layouts (kv_cache.KVCache slot-contiguous,
+kv_cache.PagedKVCache block-paged) with the same hooks: the paged steps
+route K/V rows through the slot's block table — prefill scatters each
+captured row into `page * page_size + offset` of the flattened pool
+(sentinel table entries produce out-of-bounds destinations that JAX
+drops, so pad rows and unallocated positions never touch live pages),
+decode writes the one new row the same way and attends via
+`ops.attention.paged_decode_attention`. Block tables ride into the
+jitted steps as an ordinary `[max_seqs, max_pages_per_seq]` int32
+argument; the host-side allocator (PagedKVCache) mutates them between
+steps, and `decode()` claims each sequence's next page BEFORE the step
+when it is about to cross a page boundary (the admission reserve
+guarantees that claim).
+
 Both steps are jitted with static shapes: decode always runs at
 `[max_seqs, 1]`, prefill at `[max_seqs, bucket]` per length bucket, so
-compile count is 1 + #buckets for an entire serving session.
+compile count is 1 + #buckets for an entire serving session — paging
+does not change the compile-count contract (tables are data, not
+shape).
 
 Greedy argmax is the default (temperature 0); temperature sampling
 folds the serve seed into a per-step key so a fixed seed replays the
@@ -80,7 +96,10 @@ class GenerationEngine:
         # per-iteration dynamic seq truncation is a training knob; a stale
         # value would truncate serving activations mid-stack
         self.executor.set_seq_length(None)
-        self._decode_jit = jax.jit(self._decode_impl)
+        self.paged = bool(getattr(cache, "paged", False))
+        self._decode_jit = jax.jit(
+            self._decode_impl_paged if self.paged else self._decode_impl
+        )
         # one jitted prefill per length bucket (jit caches by shape anyway;
         # the explicit dict makes the compile-count contract inspectable)
         self._prefill_cache: Dict[int, object] = {}
@@ -155,6 +174,62 @@ class GenerationEngine:
         )[:, 0]
         return new_k, new_v, self._pick(last, step), last
 
+    def _prefill_impl_paged(
+        self, params, tokens, row_tables, prompt_lens, ck, cv, step
+    ):
+        """Paged twin of _prefill_impl. row_tables [max_seqs,
+        ceil(bucket/page_size)] int32: the admitted slots' block-table
+        prefixes (pad rows and unallocated entries carry the sentinel
+        num_pages). Captured K/V rows scatter into the flattened pools at
+        `page * page_size + offset`; sentinel pages put the destination
+        out of bounds, which JAX drops — so bucket padding past a
+        prompt's allocated pages writes nothing, where the slot layout
+        writes (masked) garbage rows."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            mha_project_qkv,
+            mha_project_out,
+            scaled_dot_product_attention,
+        )
+
+        captured_k: Dict[int, object] = {}
+        captured_v: Dict[int, object] = {}
+
+        def hook(node, ins, ws, ctx):
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            captured_k[node.guid] = k
+            captured_v[node.guid] = v
+            attn = scaled_dot_product_attention(q, k, v, causal=True)
+            return [
+                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
+            ]
+
+        logits = self._forward_logits(params, tokens, hook)
+        spec = self.cache.spec
+        ps = spec.page_size
+        bucket = tokens.shape[1]
+        pos = jnp.arange(bucket)
+        # [max_seqs, bucket] flat pool destinations through the table
+        dest = (row_tables[:, pos // ps] * ps + pos % ps).reshape(-1)
+        new_k, new_v = {}, {}
+        for g in spec.layer_guids:
+            kp = ck[g].reshape(-1, spec.num_heads, spec.head_dim)
+            vp = cv[g].reshape(-1, spec.num_heads, spec.head_dim)
+            kr = captured_k[g].astype(ck[g].dtype).reshape(
+                -1, spec.num_heads, spec.head_dim
+            )
+            vr = captured_v[g].astype(cv[g].dtype).reshape(
+                -1, spec.num_heads, spec.head_dim
+            )
+            new_k[g] = kp.at[dest].set(kr).reshape(ck[g].shape)
+            new_v[g] = vp.at[dest].set(vr).reshape(cv[g].shape)
+        last = jnp.take_along_axis(
+            logits, (prompt_lens - 1)[:, None, None], axis=1
+        )[:, 0]
+        return new_k, new_v, self._pick(last, step), last
+
     def prefill(
         self,
         params,
@@ -188,12 +263,25 @@ class GenerationEngine:
             plens[i] = len(p)
         fn = self._prefill_cache.get(bucket)
         if fn is None:
-            fn = jax.jit(self._prefill_impl)
+            fn = jax.jit(
+                self._prefill_impl_paged if self.paged else self._prefill_impl
+            )
             self._prefill_cache[bucket] = fn
+        if self.paged:
+            ps = spec.page_size
+            width = -(-bucket // ps)
+            row_tables = np.full(
+                (spec.max_seqs, width), spec.num_pages, dtype=np.int32
+            )
+            for i, s in enumerate(slots):
+                row_tables[i] = self.cache.block_tables[s, :width]
+            route = jnp.asarray(row_tables)
+        else:
+            route = jnp.asarray(slot_ids)
         new_k, new_v, nxt, last = fn(
             params,
             jnp.asarray(tokens),
-            jnp.asarray(slot_ids),
+            route,
             jnp.asarray(plens),
             self.cache.k,
             self.cache.v,
@@ -246,6 +334,55 @@ class GenerationEngine:
         logits = self._forward_logits(params, tokens, hook)[:, -1, :]
         return new_k, new_v, self._pick(logits, step), logits
 
+    def _decode_impl_paged(
+        self, params, tokens, lengths, active, tables, ck, cv, step
+    ):
+        """Paged twin of _decode_impl. tables [max_seqs,
+        max_pages_per_seq] int32 block tables. The new K/V row scatters
+        into `tables[slot, lengths // page_size] * page_size + lengths %
+        page_size` of the flattened pool; inactive slots are routed to an
+        out-of-bounds destination (dropped), replacing the contiguous
+        path's where-mask."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            mha_project_qkv,
+            mha_project_out,
+            paged_decode_attention,
+        )
+
+        spec = self.cache.spec
+        ps = spec.page_size
+        oob = spec.num_pages * ps
+        new_k = dict(ck)
+        new_v = dict(cv)
+        page = jnp.take_along_axis(tables, (lengths // ps)[:, None], axis=1)[
+            :, 0
+        ]
+        dest = jnp.where(active, page * ps + lengths % ps, oob)
+
+        def row_update(pool, new):
+            flat = pool.reshape(-1, spec.num_heads, spec.head_dim)
+            return flat.at[dest].set(new[:, 0].astype(pool.dtype)).reshape(
+                pool.shape
+            )
+
+        def hook(node, ins, ws, ctx):
+            g = node.guid
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            kc = row_update(ck[g], k)
+            vc = row_update(cv[g], v)
+            new_k[g] = kc
+            new_v[g] = vc
+            attn = paged_decode_attention(q, kc, vc, tables, lengths)
+            return [
+                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
+            ]
+
+        logits = self._forward_logits(params, tokens, hook)[:, -1, :]
+        return new_k, new_v, self._pick(logits, step), logits
+
     def decode(
         self,
         params,
@@ -259,11 +396,22 @@ class GenerationEngine:
         (next_tokens [max_seqs], logits [max_seqs, V])."""
         import jax.numpy as jnp
 
+        args = []
+        if self.paged:
+            # claim the next page for any sequence about to cross a page
+            # boundary BEFORE the jitted step (host-side allocator; the
+            # admission reserve guarantees the claim succeeds)
+            for slot in np.nonzero(np.asarray(active_mask))[0]:
+                self.cache.ensure_position(
+                    int(slot), int(self.cache.lengths[slot])
+                )
+            args = [jnp.asarray(self.cache.block_tables)]
         new_k, new_v, nxt, logits = self._decode_jit(
             params,
             jnp.asarray(tokens, dtype=jnp.int32)[:, None],
             jnp.asarray(self.cache.lengths),
             jnp.asarray(active_mask),
+            *args,
             self.cache.k,
             self.cache.v,
             jnp.int32(step),

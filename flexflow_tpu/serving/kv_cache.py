@@ -1,19 +1,34 @@
-"""Preallocated, slot-addressed KV cache for the serving engine.
+"""KV caches for the serving engine: slot-contiguous and block-paged.
 
-One pair of `[max_seqs, max_len, heads, head_dim]` arrays per attention
-layer (the FlexFlow Serve / vLLM "static" layout — a fixed HBM footprint
-the scheduler packs requests into, instead of per-request tensors that
-fragment and force recompiles). A *slot* is one row of the leading dim:
-admission allocates a slot, EOS/max-tokens frees it, and the decode step
-always runs at the full `[max_seqs, 1]` shape so there is exactly ONE
-compiled decode program regardless of how many requests are in flight.
+Two layouts share one spec/geometry derivation:
 
-Prompt lengths are *bucketed*: prefill pads each admission batch's
-prompts up to the next bucket (powers of two by default), so the number
-of compiled prefill programs is bounded by the bucket count, not by the
-number of distinct prompt lengths the traffic happens to contain.
+* `KVCache` — the PR-1 "static" layout: one pair of
+  `[max_seqs, max_len, heads, head_dim]` arrays per attention layer. A
+  *slot* is one row of the leading dim; every admitted request reserves
+  `max_len` worth of HBM regardless of how many tokens it generates.
 
-Sharding: the cache derives its specs from the compiled model's
+* `PagedKVCache` — the PagedAttention layout (Kwon et al., SOSP'23 /
+  vLLM): K/V live in `[num_pages, page_size, heads, head_dim]` *pools*,
+  a host-side free-page allocator hands pages to sequences on demand,
+  and a per-slot *block table* (`[max_seqs, max_pages_per_seq]` int32,
+  padded with the sentinel `num_pages`) maps logical cache positions to
+  pool pages. A short request holds only the pages its tokens fill, so
+  the same byte budget admits more concurrent short requests — the
+  serving-capacity lever continuous batching turns into throughput.
+
+  Admission uses a preemption-free *reserve* policy: a request is
+  admitted only when the free pool covers its worst case
+  (`ceil((prompt + max_new_tokens) / page_size)` pages) on top of every
+  in-flight request's outstanding worst case, so a mid-flight decode can
+  ALWAYS claim its next page — no preemption/swap path needed.
+
+Prompt lengths are *bucketed* in both layouts: prefill pads each
+admission batch's prompts up to the next bucket (powers of two by
+default), so the number of compiled prefill programs is bounded by the
+bucket count, not by the number of distinct prompt lengths the traffic
+happens to contain.
+
+Sharding: both layouts derive their specs from the compiled model's
 ParallelTensor annotations — if the strategy shards attention heads (the
 head-parallel replica-dim rewrite, ops/attention.py), the cache's heads
 dim rides the same mesh axis, so TP-over-heads serving (the decode
@@ -42,9 +57,24 @@ def default_buckets(max_len: int, smallest: int = 16) -> Tuple[int, ...]:
     return tuple(out)
 
 
+def default_page_size(max_len: int, target: int = 16) -> int:
+    """Largest power of two <= target that divides max_len (vLLM's
+    default block size is 16; halve until the geometry is divisible)."""
+    ps = target
+    while ps > 1 and max_len % ps:
+        ps //= 2
+    return ps
+
+
 @dataclasses.dataclass(frozen=True)
 class KVCacheSpec:
-    """Static geometry of the cache, derived from the compiled model."""
+    """Static geometry of the cache, derived from the compiled model.
+
+    page_size == 0 means the slot-contiguous layout; page_size > 0 means
+    the paged layout with `num_pages` pool pages. `itemsize` is the
+    cache dtype's element width in bytes (set from the actual dtype at
+    cache construction, so bytes_per_layer/total_bytes price bf16
+    caches at 2 bytes, not a hardcoded 4)."""
 
     layer_guids: Tuple[int, ...]  # MHA node guids, topo order
     max_seqs: int
@@ -52,6 +82,9 @@ class KVCacheSpec:
     num_heads: int
     head_dim: int
     buckets: Tuple[int, ...]
+    page_size: int = 0
+    num_pages: int = 0
+    itemsize: int = 4
 
     def bucket(self, length: int) -> int:
         """Smallest bucket >= length (prefill pad target)."""
@@ -63,23 +96,120 @@ class KVCacheSpec:
         )
 
     @property
+    def paged(self) -> bool:
+        return self.page_size > 0
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        if not self.paged:
+            raise ValueError("max_pages_per_seq is a paged-layout property")
+        return self.max_len // self.page_size
+
+    @property
+    def total_rows(self) -> int:
+        """Cache positions the layout can hold (pool rows)."""
+        if self.paged:
+            return self.num_pages * self.page_size
+        return self.max_seqs * self.max_len
+
+    @property
     def bytes_per_layer(self) -> int:
-        return 2 * 4 * self.max_seqs * self.max_len * self.num_heads * self.head_dim
+        return 2 * self.itemsize * self.total_rows * self.num_heads * self.head_dim
+
+    @property
+    def total_bytes(self) -> int:
+        """Whole-cache footprint across layers — the number
+        optimize_serving's capacity estimate divides the HBM budget by."""
+        return self.bytes_per_layer * len(self.layer_guids)
+
+
+def _validate_page_geometry(max_seqs, max_len, page_size, num_pages):
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    if max_len % page_size:
+        raise ValueError(
+            f"max_len {max_len} is not divisible by page_size {page_size}"
+        )
+    if num_pages < max_len // page_size:
+        raise ValueError(
+            f"num_pages {num_pages} cannot hold even one max_len sequence "
+            f"({max_len // page_size} pages of {page_size})"
+        )
+
+
+def _derive_geometry(model):
+    """(layer_guids, heads, head_dim, head_axis, executor) from a
+    compiled FFModel. Every MULTIHEAD_ATTENTION node must agree on
+    (heads, head_dim) — one cache block size per model, like the
+    reference serve stack. The sharding comes from the Wq weight's head
+    dim: if the chosen strategy partitioned heads (parallel_idx -> mesh
+    axis), the cache heads dim shards on that axis; otherwise the cache
+    is replicated."""
+    if model.executor is None:
+        raise RuntimeError("compile() the model before building a KVCache")
+    graph = model.graph
+    executor = model.executor
+    guids = [
+        g
+        for g in executor.topo
+        if graph.nodes[g].op_type == OperatorType.MULTIHEAD_ATTENTION
+    ]
+    if not guids:
+        raise ValueError("model has no attention layers to cache")
+    geom = set()
+    head_axis = None
+    for g in guids:
+        node = graph.nodes[g]
+        heads = int(node.params["num_heads"])
+        head_dim = int(node.params["embed_dim"]) // heads
+        geom.add((heads, head_dim))
+        wq = node.weight_shapes[0] if node.weight_shapes else None
+        if wq is not None and len(wq.dims) == 3:
+            hd = wq.dims[1]
+            if hd.degree > 1 and 0 <= hd.parallel_idx < len(
+                executor.mesh_config.axis_names
+            ):
+                head_axis = executor.mesh_config.axis_names[hd.parallel_idx]
+    if len(geom) != 1:
+        raise ValueError(
+            f"attention layers disagree on (heads, head_dim): {geom}"
+        )
+    heads, head_dim = geom.pop()
+    return guids, heads, head_dim, head_axis, executor
+
+
+def _heads_sharding(executor, head_axis):
+    """NamedSharding placing dim 2 (heads) on the strategy's head axis.
+
+    Always place the cache on the mesh (replicated when heads are not
+    sharded): uncommitted fresh zeros would give the first engine step a
+    different jit signature than every later step (committed jit
+    outputs) and buy a pointless recompile."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(
+        executor.mesh, PartitionSpec(None, None, head_axis, None)
+    )
 
 
 class KVCache:
-    """Device arrays + host-side slot bookkeeping.
+    """Slot-contiguous device arrays + host-side slot bookkeeping.
 
     The arrays are functional (each engine step returns fresh ones;
     `commit` swaps them in); the slot free-list and per-slot lengths are
     plain host state the scheduler mutates between steps.
     """
 
+    paged = False
+
     def __init__(self, spec: KVCacheSpec, dtype, shardings=None):
         import jax
         import jax.numpy as jnp
 
-        self.spec = spec
+        self.spec = dataclasses.replace(
+            spec, itemsize=jnp.dtype(dtype).itemsize
+        )
+        spec = self.spec
         self.dtype = dtype
         shape = (spec.max_seqs, spec.max_len, spec.num_heads, spec.head_dim)
         self.k: Dict[int, object] = {}
@@ -110,9 +240,19 @@ class KVCache:
     def active_slots(self) -> List[int]:
         return sorted(self._active)
 
-    def alloc(self) -> Optional[int]:
+    def can_admit(self, prompt_len: int = 1, total_len: int = 0) -> bool:
+        """A slot layout admits whenever a slot is free (every slot holds
+        max_len positions, so length arguments cannot change the verdict
+        — they exist for signature parity with PagedKVCache)."""
+        return bool(self._free)
+
+    def alloc(
+        self, prompt_len: Optional[int] = None, total_len: Optional[int] = None
+    ) -> Optional[int]:
         """Take a free slot (None when full). Lowest-index-last pop so slot
-        ids stay dense and deterministic under a fixed request stream."""
+        ids stay dense and deterministic under a fixed request stream.
+        The length arguments are accepted (and ignored) so the scheduler
+        drives both layouts through one call."""
         if not self._free:
             return None
         slot = self._free.pop()
@@ -143,47 +283,10 @@ class KVCache:
         dtype=None,
         buckets: Optional[Sequence[int]] = None,
     ) -> "KVCache":
-        """Derive geometry + shardings from a compiled FFModel.
-
-        Every MULTIHEAD_ATTENTION node must agree on (heads, head_dim)
-        — one cache block size per model, like the reference serve stack.
-        The sharding comes from the Wq weight's head dim: if the chosen
-        strategy partitioned heads (parallel_idx -> mesh axis), the cache
-        heads dim shards on that axis; otherwise the cache is replicated.
-        """
+        """Derive geometry + shardings from a compiled FFModel."""
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec
 
-        if model.executor is None:
-            raise RuntimeError("compile() the model before building a KVCache")
-        graph = model.graph
-        executor = model.executor
-        guids = [
-            g
-            for g in executor.topo
-            if graph.nodes[g].op_type == OperatorType.MULTIHEAD_ATTENTION
-        ]
-        if not guids:
-            raise ValueError("model has no attention layers to cache")
-        geom = set()
-        head_axis = None
-        for g in guids:
-            node = graph.nodes[g]
-            heads = int(node.params["num_heads"])
-            head_dim = int(node.params["embed_dim"]) // heads
-            geom.add((heads, head_dim))
-            wq = node.weight_shapes[0] if node.weight_shapes else None
-            if wq is not None and len(wq.dims) == 3:
-                hd = wq.dims[1]
-                if hd.degree > 1 and 0 <= hd.parallel_idx < len(
-                    executor.mesh_config.axis_names
-                ):
-                    head_axis = executor.mesh_config.axis_names[hd.parallel_idx]
-        if len(geom) != 1:
-            raise ValueError(
-                f"attention layers disagree on (heads, head_dim): {geom}"
-            )
-        heads, head_dim = geom.pop()
+        guids, heads, head_dim, head_axis, executor = _derive_geometry(model)
         spec = KVCacheSpec(
             layer_guids=tuple(guids),
             max_seqs=max_seqs,
@@ -192,13 +295,215 @@ class KVCache:
             head_dim=head_dim,
             buckets=tuple(buckets) if buckets else default_buckets(max_len),
         )
-        # always place the cache on the mesh (replicated when heads are
-        # not sharded): uncommitted fresh zeros would give the first
-        # engine step a different jit signature than every later step
-        # (committed jit outputs) and buy a pointless recompile
-        shardings = NamedSharding(
-            executor.mesh, PartitionSpec(None, None, head_axis, None)
+        if dtype is None:
+            dtype = jnp.float32
+        return KVCache(
+            spec, dtype, shardings=_heads_sharding(executor, head_axis)
+        )
+
+
+class PagedKVCache:
+    """Block-paged pools + host-side page allocator and block tables.
+
+    Device state: one `[num_pages, page_size, heads, head_dim]` K and V
+    pool per layer (functional, swapped via `commit` like KVCache).
+    Host state: the free-page stack, per-slot block tables (sentinel =
+    `num_pages`, an out-of-bounds page id — OOB scatters drop and OOB
+    gathers are masked by lengths, so sentinel entries are inert on
+    device), per-slot lengths, and the reserve ledger that keeps
+    admission preemption-free.
+    """
+
+    paged = True
+
+    def __init__(self, spec: KVCacheSpec, dtype, shardings=None):
+        import jax
+        import jax.numpy as jnp
+
+        if not spec.paged:
+            raise ValueError("PagedKVCache needs a spec with page_size > 0")
+        _validate_page_geometry(
+            spec.max_seqs, spec.max_len, spec.page_size, spec.num_pages
+        )
+        self.spec = dataclasses.replace(
+            spec, itemsize=jnp.dtype(dtype).itemsize
+        )
+        spec = self.spec
+        self.dtype = dtype
+        shape = (spec.num_pages, spec.page_size, spec.num_heads, spec.head_dim)
+        self.k: Dict[int, object] = {}
+        self.v: Dict[int, object] = {}
+        for g in spec.layer_guids:
+            k = jnp.zeros(shape, dtype)
+            v = jnp.zeros(shape, dtype)
+            if shardings is not None:
+                k = jax.device_put(k, shardings)
+                v = jax.device_put(v, shardings)
+            self.k[g] = k
+            self.v[g] = v
+        self.lengths = np.zeros(spec.max_seqs, dtype=np.int32)
+        self.block_tables = np.full(
+            (spec.max_seqs, spec.max_pages_per_seq),
+            spec.num_pages,
+            dtype=np.int32,
+        )
+        self._free_slots: List[int] = list(range(spec.max_seqs - 1, -1, -1))
+        self._active: set = set()
+        self._free_pages: List[int] = list(range(spec.num_pages - 1, -1, -1))
+        # preemption-free reserve: _max_pages[s] is slot s's worst-case
+        # page need (fixed at admission), _held[s] what it holds now;
+        # _reserved = Σ (max - held) over active slots — pages the free
+        # list must keep back for in-flight growth
+        self._held = np.zeros(spec.max_seqs, dtype=np.int64)
+        self._max_pages = np.zeros(spec.max_seqs, dtype=np.int64)
+        self._reserved = 0
+
+    # -- page/slot management (host side) ------------------------------------
+
+    @property
+    def num_active(self) -> int:
+        return len(self._active)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def num_free_pages(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.spec.num_pages - len(self._free_pages)
+
+    def active_slots(self) -> List[int]:
+        return sorted(self._active)
+
+    def _pages_for(self, tokens: int) -> int:
+        return -(-int(tokens) // self.spec.page_size)
+
+    def can_admit(self, prompt_len: int = 1, total_len: int = 0) -> bool:
+        """True when a slot is free AND the free pool covers this
+        request's worst case on top of every in-flight reservation."""
+        max_p = self._pages_for(max(prompt_len, total_len))
+        return (
+            bool(self._free_slots)
+            and len(self._free_pages) - self._reserved >= max_p
+        )
+
+    def alloc(
+        self, prompt_len: Optional[int] = None, total_len: Optional[int] = None
+    ) -> Optional[int]:
+        """Admit a sequence: take a slot, allocate the pages its prompt
+        fills now, and reserve (without allocating) the rest of its
+        worst case. None when the reserve policy refuses. Omitted
+        lengths reserve-and-fill a full max_len (slot-equivalent
+        behavior for ad-hoc engine callers)."""
+        spec = self.spec
+        if prompt_len is None:
+            prompt_len = spec.max_len
+        total = max(prompt_len, total_len if total_len is not None else 0)
+        if total > spec.max_len:
+            raise ValueError(
+                f"sequence of {total} tokens exceeds max_len {spec.max_len}"
+            )
+        need_now = self._pages_for(prompt_len)
+        max_p = self._pages_for(total)
+        if not self.can_admit(prompt_len, total):
+            return None
+        slot = self._free_slots.pop()
+        self._active.add(slot)
+        for i in range(need_now):
+            self.block_tables[slot, i] = self._free_pages.pop()
+        self._held[slot] = need_now
+        self._max_pages[slot] = max_p
+        self._reserved += max_p - need_now
+        self.lengths[slot] = 0
+        return slot
+
+    def ensure_position(self, slot: int, pos: int) -> None:
+        """Make position `pos` of `slot` writable, claiming the next page
+        from the free list when the sequence crosses a page boundary.
+        The admission reserve guarantees the claim succeeds for any
+        position inside the request's declared worst case."""
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        pi = pos // self.spec.page_size
+        if self.block_tables[slot, pi] != self.spec.num_pages:
+            return
+        if not self._free_pages:
+            raise RuntimeError(
+                "free-page pool exhausted despite the admission reserve — "
+                "allocator invariant violated"
+            )
+        self.block_tables[slot, pi] = self._free_pages.pop()
+        self._held[slot] += 1
+        if self._held[slot] <= self._max_pages[slot]:
+            self._reserved -= 1
+
+    def free(self, slot: int) -> None:
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        self._active.remove(slot)
+        sentinel = self.spec.num_pages
+        for pi in range(self.spec.max_pages_per_seq):
+            p = int(self.block_tables[slot, pi])
+            if p != sentinel:
+                self._free_pages.append(p)
+        self.block_tables[slot, :] = sentinel
+        self._free_pages.sort(reverse=True)
+        self._reserved -= max(0, int(self._max_pages[slot] - self._held[slot]))
+        self._held[slot] = 0
+        self._max_pages[slot] = 0
+        self.lengths[slot] = 0
+        self._free_slots.append(slot)
+        self._free_slots.sort(reverse=True)
+
+    def commit(self, new_k: Dict[int, object], new_v: Dict[int, object]):
+        """Swap in the pools a jitted step returned."""
+        self.k = dict(new_k)
+        self.v = dict(new_v)
+
+    # -- construction from a compiled model ---------------------------------
+
+    @staticmethod
+    def from_model(
+        model,
+        max_seqs: int,
+        max_len: int,
+        dtype=None,
+        buckets: Optional[Sequence[int]] = None,
+        page_size: int = 0,
+        num_pages: int = 0,
+    ) -> "PagedKVCache":
+        """Derive geometry + shardings from a compiled FFModel. Defaults
+        (page_size 0 / num_pages 0) pick the vLLM-style block size and a
+        pool with EXACTLY the slot layout's capacity
+        (max_seqs * max_len rows), so existing callers see identical
+        byte footprint and admission behavior."""
+        import jax.numpy as jnp
+
+        guids, heads, head_dim, head_axis, executor = _derive_geometry(model)
+        if page_size <= 0:
+            page_size = default_page_size(max_len)
+        if max_len % page_size:
+            raise ValueError(
+                f"max_len {max_len} is not divisible by page_size {page_size}"
+            )
+        if num_pages <= 0:
+            num_pages = max_seqs * max_len // page_size
+        spec = KVCacheSpec(
+            layer_guids=tuple(guids),
+            max_seqs=max_seqs,
+            max_len=max_len,
+            num_heads=heads,
+            head_dim=head_dim,
+            buckets=tuple(buckets) if buckets else default_buckets(max_len),
+            page_size=page_size,
+            num_pages=num_pages,
         )
         if dtype is None:
             dtype = jnp.float32
-        return KVCache(spec, dtype, shardings=shardings)
+        return PagedKVCache(
+            spec, dtype, shardings=_heads_sharding(executor, head_axis)
+        )

@@ -67,6 +67,7 @@ class SchedulerStats:
     tokens_generated: int = 0
     slot_steps: int = 0  # Σ over decode iterations of max_seqs (capacity)
     busy_slot_steps: int = 0  # Σ of actually-active slots
+    peak_in_flight: int = 0  # max concurrent running requests observed
     elapsed_s: float = 0.0
 
     @property
@@ -110,16 +111,32 @@ class _SchedulerBase:
 
     def _admit(self, limit: Optional[int] = None) -> List[Request]:
         """FIFO admission into free slots (never reorders the queue —
-        starvation-free) + ONE prefill batch for the admitted set."""
+        starvation-free: the head either admits or blocks everyone
+        behind it) + ONE prefill batch for the admitted set. Admission
+        asks the cache, so the gate is layout-specific: the slot layout
+        admits while a slot is free; the paged layout also requires
+        enough free PAGES to cover the request's worst case
+        (prompt + max_new_tokens) on top of every in-flight request's
+        outstanding reserve — the preemption-free policy that lets a
+        mid-flight decode always claim its next page."""
         admitted: List[Request] = []
-        while self.queue and self.cache.num_free > 0:
+        while self.queue:
             if limit is not None and len(admitted) >= limit:
                 break
-            req = self.queue.popleft()
-            req.slot = self.cache.alloc()
+            req = self.queue[0]
+            slot = self.cache.alloc(
+                len(req.prompt), len(req.prompt) + req.max_new_tokens
+            )
+            if slot is None:
+                break
+            self.queue.popleft()
+            req.slot = slot
             req.admit_iter = self._iter
             self.running[req.slot] = req
             admitted.append(req)
+        self.stats.peak_in_flight = max(
+            self.stats.peak_in_flight, len(self.running)
+        )
         if admitted:
             nxt, _ = self.engine.prefill(
                 self.params,
