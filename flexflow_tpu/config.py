@@ -113,6 +113,11 @@ class FFConfig:
     serve_kv_layout: str = "paged"
     serve_kv_page_size: int = 0
     serve_kv_pages: int = 0
+    # speculative decoding (SpecInfer; serving/spec.py): draft source
+    # ("" = off, "ngram" = weight-free prompt lookup, "model" = second
+    # decoder LM passed to build_scheduler) and draft length per verify
+    serve_spec_draft: str = ""
+    serve_spec_k: int = 4
 
     @property
     def num_devices(self) -> int:
@@ -238,6 +243,10 @@ class FFConfig:
                 cfg.serve_kv_pages = int(take())
             elif a == "--eos-token":
                 cfg.serve_eos_token = int(take())
+            elif a == "--spec-draft":
+                cfg.serve_spec_draft = take()
+            elif a == "--spec-k":
+                cfg.serve_spec_k = int(take())
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

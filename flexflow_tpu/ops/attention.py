@@ -169,6 +169,62 @@ def decode_attention(q, k_cache, v_cache, lengths):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
 
 
+def _verify_pallas_hook(q, k_cache, v_cache, lengths):
+    """Seam for a hand-tiled TPU verify kernel (k+1-query flash against
+    the cache — the speculative-decoding scoring pass). None routes
+    verify_attention to the dense jnp path; like _decode_pallas_hook,
+    the kernel is a ROADMAP open item and on CPU the dense path wins
+    (a [w, max_len] score block per sequence with w = spec_k + 1)."""
+    return None
+
+
+def verify_attention(q, k_cache, v_cache, lengths):
+    """Speculative-decoding verify regime: w query positions per sequence
+    (the last emitted token plus the drafted continuation) attend
+    against the cache in ONE call. q: [b, w, h, d]; k_cache/v_cache:
+    [b, max_len, h, d] — already containing the w fresh K/V rows written
+    at positions lengths[i]..lengths[i]+w-1; lengths: [b] int32, the
+    cache position the FIRST of the w tokens was written at.
+
+    Query j of sequence i may see cache positions <= lengths[i] + j —
+    the staircase mask that makes the verify step causal over the draft
+    while still reading the whole prefix. decode_attention is exactly
+    the w == 1 special case, and the same fp32 accumulation / -1e30
+    fill keeps verify softmax numerics aligned with prefill and decode
+    (greedy spec decode must be token-identical to plain decode)."""
+    out = _verify_pallas_hook(q, k_cache, v_cache, lengths)
+    if out is not None:
+        return out
+    d = q.shape[-1]
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
+    w = q.shape[1]
+    klen = k_cache.shape[1]
+    # [b, w, klen]: key position <= lengths + query offset
+    allowed = (
+        jnp.arange(klen)[None, None, :]
+        <= lengths[:, None, None] + jnp.arange(w)[None, :, None]
+    )
+    logits = jnp.where(allowed[:, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
+def paged_verify_attention(q, k_pool, v_pool, block_tables, lengths):
+    """Verify attention against the block-paged cache: gathers each
+    sequence's pages into a contiguous view (same dense-gather strategy
+    as paged_decode_attention, same sentinel clamping) and runs the
+    exact verify_attention math, so paged verify is token-identical to
+    the slot layout."""
+    b = q.shape[0]
+    num_pages, page_size, heads, d = k_pool.shape
+    tbl = jnp.minimum(block_tables, num_pages - 1)
+    k = k_pool[tbl].reshape(b, -1, heads, d)
+    v = v_pool[tbl].reshape(b, -1, heads, d)
+    return verify_attention(q, k, v, lengths)
+
+
 def _paged_decode_pallas_hook(q, k_pool, v_pool, block_tables, lengths):
     """Seam for a hand-tiled TPU paged-decode kernel (single-query flash
     that walks the block table page by page instead of gathering the

@@ -1196,13 +1196,17 @@ class FFModel:
         max_new_tokens: int = 16,
         serve_config=None,
         eos_token=None,
+        draft_model=None,
     ):
         """Autoregressive generation with continuous batching (the
         FlexFlow Serve surface grafted onto the training FFModel): token-id
         prompts in, generated token lists out, scheduled by
         serving.scheduler over a preallocated KV cache. Greedy unless the
         ServeConfig sets a temperature. The model must be compiled, take a
-        single int token input, and use causal self-attention."""
+        single int token input, and use causal self-attention.
+        `serve_config.spec_draft` turns on speculative decoding
+        (serving/spec.py); `draft_model` supplies the small draft LM when
+        spec_draft is "model"."""
         from flexflow_tpu.serving.api import ServeConfig, generate
 
         if self.executor is None:
@@ -1215,6 +1219,7 @@ class FFModel:
             max_new_tokens=max_new_tokens,
             serve=serve_config,
             eos_token=eos_token,
+            draft_model=draft_model,
         )
 
     def zero_gradients(self):

@@ -865,6 +865,169 @@ def estimate_decode_step(
     return cost
 
 
+def estimate_verify_step(
+    graph: PCGGraph,
+    cm: CostModel,
+    dp: int,
+    tp: int,
+    batch: int,
+    kv_len: int,
+    k: int,
+    page_size: int = 0,
+) -> Optional[GraphCost]:
+    """Cost one speculative-decoding VERIFY iteration (k+1 scored token
+    positions per sequence, serving/engine.verify) of the whole PCG
+    under a (dp, tp) mesh — the spec-decode twin of estimate_decode_step
+    (same feasibility rules, same conservative one-all-reduce-per-node
+    TP sync charge; the synced activation is (k+1)x wider)."""
+    if batch % dp != 0:
+        return None
+    b_chip = batch // dp
+    compute = 0.0
+    sync = 0.0
+    mem = 0.0
+    for node in graph.nodes.values():
+        if node.op_type == OperatorType.INPUT or node.is_parallel_op:
+            continue
+        width = _DECODE_TP_OPS.get(node.op_type)
+        node_tp = tp
+        if width is not None and tp > 1:
+            if width(node) % tp != 0:
+                return None
+        elif width is None:
+            node_tp = 1
+        c = cm.verify_op_cost(
+            node, b_chip, kv_len, k, tp=node_tp, page_size=page_size
+        )
+        compute += c.forward_time
+        mem += c.memory
+        if node_tp > 1 and node.output_shapes:
+            out = node.output_shapes[0]
+            act = (
+                b_chip * (k + 1) * out.logical_sizes[-1] * cm.elem_bytes(out)
+            )
+            sync += cm.all_reduce(float(act), node_tp)
+    return GraphCost(
+        step_time=compute + sync,
+        compute_time=compute,
+        sync_time=sync,
+        memory_per_chip=int(mem),
+    )
+
+
+def expected_accepted_tokens(acceptance_rate: float, k: int) -> float:
+    """E[accepted drafts] of a k-token draft under a per-token
+    acceptance rate α (independence approximation: the verify accepts a
+    geometric prefix, so E = Σ_{i=1..k} α^i). The verify then emits one
+    MORE token from the target itself (correction or bonus), so
+    expected tokens per verify step is this plus one."""
+    a = min(max(float(acceptance_rate), 0.0), 1.0)
+    if a >= 1.0:
+        return float(k)
+    return a * (1.0 - a**k) / (1.0 - a)
+
+
+class SpecKResult:
+    """The draft length optimize_spec_k picked, with the priced
+    alternatives. k == 0 means speculation does not pay at this
+    acceptance rate (the draft/verify overhead exceeds the accepted
+    tokens' worth)."""
+
+    def __init__(
+        self,
+        k: int,
+        acceptance_rate: float,
+        tokens_per_s: float,
+        decode_tokens_per_s: float,
+        step_time: float,
+        tokens_per_step: float,
+    ):
+        self.k = k
+        self.acceptance_rate = acceptance_rate
+        self.tokens_per_s = tokens_per_s
+        self.decode_tokens_per_s = decode_tokens_per_s
+        self.step_time = step_time
+        self.tokens_per_step = tokens_per_step
+
+    @property
+    def speedup(self) -> float:
+        """Expected decode-throughput ratio over non-speculative decode."""
+        if not self.decode_tokens_per_s:
+            return 1.0
+        return self.tokens_per_s / self.decode_tokens_per_s
+
+    def describe(self) -> str:
+        return (
+            f"spec-k {self.k} at acceptance {self.acceptance_rate:.2f}: "
+            f"{self.tokens_per_step:.2f} tokens/step, expected "
+            f"{self.speedup:.2f}x over plain decode"
+        )
+
+
+def optimize_spec_k(
+    graph: PCGGraph,
+    spec: MachineSpec,
+    acceptance_rate: float,
+    batch: int = 1,
+    kv_len: int = 1024,
+    k_max: int = 8,
+    draft_graph: Optional[PCGGraph] = None,
+    dp: int = 1,
+    tp: int = 1,
+    page_size: int = 0,
+    machine_model=None,
+    mixed_precision: bool = False,
+) -> SpecKResult:
+    """Pick the draft length k that maximizes expected decode throughput
+    at a MEASURED per-token acceptance rate (SchedulerStats
+    .acceptance_rate from a spec-mode run, or an offline estimate).
+
+    Prices each candidate k as: one verify step of k+1 positions
+    (CostModel.verify_op_cost — weights stream once, the spec-decode
+    win) plus the draft cost (k decode steps of `draft_graph` when the
+    draft is a model; zero for the weight-free n-gram draft), buying
+    1 + E[accepted](α, k) tokens. k = 0 (plain decode) is always a
+    candidate, so a hopeless acceptance rate yields "don't speculate"
+    rather than a forced k."""
+    cm = CostModel(
+        spec,
+        measure=False,
+        machine_model=machine_model,
+        mixed_precision=mixed_precision,
+    )
+    base = estimate_decode_step(
+        graph, cm, dp, tp, batch, kv_len, page_size=page_size
+    )
+    if base is None:
+        raise ValueError(f"(dp={dp}, tp={tp}) is infeasible for this graph")
+    draft_step = 0.0
+    if draft_graph is not None:
+        d = estimate_decode_step(draft_graph, cm, dp, tp, batch, kv_len)
+        if d is None:
+            raise ValueError(
+                f"(dp={dp}, tp={tp}) is infeasible for the draft graph"
+            )
+        draft_step = d.step_time
+    decode_rate = batch / base.step_time if base.step_time else 0.0
+    best = SpecKResult(
+        0, acceptance_rate, decode_rate, decode_rate, base.step_time, 1.0
+    )
+    for k in range(1, k_max + 1):
+        vcost = estimate_verify_step(
+            graph, cm, dp, tp, batch, kv_len, k, page_size=page_size
+        )
+        if vcost is None:
+            continue
+        step_time = vcost.step_time + k * draft_step
+        tokens = 1.0 + expected_accepted_tokens(acceptance_rate, k)
+        rate = batch * tokens / step_time if step_time else 0.0
+        if rate > best.tokens_per_s:
+            best = SpecKResult(
+                k, acceptance_rate, rate, decode_rate, step_time, tokens
+            )
+    return best
+
+
 def optimize_serving(
     graph: PCGGraph,
     num_devices: int,

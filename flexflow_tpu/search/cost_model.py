@@ -523,6 +523,66 @@ class CostModel:
             memory=int(mem),
         )
 
+    def verify_op_cost(
+        self,
+        node,
+        batch: int,
+        kv_len: int,
+        k: int,
+        tp: int = 1,
+        page_size: int = 0,
+    ) -> OpCost:
+        """Forward cost of ONE speculative-decoding verify step of this
+        op on one chip: k+1 token positions per sequence (the last
+        emitted token plus k drafted tokens) scored in a single call
+        (serving/engine.GenerationEngine.verify).
+
+        The term structure is WHY speculative decoding wins: the weight
+        bytes — the decode regime's dominant cost — stream ONCE for all
+        k+1 positions, exactly as in decode_op_cost; only the
+        activation traffic and FLOPs scale with k+1, and attention
+        additionally reads the k fresh cache rows the drafts occupy
+        (page-rounded like decode when page_size > 0). So
+        verify(k) << (k+1) * decode, and the gap times the measured
+        acceptance rate is the speedup optimize_spec_k prices."""
+        tp = max(1, tp)
+        w = int(k) + 1
+        elem = lambda s: self.elem_bytes(s)  # noqa: E731
+        weight_bytes = sum(
+            s.volume() * elem(s) for s in node.weight_shapes
+        ) / tp
+        out = node.output_shapes[0] if node.output_shapes else None
+        feat = out.logical_sizes[-1] if out is not None else 1
+        out_elem = elem(out) if out is not None else 4
+        act_bytes = float(batch) * w * feat * out_elem / tp
+        flops = (
+            2.0 * batch * w * sum(s.volume() for s in node.weight_shapes) / tp
+        )
+        mem = weight_bytes
+        bytes_moved = weight_bytes + act_bytes
+        if node.op_type == OperatorType.MULTIHEAD_ATTENTION:
+            heads = int(node.params["num_heads"]) // tp
+            head_dim = int(node.params["embed_dim"]) // max(
+                1, int(node.params["num_heads"])
+            )
+            kv_rows = kv_len + w
+            if page_size > 0:
+                kv_rows = -(-kv_rows // page_size) * page_size
+            cache_bytes = 2.0 * batch * kv_rows * heads * head_dim * out_elem
+            bytes_moved += cache_bytes
+            mem += cache_bytes
+            flops += 4.0 * batch * w * (kv_len + w) * heads * head_dim
+        elif node.op_type == OperatorType.EMBEDDING:
+            # w row gathers per sequence, like decode's one
+            dim = int(node.params["out_dim"])
+            bytes_moved = float(batch) * w * dim * out_elem + act_bytes
+            flops = 0.0
+        return OpCost(
+            forward_time=self._roofline(flops, bytes_moved),
+            backward_time=0.0,
+            memory=int(mem),
+        )
+
     # -- measured mode ------------------------------------------------------
     #
     # The direct analog of the reference's inner_measure_operator_cost

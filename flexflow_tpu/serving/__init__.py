@@ -14,7 +14,12 @@ serving strategy (TP over heads at small batch) distinct from the
 training one.
 """
 
-from flexflow_tpu.serving.api import ServeConfig, build_scheduler, generate
+from flexflow_tpu.serving.api import (
+    ServeConfig,
+    build_proposer,
+    build_scheduler,
+    generate,
+)
 from flexflow_tpu.serving.engine import GenerationEngine
 from flexflow_tpu.serving.kv_cache import (
     KVCache,
@@ -30,10 +35,17 @@ from flexflow_tpu.serving.scheduler import (
     StaticBatchingScheduler,
     latency_percentiles,
 )
+from flexflow_tpu.serving.spec import (
+    DraftProposer,
+    ModelDraftProposer,
+    NGramDraftProposer,
+    accept_drafts,
+)
 
 __all__ = [
     "ServeConfig",
     "generate",
+    "build_proposer",
     "build_scheduler",
     "GenerationEngine",
     "KVCache",
@@ -46,4 +58,8 @@ __all__ = [
     "StaticBatchingScheduler",
     "SchedulerStats",
     "latency_percentiles",
+    "DraftProposer",
+    "ModelDraftProposer",
+    "NGramDraftProposer",
+    "accept_drafts",
 ]

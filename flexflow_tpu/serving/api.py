@@ -3,8 +3,9 @@
 `FFModel.generate` (runtime/model.py) delegates here, mirroring how the
 reference grew FlexFlow Serve on top of the training FFModel. ServeConfig
 rides FFConfig flag parsing (`--max-seqs`, `--max-seq-len`,
-`--serve-scheduler`, `--eos-token`), so serving scripts configure the
-engine with the same CLI the training examples use.
+`--serve-scheduler`, `--eos-token`, `--spec-draft`, `--spec-k`), so
+serving scripts configure the engine with the same CLI the training
+examples use.
 """
 
 from __future__ import annotations
@@ -25,6 +26,8 @@ _SCHEDULERS = {
     "static": StaticBatchingScheduler,
 }
 
+_SPEC_DRAFTS = ("", "ngram", "model")
+
 
 @dataclasses.dataclass
 class ServeConfig:
@@ -44,6 +47,14 @@ class ServeConfig:
     kv_layout: str = "paged"
     kv_page_size: int = 0  # 0 = auto (vLLM-style 16, halved to divide max_len)
     kv_pages: int = 0  # 0 = max_seqs * max_seq_len / page_size (same capacity)
+    # speculative decoding (SpecInfer, ASPLOS'24; serving/spec.py):
+    # "" = off, "ngram" = weight-free prompt-lookup draft, "model" = a
+    # second compiled decoder LM (pass it as build_scheduler/generate's
+    # draft_model). spec_k is the draft length per verify step;
+    # spec_ngram the lookup n-gram size.
+    spec_draft: str = ""
+    spec_k: int = 4
+    spec_ngram: int = 2
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -64,6 +75,15 @@ class ServeConfig:
                 f"max_seq_len {self.max_seq_len} is not divisible by "
                 f"kv_page_size {self.kv_page_size}"
             )
+        if self.spec_draft not in _SPEC_DRAFTS:
+            raise ValueError(
+                f"spec_draft must be one of {_SPEC_DRAFTS}, "
+                f"got {self.spec_draft!r}"
+            )
+        if self.spec_draft and self.spec_k < 1:
+            raise ValueError("spec_k must be >= 1 when spec_draft is set")
+        if self.spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
 
     @staticmethod
     def from_config(cfg) -> "ServeConfig":
@@ -79,13 +99,42 @@ class ServeConfig:
             kv_layout=cfg.serve_kv_layout,
             kv_page_size=cfg.serve_kv_page_size,
             kv_pages=cfg.serve_kv_pages,
+            spec_draft=cfg.serve_spec_draft,
+            spec_k=cfg.serve_spec_k,
         )
 
 
-def build_scheduler(model, serve: ServeConfig):
+def build_proposer(serve: ServeConfig, draft_model=None):
+    """The DraftProposer a ServeConfig asks for (None when spec decoding
+    is off). A "model" draft needs a second compiled decoder LM sharing
+    the target's vocabulary."""
+    if not serve.spec_draft:
+        return None
+    from flexflow_tpu.serving.spec import (
+        ModelDraftProposer,
+        NGramDraftProposer,
+    )
+
+    if serve.spec_draft == "ngram":
+        return NGramDraftProposer(n=serve.spec_ngram)
+    if draft_model is None:
+        raise ValueError(
+            "spec_draft='model' needs a compiled draft_model "
+            "(a small decoder LM with the target's vocabulary)"
+        )
+    return ModelDraftProposer(
+        draft_model,
+        max_seqs=serve.max_seqs,
+        max_len=serve.max_seq_len,
+        buckets=serve.prefill_buckets or None,
+    )
+
+
+def build_scheduler(model, serve: ServeConfig, draft_model=None):
     """(scheduler, engine, cache) wired to a compiled model — the pieces
     generate() uses, exposed for callers that drive iterations themselves
-    (bench_serve.py, tests)."""
+    (bench_serve.py, tests). With serve.spec_draft set, the scheduler
+    runs the speculative draft/verify loop (serving/spec.py)."""
     if serve.kv_layout == "paged":
         cache = PagedKVCache.from_model(
             model,
@@ -105,7 +154,11 @@ def build_scheduler(model, serve: ServeConfig):
     engine = GenerationEngine(
         model, cache, temperature=serve.temperature, seed=serve.seed
     )
-    sched = _SCHEDULERS[serve.scheduler](engine)
+    sched = _SCHEDULERS[serve.scheduler](
+        engine,
+        proposer=build_proposer(serve, draft_model),
+        spec_k=serve.spec_k,
+    )
     return sched, engine, cache
 
 
@@ -115,15 +168,17 @@ def generate(
     max_new_tokens: int = 16,
     serve: Optional[ServeConfig] = None,
     eos_token: Optional[int] = None,
+    draft_model=None,
 ) -> List[List[int]]:
     """Generate continuations for token-id prompts; returns the generated
     tokens (prompt excluded) in the prompts' order. Greedy by default —
     the cache-equivalence contract (tests/test_serving.py) holds for
-    greedy decoding."""
+    greedy decoding, with or without speculative drafting
+    (tests/test_spec_decode.py)."""
     serve = serve or ServeConfig()
     if eos_token is None:
         eos_token = serve.eos_token
-    sched, _, _ = build_scheduler(model, serve)
+    sched, _, _ = build_scheduler(model, serve, draft_model=draft_model)
     reqs = [
         Request(
             rid=i,

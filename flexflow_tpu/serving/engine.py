@@ -32,15 +32,28 @@ steps, and `decode()` claims each sequence's next page BEFORE the step
 when it is about to cross a page boundary (the admission reserve
 guarantees that claim).
 
-Both steps are jitted with static shapes: decode always runs at
-`[max_seqs, 1]`, prefill at `[max_seqs, bucket]` per length bucket, so
-compile count is 1 + #buckets for an entire serving session — paging
+A third step family serves speculative decoding (serving/spec.py):
+**verify** scores w = k+1 token positions per slot (the last emitted
+token plus k drafted tokens) through the KV cache in ONE prefill-shaped
+call — K/V rows for all w positions are written (slot-scattered or
+table-routed exactly like prefill), `ops.attention.verify_attention`
+runs the staircase-masked w-query attention, and the caller accepts a
+prefix of the drafts and commits/rolls back via
+`cache.truncate(slot, new_len)` (verify itself never advances lengths).
+
+All steps are jitted with static shapes: decode always runs at
+`[max_seqs, 1]`, prefill at `[max_seqs, bucket]` per length bucket,
+verify at `[max_seqs, w]` per draft width, so compile count is
+1 + #buckets + #draft-widths for an entire serving session — paging
 does not change the compile-count contract (tables are data, not
 shape).
 
 Greedy argmax is the default (temperature 0); temperature sampling
-folds the serve seed into a per-step key so a fixed seed replays the
-same stream.
+derives a PRNG key per (serve seed, slot, cache position), so a
+request's sampled stream depends only on its slot and its own tokens —
+reproducible under a fixed seed and independent of batch composition
+(which requests happen to share the iteration), the property
+rejection-sampling verify needs.
 """
 
 from __future__ import annotations
@@ -100,9 +113,11 @@ class GenerationEngine:
         self._decode_jit = jax.jit(
             self._decode_impl_paged if self.paged else self._decode_impl
         )
-        # one jitted prefill per length bucket (jit caches by shape anyway;
-        # the explicit dict makes the compile-count contract inspectable)
+        # one jitted prefill per length bucket / one jitted verify per
+        # draft width (jit caches by shape anyway; the explicit dicts make
+        # the compile-count contract inspectable)
         self._prefill_cache: Dict[int, object] = {}
+        self._verify_cache: Dict[int, object] = {}
 
     # -- shared forward ------------------------------------------------------
 
@@ -117,23 +132,35 @@ class GenerationEngine:
         )
         return values[(self._logits_ref.guid, self._logits_ref.out_idx)]
 
-    def _pick(self, logits, step):
+    def _pick(self, logits, slots, positions):
         """logits [n, vocab] -> token ids [n]. Greedy at temperature 0,
-        else categorical with the serve seed folded by the step counter
-        (deterministic replay under a fixed seed)."""
+        else categorical under a PER-ROW key derived as
+        fold_in(fold_in(PRNGKey(seed), slot), position) — `positions` is
+        the cache position each sampled token will occupy. The draw for
+        a slot therefore depends only on (seed, slot, position), never
+        on the global step counter or on which other requests share the
+        batch: a fixed seed replays the same stream even when admission
+        timing shifts, the reproducibility rejection-sampling verify
+        builds on."""
         import jax
         import jax.numpy as jnp
 
         if self.temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
-        return jax.random.categorical(
-            key, logits.astype(jnp.float32) / self.temperature, axis=-1
-        ).astype(jnp.int32)
+        base = jax.random.PRNGKey(self.seed)
+        temp = self.temperature
+
+        def one(slot, pos, row):
+            key = jax.random.fold_in(jax.random.fold_in(base, slot), pos)
+            return jax.random.categorical(
+                key, row.astype(jnp.float32) / temp
+            )
+
+        return jax.vmap(one)(slots, positions, logits).astype(jnp.int32)
 
     # -- prefill -------------------------------------------------------------
 
-    def _prefill_impl(self, params, tokens, slot_ids, prompt_lens, ck, cv, step):
+    def _prefill_impl(self, params, tokens, slot_ids, prompt_lens, ck, cv):
         """tokens [max_seqs, bucket] int32; slot_ids [max_seqs] (max_seqs
         = out-of-bounds sentinel for padding rows — JAX drops OOB scatter
         rows, so pad rows never touch live cache); prompt_lens [max_seqs]
@@ -172,19 +199,21 @@ class GenerationEngine:
         last = jnp.take_along_axis(
             logits, (prompt_lens - 1)[:, None, None], axis=1
         )[:, 0]
-        return new_k, new_v, self._pick(last, step), last
+        # the sampled token will be written at cache position prompt_lens
+        return new_k, new_v, self._pick(last, slot_ids, prompt_lens), last
 
     def _prefill_impl_paged(
-        self, params, tokens, row_tables, prompt_lens, ck, cv, step
+        self, params, tokens, slot_ids, row_tables, prompt_lens, ck, cv
     ):
         """Paged twin of _prefill_impl. row_tables [max_seqs,
         ceil(bucket/page_size)] int32: the admitted slots' block-table
         prefixes (pad rows and unallocated entries carry the sentinel
-        num_pages). Captured K/V rows scatter into the flattened pools at
-        `page * page_size + offset`; sentinel pages put the destination
-        out of bounds, which JAX drops — so bucket padding past a
-        prompt's allocated pages writes nothing, where the slot layout
-        writes (masked) garbage rows."""
+        num_pages). slot_ids only seed the per-slot sampling keys here —
+        routing is entirely through the tables. Captured K/V rows scatter
+        into the flattened pools at `page * page_size + offset`; sentinel
+        pages put the destination out of bounds, which JAX drops — so
+        bucket padding past a prompt's allocated pages writes nothing,
+        where the slot layout writes (masked) garbage rows."""
         import jax.numpy as jnp
 
         from flexflow_tpu.ops.attention import (
@@ -228,14 +257,13 @@ class GenerationEngine:
         last = jnp.take_along_axis(
             logits, (prompt_lens - 1)[:, None, None], axis=1
         )[:, 0]
-        return new_k, new_v, self._pick(last, step), last
+        return new_k, new_v, self._pick(last, slot_ids, prompt_lens), last
 
     def prefill(
         self,
         params,
         prompts: Sequence[Sequence[int]],
         slots: Sequence[int],
-        step: int = 0,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Run one admission batch; writes the cache in place (commit) and
         updates slot lengths. Returns (next_tokens [n], last_logits [n, V])
@@ -267,6 +295,7 @@ class GenerationEngine:
                 self._prefill_impl_paged if self.paged else self._prefill_impl
             )
             self._prefill_cache[bucket] = fn
+        route = [jnp.asarray(slot_ids)]
         if self.paged:
             ps = spec.page_size
             width = -(-bucket // ps)
@@ -275,17 +304,14 @@ class GenerationEngine:
             )
             for i, s in enumerate(slots):
                 row_tables[i] = self.cache.block_tables[s, :width]
-            route = jnp.asarray(row_tables)
-        else:
-            route = jnp.asarray(slot_ids)
+            route.append(jnp.asarray(row_tables))
         new_k, new_v, nxt, last = fn(
             params,
             jnp.asarray(tokens),
-            route,
+            *route,
             jnp.asarray(plens),
             self.cache.k,
             self.cache.v,
-            jnp.int32(step),
         )
         self.cache.commit(new_k, new_v)
         for p, s in zip(prompts, slots):
@@ -294,7 +320,7 @@ class GenerationEngine:
 
     # -- decode --------------------------------------------------------------
 
-    def _decode_impl(self, params, tokens, lengths, active, ck, cv, step):
+    def _decode_impl(self, params, tokens, lengths, active, ck, cv):
         """tokens [max_seqs, 1]; lengths [max_seqs] = cache position the
         incoming token is written at; active [max_seqs] bool masks cache
         writes for free slots."""
@@ -332,10 +358,12 @@ class GenerationEngine:
             ]
 
         logits = self._forward_logits(params, tokens, hook)[:, -1, :]
-        return new_k, new_v, self._pick(logits, step), logits
+        slots = jnp.arange(lengths.shape[0])
+        # the sampled token will be written at cache position lengths + 1
+        return new_k, new_v, self._pick(logits, slots, lengths + 1), logits
 
     def _decode_impl_paged(
-        self, params, tokens, lengths, active, tables, ck, cv, step
+        self, params, tokens, lengths, active, tables, ck, cv
     ):
         """Paged twin of _decode_impl. tables [max_seqs,
         max_pages_per_seq] int32 block tables. The new K/V row scatters
@@ -381,14 +409,14 @@ class GenerationEngine:
             ]
 
         logits = self._forward_logits(params, tokens, hook)[:, -1, :]
-        return new_k, new_v, self._pick(logits, step), logits
+        slots = jnp.arange(lengths.shape[0])
+        return new_k, new_v, self._pick(logits, slots, lengths + 1), logits
 
     def decode(
         self,
         params,
         tokens: np.ndarray,
         active_mask: np.ndarray,
-        step: int = 0,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """One decode iteration over every slot. tokens [max_seqs] (last
         emitted token per slot; free slots can carry anything), active_mask
@@ -405,17 +433,215 @@ class GenerationEngine:
                 self.cache.ensure_position(
                     int(slot), int(self.cache.lengths[slot])
                 )
-            args = [jnp.asarray(self.cache.block_tables)]
+            args = [jnp.asarray(self.cache.block_tables.copy())]
+        # .copy() on every mutable host array: jnp.asarray defers the
+        # host-buffer read behind the async dispatch queue, so handing it
+        # live scheduler state (lengths += 1 below, allocator table edits
+        # between iterations) races the read and corrupts the step under
+        # load — the snapshot temp is never mutated, so the deferred read
+        # is safe
         new_k, new_v, nxt, logits = self._decode_jit(
             params,
             jnp.asarray(tokens, dtype=jnp.int32)[:, None],
-            jnp.asarray(self.cache.lengths),
+            jnp.asarray(self.cache.lengths.copy()),
             jnp.asarray(active_mask),
             *args,
             self.cache.k,
             self.cache.v,
-            jnp.int32(step),
         )
         self.cache.commit(new_k, new_v)
         self.cache.lengths[np.asarray(active_mask)] += 1
         return np.asarray(nxt), np.asarray(logits)
+
+    # -- verify (speculative decoding) ---------------------------------------
+
+    def _verify_scatter_dest(self, w, lengths, draft_lens, tables, jnp):
+        """Flattened-cache destinations [max_seqs * w] for the verify
+        write: row j of slot s lands at cache position lengths[s] + j
+        when j < draft_lens[s] and the position is inside max_len; every
+        other row routes out of bounds (JAX drops OOB scatter rows), so
+        pad rows, inactive slots, and overflow never touch live cache."""
+        spec = self.cache.spec
+        pos = lengths[:, None] + jnp.arange(w)[None, :]  # [max_seqs, w]
+        valid = (jnp.arange(w)[None, :] < draft_lens[:, None]) & (
+            pos < spec.max_len
+        )
+        if self.paged:
+            ps = spec.page_size
+            page_idx = jnp.clip(pos // ps, 0, spec.max_pages_per_seq - 1)
+            entry = jnp.take_along_axis(tables, page_idx, axis=1)
+            # sentinel entries (num_pages) already land past the pool
+            flat = entry * ps + pos % ps
+            oob = spec.num_pages * ps
+        else:
+            flat = (
+                jnp.arange(spec.max_seqs)[:, None] * spec.max_len + pos
+            )
+            oob = spec.max_seqs * spec.max_len
+        return jnp.where(valid, flat, oob).reshape(-1)
+
+    def _verify_impl(self, params, tokens, lengths, draft_lens, ck, cv):
+        """tokens [max_seqs, w] int32 — column 0 is each slot's last
+        emitted (not yet cached) token, columns 1..draft_lens-1 the
+        drafted continuation; lengths [max_seqs] = cache length BEFORE
+        the step; draft_lens [max_seqs] = real rows per slot (0 for
+        inactive slots). Writes all w K/V rows (masked via OOB scatter),
+        runs staircase-masked verify attention, and returns
+        (ck', cv', logits [max_seqs, w, V]) — logits[s, j] is the
+        model's distribution for the token FOLLOWING tokens[s, j].
+        Lengths are NOT advanced; acceptance commits via
+        cache.truncate."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            mha_project_qkv,
+            mha_project_out,
+            verify_attention,
+        )
+
+        spec = self.cache.spec
+        dest = self._verify_scatter_dest(
+            tokens.shape[1], lengths, draft_lens, None, jnp
+        )
+        new_k = dict(ck)
+        new_v = dict(cv)
+
+        def row_update(cache, new):
+            flat = cache.reshape(-1, spec.num_heads, spec.head_dim)
+            rows = new.astype(cache.dtype).reshape(
+                -1, spec.num_heads, spec.head_dim
+            )
+            return flat.at[dest].set(rows).reshape(cache.shape)
+
+        def hook(node, ins, ws, ctx):
+            g = node.guid
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            kc = row_update(ck[g], k)
+            vc = row_update(cv[g], v)
+            new_k[g] = kc
+            new_v[g] = vc
+            attn = verify_attention(q, kc, vc, lengths)
+            return [
+                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
+            ]
+
+        logits = self._forward_logits(params, tokens, hook)
+        return new_k, new_v, logits
+
+    def _verify_impl_paged(
+        self, params, tokens, lengths, draft_lens, tables, ck, cv
+    ):
+        """Paged twin of _verify_impl: rows route through the block
+        tables into the flattened pools, attention gathers pages via
+        ops.attention.paged_verify_attention."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            mha_project_qkv,
+            mha_project_out,
+            paged_verify_attention,
+        )
+
+        spec = self.cache.spec
+        dest = self._verify_scatter_dest(
+            tokens.shape[1], lengths, draft_lens, tables, jnp
+        )
+        new_k = dict(ck)
+        new_v = dict(cv)
+
+        def row_update(pool, new):
+            flat = pool.reshape(-1, spec.num_heads, spec.head_dim)
+            rows = new.astype(pool.dtype).reshape(
+                -1, spec.num_heads, spec.head_dim
+            )
+            return flat.at[dest].set(rows).reshape(pool.shape)
+
+        def hook(node, ins, ws, ctx):
+            g = node.guid
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            kc = row_update(ck[g], k)
+            vc = row_update(cv[g], v)
+            new_k[g] = kc
+            new_v[g] = vc
+            attn = paged_verify_attention(q, kc, vc, tables, lengths)
+            return [
+                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
+            ]
+
+        logits = self._forward_logits(params, tokens, hook)
+        return new_k, new_v, logits
+
+    def verify(
+        self,
+        params,
+        tokens: np.ndarray,
+        draft_lens: np.ndarray,
+    ) -> np.ndarray:
+        """Score w token positions per slot through the KV cache in one
+        prefill-shaped call (SpecInfer's verify step). tokens
+        [max_seqs, w]: column 0 is the slot's last emitted token (the
+        one plain decode would feed), columns 1..draft_lens[s]-1 its
+        drafted continuation; rows with draft_lens 0 are inactive.
+        Writes the w K/V rows into the cache (paged slots claim the
+        pages those rows need first — the admission reserve covers them
+        as long as the caller keeps drafts inside the request's declared
+        worst case) but does NOT advance lengths: the caller inspects
+        the returned logits [max_seqs, w, V], accepts a prefix of the
+        drafts, and commits/rolls back with cache.truncate(slot,
+        new_len) — the paged layout returns the pages past the accepted
+        length to the free pool there. One jitted program per draft
+        width w, cached like the prefill buckets."""
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.cache.spec
+        tokens = np.asarray(tokens, dtype=np.int32)
+        draft_lens = np.asarray(draft_lens, dtype=np.int32)
+        if tokens.ndim != 2 or tokens.shape[0] != spec.max_seqs:
+            raise ValueError(
+                f"tokens must be [max_seqs={spec.max_seqs}, w], "
+                f"got {tokens.shape}"
+            )
+        w = tokens.shape[1]
+        if w < 1:
+            raise ValueError("verify needs at least one token column")
+        if draft_lens.shape != (spec.max_seqs,):
+            raise ValueError("draft_lens must be [max_seqs]")
+        for slot in np.nonzero(draft_lens)[0]:
+            need = int(self.cache.lengths[slot]) + int(draft_lens[slot])
+            if draft_lens[slot] > w or need > spec.max_len:
+                raise ValueError(
+                    f"slot {int(slot)}: draft_lens {int(draft_lens[slot])} "
+                    f"overruns width {w} or max_len {spec.max_len}"
+                )
+        args = []
+        if self.paged:
+            # claim every page the w fresh rows touch BEFORE the jitted
+            # step (host-side allocator, like decode's boundary claim)
+            for slot in np.nonzero(draft_lens)[0]:
+                start = int(self.cache.lengths[slot])
+                for p in range(start, start + int(draft_lens[slot])):
+                    self.cache.ensure_position(int(slot), p)
+            args = [jnp.asarray(self.cache.block_tables.copy())]
+        fn = self._verify_cache.get(w)
+        if fn is None:
+            fn = jax.jit(
+                self._verify_impl_paged if self.paged else self._verify_impl
+            )
+            self._verify_cache[w] = fn
+        # lengths/tables snapshot (.copy()): the caller truncates the
+        # cache right after this returns, and jnp.asarray's host read is
+        # deferred behind the dispatch queue — see decode()
+        new_k, new_v, logits = fn(
+            params,
+            jnp.asarray(tokens),
+            jnp.asarray(self.cache.lengths.copy()),
+            jnp.asarray(draft_lens),
+            *args,
+            self.cache.k,
+            self.cache.v,
+        )
+        self.cache.commit(new_k, new_v)
+        return np.asarray(logits)

@@ -39,6 +39,7 @@ chip's cache slice local.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -222,9 +223,12 @@ class KVCache:
                 v = jax.device_put(v, shardings)
             self.k[g] = k
             self.v[g] = v
-        # host bookkeeping: lengths[i] = tokens currently cached in slot i
+        # host bookkeeping: lengths[i] = tokens currently cached in slot i.
+        # _free is a min-heap so alloc pops the lowest free id (dense,
+        # deterministic slot reuse) and free is O(log n) — no full re-sort
+        # per release.
         self.lengths = np.zeros(spec.max_seqs, dtype=np.int32)
-        self._free: List[int] = list(range(spec.max_seqs - 1, -1, -1))
+        self._free: List[int] = list(range(spec.max_seqs))
         self._active: set = set()
 
     # -- slot management (host side) ----------------------------------------
@@ -249,24 +253,53 @@ class KVCache:
     def alloc(
         self, prompt_len: Optional[int] = None, total_len: Optional[int] = None
     ) -> Optional[int]:
-        """Take a free slot (None when full). Lowest-index-last pop so slot
+        """Take a free slot (None when full). Lowest-free-id pop so slot
         ids stay dense and deterministic under a fixed request stream.
         The length arguments are accepted (and ignored) so the scheduler
         drives both layouts through one call."""
         if not self._free:
             return None
-        slot = self._free.pop()
+        slot = heapq.heappop(self._free)
         self._active.add(slot)
         self.lengths[slot] = 0
         return slot
+
+    def claim(self, slot: int) -> None:
+        """Allocate a SPECIFIC free slot. Speculative decoding keeps the
+        draft model's cache slot-aligned with the target's
+        (serving/spec.py ModelDraftProposer), so the draft mirrors the
+        target's admission instead of running its own allocator."""
+        if slot in self._active:
+            raise ValueError(f"slot {slot} is already active")
+        if slot not in self._free:
+            raise ValueError(f"slot {slot} is not a valid free slot")
+        self._free.remove(slot)
+        heapq.heapify(self._free)
+        self._active.add(slot)
+        self.lengths[slot] = 0
 
     def free(self, slot: int) -> None:
         if slot not in self._active:
             raise ValueError(f"slot {slot} is not active")
         self._active.remove(slot)
         self.lengths[slot] = 0
-        self._free.append(slot)
-        self._free.sort(reverse=True)
+        heapq.heappush(self._free, slot)
+
+    def truncate(self, slot: int, new_len: int) -> None:
+        """Roll the slot's visible length to `new_len` (speculative-decode
+        rollback: verify writes k+1 rows, acceptance keeps a prefix).
+        Rows past new_len stay in HBM as stale data — the lengths mask in
+        decode/verify attention hides them and later writes overwrite
+        them, so no device work is needed. new_len may also EXCEED the
+        current length: verify commits its accepted rows through this
+        same call."""
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        if not 0 <= new_len <= self.spec.max_len:
+            raise ValueError(
+                f"new_len {new_len} outside [0, {self.spec.max_len}]"
+            )
+        self.lengths[slot] = new_len
 
     def commit(self, new_k: Dict[int, object], new_v: Dict[int, object]):
         """Swap in the arrays a jitted step returned."""
@@ -347,9 +380,12 @@ class PagedKVCache:
             spec.num_pages,
             dtype=np.int32,
         )
-        self._free_slots: List[int] = list(range(spec.max_seqs - 1, -1, -1))
+        # min-heaps: alloc pops the lowest free slot/page id (deterministic
+        # reuse order), release is O(log n) heappush instead of the old
+        # append + full sort
+        self._free_slots: List[int] = list(range(spec.max_seqs))
         self._active: set = set()
-        self._free_pages: List[int] = list(range(spec.num_pages - 1, -1, -1))
+        self._free_pages: List[int] = list(range(spec.num_pages))
         # preemption-free reserve: _max_pages[s] is slot s's worst-case
         # page need (fixed at admission), _held[s] what it holds now;
         # _reserved = Σ (max - held) over active slots — pages the free
@@ -411,10 +447,10 @@ class PagedKVCache:
         max_p = self._pages_for(total)
         if not self.can_admit(prompt_len, total):
             return None
-        slot = self._free_slots.pop()
+        slot = heapq.heappop(self._free_slots)
         self._active.add(slot)
         for i in range(need_now):
-            self.block_tables[slot, i] = self._free_pages.pop()
+            self.block_tables[slot, i] = heapq.heappop(self._free_pages)
         self._held[slot] = need_now
         self._max_pages[slot] = max_p
         self._reserved += max_p - need_now
@@ -436,10 +472,47 @@ class PagedKVCache:
                 "free-page pool exhausted despite the admission reserve — "
                 "allocator invariant violated"
             )
-        self.block_tables[slot, pi] = self._free_pages.pop()
+        self.block_tables[slot, pi] = heapq.heappop(self._free_pages)
         self._held[slot] += 1
         if self._held[slot] <= self._max_pages[slot]:
             self._reserved -= 1
+
+    def truncate(self, slot: int, new_len: int) -> None:
+        """Roll the slot's visible length to `new_len` and return every
+        page past ceil(new_len / page_size) to the free list — the
+        speculative-decode rollback (verify claims pages for all k+1
+        drafted rows; acceptance keeps a prefix). Returned pages go back
+        under the slot's admission reserve (`_reserved` grows by exactly
+        the pages released, capped at the slot's declared worst case), so
+        the preemption-free accounting holds across rollback: a future
+        re-growth of this slot re-claims from a pool that still covers
+        every in-flight worst case. new_len may exceed the current
+        length (verify commits accepted rows through this call) but
+        never the pages the slot actually holds."""
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        if not 0 <= new_len <= self.spec.max_len:
+            raise ValueError(
+                f"new_len {new_len} outside [0, {self.spec.max_len}]"
+            )
+        keep = self._pages_for(new_len)
+        if keep > self._held[slot]:
+            raise ValueError(
+                f"new_len {new_len} needs {keep} pages but slot {slot} "
+                f"holds {int(self._held[slot])}"
+            )
+        sentinel = self.spec.num_pages
+        old_resv = max(0, int(self._max_pages[slot] - self._held[slot]))
+        for pi in range(keep, self.spec.max_pages_per_seq):
+            p = int(self.block_tables[slot, pi])
+            if p != sentinel:
+                heapq.heappush(self._free_pages, p)
+                self.block_tables[slot, pi] = sentinel
+                self._held[slot] -= 1
+        self._reserved += (
+            max(0, int(self._max_pages[slot] - self._held[slot])) - old_resv
+        )
+        self.lengths[slot] = new_len
 
     def free(self, slot: int) -> None:
         if slot not in self._active:
@@ -449,15 +522,13 @@ class PagedKVCache:
         for pi in range(self.spec.max_pages_per_seq):
             p = int(self.block_tables[slot, pi])
             if p != sentinel:
-                self._free_pages.append(p)
+                heapq.heappush(self._free_pages, p)
         self.block_tables[slot, :] = sentinel
-        self._free_pages.sort(reverse=True)
         self._reserved -= max(0, int(self._max_pages[slot] - self._held[slot]))
         self._held[slot] = 0
         self._max_pages[slot] = 0
         self.lengths[slot] = 0
-        self._free_slots.append(slot)
-        self._free_slots.sort(reverse=True)
+        heapq.heappush(self._free_slots, slot)
 
     def commit(self, new_k: Dict[int, object], new_v: Dict[int, object]):
         """Swap in the pools a jitted step returned."""

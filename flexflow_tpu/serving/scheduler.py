@@ -11,6 +11,13 @@ throughput win over request-level ("static") batching comes from never
 holding finished requests' slots hostage to the longest request in a
 batch.
 
+Speculative decoding (SpecInfer, ASPLOS'24; serving/spec.py) is a mode
+of the same loop: when a scheduler carries a `DraftProposer`, step (b)
+becomes draft → one batched verify call → accept/rollback, emitting
+1..spec_k+1 tokens per slot per iteration instead of exactly one. The
+iteration-level frame is unchanged — a verify is just a wider decode —
+so admission, retirement, and slot recycling all work as before.
+
 `StaticBatchingScheduler` is the deliberately-worse baseline the bench
 and the comparison test measure against: admit a batch, decode until the
 WHOLE batch finishes, only then admit the next batch (the reference
@@ -43,6 +50,7 @@ class Request:
     admit_iter: int = -1
     finish_iter: int = -1
     submit_time: float = 0.0
+    first_token_time: float = 0.0
     finish_time: float = 0.0
 
     @property
@@ -52,6 +60,23 @@ class Request:
     @property
     def latency_s(self) -> float:
         return self.finish_time - self.submit_time
+
+    @property
+    def ttft_s(self) -> float:
+        """Submit → first generated token (the prefill-side latency a
+        user perceives before streaming starts)."""
+        return self.first_token_time - self.submit_time
+
+    @property
+    def decode_s_per_token(self) -> float:
+        """Mean seconds per generated token AFTER the first — the
+        decode-side latency speculative decoding compresses (several
+        accepted tokens share one verify step's wall time)."""
+        if len(self.generated) <= 1:
+            return 0.0
+        return (self.finish_time - self.first_token_time) / (
+            len(self.generated) - 1
+        )
 
     def _done_after(self, token: int) -> bool:
         return (
@@ -65,10 +90,18 @@ class SchedulerStats:
     decode_steps: int = 0
     prefill_batches: int = 0
     tokens_generated: int = 0
-    slot_steps: int = 0  # Σ over decode iterations of max_seqs (capacity)
+    slot_steps: int = 0  # Σ over decode/verify iterations of max_seqs
     busy_slot_steps: int = 0  # Σ of actually-active slots
     peak_in_flight: int = 0  # max concurrent running requests observed
     elapsed_s: float = 0.0
+    # speculative decoding (verify iterations only)
+    verify_steps: int = 0
+    draft_tokens_proposed: int = 0
+    draft_tokens_accepted: int = 0
+    # per-request latency accumulators (filled at retirement)
+    finished_requests: int = 0
+    ttft_sum_s: float = 0.0
+    decode_latency_sum_s: float = 0.0  # Σ of per-request decode_s_per_token
 
     @property
     def tokens_per_s(self) -> float:
@@ -76,16 +109,48 @@ class SchedulerStats:
 
     @property
     def occupancy(self) -> float:
-        """Fraction of decode slot-steps that carried a live request — the
-        metric continuous batching exists to push toward 1.0."""
+        """Fraction of decode/verify slot-steps that carried a live
+        request — the metric continuous batching exists to push toward
+        1.0."""
         return self.busy_slot_steps / self.slot_steps if self.slot_steps else 0.0
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Fraction of drafted tokens the verify step accepted — the
+        measured α that optimize_spec_k turns into a draft length."""
+        if not self.draft_tokens_proposed:
+            return 0.0
+        return self.draft_tokens_accepted / self.draft_tokens_proposed
+
+    @property
+    def mean_ttft_s(self) -> float:
+        if not self.finished_requests:
+            return 0.0
+        return self.ttft_sum_s / self.finished_requests
+
+    @property
+    def mean_decode_s_per_token(self) -> float:
+        if not self.finished_requests:
+            return 0.0
+        return self.decode_latency_sum_s / self.finished_requests
 
 
 class _SchedulerBase:
-    def __init__(self, engine, params=None):
+    """Shared admission/decode/verify machinery. `proposer` switches the
+    per-iteration generation step from plain decode to speculative
+    draft/verify (serving/spec.py): propose up to `spec_k` tokens per
+    slot, score them all in ONE engine.verify call, accept a prefix
+    (exact match under greedy, rejection sampling under temperature),
+    and roll the cache back to the accepted length."""
+
+    def __init__(self, engine, params=None, proposer=None, spec_k: int = 4):
         self.engine = engine
         self.cache = engine.cache
         self.params = params if params is not None else engine.model.params
+        self.proposer = proposer
+        self.spec_k = int(spec_k)
+        if proposer is not None and self.spec_k < 1:
+            raise ValueError("speculative decoding needs spec_k >= 1")
         self.queue: deque = deque()
         self.running: Dict[int, Request] = {}  # slot -> request
         self.finished: List[Request] = []
@@ -138,11 +203,12 @@ class _SchedulerBase:
             self.stats.peak_in_flight, len(self.running)
         )
         if admitted:
+            if self.proposer is not None:
+                self.proposer.admit(admitted)
             nxt, _ = self.engine.prefill(
                 self.params,
                 [r.prompt for r in admitted],
                 [r.slot for r in admitted],
-                step=self._iter,
             )
             self.stats.prefill_batches += 1
             for tok, req in zip(nxt, admitted):
@@ -151,6 +217,8 @@ class _SchedulerBase:
 
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(token)
+        if len(req.generated) == 1:
+            req.first_token_time = time.perf_counter()
         self.stats.tokens_generated += 1
         if req._done_after(token):
             self._retire(req)
@@ -158,9 +226,14 @@ class _SchedulerBase:
     def _retire(self, req: Request) -> None:
         req.finish_iter = self._iter
         req.finish_time = time.perf_counter()
+        if self.proposer is not None:
+            self.proposer.retire(req)
         self.cache.free(req.slot)
         del self.running[req.slot]
         self.finished.append(req)
+        self.stats.finished_requests += 1
+        self.stats.ttft_sum_s += req.ttft_s
+        self.stats.decode_latency_sum_s += req.decode_s_per_token
 
     def _decode_once(self) -> None:
         spec = self.cache.spec
@@ -169,9 +242,7 @@ class _SchedulerBase:
         for slot, req in self.running.items():
             tokens[slot] = req.generated[-1]
             active[slot] = True
-        nxt, _ = self.engine.decode(
-            self.params, tokens, active, step=self._iter
-        )
+        nxt, _ = self.engine.decode(self.params, tokens, active)
         self.stats.decode_steps += 1
         self.stats.slot_steps += spec.max_seqs
         self.stats.busy_slot_steps += int(active.sum())
@@ -179,6 +250,77 @@ class _SchedulerBase:
             req = self.running.get(slot)
             if req is not None:
                 self._emit(req, int(nxt[slot]))
+
+    def _verify_once(self) -> None:
+        """One speculative iteration: draft up to spec_k tokens per slot,
+        score every slot's (last token + drafts) in ONE batched verify,
+        then per slot accept a prefix, roll the cache to the accepted
+        length (paged slots return surplus pages), and emit
+        accepted + 1 tokens. A slot whose proposer has nothing degrades
+        to draft_lens 1 — exactly a decode step. EOS inside the accepted
+        run retires the request AT the EOS position: tokens past it are
+        never emitted."""
+        from flexflow_tpu.serving.spec import accept_drafts
+
+        spec = self.cache.spec
+        k = self.spec_k
+        proposals = self.proposer.propose(self.running, k)
+        tokens = np.zeros((spec.max_seqs, k + 1), dtype=np.int32)
+        draft_lens = np.zeros(spec.max_seqs, dtype=np.int32)
+        plan: Dict[int, List[int]] = {}
+        for slot, req in self.running.items():
+            old_len = int(self.cache.lengths[slot])
+            # the verify emits up to k_s + 1 tokens and writes k_s + 1
+            # rows, so k_s is capped by the request's remaining token
+            # budget and by the cache horizon — which also keeps paged
+            # verify inside the admission reserve's worst case
+            k_s = min(
+                len(proposals.get(slot) or ()),
+                k,
+                req.max_new_tokens - len(req.generated) - 1,
+                spec.max_len - old_len - 1,
+            )
+            drafts = list(proposals.get(slot) or ())[: max(0, k_s)]
+            tokens[slot, 0] = req.generated[-1]
+            for j, t in enumerate(drafts):
+                tokens[slot, 1 + j] = int(t)
+            draft_lens[slot] = 1 + len(drafts)
+            plan[slot] = drafts
+        logits = self.engine.verify(self.params, tokens, draft_lens)
+        self.stats.verify_steps += 1
+        self.stats.slot_steps += spec.max_seqs
+        self.stats.busy_slot_steps += len(plan)
+        for slot in sorted(plan):
+            req = self.running.get(slot)
+            if req is None:
+                continue
+            drafts = plan[slot]
+            old_len = int(self.cache.lengths[slot])
+            accepted, emitted = accept_drafts(
+                logits[slot],
+                drafts,
+                temperature=self.engine.temperature,
+                seed=self.engine.seed,
+                slot=slot,
+                base_len=old_len,
+            )
+            # commit the accepted prefix / roll back the rejected tail
+            # BEFORE emitting: _emit may retire the request, which frees
+            # the slot (truncating a freed slot would be an error)
+            self.cache.truncate(slot, old_len + accepted + 1)
+            self.proposer.rollback(slot, old_len + accepted + 1)
+            self.stats.draft_tokens_proposed += len(drafts)
+            self.stats.draft_tokens_accepted += accepted
+            for t in emitted:
+                self._emit(req, int(t))
+                if req.finished:
+                    break  # EOS mid-verify: nothing past it is emitted
+
+    def _generate_once(self) -> None:
+        if self.proposer is not None:
+            self._verify_once()
+        else:
+            self._decode_once()
 
     def run(self, requests: Optional[Sequence[Request]] = None) -> List[Request]:
         """Drain the queue (plus `requests`, submitted first) to completion;
@@ -194,14 +336,16 @@ class _SchedulerBase:
 
 class ContinuousBatchingScheduler(_SchedulerBase):
     """Orca-style: every iteration joins new prefills with in-flight
-    decodes; slots recycle the moment a request retires."""
+    decodes; slots recycle the moment a request retires. With a
+    `proposer` + `spec_k`, each iteration runs the speculative
+    draft/verify step instead of single-token decode."""
 
     def step(self) -> None:
         self._iter += 1
         self.stats.iterations += 1
         self._admit()
         if self.running:
-            self._decode_once()
+            self._generate_once()
 
 
 class StaticBatchingScheduler(_SchedulerBase):
@@ -214,12 +358,30 @@ class StaticBatchingScheduler(_SchedulerBase):
         if not self.running:
             self._admit()
         if self.running:
-            self._decode_once()
+            self._generate_once()
 
 
-def latency_percentiles(requests: Sequence[Request], pcts=(50, 95)):
-    """{pct: seconds} over finished requests' submit→finish latency."""
-    lats = [r.latency_s for r in requests if r.finished]
+_LATENCY_METRICS = {
+    "latency": lambda r: r.latency_s,
+    "ttft": lambda r: r.ttft_s,
+    "decode_per_token": lambda r: r.decode_s_per_token,
+}
+
+
+def latency_percentiles(
+    requests: Sequence[Request], pcts=(50, 95), metric: str = "latency"
+):
+    """{pct: seconds} over finished requests. metric: "latency"
+    (submit→finish, the default), "ttft" (submit→first token), or
+    "decode_per_token" (per-generated-token decode latency after the
+    first — where speculative decoding's win shows up as latency rather
+    than throughput)."""
+    if metric not in _LATENCY_METRICS:
+        raise ValueError(
+            f"metric must be one of {sorted(_LATENCY_METRICS)}, got {metric!r}"
+        )
+    fn = _LATENCY_METRICS[metric]
+    lats = [fn(r) for r in requests if r.finished]
     if not lats:
         return {p: 0.0 for p in pcts}
     return {p: float(np.percentile(lats, p)) for p in pcts}

@@ -1,0 +1,281 @@
+"""Speculative decoding (SpecInfer, Miao et al., ASPLOS 2024).
+
+Decode is weight-bandwidth-bound (`CostModel.decode_op_cost`): every
+generated token re-reads the whole weight set for ONE token of progress.
+Speculative decoding buys more tokens per weight read — a cheap *draft*
+proposes k continuation tokens, the target model scores all k+1
+positions in one prefill-shaped **verify** call
+(`GenerationEngine.verify`), and an acceptance rule keeps the longest
+prefix the target agrees with plus one bonus token from the target's own
+distribution. Greedy acceptance is exact-match, so greedy speculative
+decode is token-for-token identical to plain greedy decode — the draft
+only changes WHEN tokens arrive, never WHICH; under temperature the
+rejection-sampling rule preserves the target distribution the same way.
+
+Two draft sources implement the `DraftProposer` protocol:
+
+* `NGramDraftProposer` — weight-free prompt-lookup (the "assisted
+  generation" n-gram trick): find the most recent earlier occurrence of
+  the sequence's trailing n-gram and propose what followed it. Free to
+  run, surprisingly effective on repetitive continuations, and the CI
+  preset (no second model to build).
+* `ModelDraftProposer` — SpecInfer's small-model draft: a second
+  compiled `build_decoder_lm` with its OWN KVCache + GenerationEngine,
+  kept slot-aligned with the target (`KVCache.claim`) and rolled back
+  with the same `truncate` API the target uses. The draft always
+  decodes greedily, so its proposal is a point mass and the same
+  acceptance rule covers both proposers.
+
+Rollback is the cache-side half of the protocol: verify writes K/V rows
+for ALL k+1 positions; `cache.truncate(slot, new_len)` then commits the
+accepted prefix — the slot layout just moves the visible length (stale
+rows are masked), the paged layout also returns the pages past the
+accepted length to the free pool under the admission-reserve accounting.
+
+The scheduler side lives in serving/scheduler.py (`proposer=`/`spec_k=`
+on either scheduler class); `optimize_spec_k` (search/auto.py) picks k
+from a measured acceptance rate via `CostModel.verify_op_cost`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+# -- acceptance --------------------------------------------------------------
+
+
+def _rng(seed: int, slot: int, pos: int, sub: int) -> np.random.Generator:
+    """Deterministic per-(seed, slot, position, draw) stream — the host
+    mirror of the engine's fold_in(fold_in(key, slot), pos) discipline,
+    so rejection sampling is reproducible and independent of batch
+    composition."""
+    return np.random.default_rng([seed & 0x7FFFFFFF, slot, pos, sub])
+
+
+def _softmax(row: np.ndarray) -> np.ndarray:
+    row = row.astype(np.float64)
+    row = row - row.max()
+    e = np.exp(row)
+    return e / e.sum()
+
+
+def accept_drafts(
+    row_logits: np.ndarray,
+    drafts: Sequence[int],
+    temperature: float = 0.0,
+    seed: int = 0,
+    slot: int = 0,
+    base_len: int = 0,
+) -> Tuple[int, List[int]]:
+    """Acceptance rule for one slot's verify output. row_logits
+    [w >= len(drafts)+1, vocab] — row j is the target's distribution for
+    the token following verify input j (input 0 is the last emitted
+    token, inputs 1.. are the drafts). Returns (accepted, emitted):
+    `accepted` drafts survive and `emitted` is drafts[:accepted] plus
+    ONE token from the target itself (the correction at the first
+    rejection, or the bonus after a full accept) — so every verify emits
+    at least one token and plain decode is the drafts=[] special case.
+
+    temperature 0: greedy exact-match (argmax), which makes speculative
+    greedy decode token-identical to plain greedy decode. temperature >
+    0: rejection sampling against the point-mass proposal both proposers
+    emit (draft q is a delta): accept d with probability p(d); on
+    rejection resample from p with d zeroed out (= norm(max(0, p - q)))
+    — the Leviathan/Chen rule, which preserves the target distribution.
+    base_len is the cache position of the last emitted token; it seeds
+    the per-position RNG streams."""
+    k = len(drafts)
+    if temperature <= 0.0:
+        preds = np.argmax(row_logits[: k + 1], axis=-1)
+        accepted = 0
+        while accepted < k and int(drafts[accepted]) == int(preds[accepted]):
+            accepted += 1
+        return accepted, [int(t) for t in drafts[:accepted]] + [
+            int(preds[accepted])
+        ]
+    emitted: List[int] = []
+    for i in range(k):
+        p = _softmax(row_logits[i] / temperature)
+        d = int(drafts[i])
+        # position the decided token will occupy: base_len + 1 + i
+        u = _rng(seed, slot, base_len + 1 + i, 0).random()
+        if u <= p[d]:
+            emitted.append(d)
+            continue
+        residual = p.copy()
+        residual[d] = 0.0
+        total = residual.sum()
+        if total <= 0.0:  # p was a delta at d — accept after all
+            emitted.append(d)
+            continue
+        t = int(
+            _rng(seed, slot, base_len + 1 + i, 1).choice(
+                residual.size, p=residual / total
+            )
+        )
+        emitted.append(t)
+        return i, emitted
+    p = _softmax(row_logits[k] / temperature)
+    t = int(_rng(seed, slot, base_len + 1 + k, 0).choice(p.size, p=p))
+    emitted.append(t)
+    return k, emitted
+
+
+# -- draft proposers ----------------------------------------------------------
+
+
+class DraftProposer:
+    """Protocol for draft sources. `propose` maps running slots to draft
+    token lists (up to k each; shorter or empty is fine — the verify
+    degrades to plain decode). The lifecycle hooks exist for proposers
+    with their own cache state (ModelDraftProposer); the base
+    implementations are no-ops so stateless proposers only implement
+    propose()."""
+
+    def admit(self, requests: Sequence) -> None:  # pragma: no cover
+        pass
+
+    def retire(self, request) -> None:  # pragma: no cover
+        pass
+
+    def rollback(self, slot: int, new_len: int) -> None:  # pragma: no cover
+        pass
+
+    def propose(self, running: Dict[int, object], k: int) -> Dict[int, List[int]]:
+        raise NotImplementedError
+
+
+class NGramDraftProposer(DraftProposer):
+    """Weight-free prompt-lookup draft: propose the continuation that
+    followed the most recent earlier occurrence of the sequence's
+    trailing `n`-gram (prompt + generated so far). Repetitive text —
+    code, structured output, or a greedy model that has entered a cycle
+    — yields near-1 acceptance for zero draft cost; novel text yields no
+    match and the iteration degrades to plain decode. `max_history`
+    bounds the backward scan so long sequences stay O(max_history)."""
+
+    def __init__(self, n: int = 2, max_history: int = 4096):
+        if n < 1:
+            raise ValueError("n-gram size must be >= 1")
+        self.n = int(n)
+        self.max_history = int(max_history)
+
+    def propose(self, running, k: int) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {}
+        for slot, req in running.items():
+            seq = list(req.prompt) + list(req.generated)
+            if len(seq) > self.max_history:
+                seq = seq[-self.max_history :]
+            n = self.n
+            if len(seq) <= n:
+                continue
+            tail = seq[-n:]
+            # most recent earlier occurrence wins (locality: loops and
+            # copied spans repeat their NEAREST context)
+            for i in range(len(seq) - n - 1, -1, -1):
+                if seq[i : i + n] == tail:
+                    cont = seq[i + n : i + n + k]
+                    if cont:
+                        out[slot] = [int(t) for t in cont]
+                    break
+        return out
+
+
+class ModelDraftProposer(DraftProposer):
+    """Small-model draft (SpecInfer's SSM): a second compiled decoder LM
+    with its own slot-layout KVCache and GenerationEngine, slot-aligned
+    with the target via `KVCache.claim`. Drafting is k greedy decode
+    steps of the draft engine; between verify iterations the draft cache
+    is rolled back to the target's accepted length with the same
+    `truncate` call, and the next propose() replays whatever accepted
+    tokens the draft cache is missing (catch-up feeds) before drafting
+    fresh ones — so draft state always extends a prefix of the target's
+    committed history, never a rejected branch.
+
+    The draft model must share the target's vocabulary. The draft engine
+    always runs greedily (temperature 0), making its proposal a point
+    mass — the acceptance rule in accept_drafts covers point-mass
+    proposals exactly."""
+
+    def __init__(self, draft_model, max_seqs: int, max_len: int, buckets=None):
+        from flexflow_tpu.serving.engine import GenerationEngine
+        from flexflow_tpu.serving.kv_cache import KVCache
+
+        self.model = draft_model
+        self.cache = KVCache.from_model(
+            draft_model, max_seqs=max_seqs, max_len=max_len, buckets=buckets
+        )
+        self.engine = GenerationEngine(draft_model, self.cache, temperature=0.0)
+        self.params = draft_model.params
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def admit(self, requests) -> None:
+        """Mirror the target's admission: claim the SAME slot ids and
+        prefill the draft cache with the prompts (the prefill's own
+        next-token output is unused — drafts start from the target's
+        first emitted token at the next propose())."""
+        for req in requests:
+            self.cache.claim(req.slot)
+        self.engine.prefill(
+            self.params,
+            [r.prompt for r in requests],
+            [r.slot for r in requests],
+        )
+
+    def retire(self, request) -> None:
+        self.cache.free(request.slot)
+
+    def rollback(self, slot: int, new_len: int) -> None:
+        """Keep the prefix of the draft cache that matches the target's
+        committed history. The draft may hold FEWER positions than the
+        target committed (full-accept: the last draft token was never
+        written to the draft cache) — the gap is replayed as catch-up
+        feeds in the next propose()."""
+        self.cache.truncate(
+            slot, min(int(new_len), int(self.cache.lengths[slot]))
+        )
+
+    # -- drafting ------------------------------------------------------------
+
+    def propose(self, running, k: int) -> Dict[int, List[int]]:
+        if not running or k < 1:
+            return {}
+        spec = self.cache.spec
+        # per-slot feed script: first the committed tokens the draft
+        # cache hasn't seen yet (always at least the last emitted token),
+        # then the draft's own greedy continuations
+        pending: Dict[int, List[int]] = {}
+        drafts: Dict[int, List[int]] = {}
+        for slot, req in running.items():
+            hist = list(req.prompt) + list(req.generated)
+            done = int(self.cache.lengths[slot])
+            pending[slot] = [int(t) for t in hist[done:]]
+            drafts[slot] = []
+        while True:
+            feeds: Dict[int, int] = {}
+            for slot in running:
+                if int(self.cache.lengths[slot]) >= spec.max_len:
+                    continue  # draft cache horizon reached
+                if pending[slot]:
+                    feeds[slot] = pending[slot][0]
+                elif drafts[slot] and len(drafts[slot]) < k:
+                    feeds[slot] = drafts[slot][-1]
+            if not feeds:
+                break
+            tokens = np.zeros(spec.max_seqs, dtype=np.int32)
+            active = np.zeros(spec.max_seqs, dtype=bool)
+            for slot, tok in feeds.items():
+                tokens[slot] = tok
+                active[slot] = True
+            nxt, _ = self.engine.decode(self.params, tokens, active)
+            for slot in feeds:
+                if pending[slot]:
+                    pending[slot].pop(0)
+                    if pending[slot]:
+                        continue  # catch-up feed: prediction is known
+                drafts[slot].append(int(nxt[slot]))
+        return {s: d for s, d in drafts.items() if d}
