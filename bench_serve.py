@@ -1,6 +1,7 @@
 """Serving benchmark driver: continuous vs static batching throughput,
-(--paged) the paged-vs-slot KV cache comparison, and (--spec) the
-speculative-decoding win.
+(--paged) the paged-vs-slot KV cache comparison, (--spec) the
+speculative-decoding win, and (--decode-kernel) the Pallas flash-decode
+kernel vs the dense attention paths.
 
 Prints ONE JSON line in the bench.py protocol ({"metric", "value",
 "unit", "vs_baseline"} — extra serve-specific keys ride along).
@@ -28,6 +29,13 @@ so several tokens ride each verify step's single weight read. Greedy
 outputs are token-identical between the two engines; only the wall
 clock differs. Acceptance floor: 1.3x.
 
+--decode-kernel {auto,pallas,dense} mode (writes
+BENCH_DECODE_KERNEL.json): the flash-decode kernel engine vs the dense
+engine on both kv layouts over the standard mixed stream — off-TPU the
+kernel runs in Pallas interpret mode and the artifact records
+CORRECTNESS (greedy streams identical, step counts equal); TPU runs
+fill in the real throughput ratio.
+
 The default workload is the flagship Transformer geometry (12 layers,
 hidden 1024, 16 heads — transformer.cc:79-85) recast as a decoder LM;
 `--smoke` shrinks it for CPU CI.
@@ -38,6 +46,68 @@ from __future__ import annotations
 import json
 import os
 import sys
+
+
+# -- shared preset geometry ---------------------------------------------------
+#
+# Every section (default / --paged / --spec / --decode-kernel) derives
+# its request streams from ONE place, so a new benchmark mode cannot
+# drift from the geometry the others measure. The streams are functions
+# of (vocab, max_len) only — the same preset dict parameterizes all.
+
+
+def _gen_lengths(max_len):
+    """(short, long) generation lengths the streams interleave."""
+    return max(2, max_len // 16), max(8, max_len // 2 - 8)
+
+
+def _mixed_requests(vocab, max_len, n):
+    """Short and long continuations interleaved — the regime where
+    request-level batching strands slots (default + parity sections)."""
+    from flexflow_tpu.serving import Request
+
+    short, long_ = _gen_lengths(max_len)
+    return [
+        Request(
+            rid=i,
+            prompt=[(i * 7 + j) % vocab for j in range(1 + i % 6)],
+            max_new_tokens=short if i % 2 == 0 else long_,
+        )
+        for i in range(n)
+    ]
+
+
+def _short_requests(vocab, max_len, n):
+    """Short-everything stream (prompt 1-4 tokens, short generation) —
+    the paged-capacity probe: prompt + generation ≪ max_len."""
+    from flexflow_tpu.serving import Request
+
+    gen = _gen_lengths(max_len)[0]
+    return [
+        Request(
+            rid=i,
+            prompt=[(i * 5 + j) % vocab for j in range(1 + i % 4)],
+            max_new_tokens=gen,
+        )
+        for i in range(n)
+    ]
+
+
+def _long_requests(vocab, max_len, n):
+    """Short prompts with near-max_len continuations — the
+    acceptance-friendly speculative regime (greedy tiny LMs enter
+    cycles that prompt lookup drafts at near-1 acceptance)."""
+    from flexflow_tpu.serving import Request
+
+    gen = max_len - 16
+    return [
+        Request(
+            rid=i,
+            prompt=[(i * 5 + j) % vocab for j in range(1 + i % 4)],
+            max_new_tokens=gen,
+        )
+        for i in range(n)
+    ]
 
 
 def run(
@@ -52,7 +122,6 @@ def run(
 ):
     from flexflow_tpu.serving import (
         ContinuousBatchingScheduler,
-        Request,
         ServeConfig,
         StaticBatchingScheduler,
         build_scheduler,
@@ -62,17 +131,7 @@ def run(
     model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
 
     def requests():
-        # mixed-length stream: short and long continuations interleaved,
-        # the regime where request-level batching strands slots
-        short, long_ = max(2, max_len // 16), max(8, max_len // 2 - 8)
-        return [
-            Request(
-                rid=i,
-                prompt=[(i * 7 + j) % vocab for j in range(1 + i % 6)],
-                max_new_tokens=short if i % 2 == 0 else long_,
-            )
-            for i in range(num_requests)
-        ]
+        return _mixed_requests(vocab, max_len, num_requests)
 
     serve = ServeConfig(max_seqs=max_seqs, max_seq_len=max_len)
     _, engine, _ = build_scheduler(model, serve)
@@ -170,7 +229,6 @@ def run_paged(
     gather must cost < 10% on CPU decode throughput."""
     from flexflow_tpu.serving import (
         ContinuousBatchingScheduler,
-        Request,
         ServeConfig,
         build_scheduler,
         default_page_size,
@@ -180,31 +238,16 @@ def run_paged(
     page_size = default_page_size(max_len)
     budget_pages = max_seqs * max_len // page_size
 
-    # short-request profile: prompt 1-4 tokens, generation max_len // 16
-    gen = max(2, max_len // 16)
+    # short-request profile (prompt 1-4 tokens, generation max_len // 16)
+    gen = _gen_lengths(max_len)[0]
     need_pages = -(-(4 + gen) // page_size)
     paged_seqs = max(max_seqs, budget_pages // need_pages)
 
     def short_requests(n):
-        return [
-            Request(
-                rid=i,
-                prompt=[(i * 5 + j) % vocab for j in range(1 + i % 4)],
-                max_new_tokens=gen,
-            )
-            for i in range(n)
-        ]
+        return _short_requests(vocab, max_len, n)
 
     def mixed_requests():
-        short, long_ = max(2, max_len // 16), max(8, max_len // 2 - 8)
-        return [
-            Request(
-                rid=i,
-                prompt=[(i * 7 + j) % vocab for j in range(1 + i % 6)],
-                max_new_tokens=short if i % 2 == 0 else long_,
-            )
-            for i in range(num_requests)
-        ]
+        return _mixed_requests(vocab, max_len, num_requests)
 
     # -- capacity at a fixed byte budget ------------------------------------
     peak = {}
@@ -278,24 +321,15 @@ def run_spec(
     rate this bench records."""
     from flexflow_tpu.serving import (
         ContinuousBatchingScheduler,
-        Request,
         ServeConfig,
         build_scheduler,
         latency_percentiles,
     )
 
     model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
-    gen = max_len - 16  # long continuations: the spec-friendly regime
 
     def requests():
-        return [
-            Request(
-                rid=i,
-                prompt=[(i * 5 + j) % vocab for j in range(1 + i % 4)],
-                max_new_tokens=gen,
-            )
-            for i in range(num_requests)
-        ]
+        return _long_requests(vocab, max_len, num_requests)
 
     results = {}
     stats = {}
@@ -366,6 +400,90 @@ def run_spec(
     }
 
 
+def run_decode_kernel(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    reps: int = 2,
+    decode_kernel: str = "pallas",
+):
+    """Pallas flash-decode kernel (ops/pallas/decode_kernel.py) vs the
+    dense attention paths at identical greedy output, on BOTH kv
+    layouts, over the standard mixed stream.
+
+    Off-TPU the kernel runs in Pallas interpret mode, so this section's
+    job there is the correctness artifact CI records: every greedy
+    stream must match the dense engine's and the step counts must be
+    equal (the kernel changes how a step computes, never how many steps
+    run). The throughput ratio only means something on a real TPU —
+    interpret mode is orders of magnitude off the hardware kernel."""
+    import jax
+
+    from flexflow_tpu.serving import (
+        ContinuousBatchingScheduler,
+        ServeConfig,
+        build_scheduler,
+    )
+
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+    per_layout = {}
+    for layout in ("slot", "paged"):
+        tps, steps, streams = {}, {}, {}
+        for label, mode in (("dense", "dense"), ("kernel", decode_kernel)):
+            serve = ServeConfig(
+                max_seqs=max_seqs,
+                max_seq_len=max_len,
+                kv_layout=layout,
+                decode_kernel=mode,
+            )
+            warm, engine, _ = build_scheduler(model, serve)
+            warm.run(_mixed_requests(vocab, max_len, max_seqs + 1))
+            best = 0.0
+            for _ in range(reps):
+                sched = ContinuousBatchingScheduler(engine)
+                done = sched.run(
+                    _mixed_requests(vocab, max_len, num_requests)
+                )
+                if sched.stats.tokens_per_s >= best:
+                    best = sched.stats.tokens_per_s
+                    steps[label] = sched.stats.decode_steps
+                    streams[label] = {r.rid: tuple(r.generated) for r in done}
+            tps[label] = best
+        matched = sum(
+            1
+            for rid in streams["dense"]
+            if streams["kernel"].get(rid) == streams["dense"][rid]
+        )
+        per_layout[layout] = {
+            "kernel_tokens_per_s": round(tps["kernel"], 2),
+            "dense_tokens_per_s": round(tps["dense"], 2),
+            "throughput_ratio": round(tps["kernel"] / tps["dense"], 3)
+            if tps["dense"]
+            else 0.0,
+            "streams_match": f"{matched}/{len(streams['dense'])}",
+            "decode_steps_kernel": steps["kernel"],
+            "decode_steps_dense": steps["dense"],
+        }
+    interpret = jax.default_backend() != "tpu"
+    return {
+        "metric": f"serve_decode_kernel_{layers}L_{hidden}h",
+        "value": per_layout["paged"]["kernel_tokens_per_s"],
+        "unit": "tokens/s",
+        # kernel over dense decode throughput on the paged layout —
+        # meaningful on TPU only; in interpret mode the artifact's
+        # purpose is the streams_match / step-count correctness record
+        "vs_baseline": per_layout["paged"]["throughput_ratio"],
+        "decode_kernel": decode_kernel,
+        "interpret_mode": interpret,
+        "slot": per_layout["slot"],
+        "paged": per_layout["paged"],
+    }
+
+
 _PRESETS = {
     # flagship geometry (transformer.cc:79-85) as a decoder LM — the TPU
     # target; CPU CI uses --smoke
@@ -391,6 +509,7 @@ def main():
     args = dict(_PRESETS["flagship"])
     mode = "default"
     spec_k = 4
+    decode_kernel = "pallas"
     argv = sys.argv[1:]
     i = 0
     while i < len(argv):
@@ -401,6 +520,10 @@ def main():
             mode = "paged"
         elif a == "--spec":
             mode = "spec"
+        elif a == "--decode-kernel":
+            mode = "decode_kernel"
+            i += 1
+            decode_kernel = argv[i]
         elif a == "--spec-k":
             i += 1
             spec_k = int(argv[i])
@@ -422,6 +545,11 @@ def main():
     elif mode == "spec":
         result = run_spec(spec_k=spec_k, **args)
         with open(os.path.join(here, "BENCH_SPEC.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    elif mode == "decode_kernel":
+        result = run_decode_kernel(decode_kernel=decode_kernel, **args)
+        with open(os.path.join(here, "BENCH_DECODE_KERNEL.json"), "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
     else:

@@ -118,6 +118,10 @@ class FFConfig:
     # decoder LM passed to build_scheduler) and draft length per verify
     serve_spec_draft: str = ""
     serve_spec_k: int = 4
+    # decode/verify attention core (ops/pallas/decode_kernel.py):
+    # "auto" = Pallas flash-decode kernel on TPU when supported,
+    # "pallas" = force it (interpret mode off-TPU), "dense" = jnp paths
+    serve_decode_kernel: str = "auto"
 
     @property
     def num_devices(self) -> int:
@@ -247,6 +251,8 @@ class FFConfig:
                 cfg.serve_spec_draft = take()
             elif a == "--spec-k":
                 cfg.serve_spec_k = int(take())
+            elif a == "--decode-kernel":
+                cfg.serve_decode_kernel = take()
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

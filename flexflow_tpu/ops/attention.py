@@ -133,16 +133,23 @@ def mha_project_out(attn, ws, ctx, out_dtype, use_bias=True):
     return y
 
 
-def _decode_pallas_hook(q, k_cache, v_cache, lengths):
-    """Seam for a hand-tiled TPU decode kernel (single-query flash against
-    the cache, analogous to flash_kernel.py for training). None routes
-    decode_attention to the dense jnp path — the kernel itself is a
-    ROADMAP open item; on CPU the dense path is the measured-fast choice
-    anyway (one query row, no [s, s] score tensor to fear)."""
-    return None
+def _decode_pallas_hook(q, k_cache, v_cache, lengths, kernel="auto"):
+    """Seam for the hand-tiled TPU decode kernel (single-query flash
+    against the cache — pallas/decode_kernel.py, the serving analog of
+    flash_kernel.py for training). `kernel` is the ServeConfig
+    .decode_kernel mode: "auto" takes the kernel on TPU when the
+    geometry supports() it, "pallas" forces it (interpret mode off-TPU
+    — the CI/test path), "dense" pins the jnp path. None routes
+    decode_attention to the dense path below; on CPU "auto" stays dense
+    (one query row, no [s, s] score tensor to fear)."""
+    from flexflow_tpu.ops.pallas import decode_kernel as dk
+
+    if not dk.use_kernel(kernel, q.shape[1], k_cache.shape[1], q.shape[-1]):
+        return None
+    return dk.flash_decode(q, k_cache, v_cache, lengths)
 
 
-def decode_attention(q, k_cache, v_cache, lengths):
+def decode_attention(q, k_cache, v_cache, lengths, kernel="auto"):
     """Serving decode regime: one-query attention against a preallocated
     KV cache. q: [b, 1, h, d]; k_cache/v_cache: [b, max_len, h, d];
     lengths: [b] int32, the cache position the current token was written
@@ -153,7 +160,7 @@ def decode_attention(q, k_cache, v_cache, lengths):
     fp32 score accumulation like scaled_dot_product_attention; the mask
     uses the same -1e30 fill so decode softmax numerics line up with the
     causal prefill path."""
-    out = _decode_pallas_hook(q, k_cache, v_cache, lengths)
+    out = _decode_pallas_hook(q, k_cache, v_cache, lengths, kernel)
     if out is not None:
         return out
     d = q.shape[-1]
@@ -169,16 +176,20 @@ def decode_attention(q, k_cache, v_cache, lengths):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
 
 
-def _verify_pallas_hook(q, k_cache, v_cache, lengths):
-    """Seam for a hand-tiled TPU verify kernel (k+1-query flash against
-    the cache — the speculative-decoding scoring pass). None routes
-    verify_attention to the dense jnp path; like _decode_pallas_hook,
-    the kernel is a ROADMAP open item and on CPU the dense path wins
-    (a [w, max_len] score block per sequence with w = spec_k + 1)."""
-    return None
+def _verify_pallas_hook(q, k_cache, v_cache, lengths, kernel="auto"):
+    """Seam for the hand-tiled TPU verify kernel (w-query flash against
+    the cache — the speculative-decoding scoring pass; decode is its
+    w == 1 case, so pallas/decode_kernel.py serves both with one body).
+    None routes verify_attention to the dense jnp path; mode semantics
+    as in _decode_pallas_hook."""
+    from flexflow_tpu.ops.pallas import decode_kernel as dk
+
+    if not dk.use_kernel(kernel, q.shape[1], k_cache.shape[1], q.shape[-1]):
+        return None
+    return dk.flash_verify(q, k_cache, v_cache, lengths)
 
 
-def verify_attention(q, k_cache, v_cache, lengths):
+def verify_attention(q, k_cache, v_cache, lengths, kernel="auto"):
     """Speculative-decoding verify regime: w query positions per sequence
     (the last emitted token plus the drafted continuation) attend
     against the cache in ONE call. q: [b, w, h, d]; k_cache/v_cache:
@@ -192,7 +203,7 @@ def verify_attention(q, k_cache, v_cache, lengths):
     the w == 1 special case, and the same fp32 accumulation / -1e30
     fill keeps verify softmax numerics aligned with prefill and decode
     (greedy spec decode must be token-identical to plain decode)."""
-    out = _verify_pallas_hook(q, k_cache, v_cache, lengths)
+    out = _verify_pallas_hook(q, k_cache, v_cache, lengths, kernel)
     if out is not None:
         return out
     d = q.shape[-1]
@@ -211,12 +222,36 @@ def verify_attention(q, k_cache, v_cache, lengths):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
 
 
-def paged_verify_attention(q, k_pool, v_pool, block_tables, lengths):
-    """Verify attention against the block-paged cache: gathers each
-    sequence's pages into a contiguous view (same dense-gather strategy
-    as paged_decode_attention, same sentinel clamping) and runs the
-    exact verify_attention math, so paged verify is token-identical to
-    the slot layout."""
+def _paged_verify_pallas_hook(q, k_pool, v_pool, block_tables, lengths,
+                              kernel="auto"):
+    """Seam for the hand-tiled TPU paged-verify kernel (w-query flash
+    walking the block table page by page — the fourth member of the
+    pallas/decode_kernel.py family, completing the seam symmetry:
+    every cache-attention path now has one). None routes
+    paged_verify_attention to the dense gather path; mode semantics as
+    in _decode_pallas_hook."""
+    from flexflow_tpu.ops.pallas import decode_kernel as dk
+
+    if not dk.use_kernel(
+        kernel, q.shape[1], 0, q.shape[-1], page_size=k_pool.shape[1]
+    ):
+        return None
+    return dk.paged_flash_verify(q, k_pool, v_pool, block_tables, lengths)
+
+
+def paged_verify_attention(q, k_pool, v_pool, block_tables, lengths,
+                           kernel="auto"):
+    """Verify attention against the block-paged cache. The dense path
+    gathers each sequence's pages into a contiguous view (same
+    dense-gather strategy as paged_decode_attention, same sentinel
+    clamping) and runs the exact verify_attention math, so paged verify
+    is token-identical to the slot layout; the kernel path walks the
+    table with no gather."""
+    out = _paged_verify_pallas_hook(
+        q, k_pool, v_pool, block_tables, lengths, kernel
+    )
+    if out is not None:
+        return out
     b = q.shape[0]
     num_pages, page_size, heads, d = k_pool.shape
     tbl = jnp.minimum(block_tables, num_pages - 1)
@@ -225,19 +260,26 @@ def paged_verify_attention(q, k_pool, v_pool, block_tables, lengths):
     return verify_attention(q, k, v, lengths)
 
 
-def _paged_decode_pallas_hook(q, k_pool, v_pool, block_tables, lengths):
-    """Seam for a hand-tiled TPU paged-decode kernel (single-query flash
-    that walks the block table page by page instead of gathering the
-    pages into a contiguous [b, max_len] view first — the PagedAttention
-    kernel shape). None routes paged_decode_attention to the dense
-    gather path below; the kernel itself is a ROADMAP open item and
-    should follow the flash_kernel.py pattern (a supports() gate on the
-    page/head geometry, calibration-table tile sizes), like
-    _decode_pallas_hook for the contiguous layout."""
-    return None
+def _paged_decode_pallas_hook(q, k_pool, v_pool, block_tables, lengths,
+                              kernel="auto"):
+    """Seam for the hand-tiled TPU paged-decode kernel (single-query
+    flash that walks the block table page by page instead of gathering
+    the pages into a contiguous [b, max_len] view first — the
+    PagedAttention kernel shape, pallas/decode_kernel.py with its
+    supports() gate and calibration-table tile sizes). None routes
+    paged_decode_attention to the dense gather path below; mode
+    semantics as in _decode_pallas_hook."""
+    from flexflow_tpu.ops.pallas import decode_kernel as dk
+
+    if not dk.use_kernel(
+        kernel, q.shape[1], 0, q.shape[-1], page_size=k_pool.shape[1]
+    ):
+        return None
+    return dk.paged_flash_decode(q, k_pool, v_pool, block_tables, lengths)
 
 
-def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths):
+def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
+                           kernel="auto"):
     """Serving decode against a block-paged KV cache. q: [b, 1, h, d];
     k_pool/v_pool: [num_pages, page_size, h, d]; block_tables:
     [b, max_pages_per_seq] int32 page ids (sentinel num_pages for
@@ -251,7 +293,9 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths):
     and the same -1e30 mask drops them before softmax. (The gather is a
     per-step temp the size of ONE dense cache view; the capacity win is
     in the persistent pool allocation, not this working set.)"""
-    out = _paged_decode_pallas_hook(q, k_pool, v_pool, block_tables, lengths)
+    out = _paged_decode_pallas_hook(
+        q, k_pool, v_pool, block_tables, lengths, kernel
+    )
     if out is not None:
         return out
     b = q.shape[0]

@@ -1,0 +1,13 @@
+"""Hand-tiled Pallas TPU kernels (training flash attention, ring
+attention, serving decode/verify kernels)."""
+
+
+def compiler_params(dimension_semantics):
+    """pltpu compiler params across jax versions: newer jax spells the
+    class `CompilerParams`, 0.4.x spells it `TPUCompilerParams` — the
+    kernels only ever pass dimension_semantics, so one shim covers
+    both."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=tuple(dimension_semantics))

@@ -1,0 +1,425 @@
+"""Hand-tiled Pallas TPU flash-decode kernels against the serving KV cache.
+
+The serving engine's decode regime (flexflow_tpu/serving/engine.py) is
+memory-bound on the KV-cache read: one (decode) or a handful (verify)
+of query positions per sequence attend against up to max_len cached
+rows, so the dense jnp paths in ops/attention.py pay for a full
+[b, h, w, max_len] f32 score tensor — and, on the block-paged layout,
+for gathering every page into a contiguous cache view first. This
+module is the kernel family that fills the Pallas hook seams there,
+Flash-Decoding style (Dao et al., 2023):
+
+  * **Split-KV online softmax** — grid (batch, heads, kv_chunks) with
+    the KV-chunk dim innermost ("arbitrary", i.e. sequential): each
+    chunk folds an MXU `q @ k^T` score tile into running
+    max / sum-exp / weighted-V accumulators held in VMEM scratch, and
+    the output tile is written once on the last chunk. No score tensor
+    ever exists in HBM — the same trade flash_kernel.py makes for
+    training, restricted to the w-query forward (no backward: serving
+    never differentiates through the cache).
+  * **Length gating per chunk** — `lengths` rides in as a
+    scalar-prefetch argument, so whole chunks past
+    `lengths[i] + w - 1` are skipped (pl.when) and their DMAs
+    redirected to chunk 0, the split-KV analog of the causal-block
+    skip in flash_kernel.py.
+  * **Decode is the w == 1 case of verify** — one kernel body computes
+    the staircase mask `key_pos <= lengths[i] + query_offset`
+    (ops/attention.verify_attention's semantics); with w = 1 the
+    staircase degenerates to decode_attention's `key_pos <= lengths[i]`
+    mask. Sharing the body is what keeps greedy speculative decoding
+    token-identical to plain decode on the kernel path.
+  * **The paged variant walks the block table** — grid
+    (batch, heads, pages): the K/V BlockSpec index maps read the
+    scalar-prefetched block table to DMA each logical page straight
+    from the pool (PagedAttention, Kwon et al., SOSP'23), so the
+    per-step contiguous gather the dense paged path pays disappears.
+    Sentinel entries (num_pages) are clamped for the DMA and masked in
+    the score tile, so unallocated pages are numerically inert exactly
+    like the dense path's clamp-and-mask.
+
+Tile size: the contiguous kernel's KV chunk defaults to the
+v5e-calibrated 512 rows (calibration/v5e.json "decode_blocks", installed
+at compile like the training kernel's flash_blocks) shrunk to the
+largest sublane-aligned divisor of max_len; the paged kernel's chunk is
+one page (the block table gives no contiguity beyond a page).
+
+`supports()` gates geometry (callers fall back to the dense paths), and
+`interpret=None` auto-selects the Pallas interpreter off-TPU so the
+exact kernel code path runs under JAX_PLATFORMS=cpu — tier-1 tests
+(tests/test_decode_kernel.py) assert parity against the dense paths
+there.
+
+Shapes at the API boundary match ops/attention.py: q [b, w, h, d],
+contiguous cache [b, max_len, h, d], paged pools
+[num_pages, page_size, h, d] with block_tables [b, max_pages_per_seq].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flexflow_tpu.ops.pallas import compiler_params as _compiler_params
+
+LANES = 128
+SUBLANES = 8
+_MASK = -1e30  # finite mask fill: exp()=0 without inf-inf NaNs (matches
+#               the dense paths' fill, so softmax numerics line up)
+
+# modes the ServeConfig.decode_kernel toggle takes (threaded through
+# engine hooks into use_kernel below)
+MODES = ("auto", "pallas", "dense")
+
+# draft widths past this don't belong to the decode regime (a verify
+# step that wide is prefill-shaped; the training kernel serves it)
+_MAX_W = 64
+
+# process-wide tuned KV-chunk rows for the contiguous kernel, overridden
+# from a measured calibration table ("decode_blocks" entry, installed by
+# runtime/model.py compile() like flash_kernel's flash_blocks). The
+# built-in default mirrors the flash kernel's v5e-measured preference
+# for wide K blocks: 512 rows is a 128 KB f32 chunk at head_dim 64 —
+# small next to VMEM, wide enough to amortize the per-chunk rescale.
+_TUNED = {"block_k": 512}
+
+
+def set_tuned_decode_blocks(block_k: int) -> None:
+    """Install the measured-best KV chunk size (calibration-table
+    "decode_blocks" entry; runtime/model.py installs it at compile when
+    a calibration file is configured)."""
+    _TUNED["block_k"] = int(block_k)
+
+
+def _pick_chunk(kv_len: int, pref: Optional[int] = None) -> Optional[int]:
+    """Largest KV chunk <= pref that divides kv_len and is
+    sublane-aligned (the chunk is the second-minor dim of the (bk, d)
+    K tile, so 8-row granularity, not the 128-lane rule the training
+    kernel's seq-minor layout needs)."""
+    b = min(pref or _TUNED["block_k"], kv_len)
+    while b >= SUBLANES:
+        if kv_len % b == 0 and b % SUBLANES == 0:
+            return b
+        b -= SUBLANES
+    return None
+
+
+def supports(w: int, kv_len: int, head_dim: int, page_size: int = 0) -> bool:
+    """Whether the kernel family takes this cache geometry. False routes
+    the caller to the dense jnp paths (ops/attention.py) — the explicit
+    fallback contract, like flash_kernel.supports for training shapes.
+
+    w: query positions per sequence (1 = decode, k+1 = verify);
+    kv_len: max_len of the contiguous cache; page_size > 0 checks the
+    paged variant instead (its chunk is one page, so the page must be
+    sublane-aligned; kv_len is ignored — the walk is table-driven)."""
+    if not 1 <= w <= _MAX_W or head_dim % SUBLANES:
+        return False
+    if page_size > 0:
+        return page_size % SUBLANES == 0
+    return kv_len >= 1 and _pick_chunk(kv_len) is not None
+
+
+def use_kernel(
+    mode: str, w: int, kv_len: int, head_dim: int, page_size: int = 0
+) -> bool:
+    """Resolve a ServeConfig.decode_kernel mode for one geometry:
+    "dense" never takes the kernel, "pallas" takes it whenever
+    supports() passes (interpret mode runs it off-TPU — the CI/test
+    path), "auto" additionally requires a real TPU backend (on CPU the
+    dense one-query path is the measured-fast choice; interpreting the
+    kernel there is a correctness tool, not a serving config)."""
+    if mode not in MODES:
+        raise ValueError(f"decode_kernel must be one of {MODES}, got {mode!r}")
+    if mode == "dense" or not supports(w, kv_len, head_dim, page_size):
+        return False
+    return mode == "pallas" or jax.default_backend() == "tpu"
+
+
+class _Cfg(NamedTuple):
+    w: int
+    sm_scale: float
+    block_k: int
+    interpret: bool
+
+
+def _stair_mask(s, cfg, length, k_start):
+    """Apply the staircase mask to a (w, bk) score tile whose keys start
+    at global cache position k_start: query row j sees key positions
+    <= length + j. With w == 1 this is exactly decode_attention's
+    `key_pos <= lengths[i]` mask."""
+    kpos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    qoff = lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    return jnp.where(kpos <= length + qoff, s, _MASK)
+
+
+def _online_softmax_step(s, v, m_scr, l_scr, acc_scr):
+    """Fold one masked score tile (w, bk) and its V chunk (bk, d) into
+    the running (m, l, acc) accumulators — the flash_kernel.py forward
+    update, minus the LSE output serving never needs."""
+    m_prev = m_scr[:, :1]  # (w, 1)
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)  # masked entries: exp(~-1e30) == 0
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * corr + lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+
+def _finish(o_ref, l_scr, acc_scr):
+    # position 0 is visible to every query row (lengths >= 0), so l > 0
+    # for live rows; the max guards the padded scratch lanes
+    l = jnp.maximum(l_scr[:, :1], 1e-30)
+    o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+# -- contiguous cache ---------------------------------------------------------
+
+
+def _decode_kernel(
+    len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, cfg, nk
+):
+    ib = pl.program_id(0)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _MASK)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[ib]
+
+    # chunk visible iff it holds at least one key some query row sees
+    @pl.when(ik * cfg.block_k <= length + (cfg.w - 1))
+    def _body():
+        q = q_ref[0, 0]  # (w, d)
+        k = k_ref[0, 0]  # (bk, d)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.sm_scale  # (w, bk) f32
+        s = _stair_mask(s, cfg, length, ik * cfg.block_k)
+        _online_softmax_step(s, v_ref[0, 0], m_scr, l_scr, acc_scr)
+
+    @pl.when(ik == nk - 1)
+    def _done():
+        _finish(o_ref, l_scr, acc_scr)
+
+
+def flash_verify(
+    q,
+    k_cache,
+    v_cache,
+    lengths,
+    sm_scale: Optional[float] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """w-query flash attention against the contiguous cache with the
+    staircase mask — ops/attention.verify_attention's semantics on the
+    split-KV kernel. q: [b, w, h, d]; k_cache/v_cache:
+    [b, max_len, h, d]; lengths: [b] int32. Returns [b, w, h, d].
+    interpret=None auto-selects the Pallas interpreter off-TPU."""
+    b, w, h, d = q.shape
+    kv_len = k_cache.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    bk = block_k or _pick_chunk(kv_len)
+    if bk is None or kv_len % bk:
+        raise ValueError(
+            f"flash decode: cache length {kv_len} not tileable "
+            f"(chunk {bk}); use supports() and fall back to dense"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cfg = _Cfg(w, sm_scale, bk, interpret)
+    nk = kv_len // bk
+    qt = q.transpose(0, 2, 1, 3)  # [b, h, w, d]
+    kt = k_cache.transpose(0, 2, 1, 3)
+    vt = v_cache.transpose(0, 2, 1, 3)
+
+    def q_map(ib, ih, ik, lens):
+        return (ib, ih, 0, 0)
+
+    def kv_map(ib, ih, ik, lens):
+        # skipped (past-length) chunk: redirect the DMA to chunk 0,
+        # which the next (ib, ih) program always needs
+        ik = lax.select(ik * bk <= lens[ib] + (w - 1), ik, 0)
+        return (ib, ih, ik, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, cfg=cfg, nk=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, w, d), q_map),
+                pl.BlockSpec((1, 1, bk, d), kv_map),
+                pl.BlockSpec((1, 1, bk, d), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, w, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, d), q.dtype),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def flash_decode(q, k_cache, v_cache, lengths, **kw):
+    """Single-query flash decode — the w == 1 case of flash_verify
+    (ops/attention.decode_attention's semantics)."""
+    return flash_verify(q, k_cache, v_cache, lengths, **kw)
+
+
+# -- block-paged cache --------------------------------------------------------
+
+
+def _paged_kernel(
+    len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, cfg, num_pages, page_size, np_seq,
+):
+    ib = pl.program_id(0)
+    ip = pl.program_id(2)
+
+    @pl.when(ip == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _MASK)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[ib]
+
+    # a page contributes iff it is inside the staircase AND allocated
+    # (sentinel entries sit past the length gate whenever the engine's
+    # allocator invariants hold — the table check is defensive, for
+    # standalone callers handing the kernel ragged tables)
+    @pl.when(
+        (ip * page_size <= length + (cfg.w - 1))
+        & (tbl_ref[ib, ip] < num_pages)
+    )
+    def _body():
+        q = q_ref[0, 0]  # (w, d)
+        k = k_ref[0, :, 0, :]  # (page_size, d)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.sm_scale  # (w, page_size)
+        s = _stair_mask(s, cfg, length, ip * page_size)
+        _online_softmax_step(s, v_ref[0, :, 0, :], m_scr, l_scr, acc_scr)
+
+    @pl.when(ip == np_seq - 1)
+    def _done():
+        _finish(o_ref, l_scr, acc_scr)
+
+
+def paged_flash_verify(
+    q,
+    k_pool,
+    v_pool,
+    block_tables,
+    lengths,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+):
+    """w-query flash attention that walks the block table page by page —
+    ops/attention.paged_verify_attention's semantics with NO contiguous
+    gather (the PagedAttention kernel shape). q: [b, w, h, d];
+    k_pool/v_pool: [num_pages, page_size, h, d]; block_tables:
+    [b, max_pages_per_seq] int32 (sentinel num_pages = unallocated);
+    lengths: [b] int32. Returns [b, w, h, d].
+
+    Rows whose VISIBLE positions point at sentinel pages return zeros
+    (no page contributes), where the dense path softmaxes over the
+    clamped page's stale rows instead. Both only happens for dead
+    slots — the engine allocates every page inside a live slot's
+    lengths + w before the step, so live rows agree exactly — and dead
+    rows' outputs are discarded by the scheduler either way."""
+    b, w, h, d = q.shape
+    num_pages, page_size = k_pool.shape[0], k_pool.shape[1]
+    np_seq = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if page_size % SUBLANES:
+        raise ValueError(
+            f"paged flash decode: page_size {page_size} is not "
+            f"sublane-aligned ({SUBLANES}); use supports() and fall "
+            "back to dense"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cfg = _Cfg(w, sm_scale, page_size, interpret)
+    qt = q.transpose(0, 2, 1, 3)  # [b, h, w, d]
+
+    def q_map(ib, ih, ip, lens, tbl):
+        return (ib, ih, 0, 0)
+
+    def kv_map(ib, ih, ip, lens, tbl):
+        # skipped pages prefetch the sequence's first page; sentinel
+        # entries clamp to a real page (their scores are masked)
+        ip = lax.select(ip * page_size <= lens[ib] + (w - 1), ip, 0)
+        page = jnp.minimum(tbl[ib, ip], num_pages - 1)
+        return (page, 0, ih, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel,
+            cfg=cfg,
+            num_pages=num_pages,
+            page_size=page_size,
+            np_seq=np_seq,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, np_seq),
+            in_specs=[
+                pl.BlockSpec((1, 1, w, d), q_map),
+                pl.BlockSpec((1, page_size, 1, d), kv_map),
+                pl.BlockSpec((1, page_size, 1, d), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, w, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, d), q.dtype),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        qt,
+        k_pool,
+        v_pool,
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def paged_flash_decode(q, k_pool, v_pool, block_tables, lengths, **kw):
+    """Single-query paged flash decode — the w == 1 case of
+    paged_flash_verify (ops/attention.paged_decode_attention's
+    semantics)."""
+    return paged_flash_verify(q, k_pool, v_pool, block_tables, lengths, **kw)

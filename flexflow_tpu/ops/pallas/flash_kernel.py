@@ -45,6 +45,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from flexflow_tpu.ops.pallas import compiler_params as _compiler_params
+
 LANES = 128
 _MASK = -1e30  # finite mask value: keeps exp()=0 without inf-inf NaNs
 
@@ -214,10 +216,8 @@ def _fwd(cfg: _Cfg, q, k, v):
             ],
         ),
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(
-                "parallel", "parallel", "parallel", "arbitrary"
-            )
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=cfg.interpret,
     )(q, k, v)
@@ -398,10 +398,8 @@ def _bwd_from_delta(cfg, q, k, v, lse, do, delta):
             scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
         ),
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(
-                "parallel", "parallel", "parallel", "arbitrary"
-            )
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=cfg.interpret,
     )(q, k, v, do, lse_b, delta_b)[0]
@@ -444,10 +442,8 @@ def _bwd_from_delta(cfg, q, k, v, lse, do, delta):
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(
-                "parallel", "parallel", "parallel", "arbitrary"
-            )
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=cfg.interpret,
     )(q, k, v, do, lse_b, delta_b)

@@ -32,10 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax>=0.8 top-level; older releases keep it in experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from flexflow_tpu.parallel._shardmap_compat import shard_map_unchecked
 
 
 def _local_ring_attention(q, k, v, axis_name: str, n_shards: int, causal: bool):
@@ -216,7 +213,10 @@ def ring_attention(
         _local_ring_attention_pallas if use_pallas else _local_ring_attention
     )
     spec = P(batch_axis, seq_axis, head_axis, None)
-    inner = shard_map(
+    # replication checking off (the scan carry mixes locally-created
+    # accumulators with ring-permuted blocks) via the version-compat
+    # shim: check_vma on jax >= 0.8, check_rep before
+    inner = shard_map_unchecked(
         functools.partial(
             body,
             axis_name=seq_axis,
@@ -226,8 +226,5 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        # the scan carry mixes locally-created accumulators with
-        # ring-permuted blocks; skip the varying-axis type check
-        check_vma=False,
     )
     return inner(q, k, v)

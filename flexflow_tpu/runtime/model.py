@@ -733,6 +733,13 @@ class FFModel:
                     )
 
                     set_tuned_blocks(fb["block_q"], fb["block_k"])
+                db = _doc.get("decode_blocks") or {}
+                if db.get("block_k"):
+                    from flexflow_tpu.ops.pallas.decode_kernel import (
+                        set_tuned_decode_blocks,
+                    )
+
+                    set_tuned_decode_blocks(db["block_k"])
                 caps = _doc.get("attn_caps") or {}
                 if caps.get("mono_mb") and caps.get("chunk_mb"):
                     from flexflow_tpu.ops.attention import set_dense_caps

@@ -760,6 +760,23 @@ def _serving_cache_geometry(graph: PCGGraph):
     return tuple(guids), heads, head_dim
 
 
+def resolve_decode_kernel(
+    mode: str, graph: PCGGraph, kv_len: int, page_size: int = 0, w: int = 1
+) -> str:
+    """Resolve a ServeConfig.decode_kernel mode into the cost term to
+    price ("pallas" or "dense") for this graph's cache geometry —
+    the search-side mirror of the runtime selection in
+    ops/pallas/decode_kernel.use_kernel, so optimize_serving and
+    optimize_spec_k rank strategies with the cost shape the engine
+    will actually run."""
+    from flexflow_tpu.ops.pallas import decode_kernel as dk
+
+    _, _, head_dim = _serving_cache_geometry(graph)
+    if dk.use_kernel(mode, w, kv_len, head_dim, page_size):
+        return "pallas"
+    return "dense"
+
+
 def estimate_max_in_flight(
     graph: PCGGraph,
     cache_bytes: int,
@@ -819,6 +836,7 @@ def estimate_decode_step(
     batch: int,
     kv_len: int,
     page_size: int = 0,
+    decode_kernel: str = "dense",
 ) -> Optional[GraphCost]:
     """Cost one decode iteration of the whole PCG under a (dp, tp) mesh;
     None when infeasible (dp doesn't divide the batch, tp doesn't divide
@@ -848,7 +866,8 @@ def estimate_decode_step(
         elif width is None:
             node_tp = 1
         c = cm.decode_op_cost(
-            node, b_chip, kv_len, tp=node_tp, page_size=page_size
+            node, b_chip, kv_len, tp=node_tp, page_size=page_size,
+            kernel=decode_kernel,
         )
         compute += c.forward_time
         mem += c.memory
@@ -874,6 +893,7 @@ def estimate_verify_step(
     kv_len: int,
     k: int,
     page_size: int = 0,
+    decode_kernel: str = "dense",
 ) -> Optional[GraphCost]:
     """Cost one speculative-decoding VERIFY iteration (k+1 scored token
     positions per sequence, serving/engine.verify) of the whole PCG
@@ -897,7 +917,8 @@ def estimate_verify_step(
         elif width is None:
             node_tp = 1
         c = cm.verify_op_cost(
-            node, b_chip, kv_len, k, tp=node_tp, page_size=page_size
+            node, b_chip, kv_len, k, tp=node_tp, page_size=page_size,
+            kernel=decode_kernel,
         )
         compute += c.forward_time
         mem += c.memory
@@ -977,6 +998,7 @@ def optimize_spec_k(
     page_size: int = 0,
     machine_model=None,
     mixed_precision: bool = False,
+    decode_kernel: str = "dense",
 ) -> SpecKResult:
     """Pick the draft length k that maximizes expected decode throughput
     at a MEASURED per-token acceptance rate (SchedulerStats
@@ -996,13 +1018,17 @@ def optimize_spec_k(
         mixed_precision=mixed_precision,
     )
     base = estimate_decode_step(
-        graph, cm, dp, tp, batch, kv_len, page_size=page_size
+        graph, cm, dp, tp, batch, kv_len, page_size=page_size,
+        decode_kernel=decode_kernel,
     )
     if base is None:
         raise ValueError(f"(dp={dp}, tp={tp}) is infeasible for this graph")
     draft_step = 0.0
     if draft_graph is not None:
-        d = estimate_decode_step(draft_graph, cm, dp, tp, batch, kv_len)
+        d = estimate_decode_step(
+            draft_graph, cm, dp, tp, batch, kv_len,
+            decode_kernel=decode_kernel,
+        )
         if d is None:
             raise ValueError(
                 f"(dp={dp}, tp={tp}) is infeasible for the draft graph"
@@ -1014,7 +1040,8 @@ def optimize_spec_k(
     )
     for k in range(1, k_max + 1):
         vcost = estimate_verify_step(
-            graph, cm, dp, tp, batch, kv_len, k, page_size=page_size
+            graph, cm, dp, tp, batch, kv_len, k, page_size=page_size,
+            decode_kernel=decode_kernel,
         )
         if vcost is None:
             continue
@@ -1041,6 +1068,7 @@ def optimize_serving(
     mean_prompt_len: Optional[int] = None,
     mean_gen_len: Optional[int] = None,
     max_len: Optional[int] = None,
+    decode_kernel: str = "dense",
 ) -> ServingSearchResult:
     """Pick the decode-latency-optimal (dp, tp) mesh for serving
     `batch_size` concurrent sequences at `kv_len` cache positions.
@@ -1049,7 +1077,11 @@ def optimize_serving(
     keeps the feasible minimum-step-time one.
 
     page_size > 0 prices the paged KV layout (per-sequence reads round
-    up to whole pages). When a measured length profile is supplied
+    up to whole pages); decode_kernel ("pallas" | "dense", resolve a
+    ServeConfig mode via resolve_decode_kernel) selects the attention
+    core's cost shape — the kernel's single page-granular pool read vs
+    the dense fallback's gather. When a measured length profile is
+    supplied
     (mean_prompt_len + mean_gen_len), the winner also carries
     `max_in_flight`: how many such sequences fit in the winning mesh's
     leftover HBM (chip capacity minus its weight shard, through
@@ -1067,7 +1099,8 @@ def optimize_serving(
             continue
         for dp, tp in _mesh_factorizations(used):
             cost = estimate_decode_step(
-                graph, cm, dp, tp, batch_size, kv_len, page_size=page_size
+                graph, cm, dp, tp, batch_size, kv_len, page_size=page_size,
+                decode_kernel=decode_kernel,
             )
             if cost is None or not cost.feasible(spec):
                 continue
@@ -1114,7 +1147,9 @@ def search_serving_strategy(
     """Model-level entry: cost the compiled builder graph's decode regime
     on the config's machine (chip/nodes like the training search). kv_len
     defaults to the config's serving cache length; the KV layout and page
-    geometry come from the config's --kv-layout/--kv-page-size flags, and
+    geometry come from the config's --kv-layout/--kv-page-size flags, the
+    attention core's cost shape from --decode-kernel (resolved against
+    the graph's cache geometry exactly like the engine resolves it), and
     a supplied length profile fills the winner's max_in_flight capacity
     estimate."""
     from flexflow_tpu.serving.kv_cache import default_page_size
@@ -1125,6 +1160,12 @@ def search_serving_strategy(
         page_size = cfg.serve_kv_page_size or default_page_size(
             cfg.serve_max_seq_len
         )
+    decode_kernel = resolve_decode_kernel(
+        getattr(cfg, "serve_decode_kernel", "auto"),
+        model.graph,
+        cfg.serve_max_seq_len,
+        page_size=page_size,
+    )
     n = cfg.num_devices if cfg.workers_per_node > 0 else None
     if n is None:
         import jax
@@ -1146,6 +1187,7 @@ def search_serving_strategy(
         mean_prompt_len=mean_prompt_len,
         mean_gen_len=mean_gen_len,
         max_len=cfg.serve_max_seq_len,
+        decode_kernel=decode_kernel,
     )
 
 

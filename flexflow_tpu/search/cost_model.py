@@ -467,7 +467,13 @@ class CostModel:
     # it is analytic-only (the measured path times training shapes).
 
     def decode_op_cost(
-        self, node, batch: int, kv_len: int, tp: int = 1, page_size: int = 0
+        self,
+        node,
+        batch: int,
+        kv_len: int,
+        tp: int = 1,
+        page_size: int = 0,
+        kernel: str = "dense",
     ) -> OpCost:
         """Forward cost of ONE decode step of this op on one chip.
 
@@ -486,7 +492,18 @@ class CostModel:
         whole pages, so the KV term rounds UP to page granularity — the
         per-sequence rounding waste paging pays for its pool-level
         packing win, which optimize_serving's max-in-flight estimate
-        prices on the other side."""
+        prices on the other side.
+
+        kernel selects the attention core's memory-bound term: "pallas"
+        prices the flash-decode kernel path (ops/pallas/decode_kernel
+        .py) — the cache bytes are read ONCE at page granularity,
+        straight from the pool through the block table; "dense" (the
+        fallback) prices the jnp gather path on the paged layout, which
+        materializes a contiguous per-step cache view first — one extra
+        write plus one extra read of the gathered bytes on top of the
+        pool read, so the dense paged KV term is 3x the kernel's. On
+        the contiguous layout the two paths move the same bytes and the
+        term is unchanged."""
         tp = max(1, tp)
         elem = lambda s: self.elem_bytes(s)  # noqa: E731
         weight_bytes = sum(
@@ -508,8 +525,13 @@ class CostModel:
             if page_size > 0:
                 kv_rows = -(-kv_len // page_size) * page_size
             cache_bytes = 2.0 * batch * kv_rows * heads * head_dim * out_elem
-            bytes_moved += cache_bytes
             mem += cache_bytes
+            if page_size > 0 and kernel != "pallas":
+                # dense fallback on the paged layout: gather the pages
+                # into a contiguous view (write), then attend over it
+                # (read) — on top of the pool read itself
+                cache_bytes *= 3.0
+            bytes_moved += cache_bytes
             flops += 4.0 * batch * kv_len * heads * head_dim
         elif node.op_type == OperatorType.EMBEDDING:
             # one row gather per sequence — the table is read sparsely,
@@ -531,6 +553,7 @@ class CostModel:
         k: int,
         tp: int = 1,
         page_size: int = 0,
+        kernel: str = "dense",
     ) -> OpCost:
         """Forward cost of ONE speculative-decoding verify step of this
         op on one chip: k+1 token positions per sequence (the last
@@ -544,7 +567,11 @@ class CostModel:
         additionally reads the k fresh cache rows the drafts occupy
         (page-rounded like decode when page_size > 0). So
         verify(k) << (k+1) * decode, and the gap times the measured
-        acceptance rate is the speedup optimize_spec_k prices."""
+        acceptance rate is the speedup optimize_spec_k prices.
+
+        kernel as in decode_op_cost: "pallas" prices the flash-verify
+        kernel's single page-granular cache read; "dense" adds the
+        paged gather's extra write + read of the contiguous view."""
         tp = max(1, tp)
         w = int(k) + 1
         elem = lambda s: self.elem_bytes(s)  # noqa: E731
@@ -569,8 +596,11 @@ class CostModel:
             if page_size > 0:
                 kv_rows = -(-kv_rows // page_size) * page_size
             cache_bytes = 2.0 * batch * kv_rows * heads * head_dim * out_elem
-            bytes_moved += cache_bytes
             mem += cache_bytes
+            if page_size > 0 and kernel != "pallas":
+                # dense gather tax, as in decode_op_cost
+                cache_bytes *= 3.0
+            bytes_moved += cache_bytes
             flops += 4.0 * batch * w * (kv_len + w) * heads * head_dim
         elif node.op_type == OperatorType.EMBEDDING:
             # w row gathers per sequence, like decode's one

@@ -55,6 +55,12 @@ class ServeConfig:
     spec_draft: str = ""
     spec_k: int = 4
     spec_ngram: int = 2
+    # decode/verify attention core (ops/pallas/decode_kernel.py):
+    # "auto" = the Pallas flash-decode kernel on TPU when the geometry
+    # supports() it (dense otherwise), "pallas" = force the kernel
+    # (interpret mode off-TPU — the CI/parity path), "dense" = always
+    # the jnp paths.
+    decode_kernel: str = "auto"
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -84,6 +90,13 @@ class ServeConfig:
             raise ValueError("spec_k must be >= 1 when spec_draft is set")
         if self.spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
+        from flexflow_tpu.ops.pallas.decode_kernel import MODES
+
+        if self.decode_kernel not in MODES:
+            raise ValueError(
+                f"decode_kernel must be one of {MODES}, "
+                f"got {self.decode_kernel!r}"
+            )
 
     @staticmethod
     def from_config(cfg) -> "ServeConfig":
@@ -101,6 +114,7 @@ class ServeConfig:
             kv_pages=cfg.serve_kv_pages,
             spec_draft=cfg.serve_spec_draft,
             spec_k=cfg.serve_spec_k,
+            decode_kernel=cfg.serve_decode_kernel,
         )
 
 
@@ -127,6 +141,7 @@ def build_proposer(serve: ServeConfig, draft_model=None):
         max_seqs=serve.max_seqs,
         max_len=serve.max_seq_len,
         buckets=serve.prefill_buckets or None,
+        decode_kernel=serve.decode_kernel,
     )
 
 
@@ -152,7 +167,11 @@ def build_scheduler(model, serve: ServeConfig, draft_model=None):
             buckets=serve.prefill_buckets or None,
         )
     engine = GenerationEngine(
-        model, cache, temperature=serve.temperature, seed=serve.seed
+        model,
+        cache,
+        temperature=serve.temperature,
+        seed=serve.seed,
+        decode_kernel=serve.decode_kernel,
     )
     sched = _SCHEDULERS[serve.scheduler](
         engine,

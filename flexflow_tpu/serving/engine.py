@@ -15,8 +15,10 @@ attention core:
   * **decode**: one query position per slot. The new K/V row is written
     at `lengths[slot]` via a per-row dynamic_update_slice, then
     `ops.attention.decode_attention` runs masked one-query attention
-    against the cache (dense jnp path on CPU; `_decode_pallas_hook` is
-    the TPU-kernel seam).
+    against the cache — the dense jnp path, or the Pallas flash-decode
+    kernel (ops/pallas/decode_kernel.py) when the engine's
+    `decode_kernel` mode selects it ("auto" on TPU, "pallas" forced,
+    "dense" pinned).
 
 The engine serves BOTH cache layouts (kv_cache.KVCache slot-contiguous,
 kv_cache.PagedKVCache block-paged) with the same hooks: the paged steps
@@ -69,16 +71,36 @@ class GenerationEngine:
     """Step functions over (params, cache); all scheduling lives in
     serving.scheduler."""
 
-    def __init__(self, model, cache, temperature: float = 0.0, seed: int = 0):
+    def __init__(
+        self,
+        model,
+        cache,
+        temperature: float = 0.0,
+        seed: int = 0,
+        decode_kernel: str = "auto",
+    ):
         import jax
+
+        from flexflow_tpu.ops.pallas.decode_kernel import MODES
 
         if model.executor is None:
             raise RuntimeError("compile() the model before serving")
+        if decode_kernel not in MODES:
+            raise ValueError(
+                f"decode_kernel must be one of {MODES}, got {decode_kernel!r}"
+            )
         self.model = model
         self.executor = model.executor
         self.cache = cache
         self.temperature = float(temperature)
         self.seed = int(seed)
+        # how the decode/verify attention core runs (threaded into every
+        # ops.attention call below): "auto" = Pallas decode kernel on TPU
+        # when the geometry supports() it, "pallas" = force the kernel
+        # (interpret mode off-TPU), "dense" = always the jnp paths. A
+        # trace-time constant: each engine owns its jitted steps, so two
+        # engines with different modes coexist in one process.
+        self.decode_kernel = decode_kernel
         graph = model.graph
         inputs = [
             graph.nodes[g]
@@ -352,7 +374,9 @@ class GenerationEngine:
             vc = row_update(cv[g], v)
             new_k[g] = kc
             new_v[g] = vc
-            attn = decode_attention(q, kc, vc, lengths)
+            attn = decode_attention(
+                q, kc, vc, lengths, kernel=self.decode_kernel
+            )
             return [
                 mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
             ]
@@ -403,7 +427,9 @@ class GenerationEngine:
             vc = row_update(cv[g], v)
             new_k[g] = kc
             new_v[g] = vc
-            attn = paged_decode_attention(q, kc, vc, tables, lengths)
+            attn = paged_decode_attention(
+                q, kc, vc, tables, lengths, kernel=self.decode_kernel
+            )
             return [
                 mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
             ]
@@ -521,7 +547,9 @@ class GenerationEngine:
             vc = row_update(cv[g], v)
             new_k[g] = kc
             new_v[g] = vc
-            attn = verify_attention(q, kc, vc, lengths)
+            attn = verify_attention(
+                q, kc, vc, lengths, kernel=self.decode_kernel
+            )
             return [
                 mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
             ]
@@ -565,7 +593,9 @@ class GenerationEngine:
             vc = row_update(cv[g], v)
             new_k[g] = kc
             new_v[g] = vc
-            attn = paged_verify_attention(q, kc, vc, tables, lengths)
+            attn = paged_verify_attention(
+                q, kc, vc, tables, lengths, kernel=self.decode_kernel
+            )
             return [
                 mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
             ]

@@ -200,7 +200,14 @@ class ModelDraftProposer(DraftProposer):
     mass — the acceptance rule in accept_drafts covers point-mass
     proposals exactly."""
 
-    def __init__(self, draft_model, max_seqs: int, max_len: int, buckets=None):
+    def __init__(
+        self,
+        draft_model,
+        max_seqs: int,
+        max_len: int,
+        buckets=None,
+        decode_kernel: str = "auto",
+    ):
         from flexflow_tpu.serving.engine import GenerationEngine
         from flexflow_tpu.serving.kv_cache import KVCache
 
@@ -208,7 +215,12 @@ class ModelDraftProposer(DraftProposer):
         self.cache = KVCache.from_model(
             draft_model, max_seqs=max_seqs, max_len=max_len, buckets=buckets
         )
-        self.engine = GenerationEngine(draft_model, self.cache, temperature=0.0)
+        # the draft's k decode steps live in the same memory-bound regime
+        # as the target's — the Pallas decode-kernel toggle rides along
+        self.engine = GenerationEngine(
+            draft_model, self.cache, temperature=0.0,
+            decode_kernel=decode_kernel,
+        )
         self.params = draft_model.params
 
     # -- lifecycle -----------------------------------------------------------

@@ -343,13 +343,20 @@ def test_decode_cost_rounds_kv_to_page_granularity():
     )
     flat = cm.decode_op_cost(mha, batch=1, kv_len=100)
     paged = cm.decode_op_cost(mha, batch=1, kv_len=100, page_size=64)
-    aligned = cm.decode_op_cost(mha, batch=1, kv_len=128, page_size=64)
+    aligned = cm.decode_op_cost(
+        mha, batch=1, kv_len=128, page_size=64, kernel="pallas"
+    )
     exact = cm.decode_op_cost(mha, batch=1, kv_len=128)
     # 100 positions round up to 2 pages of 64 = 128 rows streamed/held
     assert paged.memory == aligned.memory == exact.memory
     assert paged.memory > flat.memory
-    # page-aligned lengths price identically to the flat layout
+    # on the kernel path (one page-granular pool read, no gather),
+    # page-aligned lengths price identically to the flat layout; the
+    # dense fallback additionally pays the gather's write + re-read
     assert aligned.forward_time == exact.forward_time
+    dense_aligned = cm.decode_op_cost(mha, batch=1, kv_len=128, page_size=64)
+    assert dense_aligned.forward_time > aligned.forward_time
+    assert dense_aligned.memory == aligned.memory
 
 
 def test_max_in_flight_estimate_prefers_paging():
