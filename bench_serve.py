@@ -1,7 +1,8 @@
 """Serving benchmark driver: continuous vs static batching throughput,
 (--paged) the paged-vs-slot KV cache comparison, (--spec) the
-speculative-decoding win, and (--decode-kernel) the Pallas flash-decode
-kernel vs the dense attention paths.
+speculative-decoding win, (--decode-kernel) the Pallas flash-decode
+kernel vs the dense attention paths, and (--chaos) the seeded
+fault-injection resilience proof.
 
 Prints ONE JSON line in the bench.py protocol ({"metric", "value",
 "unit", "vs_baseline"} — extra serve-specific keys ride along).
@@ -35,6 +36,16 @@ engine on both kv layouts over the standard mixed stream — off-TPU the
 kernel runs in Pallas interpret mode and the artifact records
 CORRECTNESS (greedy streams identical, step counts equal); TPU runs
 fill in the real throughput ratio.
+
+--chaos mode (writes BENCH_CHAOS.json): a seeded FaultInjector
+(serving/faults.py) runs the mixed stream under OPTIMISTIC admission on
+an undersized page pool while injecting NaN logits, mid-flight
+cancellations, latency spikes, and page-pool steals. The driver asserts
+— and EXITS NONZERO on violation — that every submitted request reaches
+a terminal status (no request is ever silently lost) and that the page
+allocator invariants hold after every iteration; the artifact records
+goodput, preemption, and per-status counts. This is the CI resilience
+gate, not a throughput number.
 
 The default workload is the flagship Transformer geometry (12 layers,
 hidden 1024, 16 heads — transformer.cc:79-85) recast as a decoder LM;
@@ -484,6 +495,111 @@ def run_decode_kernel(
     }
 
 
+def run_chaos(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    reps: int = 2,
+    seed: int = 0,
+):
+    """Seeded chaos run: optimistic admission on a page pool sized to
+    FORCE preemption, plus injected NaN logits, cancellations, latency
+    spikes, and page steals. Success is not throughput — it is (a) every
+    submitted rid reaching exactly one terminal status and (b) the page
+    allocator's full accounting holding after every iteration. Either
+    violation raises, which the CI step turns into a red build."""
+    from flexflow_tpu.serving import (
+        FaultInjector,
+        FaultPlan,
+        Request,
+        ServeConfig,
+        TERMINAL_STATUSES,
+        build_scheduler,
+    )
+
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+    page_size = max_len // 8
+    # the minimum legal pool (one max_len sequence): optimistic
+    # admission overcommits it immediately, forcing preemption
+    num_pages = max_len // page_size
+    serve = ServeConfig(
+        max_seqs=max_seqs,
+        max_seq_len=max_len,
+        kv_layout="paged",
+        kv_page_size=page_size,
+        kv_pages=num_pages,
+        admission="optimistic",
+        max_preemptions=6,
+    )
+    plan = FaultPlan(
+        nan_rate=0.01,
+        cancel_rate=0.005,
+        spike_rate=0.05,
+        spike_s=0.001,
+        steal_iters=(4, 9),
+        steal_pages=2,
+        steal_hold=3,
+    )
+    injector = FaultInjector(plan, seed=seed)
+    sched, engine, cache = build_scheduler(model, serve, injector=injector)
+    requests = _mixed_requests(vocab, max_len, num_requests)
+    # a few requests carry deadlines the spikes may push past
+    for r in requests[:: max(1, num_requests // 4)]:
+        r.deadline_s = 30.0
+    for r in requests:
+        sched.submit(r, strict=False)
+    import time as _time
+
+    t0 = _time.perf_counter()
+    while sched.queue or sched.running:
+        sched.step()
+        cache.check_invariants(extra_free=injector.stolen_pages)
+    sched.stats.elapsed_s += _time.perf_counter() - t0
+    injector.release_stolen_pages(cache)
+    cache.check_invariants()
+
+    s = sched.stats
+    by_status = {}
+    for r in sched.finished:
+        by_status[r.status] = by_status.get(r.status, 0) + 1
+    lost = [
+        r.rid
+        for r in requests
+        if r.status not in TERMINAL_STATUSES
+    ]
+    if lost:
+        raise SystemExit(f"chaos run LOST requests (no terminal status): {lost}")
+    if s.terminal_requests != s.submitted_requests:
+        raise SystemExit(
+            f"terminal accounting mismatch: {s.terminal_requests} terminal "
+            f"!= {s.submitted_requests} submitted"
+        )
+    return {
+        "metric": f"serve_chaos_{layers}L_{hidden}h",
+        # goodput under faults: tokens of successfully FINISHED requests
+        "value": round(s.goodput_tokens_per_s, 2),
+        "unit": "goodput_tokens/s",
+        # fraction of submitted requests that FINISHED under chaos
+        "vs_baseline": round(s.finished_requests / s.submitted_requests, 3),
+        "seed": seed,
+        "admission": "optimistic",
+        "page_size": page_size,
+        "num_pages": num_pages,
+        "submitted": s.submitted_requests,
+        "by_status": by_status,
+        "preemptions": s.preemptions,
+        "peak_in_flight": s.peak_in_flight,
+        "injected": injector.summary(),
+        "lost_requests": 0,
+        "invariant_violations": 0,
+        "tokens_per_s": round(s.tokens_per_s, 2),
+    }
+
+
 _PRESETS = {
     # flagship geometry (transformer.cc:79-85) as a decoder LM — the TPU
     # target; CPU CI uses --smoke
@@ -509,6 +625,7 @@ def main():
     args = dict(_PRESETS["flagship"])
     mode = "default"
     spec_k = 4
+    seed = 0
     decode_kernel = "pallas"
     argv = sys.argv[1:]
     i = 0
@@ -520,6 +637,11 @@ def main():
             mode = "paged"
         elif a == "--spec":
             mode = "spec"
+        elif a == "--chaos":
+            mode = "chaos"
+        elif a == "--seed":
+            i += 1
+            seed = int(argv[i])
         elif a == "--decode-kernel":
             mode = "decode_kernel"
             i += 1
@@ -550,6 +672,11 @@ def main():
     elif mode == "decode_kernel":
         result = run_decode_kernel(decode_kernel=decode_kernel, **args)
         with open(os.path.join(here, "BENCH_DECODE_KERNEL.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    elif mode == "chaos":
+        result = run_chaos(seed=seed, **args)
+        with open(os.path.join(here, "BENCH_CHAOS.json"), "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
     else:

@@ -122,6 +122,12 @@ class FFConfig:
     # "auto" = Pallas flash-decode kernel on TPU when supported,
     # "pallas" = force it (interpret mode off-TPU), "dense" = jnp paths
     serve_decode_kernel: str = "auto"
+    # paged admission policy (serving/scheduler.py): "reserve" =
+    # preemption-free worst-case gate, "optimistic" = admit beyond the
+    # reserve and preempt-by-recompute on pool exhaustion, up to
+    # --max-preemptions per request
+    serve_admission: str = "reserve"
+    serve_max_preemptions: int = 3
 
     @property
     def num_devices(self) -> int:
@@ -253,6 +259,10 @@ class FFConfig:
                 cfg.serve_spec_k = int(take())
             elif a == "--decode-kernel":
                 cfg.serve_decode_kernel = take()
+            elif a == "--admission":
+                cfg.serve_admission = take()
+            elif a == "--max-preemptions":
+                cfg.serve_max_preemptions = int(take())
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

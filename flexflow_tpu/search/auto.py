@@ -703,7 +703,14 @@ class ServingSearchResult:
     `max_in_flight` (filled when the caller supplies a prompt/generation
     length distribution) is the capacity estimate: how many concurrent
     sequences of that profile the per-chip cache byte budget holds under
-    the priced KV layout — the number the paged cache exists to raise."""
+    the priced KV layout — the number the paged cache exists to raise.
+    It prices each sequence at its steady-state footprint, i.e. the
+    capacity OPTIMISTIC admission reaches; `max_in_flight_reserve` is
+    the same budget divided by the worst case the preemption-free
+    reserve gate charges (prompt + full max_new_tokens budget), so the
+    gap between the two numbers is exactly what switching
+    `--admission optimistic` buys — at the price of occasional
+    preemption-by-recompute (estimate_recompute_step)."""
 
     def __init__(
         self,
@@ -714,6 +721,7 @@ class ServingSearchResult:
         cost,
         page_size: int = 0,
         max_in_flight: Optional[int] = None,
+        max_in_flight_reserve: Optional[int] = None,
     ):
         self.dp = dp
         self.tp = tp
@@ -722,6 +730,7 @@ class ServingSearchResult:
         self.cost = cost
         self.page_size = page_size
         self.max_in_flight = max_in_flight
+        self.max_in_flight_reserve = max_in_flight_reserve
 
     @property
     def tokens_per_s(self) -> float:
@@ -734,6 +743,8 @@ class ServingSearchResult:
             if self.max_in_flight is not None
             else ""
         )
+        if self.max_in_flight_reserve is not None:
+            fit += f" ({self.max_in_flight_reserve} under reserve admission)"
         return (
             f"serving mesh(data={self.dp}, model={self.tp}), batch "
             f"{self.batch}, kv {self.kv_len}{layout}: decode step "
@@ -786,6 +797,8 @@ def estimate_max_in_flight(
     page_size: int = 0,
     tp: int = 1,
     itemsize: int = 4,
+    admission: str = "optimistic",
+    max_new_tokens: Optional[int] = None,
 ) -> int:
     """How many concurrent sequences with the measured length profile
     (mean_prompt_len + mean_gen_len cached tokens each) fit in a
@@ -796,12 +809,31 @@ def estimate_max_in_flight(
     paged layout charges ceil((prompt + gen) / page_size) whole pages —
     the per-request footprint difference that lets paging admit more
     short requests at the same budget. TP over heads divides the
-    per-chip row size, so a TP mesh fits proportionally more."""
+    per-chip row size, so a TP mesh fits proportionally more.
+
+    `admission` picks WHICH per-sequence charge divides the budget:
+    "optimistic" (the default, and the only policy a steady-state
+    footprint can reach) charges each sequence the pages its profile
+    actually fills; "reserve" charges the worst case the preemption-free
+    gate holds back — prompt + the full `max_new_tokens` budget
+    (defaulting to mean_gen_len, i.e. a workload that declares exactly
+    what it uses). The ratio of the two answers is the concurrency
+    headroom `--admission optimistic` unlocks on budget-declaring-but-
+    short-finishing traffic (requests that reserve 256 tokens and emit
+    20)."""
     from flexflow_tpu.serving.kv_cache import KVCacheSpec
 
+    if admission not in ("reserve", "optimistic"):
+        raise ValueError(
+            f"admission must be 'reserve' or 'optimistic', got {admission!r}"
+        )
     guids, heads, head_dim = _serving_cache_geometry(graph)
     heads_chip = max(1, heads // max(1, tp))
-    seq_len = min(max_len, int(mean_prompt_len) + int(mean_gen_len))
+    if admission == "reserve":
+        budget = max_new_tokens if max_new_tokens is not None else mean_gen_len
+        seq_len = min(max_len, int(mean_prompt_len) + int(budget))
+    else:
+        seq_len = min(max_len, int(mean_prompt_len) + int(mean_gen_len))
     if page_size > 0:
         one = KVCacheSpec(
             layer_guids=guids,
@@ -927,6 +959,57 @@ def estimate_verify_step(
             act = (
                 b_chip * (k + 1) * out.logical_sizes[-1] * cm.elem_bytes(out)
             )
+            sync += cm.all_reduce(float(act), node_tp)
+    return GraphCost(
+        step_time=compute + sync,
+        compute_time=compute,
+        sync_time=sync,
+        memory_per_chip=int(mem),
+    )
+
+
+def estimate_recompute_step(
+    graph: PCGGraph,
+    cm: CostModel,
+    dp: int,
+    tp: int,
+    resume_len: int,
+    page_size: int = 0,
+    decode_kernel: str = "dense",
+) -> Optional[GraphCost]:
+    """Cost of recovering ONE preempted sequence by recompute: a single
+    prefill-shaped pass over its prompt + generated-so-far
+    (`resume_len` positions) against an empty cache — what the
+    scheduler's preemption-by-recompute path actually runs
+    (serving/scheduler.py re-admission). Optimistic admission pays this
+    per preemption event where the reserve policy pays nothing; weigh
+    it against the extra concurrency estimate_max_in_flight reports and
+    the workload's expected preemption rate. Same feasibility rules as
+    estimate_decode_step; None when (dp, tp) is infeasible."""
+    if resume_len < 1:
+        raise ValueError(f"resume_len must be >= 1, got {resume_len}")
+    compute = 0.0
+    sync = 0.0
+    mem = 0.0
+    for node in graph.nodes.values():
+        if node.op_type == OperatorType.INPUT or node.is_parallel_op:
+            continue
+        width = _DECODE_TP_OPS.get(node.op_type)
+        node_tp = tp
+        if width is not None and tp > 1:
+            if width(node) % tp != 0:
+                return None
+        elif width is None:
+            node_tp = 1
+        c = cm.prefill_op_cost(
+            node, 1, resume_len, tp=node_tp, page_size=page_size,
+            kernel=decode_kernel,
+        )
+        compute += c.forward_time
+        mem += c.memory
+        if node_tp > 1 and node.output_shapes:
+            out = node.output_shapes[0]
+            act = resume_len * out.logical_sizes[-1] * cm.elem_bytes(out)
             sync += cm.all_reduce(float(act), node_tp)
     return GraphCost(
         step_time=compute + sync,
@@ -1069,6 +1152,7 @@ def optimize_serving(
     mean_gen_len: Optional[int] = None,
     max_len: Optional[int] = None,
     decode_kernel: str = "dense",
+    max_new_tokens: Optional[int] = None,
 ) -> ServingSearchResult:
     """Pick the decode-latency-optimal (dp, tp) mesh for serving
     `batch_size` concurrent sequences at `kv_len` cache positions.
@@ -1086,7 +1170,12 @@ def optimize_serving(
     `max_in_flight`: how many such sequences fit in the winning mesh's
     leftover HBM (chip capacity minus its weight shard, through
     KVCacheSpec.total_bytes) — the "how many sequences fit" answer that
-    turns page geometry into a capacity verdict."""
+    turns page geometry into a capacity verdict. Supplying
+    `max_new_tokens` (the per-request generation BUDGET, as opposed to
+    the mean actually generated) additionally fills
+    `max_in_flight_reserve` — the same budget under the preemption-free
+    reserve admission gate, so the result compares what
+    `--admission optimistic` buys over `reserve` on this workload."""
     cm = CostModel(
         spec,
         measure=False,  # the measured table times training shapes
@@ -1134,6 +1223,18 @@ def optimize_serving(
             page_size=page_size,
             tp=best.tp,
         )
+        if max_new_tokens is not None:
+            best.max_in_flight_reserve = estimate_max_in_flight(
+                graph,
+                budget,
+                mean_prompt_len,
+                mean_gen_len,
+                horizon,
+                page_size=page_size,
+                tp=best.tp,
+                admission="reserve",
+                max_new_tokens=max_new_tokens,
+            )
     return best
 
 
@@ -1143,6 +1244,7 @@ def search_serving_strategy(
     kv_len: Optional[int] = None,
     mean_prompt_len: Optional[int] = None,
     mean_gen_len: Optional[int] = None,
+    max_new_tokens: Optional[int] = None,
 ) -> ServingSearchResult:
     """Model-level entry: cost the compiled builder graph's decode regime
     on the config's machine (chip/nodes like the training search). kv_len
@@ -1188,6 +1290,7 @@ def search_serving_strategy(
         mean_gen_len=mean_gen_len,
         max_len=cfg.serve_max_seq_len,
         decode_kernel=decode_kernel,
+        max_new_tokens=max_new_tokens,
     )
 
 

@@ -613,6 +613,34 @@ class CostModel:
             memory=int(mem),
         )
 
+    def prefill_op_cost(
+        self,
+        node,
+        batch: int,
+        seq_len: int,
+        tp: int = 1,
+        page_size: int = 0,
+        kernel: str = "dense",
+    ) -> OpCost:
+        """Forward cost of ONE prefill of `seq_len` token positions of
+        this op on one chip, against an empty cache — a verify step with
+        kv_len 0 and w = seq_len positions, which is exactly the shape
+        the engine runs (verify IS a prefill-shaped call). Exists so
+        preemption-by-recompute can be priced: a preempted sequence's
+        recovery bill is one prefill over prompt + generated-so-far
+        (search/auto.estimate_recompute_step), the number that decides
+        whether optimistic admission's extra in-flight sequences pay for
+        the recompute they occasionally trigger."""
+        return self.verify_op_cost(
+            node,
+            batch,
+            kv_len=0,
+            k=max(0, int(seq_len) - 1),
+            tp=tp,
+            page_size=page_size,
+            kernel=kernel,
+        )
+
     # -- measured mode ------------------------------------------------------
     #
     # The direct analog of the reference's inner_measure_operator_cost

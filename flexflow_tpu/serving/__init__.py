@@ -7,11 +7,13 @@ host-side page allocator and block tables (kv_cache; the PR-1
 slot-contiguous layout remains as the kv_layout="slot" baseline),
 prefill/decode step functions that re-execute the
 compiled graph with a cache-aware attention hook (engine), an Orca-style
-iteration-level scheduler (scheduler), and the `FFModel.generate` /
-ServeConfig surface (api). The decode regime also has its own cost
-family in search/cost_model.py so the auto-parallel search can pick a
-serving strategy (TP over heads at small batch) distinct from the
-training one.
+iteration-level scheduler with per-request fault isolation, deadlines/
+cancellation, and optimistic-admission preemption-by-recompute
+(scheduler), a seeded deterministic fault-injection harness (faults),
+and the `FFModel.generate` / ServeConfig surface (api). The decode
+regime also has its own cost family in search/cost_model.py so the
+auto-parallel search can pick a serving strategy (TP over heads at
+small batch) distinct from the training one.
 """
 
 from flexflow_tpu.serving.api import (
@@ -21,16 +23,26 @@ from flexflow_tpu.serving.api import (
     generate,
 )
 from flexflow_tpu.serving.engine import GenerationEngine
+from flexflow_tpu.serving.faults import (
+    DraftFault,
+    FaultError,
+    FaultInjector,
+    FaultPlan,
+    KernelFault,
+)
 from flexflow_tpu.serving.kv_cache import (
     KVCache,
     KVCacheSpec,
     PagedKVCache,
+    PagePoolExhausted,
     default_buckets,
     default_page_size,
 )
 from flexflow_tpu.serving.scheduler import (
+    TERMINAL_STATUSES,
     ContinuousBatchingScheduler,
     Request,
+    RequestStatus,
     SchedulerStats,
     StaticBatchingScheduler,
     latency_percentiles,
@@ -54,10 +66,18 @@ __all__ = [
     "default_buckets",
     "default_page_size",
     "Request",
+    "RequestStatus",
+    "TERMINAL_STATUSES",
     "ContinuousBatchingScheduler",
     "StaticBatchingScheduler",
     "SchedulerStats",
     "latency_percentiles",
+    "FaultError",
+    "FaultInjector",
+    "FaultPlan",
+    "KernelFault",
+    "DraftFault",
+    "PagePoolExhausted",
     "DraftProposer",
     "ModelDraftProposer",
     "NGramDraftProposer",

@@ -61,6 +61,14 @@ class ServeConfig:
     # (interpret mode off-TPU — the CI/parity path), "dense" = always
     # the jnp paths.
     decode_kernel: str = "auto"
+    # admission policy for the paged layout (serving/scheduler.py):
+    # "reserve" gates each admit on its worst-case page need on top of
+    # every in-flight reservation (preemption-free); "optimistic"
+    # admits on the pages needed NOW and answers later pool exhaustion
+    # with preemption-by-recompute, bounded by max_preemptions per
+    # request before hard FAILED. The slot layout ignores both.
+    admission: str = "reserve"
+    max_preemptions: int = 3
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -70,6 +78,17 @@ class ServeConfig:
             )
         if self.max_seqs < 1 or self.max_seq_len < 2:
             raise ValueError("max_seqs >= 1 and max_seq_len >= 2 required")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}"
+            )
+        if self.admission not in ("reserve", "optimistic"):
+            raise ValueError(
+                f"admission must be 'reserve' or 'optimistic', "
+                f"got {self.admission!r}"
+            )
+        if self.max_preemptions < 0:
+            raise ValueError("max_preemptions must be >= 0")
         if self.kv_layout not in ("paged", "slot"):
             raise ValueError(
                 f"kv_layout must be 'paged' or 'slot', got {self.kv_layout!r}"
@@ -115,6 +134,8 @@ class ServeConfig:
             spec_draft=cfg.serve_spec_draft,
             spec_k=cfg.serve_spec_k,
             decode_kernel=cfg.serve_decode_kernel,
+            admission=cfg.serve_admission,
+            max_preemptions=cfg.serve_max_preemptions,
         )
 
 
@@ -145,11 +166,13 @@ def build_proposer(serve: ServeConfig, draft_model=None):
     )
 
 
-def build_scheduler(model, serve: ServeConfig, draft_model=None):
+def build_scheduler(model, serve: ServeConfig, draft_model=None, injector=None):
     """(scheduler, engine, cache) wired to a compiled model — the pieces
     generate() uses, exposed for callers that drive iterations themselves
     (bench_serve.py, tests). With serve.spec_draft set, the scheduler
-    runs the speculative draft/verify loop (serving/spec.py)."""
+    runs the speculative draft/verify loop (serving/spec.py). `injector`
+    threads a faults.FaultInjector through the engine and scheduler
+    seams — the chaos harness's entry point."""
     if serve.kv_layout == "paged":
         cache = PagedKVCache.from_model(
             model,
@@ -172,11 +195,15 @@ def build_scheduler(model, serve: ServeConfig, draft_model=None):
         temperature=serve.temperature,
         seed=serve.seed,
         decode_kernel=serve.decode_kernel,
+        injector=injector,
     )
     sched = _SCHEDULERS[serve.scheduler](
         engine,
         proposer=build_proposer(serve, draft_model),
         spec_k=serve.spec_k,
+        admission=serve.admission,
+        max_preemptions=serve.max_preemptions,
+        injector=injector,
     )
     return sched, engine, cache
 
@@ -193,7 +220,13 @@ def generate(
     tokens (prompt excluded) in the prompts' order. Greedy by default —
     the cache-equivalence contract (tests/test_serving.py) holds for
     greedy decoding, with or without speculative drafting
-    (tests/test_spec_decode.py)."""
+    (tests/test_spec_decode.py).
+
+    Per-request fault isolation: an invalid request in the batch (e.g. a
+    prompt whose prompt + max_new_tokens exceeds the cache horizon)
+    becomes a FAILED entry with an empty continuation instead of an
+    exception that loses the whole batch — the serving-surface contract
+    (one bad client request must not take down its neighbors)."""
     serve = serve or ServeConfig()
     if eos_token is None:
         eos_token = serve.eos_token
@@ -207,6 +240,8 @@ def generate(
         )
         for i, p in enumerate(prompts)
     ]
-    done = sched.run(reqs)
+    for r in reqs:
+        sched.submit(r, strict=False)
+    done = sched.run()
     by_rid = {r.rid: r for r in done}
     return [by_rid[i].generated for i in range(len(reqs))]

@@ -78,6 +78,7 @@ class GenerationEngine:
         temperature: float = 0.0,
         seed: int = 0,
         decode_kernel: str = "auto",
+        injector=None,
     ):
         import jax
 
@@ -94,6 +95,11 @@ class GenerationEngine:
         self.cache = cache
         self.temperature = float(temperature)
         self.seed = int(seed)
+        # resilience: a faults.FaultInjector seam before kernel-path
+        # dispatches, plus the fallback ledger the chaos bench reads
+        self.injector = injector
+        self.kernel_fallbacks = 0
+        self.kernel_fallback_error: str = ""
         # how the decode/verify attention core runs (threaded into every
         # ops.attention call below): "auto" = Pallas decode kernel on TPU
         # when the geometry supports() it, "pallas" = force the kernel
@@ -140,6 +146,45 @@ class GenerationEngine:
         # the compile-count contract inspectable)
         self._prefill_cache: Dict[int, object] = {}
         self._verify_cache: Dict[int, object] = {}
+
+    # -- kernel-failure fallback ---------------------------------------------
+
+    def _dispatch(self, site: str, call):
+        """Run one jitted decode/verify step. On the dense paths this is
+        just `call()`; on a Pallas-kernel path the outputs are forced
+        first (surfacing async compile/runtime errors BEFORE the cache
+        commits them) and ANY failure — injected through the fault seam
+        or real — permanently falls the engine back to the dense paths
+        and retries the step once. Serving survives a broken kernel at
+        the cost of the dense path's speed; the fallback is recorded in
+        `kernel_fallbacks` / `kernel_fallback_error`."""
+        import jax
+
+        if self.decode_kernel == "dense":
+            return call()
+        try:
+            if self.injector is not None:
+                self.injector.maybe_kernel_fault(site)
+            out = call()
+            jax.block_until_ready(out)
+            return out
+        except Exception as e:
+            self._fall_back_to_dense(e)
+            return call()
+
+    def _fall_back_to_dense(self, error) -> None:
+        import jax
+
+        self.kernel_fallbacks += 1
+        self.kernel_fallback_error = repr(error)
+        self.decode_kernel = "dense"
+        # the jitted steps baked the failed mode in at trace time;
+        # rebuild them so the retry traces the dense attention cores
+        # (prefill never touches the kernel, so its cache stands)
+        self._decode_jit = jax.jit(
+            self._decode_impl_paged if self.paged else self._decode_impl
+        )
+        self._verify_cache.clear()
 
     # -- shared forward ------------------------------------------------------
 
@@ -466,7 +511,7 @@ class GenerationEngine:
         # between iterations) races the read and corrupts the step under
         # load — the snapshot temp is never mutated, so the deferred read
         # is safe
-        new_k, new_v, nxt, logits = self._decode_jit(
+        step_args = (
             params,
             jnp.asarray(tokens, dtype=jnp.int32)[:, None],
             jnp.asarray(self.cache.lengths.copy()),
@@ -474,6 +519,9 @@ class GenerationEngine:
             *args,
             self.cache.k,
             self.cache.v,
+        )
+        new_k, new_v, nxt, logits = self._dispatch(
+            "decode", lambda: self._decode_jit(*step_args)
         )
         self.cache.commit(new_k, new_v)
         self.cache.lengths[np.asarray(active_mask)] += 1
@@ -655,16 +703,10 @@ class GenerationEngine:
                 for p in range(start, start + int(draft_lens[slot])):
                     self.cache.ensure_position(int(slot), p)
             args = [jnp.asarray(self.cache.block_tables.copy())]
-        fn = self._verify_cache.get(w)
-        if fn is None:
-            fn = jax.jit(
-                self._verify_impl_paged if self.paged else self._verify_impl
-            )
-            self._verify_cache[w] = fn
         # lengths/tables snapshot (.copy()): the caller truncates the
         # cache right after this returns, and jnp.asarray's host read is
         # deferred behind the dispatch queue — see decode()
-        new_k, new_v, logits = fn(
+        step_args = (
             params,
             jnp.asarray(tokens),
             jnp.asarray(self.cache.lengths.copy()),
@@ -673,5 +715,18 @@ class GenerationEngine:
             self.cache.k,
             self.cache.v,
         )
+
+        def call():
+            # resolved inside the dispatch so a kernel fallback's
+            # cleared cache re-traces with the dense attention core
+            fn = self._verify_cache.get(w)
+            if fn is None:
+                fn = jax.jit(
+                    self._verify_impl_paged if self.paged else self._verify_impl
+                )
+                self._verify_cache[w] = fn
+            return fn(*step_args)
+
+        new_k, new_v, logits = self._dispatch("verify", call)
         self.cache.commit(new_k, new_v)
         return np.asarray(logits)

@@ -1,0 +1,257 @@
+"""Deterministic fault injection for the serving engine.
+
+Production serving dies from the faults nobody scheduled: a kernel
+miscompiles on one geometry, a model emits NaN logits for one request,
+a burst of long prompts drains the page pool, a client disconnects
+mid-stream. The resilience contract (serving/scheduler.py) is that every
+such fault retires ONE request — or falls back to a slower path — while
+every unaffected request's greedy token stream stays identical to a
+fault-free run. A contract like that is only worth having if it is
+*proved*, so this module is a chaos harness: a seeded `FaultInjector`
+threaded through the engine/scheduler seams that injects
+
+* **corrupted logits** — one slot's logits row becomes NaN after a
+  decode/verify/prefill step (the scheduler's per-step finite guard must
+  retire exactly that slot as FAILED);
+* **kernel failure** — the next Pallas-kernel dispatch raises
+  (the engine must fall back to the dense attention paths, permanently,
+  and keep serving);
+* **page-pool exhaustion** — pages are stolen from the paged cache's
+  free pool for a bounded window (under optimistic admission the
+  scheduler must preempt-and-recompute; the allocator invariants must
+  hold throughout);
+* **step latency spikes** — a host-side sleep before an iteration
+  (deadlines must fire, goodput accounting must stay honest);
+* **mid-flight cancellation** — `scheduler.cancel(rid)` on a running
+  request (its slot and pages must free; the stream must stop).
+
+Determinism discipline: every decision draws from a fresh
+`np.random.default_rng([seed, iteration, site, key])` stream, so the
+schedule is a pure function of (seed, plan, workload) and independent of
+host call ordering — the property the token-identity proofs in
+tests/test_resilience.py are built on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import Counter
+from typing import Dict, List, Mapping, Sequence, Tuple
+
+import numpy as np
+
+from flexflow_tpu.serving.kv_cache import PagePoolExhausted
+
+__all__ = [
+    "FaultError",
+    "KernelFault",
+    "DraftFault",
+    "PagePoolExhausted",
+    "FaultPlan",
+    "FaultInjector",
+]
+
+
+class FaultError(RuntimeError):
+    """Base class for injected faults."""
+
+
+class KernelFault(FaultError):
+    """Injected Pallas-kernel dispatch failure (the engine answers by
+    falling back to the dense attention paths)."""
+
+
+class DraftFault(FaultError):
+    """Injected draft-proposer failure (the scheduler answers by
+    degrading the iteration to plain decode)."""
+
+
+# deterministic sub-stream ids per injection site
+_SITE = {"spike": 1, "cancel": 2, "nan": 3, "kernel": 4, "draft": 5}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """What to inject. Rates are per-opportunity probabilities drawn
+    from the injector's seeded streams; the `*_iters` fields schedule
+    faults at EXACT scheduler iterations for targeted tests (both
+    compose). All-zero defaults inject nothing."""
+
+    # corrupted (NaN) logits: per-(iteration, slot) probability, plus an
+    # explicit {iteration: [slot, ...]} schedule
+    nan_rate: float = 0.0
+    nan_iters: Mapping[int, Sequence[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    # Pallas-kernel dispatch failure: per-dispatch probability, plus
+    # explicit scheduler iterations. Only fires while the engine is on a
+    # kernel path — once fallen back to dense there is nothing to fail.
+    kernel_rate: float = 0.0
+    kernel_iters: Sequence[int] = ()
+    # draft-proposer failure (spec mode): per-iteration probability plus
+    # explicit iterations; the iteration degrades to plain decode
+    draft_rate: float = 0.0
+    draft_iters: Sequence[int] = ()
+    # host-side latency spike before an iteration
+    spike_rate: float = 0.0
+    spike_s: float = 0.0
+    # mid-flight cancellation: per-(iteration, running rid) probability,
+    # plus an explicit {iteration: [rid, ...]} schedule
+    cancel_rate: float = 0.0
+    cancel_iters: Mapping[int, Sequence[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    # page-pool exhaustion: at each listed iteration, steal up to
+    # `steal_pages` pages from the paged cache's free pool and hold them
+    # for `steal_hold` iterations before returning them
+    steal_iters: Sequence[int] = ()
+    steal_pages: int = 0
+    steal_hold: int = 2
+
+    def __post_init__(self):
+        for name in ("nan_rate", "kernel_rate", "draft_rate", "spike_rate",
+                     "cancel_rate"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.spike_s < 0.0 or self.steal_pages < 0 or self.steal_hold < 0:
+            raise ValueError("spike_s / steal_pages / steal_hold must be >= 0")
+
+
+class FaultInjector:
+    """Seeded, deterministic fault source threaded through the serving
+    seams. The scheduler calls `on_iteration` at every step boundary
+    (spikes, cancellations, page steal/return), `corrupt_logits` on each
+    step's host-side logits, and `maybe_draft_fault` before proposing;
+    the engine calls `maybe_kernel_fault` before each kernel-path
+    dispatch. `injected` counts every fault that actually fired, keyed
+    by site — the ledger the chaos bench publishes."""
+
+    def __init__(self, plan: FaultPlan = None, seed: int = 0):
+        self.plan = plan if plan is not None else FaultPlan()
+        self.seed = int(seed) & 0x7FFFFFFF
+        self.injected: Counter = Counter()
+        self._iter = 0
+        # pages stolen from a paged cache's free pool: [(page, release_iter)]
+        self._stolen: List[Tuple[int, int]] = []
+
+    def _rng(self, site: str, key: int = 0) -> np.random.Generator:
+        return np.random.default_rng(
+            [self.seed, self._iter, _SITE[site], int(key) & 0x7FFFFFFF]
+        )
+
+    @property
+    def stolen_pages(self) -> int:
+        """Pages currently held outside the cache's free pool — the
+        allocator invariant check must count them (check_invariants
+        extra_free)."""
+        return len(self._stolen)
+
+    # -- scheduler seams -----------------------------------------------------
+
+    def on_iteration(self, iteration: int, scheduler) -> None:
+        """Step-boundary faults: latency spike, cancellations, page
+        steal/return. Called by the scheduler BEFORE admission so a
+        stolen page affects this iteration's gate."""
+        self._iter = int(iteration)
+        plan = self.plan
+        if plan.spike_s > 0.0 and plan.spike_rate > 0.0:
+            if self._rng("spike").random() < plan.spike_rate:
+                self.injected["spike"] += 1
+                time.sleep(plan.spike_s)
+        # cancellations: explicit rids first, then rate draws over the
+        # running set (sorted for determinism)
+        for rid in plan.cancel_iters.get(self._iter, ()):
+            if scheduler.cancel(int(rid)):
+                self.injected["cancel"] += 1
+        if plan.cancel_rate > 0.0:
+            rids = sorted(r.rid for r in scheduler.running.values())
+            for rid in rids:
+                if self._rng("cancel", rid).random() < plan.cancel_rate:
+                    if scheduler.cancel(rid):
+                        self.injected["cancel"] += 1
+        cache = scheduler.cache
+        if getattr(cache, "paged", False):
+            self._page_faults(cache)
+
+    def _page_faults(self, cache) -> None:
+        """Steal pages at scheduled iterations; return them after the
+        hold window. Stolen pages leave the free heap entirely — the
+        closest host-side analog to a neighbor tenant (or a leak)
+        draining the pool out from under the allocator."""
+        import heapq
+
+        plan = self.plan
+        kept: List[Tuple[int, int]] = []
+        for page, release_iter in self._stolen:
+            if self._iter >= release_iter:
+                heapq.heappush(cache._free_pages, page)
+            else:
+                kept.append((page, release_iter))
+        self._stolen = kept
+        if self._iter in set(plan.steal_iters) and plan.steal_pages > 0:
+            for _ in range(min(plan.steal_pages, len(cache._free_pages))):
+                page = heapq.heappop(cache._free_pages)
+                self._stolen.append((page, self._iter + plan.steal_hold))
+                self.injected["page_steal"] += 1
+
+    def release_stolen_pages(self, cache) -> None:
+        """Return every held page immediately (end-of-run cleanup)."""
+        import heapq
+
+        for page, _ in self._stolen:
+            heapq.heappush(cache._free_pages, page)
+        self._stolen = []
+
+    def corrupt_logits(self, logits: np.ndarray, slots, rows=None) -> List[int]:
+        """Overwrite the listed-or-drawn slots' logits rows with NaN in
+        place (logits is a host-side array a step returned). The fault
+        schedule is keyed by SLOT id; `rows` maps each slot to its row
+        index in `logits` when the two differ (prefill returns one row
+        per admitted request, decode/verify one row per slot). Returns
+        the corrupted slots. The scheduler's finite guard — not this
+        method — decides what happens next, exactly as it would for a
+        model-produced NaN."""
+        plan = self.plan
+        slots = [int(s) for s in slots]
+        rows = slots if rows is None else [int(r) for r in rows]
+        hit: List[int] = []
+        scheduled = set(plan.nan_iters.get(self._iter, ()))
+        for slot, row in sorted(zip(slots, rows)):
+            if slot in scheduled or (
+                plan.nan_rate > 0.0
+                and self._rng("nan", slot).random() < plan.nan_rate
+            ):
+                logits[row] = np.nan
+                hit.append(slot)
+                self.injected["nan"] += 1
+        return hit
+
+    def maybe_draft_fault(self) -> None:
+        plan = self.plan
+        if self._iter in set(plan.draft_iters) or (
+            plan.draft_rate > 0.0
+            and self._rng("draft").random() < plan.draft_rate
+        ):
+            self.injected["draft"] += 1
+            raise DraftFault(f"injected draft fault at iteration {self._iter}")
+
+    # -- engine seam ---------------------------------------------------------
+
+    def maybe_kernel_fault(self, site: str = "decode") -> None:
+        """Raise KernelFault when the plan says this dispatch fails. The
+        engine only consults this on kernel-path dispatches, so a
+        fallen-back (dense) engine never faults again."""
+        plan = self.plan
+        if self._iter in set(plan.kernel_iters) or (
+            plan.kernel_rate > 0.0
+            and self._rng("kernel").random() < plan.kernel_rate
+        ):
+            self.injected["kernel"] += 1
+            raise KernelFault(
+                f"injected {site} kernel fault at iteration {self._iter}"
+            )
+
+    def summary(self) -> Dict[str, int]:
+        return dict(self.injected)

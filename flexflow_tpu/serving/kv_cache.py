@@ -16,11 +16,19 @@ Two layouts share one spec/geometry derivation:
   the same byte budget admits more concurrent short requests — the
   serving-capacity lever continuous batching turns into throughput.
 
-  Admission uses a preemption-free *reserve* policy: a request is
-  admitted only when the free pool covers its worst case
-  (`ceil((prompt + max_new_tokens) / page_size)` pages) on top of every
-  in-flight request's outstanding worst case, so a mid-flight decode can
-  ALWAYS claim its next page — no preemption/swap path needed.
+  Admission supports two policies. The default *reserve* policy is
+  preemption-free: a request is admitted only when the free pool covers
+  its worst case (`ceil((prompt + max_new_tokens) / page_size)` pages)
+  on top of every in-flight request's outstanding worst case, so a
+  mid-flight decode can ALWAYS claim its next page — no preemption/swap
+  path needed. The opt-in *optimistic* policy (vLLM's posture) admits on
+  the pages a request needs NOW and reserves nothing for its growth;
+  when the pool later runs dry mid-decode, `ensure_position` raises
+  `PagePoolExhausted` and the scheduler preempts a victim — frees its
+  pages and requeues it for prefill-from-recompute
+  (serving/scheduler.py). Optimistic slots never contribute to the
+  reserve ledger, so the two policies compose: reserve-admitted slots
+  keep their guarantee even while optimistic slots gamble.
 
 Prompt lengths are *bucketed* in both layouts: prefill pads each
 admission batch's prompts up to the next bucket (powers of two by
@@ -45,6 +53,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from flexflow_tpu.core.types import OperatorType
+
+
+class PagePoolExhausted(RuntimeError):
+    """The free-page pool cannot supply a page a sequence needs NOW.
+
+    Under the reserve admission policy this means the allocator invariant
+    was violated (something outside the accounting drained the pool — a
+    fault, not a workload); under the optimistic policy it is an expected
+    runtime condition the scheduler answers with preemption-by-recompute.
+    """
 
 
 def default_buckets(max_len: int, smallest: int = 16) -> Tuple[int, ...]:
@@ -244,19 +262,30 @@ class KVCache:
     def active_slots(self) -> List[int]:
         return sorted(self._active)
 
-    def can_admit(self, prompt_len: int = 1, total_len: int = 0) -> bool:
+    def can_admit(
+        self,
+        prompt_len: int = 1,
+        total_len: int = 0,
+        optimistic: bool = False,
+    ) -> bool:
         """A slot layout admits whenever a slot is free (every slot holds
-        max_len positions, so length arguments cannot change the verdict
-        — they exist for signature parity with PagedKVCache)."""
+        max_len positions, so length arguments — and the admission policy
+        — cannot change the verdict; they exist for signature parity with
+        PagedKVCache)."""
         return bool(self._free)
 
     def alloc(
-        self, prompt_len: Optional[int] = None, total_len: Optional[int] = None
+        self,
+        prompt_len: Optional[int] = None,
+        total_len: Optional[int] = None,
+        optimistic: bool = False,
     ) -> Optional[int]:
         """Take a free slot (None when full). Lowest-free-id pop so slot
         ids stay dense and deterministic under a fixed request stream.
-        The length arguments are accepted (and ignored) so the scheduler
-        drives both layouts through one call."""
+        The length/policy arguments are accepted (and ignored) so the
+        scheduler drives both layouts through one call — a slot pins
+        max_len rows either way, so the slot layout has no page pressure
+        and nothing to admit optimistically against."""
         if not self._free:
             return None
         slot = heapq.heappop(self._free)
@@ -305,6 +334,20 @@ class KVCache:
         """Swap in the arrays a jitted step returned."""
         self.k = dict(new_k)
         self.v = dict(new_v)
+
+    def check_invariants(self, extra_free: int = 0) -> None:
+        """Assert the slot bookkeeping is consistent — the chaos-harness
+        probe (tests/test_resilience.py, bench_serve.py --chaos) calls
+        this after every iteration. `extra_free` exists for signature
+        parity with PagedKVCache (a fault injector holding pages has no
+        slot-layout analog)."""
+        spec = self.spec
+        assert self._active.isdisjoint(self._free)
+        assert len(self._active) + len(self._free) == spec.max_seqs
+        for s in self._free:
+            assert self.lengths[s] == 0
+        for s in self._active:
+            assert 0 <= self.lengths[s] <= spec.max_len
 
     # -- construction from a compiled model ---------------------------------
 
@@ -388,11 +431,15 @@ class PagedKVCache:
         self._free_pages: List[int] = list(range(spec.num_pages))
         # preemption-free reserve: _max_pages[s] is slot s's worst-case
         # page need (fixed at admission), _held[s] what it holds now;
-        # _reserved = Σ (max - held) over active slots — pages the free
-        # list must keep back for in-flight growth
+        # _reserved = Σ (max - held) over active RESERVE-admitted slots —
+        # pages the free list must keep back for in-flight growth.
+        # Optimistic slots (admitted beyond the reserve; preempted on
+        # pool exhaustion) keep _max_pages pinned to _held and never
+        # touch _reserved.
         self._held = np.zeros(spec.max_seqs, dtype=np.int64)
         self._max_pages = np.zeros(spec.max_seqs, dtype=np.int64)
         self._reserved = 0
+        self._optimistic: set = set()
 
     # -- page/slot management (host side) ------------------------------------
 
@@ -418,23 +465,38 @@ class PagedKVCache:
     def _pages_for(self, tokens: int) -> int:
         return -(-int(tokens) // self.spec.page_size)
 
-    def can_admit(self, prompt_len: int = 1, total_len: int = 0) -> bool:
+    def can_admit(
+        self,
+        prompt_len: int = 1,
+        total_len: int = 0,
+        optimistic: bool = False,
+    ) -> bool:
         """True when a slot is free AND the free pool covers this
-        request's worst case on top of every in-flight reservation."""
-        max_p = self._pages_for(max(prompt_len, total_len))
+        request's page need on top of every in-flight reservation: the
+        worst case (prompt + max_new_tokens) under the reserve policy,
+        only the pages the prompt fills NOW under the optimistic one."""
+        if optimistic:
+            need = self._pages_for(prompt_len)
+        else:
+            need = self._pages_for(max(prompt_len, total_len))
         return (
             bool(self._free_slots)
-            and len(self._free_pages) - self._reserved >= max_p
+            and len(self._free_pages) - self._reserved >= need
         )
 
     def alloc(
-        self, prompt_len: Optional[int] = None, total_len: Optional[int] = None
+        self,
+        prompt_len: Optional[int] = None,
+        total_len: Optional[int] = None,
+        optimistic: bool = False,
     ) -> Optional[int]:
         """Admit a sequence: take a slot, allocate the pages its prompt
-        fills now, and reserve (without allocating) the rest of its
-        worst case. None when the reserve policy refuses. Omitted
-        lengths reserve-and-fill a full max_len (slot-equivalent
-        behavior for ad-hoc engine callers)."""
+        fills now, and — under the default reserve policy — reserve
+        (without allocating) the rest of its worst case. None when the
+        policy refuses. `optimistic=True` reserves nothing beyond the
+        prompt's pages (the slot may later hit PagePoolExhausted and be
+        preempted). Omitted lengths reserve-and-fill a full max_len
+        (slot-equivalent behavior for ad-hoc engine callers)."""
         spec = self.spec
         if prompt_len is None:
             prompt_len = spec.max_len
@@ -445,30 +507,50 @@ class PagedKVCache:
             )
         need_now = self._pages_for(prompt_len)
         max_p = self._pages_for(total)
-        if not self.can_admit(prompt_len, total):
+        if not self.can_admit(prompt_len, total, optimistic=optimistic):
             return None
         slot = heapq.heappop(self._free_slots)
         self._active.add(slot)
         for i in range(need_now):
             self.block_tables[slot, i] = heapq.heappop(self._free_pages)
         self._held[slot] = need_now
-        self._max_pages[slot] = max_p
-        self._reserved += max_p - need_now
+        if optimistic:
+            # no growth reserve: _max_pages tracks _held so this slot
+            # contributes zero to the reserve ledger, now and forever
+            self._optimistic.add(slot)
+            self._max_pages[slot] = need_now
+        else:
+            self._max_pages[slot] = max_p
+            self._reserved += max_p - need_now
         self.lengths[slot] = 0
         return slot
 
     def ensure_position(self, slot: int, pos: int) -> None:
         """Make position `pos` of `slot` writable, claiming the next page
         from the free list when the sequence crosses a page boundary.
-        The admission reserve guarantees the claim succeeds for any
-        position inside the request's declared worst case."""
+        For reserve-admitted slots the admission reserve guarantees the
+        claim succeeds for any position inside the declared worst case;
+        an optimistic slot's claim must additionally leave the reserve
+        intact, and raises PagePoolExhausted when it cannot — the signal
+        the scheduler answers with preemption-by-recompute."""
         if slot not in self._active:
             raise ValueError(f"slot {slot} is not active")
         pi = pos // self.spec.page_size
         if self.block_tables[slot, pi] != self.spec.num_pages:
             return
+        if slot in self._optimistic:
+            if len(self._free_pages) - self._reserved < 1:
+                raise PagePoolExhausted(
+                    f"free-page pool exhausted: optimistic slot {slot} "
+                    f"needs a page but {len(self._free_pages)} free - "
+                    f"{self._reserved} reserved leaves none"
+                )
+            self.block_tables[slot, pi] = heapq.heappop(self._free_pages)
+            self._held[slot] += 1
+            self._max_pages[slot] = self._held[slot]
+            return
         if not self._free_pages:
-            raise RuntimeError(
+            raise PagePoolExhausted(
                 "free-page pool exhausted despite the admission reserve — "
                 "allocator invariant violated"
             )
@@ -509,9 +591,14 @@ class PagedKVCache:
                 heapq.heappush(self._free_pages, p)
                 self.block_tables[slot, pi] = sentinel
                 self._held[slot] -= 1
-        self._reserved += (
-            max(0, int(self._max_pages[slot] - self._held[slot])) - old_resv
-        )
+        if slot in self._optimistic:
+            # released pages return to the COMMON pool, not a reserve
+            self._max_pages[slot] = self._held[slot]
+        else:
+            self._reserved += (
+                max(0, int(self._max_pages[slot] - self._held[slot]))
+                - old_resv
+            )
         self.lengths[slot] = new_len
 
     def free(self, slot: int) -> None:
@@ -524,7 +611,12 @@ class PagedKVCache:
             if p != sentinel:
                 heapq.heappush(self._free_pages, p)
         self.block_tables[slot, :] = sentinel
-        self._reserved -= max(0, int(self._max_pages[slot] - self._held[slot]))
+        if slot in self._optimistic:
+            self._optimistic.discard(slot)
+        else:
+            self._reserved -= max(
+                0, int(self._max_pages[slot] - self._held[slot])
+            )
         self._held[slot] = 0
         self._max_pages[slot] = 0
         self.lengths[slot] = 0
@@ -534,6 +626,51 @@ class PagedKVCache:
         """Swap in the pools a jitted step returned."""
         self.k = dict(new_k)
         self.v = dict(new_v)
+
+    def check_invariants(self, extra_free: int = 0) -> None:
+        """Assert the page allocator's full accounting is consistent —
+        the chaos-harness probe (tests/test_resilience.py,
+        bench_serve.py --chaos) calls this after every iteration.
+        `extra_free` is pages a fault injector is deliberately holding
+        outside the pool (faults.FaultInjector page-steal), which the
+        conservation check must count."""
+        spec = self.spec
+        sentinel = spec.num_pages
+        live: List[int] = []
+        for s in range(spec.max_seqs):
+            row = [int(p) for p in self.block_tables[s] if p != sentinel]
+            live.extend(row)
+            # per-slot ledger matches the table; free slots hold nothing
+            assert len(row) == int(self._held[s])
+            if s not in self._active:
+                assert not row and self.lengths[s] == 0
+            else:
+                # visible length fits in the held pages
+                assert int(self.lengths[s]) <= len(row) * spec.page_size
+        # no double allocation anywhere in the table
+        assert len(live) == len(set(live))
+        # conservation: live + free (+ injector-held) is the whole pool
+        assert set(live).isdisjoint(self._free_pages)
+        assert len(live) + len(self._free_pages) + extra_free == (
+            spec.num_pages
+        )
+        # the reserve ledger re-derives from the per-slot worst cases,
+        # counting only reserve-admitted slots, and never promises pages
+        # the pool doesn't have
+        resv = sum(
+            max(0, int(self._max_pages[s] - self._held[s]))
+            for s in self._active
+            if s not in self._optimistic
+        )
+        assert resv == self._reserved
+        assert 0 <= self._reserved <= len(self._free_pages) + extra_free
+        # optimistic slots never carry a growth reserve
+        for s in self._optimistic:
+            assert s in self._active
+            assert int(self._max_pages[s]) == int(self._held[s])
+        # slot bookkeeping
+        assert self._active.isdisjoint(self._free_slots)
+        assert len(self._active) + len(self._free_slots) == spec.max_seqs
 
     # -- construction from a compiled model ---------------------------------
 

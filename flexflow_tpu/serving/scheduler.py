@@ -1,4 +1,6 @@
-"""Iteration-level request scheduling (Orca, OSDI'22).
+"""Iteration-level request scheduling (Orca, OSDI'22) with per-request
+fault isolation and preemption-by-recompute (vLLM / PagedAttention,
+SOSP'23).
 
 The unit of scheduling is one model *iteration*, not one request: every
 iteration the scheduler (a) admits queued requests into free KV-cache
@@ -18,6 +20,33 @@ becomes draft → one batched verify call → accept/rollback, emitting
 iteration-level frame is unchanged — a verify is just a wider decode —
 so admission, retirement, and slot recycling all work as before.
 
+**Request lifecycle.** Every request ends in exactly one terminal
+status: FINISHED (EOS / token budget), FAILED (bad input, non-finite
+logits, an engine fault, or too many preemptions — the error is captured
+on the request), CANCELLED (`scheduler.cancel(rid)`), or TIMED_OUT
+(`Request.deadline_s` elapsed, whether queued or running). PREEMPTED is
+the one transient status: an optimistic-admission victim whose pages
+were reclaimed goes back to the queue head and re-enters RUNNING via
+prefill-from-recompute. The resilience contract — proved by
+tests/test_resilience.py under a seeded FaultInjector — is that a fault
+retires only the requests it touches: every other slot's greedy token
+stream is identical to a fault-free run, because greedy decode is a pure
+function of a slot's own context, never of which neighbors share the
+iteration.
+
+**Admission policies** (paged layout): the default `reserve` policy
+admits only when the free pool covers a request's worst case on top of
+every in-flight reservation — preemption-free by construction. The
+opt-in `optimistic` policy admits on the pages a request needs NOW;
+when the pool later runs dry mid-decode (PagePoolExhausted from
+`ensure_position`), the scheduler preempts the youngest-by-admission
+victims — frees their pages and requeues them at the queue head for
+prefill-from-recompute over prompt + tokens generated so far — up to
+`max_preemptions` times per request before hard FAILED. Recompute (not
+swap) is the right recovery here for the same reason vLLM defaults to
+it: a preempted sequence's KV is recomputable from its token history in
+one prefill-shaped step, so no swap-space subsystem is needed.
+
 `StaticBatchingScheduler` is the deliberately-worse baseline the bench
 and the comparison test measure against: admit a batch, decode until the
 WHOLE batch finishes, only then admit the next batch (the reference
@@ -29,33 +58,81 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from flexflow_tpu.serving.kv_cache import PagePoolExhausted
+
+
+class RequestStatus:
+    """String constants (json-friendly) for the request lifecycle."""
+
+    QUEUED = "queued"
+    RUNNING = "running"
+    PREEMPTED = "preempted"  # transient: requeued for recompute
+    FINISHED = "finished"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+    TIMED_OUT = "timed_out"
+
+
+#: statuses a request never leaves
+TERMINAL_STATUSES = frozenset(
+    {
+        RequestStatus.FINISHED,
+        RequestStatus.FAILED,
+        RequestStatus.CANCELLED,
+        RequestStatus.TIMED_OUT,
+    }
+)
+
+_ADMISSION_MODES = ("reserve", "optimistic")
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request. `generated` accumulates post-prompt tokens
-    (the first comes from the admission prefill itself)."""
+    (the first comes from the admission prefill itself). `deadline_s` is
+    a wall-clock budget from submit — queued or running, the request is
+    TIMED_OUT once it elapses. `events` is the per-request audit log:
+    (wall time, event, detail) for submit/admit/first_token/preempt/
+    terminal transitions."""
 
     rid: int
     prompt: List[int]
     max_new_tokens: int = 16
     eos_token: Optional[int] = None
+    deadline_s: Optional[float] = None
 
     generated: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
+    status: str = RequestStatus.QUEUED
+    error: Optional[str] = None
+    preemptions: int = 0
     submit_iter: int = -1
     admit_iter: int = -1
     finish_iter: int = -1
     submit_time: float = 0.0
     first_token_time: float = 0.0
     finish_time: float = 0.0
+    events: List[Tuple[float, str, str]] = dataclasses.field(
+        default_factory=list
+    )
+
+    def log(self, event: str, detail: str = "") -> None:
+        self.events.append((time.perf_counter(), event, detail))
 
     @property
     def finished(self) -> bool:
-        return self.finish_iter >= 0
+        """Terminal in ANY status — the request will never run again."""
+        return self.status in TERMINAL_STATUSES
+
+    @property
+    def ok(self) -> bool:
+        """Terminal AND successful — the only requests whose latency
+        numbers mean anything."""
+        return self.status == RequestStatus.FINISHED
 
     @property
     def latency_s(self) -> float:
@@ -64,7 +141,10 @@ class Request:
     @property
     def ttft_s(self) -> float:
         """Submit → first generated token (the prefill-side latency a
-        user perceives before streaming starts)."""
+        user perceives before streaming starts). Meaningless (0.0) for
+        a request that never produced a token."""
+        if not self.generated:
+            return 0.0
         return self.first_token_time - self.submit_time
 
     @property
@@ -76,6 +156,12 @@ class Request:
             return 0.0
         return (self.finish_time - self.first_token_time) / (
             len(self.generated) - 1
+        )
+
+    def deadline_exceeded(self, now: float) -> bool:
+        return (
+            self.deadline_s is not None
+            and now - self.submit_time > self.deadline_s
         )
 
     def _done_after(self, token: int) -> bool:
@@ -98,14 +184,41 @@ class SchedulerStats:
     verify_steps: int = 0
     draft_tokens_proposed: int = 0
     draft_tokens_accepted: int = 0
-    # per-request latency accumulators (filled at retirement)
-    finished_requests: int = 0
+    # request lifecycle (filled at terminal transitions)
+    submitted_requests: int = 0
+    finished_requests: int = 0  # FINISHED only — not failures
+    failed_requests: int = 0
+    cancelled_requests: int = 0
+    timed_out_requests: int = 0
+    preemptions: int = 0  # preempt-and-requeue events
+    step_faults: int = 0  # whole-step engine faults (all slots retired)
+    draft_faults: int = 0  # proposer faults degraded to plain decode
+    tokens_finished: int = 0  # Σ generated over FINISHED requests only
+    # per-request latency accumulators (FINISHED requests only — a
+    # request failing before its first token has no TTFT to aggregate)
     ttft_sum_s: float = 0.0
     decode_latency_sum_s: float = 0.0  # Σ of per-request decode_s_per_token
 
     @property
     def tokens_per_s(self) -> float:
         return self.tokens_generated / self.elapsed_s if self.elapsed_s else 0.0
+
+    @property
+    def goodput_tokens_per_s(self) -> float:
+        """Tokens of successfully FINISHED requests per second — the
+        number a resilient scheduler maximizes under faults. Tokens
+        generated for requests that later failed, timed out, or were
+        cancelled are work, not goodput."""
+        return self.tokens_finished / self.elapsed_s if self.elapsed_s else 0.0
+
+    @property
+    def terminal_requests(self) -> int:
+        return (
+            self.finished_requests
+            + self.failed_requests
+            + self.cancelled_requests
+            + self.timed_out_requests
+        )
 
     @property
     def occupancy(self) -> float:
@@ -138,12 +251,23 @@ class SchedulerStats:
 class _SchedulerBase:
     """Shared admission/decode/verify machinery. `proposer` switches the
     per-iteration generation step from plain decode to speculative
-    draft/verify (serving/spec.py): propose up to `spec_k` tokens per
-    slot, score them all in ONE engine.verify call, accept a prefix
-    (exact match under greedy, rejection sampling under temperature),
-    and roll the cache back to the accepted length."""
+    draft/verify (serving/spec.py). `admission` picks the paged cache's
+    policy ("reserve" = preemption-free worst-case gate, "optimistic" =
+    admit-now/preempt-later, bounded by `max_preemptions` per request).
+    `injector` threads a faults.FaultInjector through the step
+    boundaries; the isolation machinery below runs either way — the
+    injector only makes faults happen on schedule."""
 
-    def __init__(self, engine, params=None, proposer=None, spec_k: int = 4):
+    def __init__(
+        self,
+        engine,
+        params=None,
+        proposer=None,
+        spec_k: int = 4,
+        admission: str = "reserve",
+        max_preemptions: int = 3,
+        injector=None,
+    ):
         self.engine = engine
         self.cache = engine.cache
         self.params = params if params is not None else engine.model.params
@@ -151,26 +275,209 @@ class _SchedulerBase:
         self.spec_k = int(spec_k)
         if proposer is not None and self.spec_k < 1:
             raise ValueError("speculative decoding needs spec_k >= 1")
+        if admission not in _ADMISSION_MODES:
+            raise ValueError(
+                f"admission must be one of {_ADMISSION_MODES}, "
+                f"got {admission!r}"
+            )
+        self.admission = admission
+        self.max_preemptions = int(max_preemptions)
+        self.injector = injector
         self.queue: deque = deque()
         self.running: Dict[int, Request] = {}  # slot -> request
         self.finished: List[Request] = []
         self.stats = SchedulerStats()
+        self._by_rid: Dict[int, Request] = {}
         self._iter = 0
 
-    # -- submission ----------------------------------------------------------
+    # -- submission / cancellation -------------------------------------------
 
-    def submit(self, request: Request) -> None:
+    def submit(self, request: Request, strict: bool = True) -> bool:
+        """Queue a request. Invalid requests raise ValueError when
+        `strict` (the library-call contract), or transition straight to
+        FAILED when not (the serving-surface contract: one bad request
+        must not take down a batch submitted with it). Returns True when
+        the request entered the queue."""
+        try:
+            self._validate(request)
+        except ValueError as e:
+            if strict:
+                raise
+            request.submit_iter = self._iter
+            request.submit_time = time.perf_counter()
+            self._by_rid[request.rid] = request
+            self.stats.submitted_requests += 1
+            self._finalize(request, RequestStatus.FAILED, error=str(e))
+            return False
+        request.status = RequestStatus.QUEUED
+        request.submit_iter = self._iter
+        request.submit_time = time.perf_counter()
+        request.log("submit")
+        self._by_rid[request.rid] = request
+        self.stats.submitted_requests += 1
+        self.queue.append(request)
+        return True
+
+    def _validate(self, request: Request) -> None:
         if not request.prompt:
             raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"request {request.rid}: max_new_tokens must be >= 1, "
+                f"got {request.max_new_tokens}"
+            )
+        if request.deadline_s is not None and request.deadline_s <= 0:
+            raise ValueError(
+                f"request {request.rid}: deadline_s must be > 0, "
+                f"got {request.deadline_s}"
+            )
         need = len(request.prompt) + request.max_new_tokens
         if need > self.cache.spec.max_len:
             raise ValueError(
                 f"request {request.rid}: prompt+max_new_tokens {need} "
                 f"exceeds cache max_len {self.cache.spec.max_len}"
             )
-        request.submit_iter = self._iter
-        request.submit_time = time.perf_counter()
-        self.queue.append(request)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a queued or running request; its slot and pages free
+        at the next finalize. Returns False for unknown or already-
+        terminal rids (cancellation races are expected, not errors)."""
+        req = self._by_rid.get(rid)
+        if req is None or req.status in TERMINAL_STATUSES:
+            return False
+        self._finalize(req, RequestStatus.CANCELLED)
+        return True
+
+    # -- lifecycle core ------------------------------------------------------
+
+    def _finalize(self, req: Request, status: str, error: Optional[str] = None):
+        """The ONLY transition into a terminal status: releases the
+        slot/pages (or the queue position), notifies the proposer, logs
+        the event, and feeds the stats — so every path (finish, fail,
+        cancel, timeout, preemption overrun) accounts identically and no
+        request can leak a slot or vanish without a terminal record."""
+        if req.status in TERMINAL_STATUSES:
+            return
+        req.status = status
+        req.error = error
+        req.finish_iter = self._iter
+        req.finish_time = time.perf_counter()
+        req.log(status, error or "")
+        if req.slot is not None and self.running.get(req.slot) is req:
+            if self.proposer is not None:
+                self.proposer.retire(req)
+            del self.running[req.slot]
+            self.cache.free(req.slot)
+            req.slot = None
+        else:
+            # identity-based removal: Request is a dataclass, so the
+            # deque's __eq__-based remove() could drop a twin instead
+            for i, queued in enumerate(self.queue):
+                if queued is req:
+                    del self.queue[i]
+                    break
+        self.finished.append(req)
+        stats = self.stats
+        if status == RequestStatus.FINISHED:
+            stats.finished_requests += 1
+            stats.tokens_finished += len(req.generated)
+            # latency aggregates take FINISHED requests only: a request
+            # retired before its first token has no TTFT, and averaging
+            # a 0.0 in would fake lower latencies exactly when faults
+            # are making things worse
+            stats.ttft_sum_s += req.ttft_s
+            stats.decode_latency_sum_s += req.decode_s_per_token
+        elif status == RequestStatus.FAILED:
+            stats.failed_requests += 1
+        elif status == RequestStatus.CANCELLED:
+            stats.cancelled_requests += 1
+        elif status == RequestStatus.TIMED_OUT:
+            stats.timed_out_requests += 1
+
+    def _fail(self, req: Request, error: str) -> None:
+        self._finalize(req, RequestStatus.FAILED, error=error)
+
+    def _reap_deadlines(self) -> None:
+        now = time.perf_counter()
+        for req in [r for r in self.queue if r.deadline_exceeded(now)]:
+            self._finalize(req, RequestStatus.TIMED_OUT)
+        for req in [
+            r for r in list(self.running.values()) if r.deadline_exceeded(now)
+        ]:
+            self._finalize(req, RequestStatus.TIMED_OUT)
+
+    # -- preemption (optimistic admission) -----------------------------------
+
+    def _pick_victim(self) -> Optional[Request]:
+        """Youngest-by-admission running request — the vLLM victim rule:
+        the newest sequence has the least recompute to lose and, under
+        FIFO, the weakest fairness claim. (admit_iter, rid) makes the
+        choice deterministic within an admission batch."""
+        if not self.running:
+            return None
+        return max(
+            self.running.values(), key=lambda r: (r.admit_iter, r.rid)
+        )
+
+    def _preempt(self, req: Request) -> None:
+        """Reclaim the victim's slot and pages and requeue it at the
+        queue HEAD for prefill-from-recompute (prompt + generated so
+        far). A request preempted more than `max_preemptions` times
+        hard-fails instead — the bound that turns a livelock into a
+        diagnosable error."""
+        req.preemptions += 1
+        self.stats.preemptions += 1
+        if req.preemptions > self.max_preemptions:
+            self._fail(
+                req,
+                f"preempted {req.preemptions} times "
+                f"(max_preemptions {self.max_preemptions})",
+            )
+            return
+        req.status = RequestStatus.PREEMPTED
+        req.log("preempt", f"iteration {self._iter}")
+        if self.proposer is not None:
+            self.proposer.retire(req)
+        del self.running[req.slot]
+        self.cache.free(req.slot)
+        req.slot = None
+        req.status = RequestStatus.QUEUED
+        self.queue.appendleft(req)
+
+    def _secure_pages(self, widths: Dict[int, int]) -> None:
+        """Claim every page this iteration's step will touch BEFORE the
+        jitted call: slot s writes rows lengths[s] .. lengths[s] +
+        widths[s] - 1. Under reserve admission the claims are guaranteed
+        (a PagePoolExhausted here means something outside the accounting
+        drained the pool — an injected fault — and fails just that
+        slot); under optimistic admission a dry pool preempts the
+        youngest victim and retries, so the engine's own ensure_position
+        calls always find the pages already present."""
+        if not getattr(self.cache, "paged", False):
+            return
+        for slot in sorted(widths):
+            req = self.running.get(slot)
+            if req is None:
+                continue
+            start = int(self.cache.lengths[slot])
+            pos = start
+            while req.status == RequestStatus.RUNNING and (
+                pos < start + widths[slot]
+            ):
+                try:
+                    self.cache.ensure_position(slot, pos)
+                    pos += 1
+                except PagePoolExhausted as e:
+                    if self.admission != "optimistic":
+                        self._fail(req, str(e))
+                        break
+                    victim = self._pick_victim()
+                    if victim is None:
+                        self._fail(req, str(e))
+                        break
+                    self._preempt(victim)
+                    # preempting may have evicted `req` itself (it was
+                    # the youngest); its requeue ends the claim loop
 
     # -- shared pieces -------------------------------------------------------
 
@@ -180,38 +487,67 @@ class _SchedulerBase:
         behind it) + ONE prefill batch for the admitted set. Admission
         asks the cache, so the gate is layout-specific: the slot layout
         admits while a slot is free; the paged layout also requires
-        enough free PAGES to cover the request's worst case
-        (prompt + max_new_tokens) on top of every in-flight request's
-        outstanding reserve — the preemption-free policy that lets a
-        mid-flight decode always claim its next page."""
+        enough free PAGES — the request's worst case under the reserve
+        policy, only its immediate need under the optimistic one. A
+        preempted request re-admits with its recompute sequence
+        (prompt + tokens already generated): the prefill rebuilds the
+        KV it lost and its next token comes out of that same call."""
+        optimistic = self.admission == "optimistic"
         admitted: List[Request] = []
+        seqs: List[List[int]] = []
         while self.queue:
             if limit is not None and len(admitted) >= limit:
                 break
             req = self.queue[0]
+            seq = list(req.prompt) + list(req.generated)
             slot = self.cache.alloc(
-                len(req.prompt), len(req.prompt) + req.max_new_tokens
+                len(seq),
+                len(req.prompt) + req.max_new_tokens,
+                optimistic=optimistic,
             )
             if slot is None:
                 break
             self.queue.popleft()
             req.slot = slot
             req.admit_iter = self._iter
+            req.status = RequestStatus.RUNNING
+            req.log("admit", f"slot {slot}")
             self.running[req.slot] = req
             admitted.append(req)
+            seqs.append(seq)
         self.stats.peak_in_flight = max(
             self.stats.peak_in_flight, len(self.running)
         )
         if admitted:
             if self.proposer is not None:
                 self.proposer.admit(admitted)
-            nxt, _ = self.engine.prefill(
-                self.params,
-                [r.prompt for r in admitted],
-                [r.slot for r in admitted],
-            )
+            try:
+                nxt, last = self.engine.prefill(
+                    self.params, seqs, [r.slot for r in admitted]
+                )
+            except Exception as e:  # fault isolation: the batch fails,
+                # in-flight slots are untouched and keep decoding
+                self.stats.step_faults += 1
+                for req in admitted:
+                    self._fail(req, f"prefill failed: {e!r}")
+                return admitted
             self.stats.prefill_batches += 1
-            for tok, req in zip(nxt, admitted):
+            if self.injector is not None:
+                # np.array (copy): the step's output buffer is read-only
+                last = np.array(last)
+                self.injector.corrupt_logits(
+                    last,
+                    [r.slot for r in admitted],
+                    rows=range(len(admitted)),
+                )
+            for i, (tok, req) in enumerate(zip(nxt, admitted)):
+                if not np.isfinite(last[i]).all():
+                    self._fail(
+                        req,
+                        f"non-finite prefill logits at iteration "
+                        f"{self._iter}",
+                    )
+                    continue
                 self._emit(req, int(tok))
         return admitted
 
@@ -219,37 +555,64 @@ class _SchedulerBase:
         req.generated.append(token)
         if len(req.generated) == 1:
             req.first_token_time = time.perf_counter()
+            req.log("first_token")
         self.stats.tokens_generated += 1
         if req._done_after(token):
-            self._retire(req)
+            self._finalize(req, RequestStatus.FINISHED)
 
-    def _retire(self, req: Request) -> None:
-        req.finish_iter = self._iter
-        req.finish_time = time.perf_counter()
-        if self.proposer is not None:
-            self.proposer.retire(req)
-        self.cache.free(req.slot)
-        del self.running[req.slot]
-        self.finished.append(req)
-        self.stats.finished_requests += 1
-        self.stats.ttft_sum_s += req.ttft_s
-        self.stats.decode_latency_sum_s += req.decode_s_per_token
+    def _fail_all_running(self, error: str) -> None:
+        """Whole-step engine fault with no slot attribution: retire every
+        participant with the captured error rather than crash the run —
+        the queue behind them keeps serving."""
+        self.stats.step_faults += 1
+        for req in list(self.running.values()):
+            self._fail(req, error)
 
     def _decode_once(self) -> None:
+        self._secure_pages({slot: 1 for slot in self.running})
+        if not self.running:
+            return
         spec = self.cache.spec
         tokens = np.zeros(spec.max_seqs, dtype=np.int32)
         active = np.zeros(spec.max_seqs, dtype=bool)
         for slot, req in self.running.items():
             tokens[slot] = req.generated[-1]
             active[slot] = True
-        nxt, _ = self.engine.decode(self.params, tokens, active)
+        try:
+            nxt, logits = self.engine.decode(self.params, tokens, active)
+        except Exception as e:
+            self._fail_all_running(f"decode step failed: {e!r}")
+            return
         self.stats.decode_steps += 1
         self.stats.slot_steps += spec.max_seqs
         self.stats.busy_slot_steps += int(active.sum())
-        for slot in [s for s, a in enumerate(active) if a]:
+        active_slots = [s for s, a in enumerate(active) if a]
+        if self.injector is not None:
+            logits = np.array(logits)  # writable copy for the injector
+            self.injector.corrupt_logits(logits, active_slots)
+        for slot in active_slots:
             req = self.running.get(slot)
-            if req is not None:
-                self._emit(req, int(nxt[slot]))
+            if req is None:
+                continue
+            if not np.isfinite(logits[slot]).all():
+                self._fail(
+                    req, f"non-finite logits at iteration {self._iter}"
+                )
+                continue
+            self._emit(req, int(nxt[slot]))
+
+    def _propose(self, k: int) -> Dict[int, List[int]]:
+        """Draft tokens for the running slots; a proposer fault (real or
+        injected) degrades THIS iteration to plain decode — empty
+        proposals make every verify a w=1 decode — instead of killing
+        the run."""
+        try:
+            if self.injector is not None:
+                self.injector.maybe_draft_fault()
+            return self.proposer.propose(self.running, k)
+        except Exception:
+            self.stats.draft_faults += 1
+            return {}
 
     def _verify_once(self) -> None:
         """One speculative iteration: draft up to spec_k tokens per slot,
@@ -264,9 +627,7 @@ class _SchedulerBase:
 
         spec = self.cache.spec
         k = self.spec_k
-        proposals = self.proposer.propose(self.running, k)
-        tokens = np.zeros((spec.max_seqs, k + 1), dtype=np.int32)
-        draft_lens = np.zeros(spec.max_seqs, dtype=np.int32)
+        proposals = self._propose(k)
         plan: Dict[int, List[int]] = {}
         for slot, req in self.running.items():
             old_len = int(self.cache.lengths[slot])
@@ -280,22 +641,45 @@ class _SchedulerBase:
                 req.max_new_tokens - len(req.generated) - 1,
                 spec.max_len - old_len - 1,
             )
-            drafts = list(proposals.get(slot) or ())[: max(0, k_s)]
+            plan[slot] = list(proposals.get(slot) or ())[: max(0, k_s)]
+        # claim pages for every row the verify writes; optimistic
+        # preemption may evict plan slots, so the arrays build AFTER
+        self._secure_pages({s: 1 + len(d) for s, d in plan.items()})
+        plan = {s: d for s, d in plan.items() if s in self.running}
+        if not plan:
+            return
+        tokens = np.zeros((spec.max_seqs, k + 1), dtype=np.int32)
+        draft_lens = np.zeros(spec.max_seqs, dtype=np.int32)
+        for slot, drafts in plan.items():
+            req = self.running[slot]
             tokens[slot, 0] = req.generated[-1]
             for j, t in enumerate(drafts):
                 tokens[slot, 1 + j] = int(t)
             draft_lens[slot] = 1 + len(drafts)
-            plan[slot] = drafts
-        logits = self.engine.verify(self.params, tokens, draft_lens)
+        try:
+            logits = self.engine.verify(self.params, tokens, draft_lens)
+        except Exception as e:
+            self._fail_all_running(f"verify step failed: {e!r}")
+            return
         self.stats.verify_steps += 1
         self.stats.slot_steps += spec.max_seqs
         self.stats.busy_slot_steps += len(plan)
+        if self.injector is not None:
+            logits = np.array(logits)  # writable copy for the injector
+            self.injector.corrupt_logits(logits, sorted(plan))
         for slot in sorted(plan):
             req = self.running.get(slot)
             if req is None:
                 continue
             drafts = plan[slot]
             old_len = int(self.cache.lengths[slot])
+            if not np.isfinite(logits[slot, : 1 + len(drafts)]).all():
+                # lengths never advanced for this slot; freeing it
+                # returns its pages, stale verify rows and all
+                self._fail(
+                    req, f"non-finite logits at iteration {self._iter}"
+                )
+                continue
             accepted, emitted = accept_drafts(
                 logits[slot],
                 drafts,
@@ -322,9 +706,18 @@ class _SchedulerBase:
         else:
             self._decode_once()
 
+    def _begin_iteration(self) -> None:
+        self._iter += 1
+        self.stats.iterations += 1
+        if self.injector is not None:
+            self.injector.on_iteration(self._iter, self)
+        self._reap_deadlines()
+
     def run(self, requests: Optional[Sequence[Request]] = None) -> List[Request]:
-        """Drain the queue (plus `requests`, submitted first) to completion;
-        returns finished requests in completion order."""
+        """Drain the queue (plus `requests`, submitted first) to
+        completion; returns requests in terminal order — check
+        `Request.status`/`Request.ok`, a fault-isolated run finishes
+        with FAILED entries instead of raising."""
         for r in requests or ():
             self.submit(r)
         t0 = time.perf_counter()
@@ -341,8 +734,7 @@ class ContinuousBatchingScheduler(_SchedulerBase):
     draft/verify step instead of single-token decode."""
 
     def step(self) -> None:
-        self._iter += 1
-        self.stats.iterations += 1
+        self._begin_iteration()
         self._admit()
         if self.running:
             self._generate_once()
@@ -353,8 +745,7 @@ class StaticBatchingScheduler(_SchedulerBase):
     finishes; freed slots stay idle until the batch drains."""
 
     def step(self) -> None:
-        self._iter += 1
-        self.stats.iterations += 1
+        self._begin_iteration()
         if not self.running:
             self._admit()
         if self.running:
@@ -371,7 +762,9 @@ _LATENCY_METRICS = {
 def latency_percentiles(
     requests: Sequence[Request], pcts=(50, 95), metric: str = "latency"
 ):
-    """{pct: seconds} over finished requests. metric: "latency"
+    """{pct: seconds} over successfully FINISHED requests (failed,
+    cancelled, and timed-out requests have no meaningful latency and
+    would drag the percentiles toward zero). metric: "latency"
     (submit→finish, the default), "ttft" (submit→first token), or
     "decode_per_token" (per-generated-token decode latency after the
     first — where speculative decoding's win shows up as latency rather
@@ -381,7 +774,7 @@ def latency_percentiles(
             f"metric must be one of {sorted(_LATENCY_METRICS)}, got {metric!r}"
         )
     fn = _LATENCY_METRICS[metric]
-    lats = [fn(r) for r in requests if r.finished]
+    lats = [fn(r) for r in requests if r.ok]
     if not lats:
         return {p: 0.0 for p in pcts}
     return {p: float(np.percentile(lats, p)) for p in pcts}

@@ -133,7 +133,9 @@ class DraftProposer:
     degrades to plain decode). The lifecycle hooks exist for proposers
     with their own cache state (ModelDraftProposer); the base
     implementations are no-ops so stateless proposers only implement
-    propose()."""
+    propose(). `retire` fires for EVERY slot release — terminal
+    statuses and preemptions alike (a preempted request re-enters via
+    `admit` with its recompute history)."""
 
     def admit(self, requests: Sequence) -> None:  # pragma: no cover
         pass
@@ -227,14 +229,18 @@ class ModelDraftProposer(DraftProposer):
 
     def admit(self, requests) -> None:
         """Mirror the target's admission: claim the SAME slot ids and
-        prefill the draft cache with the prompts (the prefill's own
-        next-token output is unused — drafts start from the target's
-        first emitted token at the next propose())."""
+        prefill the draft cache with each request's committed history —
+        the prompt, plus any tokens already generated when a preempted
+        request re-admits for recompute (serving/scheduler.py); feeding
+        them here in one prefill is the draft-side recompute that would
+        otherwise replay token-by-token as catch-up feeds. The
+        prefill's own next-token output is unused — drafts start from
+        the target's last emitted token at the next propose()."""
         for req in requests:
             self.cache.claim(req.slot)
         self.engine.prefill(
             self.params,
-            [r.prompt for r in requests],
+            [list(r.prompt) + list(r.generated) for r in requests],
             [r.slot for r in requests],
         )
 

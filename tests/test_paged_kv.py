@@ -64,7 +64,7 @@ def _requests(spec):
     return [
         Request(
             rid=i,
-            prompt=[(i * 7 + j) % VOCAB + 1 for j in range(1 + i % 5)],
+            prompt=[(i * 7 + j) % (VOCAB - 1) + 1 for j in range(1 + i % 5)],
             max_new_tokens=n,
         )
         for i, n in enumerate(spec)
@@ -159,8 +159,15 @@ def test_paged_decode_attention_matches_dense():
 # -- allocator invariants -----------------------------------------------------
 
 
-def _check_allocator_invariants(cache):
+def _check_allocator_invariants(cache, injector=None):
+    """Full allocator consistency probe, shared with the resilience
+    suite (tests/test_resilience.py imports it): the cache's own
+    check_invariants (per-slot ledger vs table, reserve re-derivation,
+    conservation — counting any pages a FaultInjector is deliberately
+    holding) plus the historical explicit asserts."""
     spec = cache.spec
+    extra = injector.stolen_pages if injector is not None else 0
+    cache.check_invariants(extra_free=extra)
     live = [
         int(p)
         for row in cache.block_tables
@@ -169,12 +176,12 @@ def _check_allocator_invariants(cache):
     ]
     # no double allocation: a page appears in at most one table entry
     assert len(live) == len(set(live))
-    # free-list conservation: free + held = pool, disjoint
+    # free-list conservation: free + held (+ injector-stolen) = pool
     assert set(live).isdisjoint(cache._free_pages)
-    assert len(live) + cache.num_free_pages == spec.num_pages
-    assert cache.pages_in_use == len(live)
+    assert len(live) + cache.num_free_pages + extra == spec.num_pages
+    assert cache.pages_in_use == len(live) + extra
     # the reserve never promises pages the pool doesn't have
-    assert 0 <= cache._reserved <= cache.num_free_pages
+    assert 0 <= cache._reserved <= cache.num_free_pages + extra
 
 
 def test_allocator_invariants_through_schedule(lm):
@@ -240,7 +247,7 @@ def test_paged_capacity_beats_slot_on_short_requests(lm):
         # short profile: prompt 1-3 + 4 generated << max_len 32
         sched.run(
             [
-                Request(rid=i, prompt=[(i + j) % VOCAB + 1
+                Request(rid=i, prompt=[(i + j) % (VOCAB - 1) + 1
                                        for j in range(1 + i % 3)],
                         max_new_tokens=4)
                 for i in range(8)
@@ -249,6 +256,54 @@ def test_paged_capacity_beats_slot_on_short_requests(lm):
         peak[name] = sched.stats.peak_in_flight
     assert peak["slot"] == max_seqs
     assert peak["paged"] >= 1.5 * peak["slot"]
+
+
+def test_optimistic_alloc_reserves_nothing():
+    """Optimistic admission charges only the pages needed NOW, keeps the
+    reserve ledger at zero for its slots, and raises PagePoolExhausted
+    (instead of over-promising) when a later claim finds the pool dry —
+    the trigger for the scheduler's preemption-by-recompute. Reserve
+    accounting for coexisting reserve-admitted slots is untouched."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.serving.kv_cache import PagePoolExhausted
+
+    spec = KVCacheSpec(
+        layer_guids=(1,), max_seqs=4, max_len=32, num_heads=2, head_dim=4,
+        buckets=(32,), page_size=4, num_pages=10,
+    )
+    cache = PagedKVCache(spec, jnp.float32)
+    # reserve-admitted neighbor: 1 page held, 2 more reserved
+    rsv = cache.alloc(4, 12)
+    assert cache._reserved == 2
+    # optimistic slot: worst case 32 tokens = 8 pages would NOT fit on
+    # top of the neighbor's reserve, but its 2 prompt pages do
+    assert not cache.can_admit(8, 32)
+    opt = cache.alloc(8, 32, optimistic=True)
+    assert opt is not None
+    assert cache._reserved == 2  # unchanged: no optimistic reserve
+    _check_allocator_invariants(cache)
+    # grow the optimistic slot until free - reserved hits zero:
+    # 10 - 1 - 2 held leaves 7 free, 2 reserved -> 5 more claims succeed
+    for pos in range(8, 28, 4):
+        cache.ensure_position(opt, pos)
+    assert cache.num_free_pages - cache._reserved == 0
+    with pytest.raises(PagePoolExhausted, match="optimistic"):
+        cache.ensure_position(opt, 28)
+    # the reserve-admitted slot's guaranteed claims still succeed
+    cache.ensure_position(rsv, 4)
+    cache.ensure_position(rsv, 8)
+    assert cache._reserved == 0
+    _check_allocator_invariants(cache)
+    # truncate returns optimistic pages to the COMMON pool (reserve flat)
+    cache.truncate(opt, 9)
+    assert cache._reserved == 0
+    assert int(cache._max_pages[opt]) == int(cache._held[opt]) == 3
+    _check_allocator_invariants(cache)
+    cache.free(opt)
+    cache.free(rsv)
+    _check_allocator_invariants(cache)
+    assert cache.num_free_pages == spec.num_pages
 
 
 # -- config wiring / validation ----------------------------------------------

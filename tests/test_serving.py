@@ -139,7 +139,7 @@ def test_generate_on_default_multichip_mesh():
 
 def _requests(spec):
     return [
-        Request(rid=i, prompt=[(i * 7 + j) % VOCAB + 1 for j in range(1 + i % 5)],
+        Request(rid=i, prompt=[(i * 7 + j) % (VOCAB - 1) + 1 for j in range(1 + i % 5)],
                 max_new_tokens=n)
         for i, n in enumerate(spec)
     ]

@@ -204,7 +204,7 @@ def test_allocator_invariants_through_spec_schedule(lm):
     )
     for i, n in enumerate([2, 9, 4, 1, 7, 3, 5, 8, 2, 6]):
         sched.submit(Request(
-            rid=i, prompt=[(i * 7 + j) % VOCAB + 1 for j in range(1 + i % 5)],
+            rid=i, prompt=[(i * 7 + j) % (VOCAB - 1) + 1 for j in range(1 + i % 5)],
             max_new_tokens=n,
         ))
     while sched.queue or sched.running:
