@@ -1,0 +1,48 @@
+"""fxlint: static analysis for the repo's own bug classes.
+
+Unity (OSDI'22) validates parallelization decisions BEFORE execution;
+this package does the same for the JAX-side invariants this codebase
+has already paid to learn:
+
+* **dispatch-race** (FX1xx, `dispatch_race.py`) — the PR 3 bug class:
+  a mutable host array (``cache.lengths``, allocator block tables)
+  handed to ``jnp.asarray``/a jitted call without a snapshot while the
+  same attribute is mutated elsewhere. ``jnp.asarray`` defers the
+  host-buffer read behind the async dispatch queue, so the read races
+  the next iteration's mutation and corrupts the step under load.
+* **retrace-storm** (FX2xx, `retrace.py`) — ``jax.jit`` wrappers
+  constructed per iteration, per-call Python values in static jit
+  positions, and shape-polymorphic arguments on serving hot paths —
+  each retriggers XLA compilation per step.
+* **strategy-validate** (FX3xx, `strategy_check.py`) — the graph-level
+  PCG/strategy checker: mesh axes exist, degrees are expressible on
+  the mesh, replica dims agree across producer/consumer edges,
+  machine bounds hold. Runs inside ``FFModel.compile()`` (typed
+  ``StrategyValidationError`` before any XLA lowering) and replays
+  over ``search/strategy_io`` JSON files via ``fxlint --strategy``.
+* **pallas-gate** (FX4xx, `pallas_gate.py`) — every ``pallas_call``
+  module must expose a ``supports()`` geometry gate, cross-module
+  kernel calls must sit behind ``supports()``/``use_kernel()`` with a
+  dense fallback, and gate constants (sublane alignment, ``_MAX_W``)
+  must agree with the kernel-body constants.
+
+CLI: ``python -m flexflow_tpu.analysis`` (diagnostics are
+``file:line rule-id message``; a checked-in baseline file absorbs
+accepted findings and CI fails on any NEW one — see docs/analysis.md).
+"""
+
+from flexflow_tpu.analysis.diagnostics import Diagnostic
+from flexflow_tpu.analysis.strategy_check import (
+    StrategyDiagnostic,
+    StrategyValidationError,
+    validate_graph_strategy,
+    validate_strategy_doc,
+)
+
+__all__ = [
+    "Diagnostic",
+    "StrategyDiagnostic",
+    "StrategyValidationError",
+    "validate_graph_strategy",
+    "validate_strategy_doc",
+]

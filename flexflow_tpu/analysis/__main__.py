@@ -1,0 +1,8 @@
+"""``python -m flexflow_tpu.analysis`` — the fxlint CLI."""
+
+import sys
+
+from flexflow_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,204 @@
+"""fxlint driver: file scan, rule selection, baseline compare, exit code.
+
+``python -m flexflow_tpu.analysis [paths] [options]`` — see
+docs/analysis.md. Exit 0 when every finding is baselined (or none),
+1 when NEW findings exist, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from flexflow_tpu.analysis import dispatch_race, pallas_gate, retrace
+from flexflow_tpu.analysis.diagnostics import (
+    Diagnostic,
+    baseline_key,
+    collect_python_files,
+    load_baseline,
+    parse_files,
+    write_baseline,
+)
+
+#: rule families: name -> (module, rule-id prefix)
+FAMILIES = {
+    "dispatch-race": (dispatch_race, "FX1"),
+    "retrace-storm": (retrace, "FX2"),
+    "pallas-gate": (pallas_gate, "FX4"),
+}
+
+
+def run_rules(
+    paths: Sequence[str], families: Optional[Sequence[str]] = None
+) -> List[Diagnostic]:
+    """Run the AST rule families over `paths` (files or directories).
+    `families` filters by family name or rule-id prefix; None runs all."""
+    files = collect_python_files(paths)
+    trees, diags = parse_files(files)
+    selected = _select_families(families)
+    for name in selected:
+        module, _prefix = FAMILIES[name]
+        diags.extend(module.run(trees))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule_id))
+
+
+def _select_families(families: Optional[Sequence[str]]) -> List[str]:
+    if not families:
+        return list(FAMILIES)
+    out = []
+    for want in families:
+        for name, (_module, prefix) in FAMILIES.items():
+            if want == name or want.upper().startswith(prefix):
+                if name not in out:
+                    out.append(name)
+                break
+        else:
+            raise SystemExit(
+                f"fxlint: unknown rule family {want!r} "
+                f"(known: {sorted(FAMILIES)})"
+            )
+    return out
+
+
+def check_strategy_files(paths: Sequence[str]) -> List[Diagnostic]:
+    """Replay the FX3xx strategy validator over exported strategy JSON
+    files (search/strategy_io format)."""
+    from flexflow_tpu.analysis.strategy_check import validate_strategy_doc
+
+    diags: List[Diagnostic] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            diags.append(
+                Diagnostic("FX000", path, 1, f"unreadable strategy file: {e}")
+            )
+            continue
+        for sd in validate_strategy_doc(doc):
+            diags.append(
+                Diagnostic(
+                    sd.rule_id,
+                    path,
+                    1,
+                    f"[{sd.node or 'mesh'}] {sd.message}",
+                    severity=sd.severity,
+                )
+            )
+    return diags
+
+
+def _all_rule_docs() -> Dict[str, str]:
+    from flexflow_tpu.analysis import strategy_check
+
+    docs: Dict[str, str] = {"FX000": "unparseable file / unreadable input"}
+    for module, _prefix in FAMILIES.values():
+        docs.update(module.RULES)
+    docs.update(strategy_check.RULES)
+    return dict(sorted(docs.items()))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fxlint",
+        description=(
+            "Repo-specific static analysis: dispatch races, retrace "
+            "storms, strategy invariants, Pallas geometry gates."
+        ),
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to scan (default: the flexflow_tpu package)",
+    )
+    ap.add_argument(
+        "--baseline",
+        default="fxlint_baseline.txt",
+        help="baseline file of accepted findings (default: "
+        "fxlint_baseline.txt)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: every finding counts as new",
+    )
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to the current findings and exit 0",
+    )
+    ap.add_argument(
+        "--rules",
+        default="",
+        help="comma-separated rule families or id prefixes "
+        "(dispatch-race,retrace-storm,pallas-gate / FX1,FX2,FX4)",
+    )
+    ap.add_argument(
+        "--strategy",
+        action="append",
+        default=[],
+        metavar="FILE",
+        help="also replay the FX3xx strategy validator over an exported "
+        "strategy JSON file (repeatable)",
+    )
+    ap.add_argument(
+        "--show-baselined",
+        action="store_true",
+        help="print baselined findings too (marked), not just new ones",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, doc in _all_rule_docs().items():
+            print(f"{rid}  {doc}")
+        return 0
+
+    paths = args.paths
+    if not paths and not args.strategy:
+        default = os.path.join(os.getcwd(), "flexflow_tpu")
+        if not os.path.isdir(default):
+            print(
+                "fxlint: no paths given and ./flexflow_tpu not found "
+                "(run from the repo root or pass paths)",
+                file=sys.stderr,
+            )
+            return 2
+        paths = [default]
+
+    families = [f for f in args.rules.split(",") if f] or None
+    diags: List[Diagnostic] = []
+    if paths:
+        diags.extend(run_rules(paths, families))
+    diags.extend(check_strategy_files(args.strategy))
+
+    if args.update_baseline:
+        write_baseline(args.baseline, diags)
+        print(
+            f"fxlint: baseline {args.baseline} updated with "
+            f"{len(diags)} finding(s)"
+        )
+        return 0
+
+    baseline = (
+        set() if args.no_baseline else load_baseline(args.baseline)
+    )
+    base_dir = os.path.dirname(os.path.abspath(args.baseline)) or "."
+    new: List[Diagnostic] = []
+    old: List[Diagnostic] = []
+    for d in diags:
+        (old if baseline_key(d, base_dir) in baseline else new).append(d)
+    for d in new:
+        print(d.format())
+    if args.show_baselined:
+        for d in old:
+            print(f"{d.format()} (baselined)")
+    print(
+        f"fxlint: {len(new)} new finding(s), {len(old)} baselined"
+    )
+    return 1 if new else 0

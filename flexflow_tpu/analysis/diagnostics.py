@@ -1,0 +1,173 @@
+"""Diagnostic type, shared AST helpers, and the baseline workflow.
+
+A finding prints as ``file:line rule-id message``. The baseline file
+(one ``rule-id<TAB>path<TAB>message`` per line) records ACCEPTED
+findings: the linter exits nonzero only on findings not in the
+baseline, so CI fails on regressions without demanding a
+fix-everything flag day. Baseline keys deliberately exclude the line
+number — unrelated edits that shift a finding a few lines must not
+break CI — and store paths relative to the baseline file's directory
+so the key is stable regardless of the invoking cwd.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding. `severity` is "error" (counts toward the exit
+    code) or "warning" (informational)."""
+
+    rule_id: str
+    path: str
+    line: int
+    message: str
+    severity: str = "error"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line} {self.rule_id} {self.message}"
+
+
+def baseline_key(diag: Diagnostic, baseline_dir: str) -> Tuple[str, str, str]:
+    """(rule, path-relative-to-baseline, message) — line-independent."""
+    path = os.path.abspath(diag.path)
+    try:
+        rel = os.path.relpath(path, baseline_dir)
+    except ValueError:  # different drive (windows)
+        rel = path
+    return (diag.rule_id, rel.replace(os.sep, "/"), diag.message)
+
+
+def load_baseline(path: str) -> Set[Tuple[str, str, str]]:
+    keys: Set[Tuple[str, str, str]] = set()
+    if not os.path.exists(path):
+        return keys
+    with open(path) as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split("\t", 2)
+            if len(parts) == 3:
+                keys.add((parts[0], parts[1], parts[2]))
+    return keys
+
+
+def write_baseline(path: str, diagnostics: Iterable[Diagnostic]) -> None:
+    base_dir = os.path.dirname(os.path.abspath(path)) or "."
+    keys = sorted({baseline_key(d, base_dir) for d in diagnostics})
+    with open(path, "w") as f:
+        f.write(
+            "# fxlint baseline — accepted findings "
+            "(rule-id<TAB>path<TAB>message).\n"
+            "# Regenerate with: python -m flexflow_tpu.analysis "
+            "--update-baseline\n"
+            "# CI fails on findings NOT listed here; fix the code or "
+            "re-baseline deliberately.\n"
+        )
+        for k in keys:
+            f.write("\t".join(k) + "\n")
+
+
+# -- file collection / parsing ------------------------------------------------
+
+
+def collect_python_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/directories into a sorted .py file list, skipping
+    caches and hidden directories."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [
+                d
+                for d in dirs
+                if d != "__pycache__" and not d.startswith(".")
+            ]
+            for name in files:
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return sorted(set(out))
+
+
+def parse_files(
+    files: Iterable[str],
+) -> Tuple[Dict[str, ast.Module], List[Diagnostic]]:
+    """path -> parsed module. Unparseable files become FX000 findings
+    instead of crashing the lint run."""
+    trees: Dict[str, ast.Module] = {}
+    diags: List[Diagnostic] = []
+    for path in files:
+        try:
+            with open(path, "rb") as f:
+                src = f.read()
+            trees[path] = ast.parse(src, filename=path)
+        except (SyntaxError, ValueError, OSError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            diags.append(
+                Diagnostic("FX000", path, line, f"unparseable file: {e}")
+            )
+    return trees, diags
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+
+def name_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Dotted-name chain of an expression: ``a.b.c`` -> ("a","b","c"),
+    None for anything that is not a pure Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """A ``jax.jit(...)`` / ``jit(...)`` wrapper construction."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = name_chain(node.func)
+    return chain in (("jax", "jit"), ("jit",))
+
+
+def collect_jitted_names(tree: ast.Module) -> Dict[str, Tuple[int, ...]]:
+    """Names bound to a jit wrapper in this module, with their static
+    argument positions: ``self._step = jax.jit(f, static_argnums=(1,))``
+    yields {"_step": (1,)} (plain ``x = jax.jit(f)`` yields {"x": ()}).
+    Keyed by the LAST chain element so attribute-held wrappers are
+    recognized at ``self._step(...)`` call sites."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not is_jit_call(node.value):
+            continue
+        static: Tuple[int, ...] = ()
+        for kw in node.value.keywords:
+            if kw.arg != "static_argnums":
+                continue
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, int
+            ):
+                static = (kw.value.value,)
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                static = tuple(
+                    e.value
+                    for e in kw.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                )
+        for target in node.targets:
+            chain = name_chain(target)
+            if chain:
+                out[chain[-1]] = static
+    return out

@@ -1,0 +1,171 @@
+"""FX1xx — dispatch-race: mutable host state into the async jit queue.
+
+The PR 3 bug class. ``jnp.asarray(x)`` does NOT read ``x``'s buffer at
+call time: the read is deferred behind JAX's async dispatch queue. If
+``x`` is live scheduler/allocator state (``cache.lengths``, paged
+block tables) that the host mutates between iterations, the deferred
+read races the mutation and the jitted step silently consumes a future
+iteration's state — wrong-context decodes under load, unreproducible
+off-peak.
+
+The blessed idiom is ONE of:
+
+* ``serving.engine.snapshot(attr)`` — the repo-wide snapshot helper;
+* an explicit ``attr.copy()`` / ``np.array(attr)`` inside the
+  ``jnp.asarray`` call.
+
+Rules (attribute-name granularity — ``ast`` cannot resolve types, so a
+mutated attribute NAME taints every load of that name; accepted
+findings go to the baseline):
+
+* **FX101** — ``jnp.asarray(...)`` whose argument contains a load of an
+  attribute that is subscript-mutated somewhere in the scanned file set
+  (``obj.attr[i] = ...`` / ``obj.attr[i] += ...``), with no snapshot
+  wrapper between the asarray and the load.
+* **FX102** — the same un-snapshotted attribute passed directly to a
+  callable that was bound from ``jax.jit(...)`` (the array would be
+  committed to the queue by the call itself).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from flexflow_tpu.analysis.diagnostics import (
+    Diagnostic,
+    collect_jitted_names,
+    name_chain,
+)
+
+RULES = {
+    "FX101": "mutable host attribute into jnp.asarray without a snapshot",
+    "FX102": "mutable host attribute passed raw into a jitted callable",
+}
+
+_ASARRAY_CHAINS = {("jnp", "asarray"), ("jax", "numpy", "asarray")}
+_SNAPSHOT_NAMES = {"snapshot"}
+
+
+def _is_asarray(func: ast.AST) -> bool:
+    return name_chain(func) in _ASARRAY_CHAINS
+
+
+def _is_snapshot_call(node: ast.Call) -> bool:
+    """A call that yields an immutable copy: ``x.copy()``,
+    ``np.array(x)`` (copies by default), or the blessed
+    ``snapshot(x)`` helper."""
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "copy":
+        return True
+    chain = name_chain(node.func)
+    if chain is None:
+        return False
+    if chain[-1] in _SNAPSHOT_NAMES:
+        return True
+    return len(chain) >= 2 and chain[-2] in ("np", "numpy") and (
+        chain[-1] == "array"
+    )
+
+
+def collect_mutated_attrs(trees: Dict[str, ast.Module]) -> Set[str]:
+    """Attribute names that are subscript-assigned anywhere in the file
+    set — the in-place array writes a deferred host read can race.
+    Writes inside ``__init__`` don't count: construction precedes
+    sharing, so init-time population (e.g. a cache's per-layer device
+    dicts) cannot race a dispatch."""
+    mutated: Set[str] = set()
+
+    def record(target: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                record(el)
+        elif isinstance(target, ast.Subscript) and isinstance(
+            target.value, ast.Attribute
+        ):
+            mutated.add(target.value.attr)
+
+    def visit(node: ast.AST) -> None:
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "__init__"
+        ):
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                record(t)
+        elif isinstance(node, ast.AugAssign):
+            record(node.target)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for tree in trees.values():
+        visit(tree)
+    return mutated
+
+
+def _tainted_loads(
+    expr: ast.AST, mutated: Set[str]
+) -> List[Tuple[str, int]]:
+    """(attr, line) for every load of a mutated attribute inside `expr`
+    that is not protected by a snapshot wrapper."""
+    found: List[Tuple[str, int]] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Call) and _is_snapshot_call(node):
+            return  # everything below this call is snapshotted
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and node.attr in mutated
+        ):
+            found.append((node.attr, node.lineno))
+            return  # the inner chain is the same access path
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return found
+
+
+def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
+    mutated = collect_mutated_attrs(trees)
+    diags: List[Diagnostic] = []
+    for path, tree in trees.items():
+        jitted = collect_jitted_names(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_asarray(node.func):
+                for arg in node.args:
+                    for attr, line in _tainted_loads(arg, mutated):
+                        diags.append(
+                            Diagnostic(
+                                "FX101",
+                                path,
+                                line,
+                                f"mutable host attribute '{attr}' flows "
+                                "into jnp.asarray without a snapshot "
+                                "(.copy()/np.array/snapshot) — the "
+                                "deferred host read races later "
+                                "mutation behind the dispatch queue",
+                            )
+                        )
+                continue
+            chain = name_chain(node.func)
+            if chain is not None and chain[-1] in jitted:
+                for arg in node.args:
+                    if isinstance(arg, ast.Starred):
+                        continue
+                    for attr, line in _tainted_loads(arg, mutated):
+                        diags.append(
+                            Diagnostic(
+                                "FX102",
+                                path,
+                                line,
+                                f"mutable host attribute '{attr}' passed "
+                                f"raw into jitted callable "
+                                f"'{chain[-1]}' — snapshot it before "
+                                "dispatch",
+                            )
+                        )
+    return diags

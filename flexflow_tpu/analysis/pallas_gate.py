@@ -1,0 +1,230 @@
+"""FX4xx — pallas-gate: every kernel sits behind a geometry gate.
+
+The Pallas kernels (ops/pallas/) only take certain geometries
+(sublane-aligned head_dim/page/chunk, ``w <= _MAX_W``); everything
+else must route to the dense jnp paths. The contract has two halves —
+a ``supports()`` predicate next to the kernel, and callers that
+consult it before dispatching — and it decays in two ways: a new
+kernel ships without a gate, or the gate's constants drift from the
+kernel body's BlockSpec constants. Rules:
+
+* **FX401** — a module contains ``pallas_call`` but defines no
+  ``supports()`` predicate: the kernel has no geometry gate for
+  callers to consult.
+* **FX402** — gate-constant drift: ``SUBLANES``/``LANES`` values
+  disagree across kernel modules, or a kernel module defines an
+  alignment/width constant (``SUBLANES``, ``_MAX_W``) that its own
+  ``supports()`` never references (the gate and the kernel body can
+  then diverge silently).
+* **FX403** — a cross-module call to a kernel entry point from a
+  function with no ``supports()``/``use_kernel()`` gate: rejected
+  geometries would reach the kernel and die inside Mosaic instead of
+  falling back to dense. Public callers need the gate in the SAME
+  function; private helpers (``_name``) may rely on a gate elsewhere
+  in their module (e.g. ring_attention's ``_pallas_ok``).
+
+Kernel entry points are computed, not hardcoded: the functions of a
+``pallas_call`` module that (transitively, within the module) reach a
+``pallas_call``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set
+
+from flexflow_tpu.analysis.diagnostics import Diagnostic, name_chain
+
+RULES = {
+    "FX401": "pallas_call module without a supports() geometry gate",
+    "FX402": "gate constants drift from kernel-body constants",
+    "FX403": "cross-module kernel call without a supports()/use_kernel() gate",
+}
+
+_GATE_CONSTANTS = ("SUBLANES", "LANES")
+_SUPPORTS_MUST_USE = ("SUBLANES", "_MAX_W")
+
+
+def _contains_pallas_call(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = name_chain(node.func)
+            if chain and chain[-1] == "pallas_call":
+                return True
+    return False
+
+
+def _module_constants(tree: ast.Module) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (
+            isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, int)
+        ):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = node.value.value
+    return out
+
+
+def _calls_in(node: ast.AST) -> Set[str]:
+    """Last-element names of every call target in the subtree."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            chain = name_chain(n.func)
+            if chain:
+                out.add(chain[-1])
+    return out
+
+
+def _kernel_entries(tree: ast.Module) -> Set[str]:
+    """Functions of a kernel module that reach pallas_call (directly or
+    through same-module calls) — the names outside callers must gate."""
+    funcs = {
+        n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+    }
+    entries = {
+        name
+        for name, fn in funcs.items()
+        if _contains_pallas_call(fn)
+    }
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in funcs.items():
+            if name in entries:
+                continue
+            if _calls_in(fn) & entries:
+                entries.add(name)
+                changed = True
+    return entries
+
+
+def _gate_present(names: Set[str]) -> bool:
+    return any("supports" in n or n == "use_kernel" for n in names)
+
+
+def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    kernel_modules: Dict[str, ast.Module] = {}
+    constants: Dict[str, Dict[str, int]] = {}
+    entries_by_module: Dict[str, Set[str]] = {}
+
+    for path, tree in trees.items():
+        if _contains_pallas_call(tree):
+            kernel_modules[path] = tree
+            entries_by_module[path] = _kernel_entries(tree)
+        consts = _module_constants(tree)
+        if any(c in consts for c in _GATE_CONSTANTS):
+            constants[path] = consts
+
+    # FX401 + the supports-uses-its-constants half of FX402
+    for path, tree in kernel_modules.items():
+        supports_fns = [
+            n
+            for n in tree.body
+            if isinstance(n, ast.FunctionDef) and "supports" in n.name
+        ]
+        if not supports_fns:
+            diags.append(
+                Diagnostic(
+                    "FX401",
+                    path,
+                    1,
+                    "module contains pallas_call but defines no "
+                    "supports() geometry gate — callers cannot fall "
+                    "back to dense",
+                )
+            )
+            continue
+        referenced: Set[str] = set()
+        for fn in supports_fns:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name):
+                    referenced.add(node.id)
+        consts = _module_constants(tree)
+        for c in _SUPPORTS_MUST_USE:
+            if c in consts and c not in referenced:
+                diags.append(
+                    Diagnostic(
+                        "FX402",
+                        path,
+                        1,
+                        f"kernel module defines {c} but supports() "
+                        "never references it — the gate can drift from "
+                        "the kernel body's constants",
+                    )
+                )
+
+    # cross-module constant agreement (FX402)
+    for const in _GATE_CONSTANTS:
+        values = {
+            path: consts[const]
+            for path, consts in constants.items()
+            if const in consts
+        }
+        if len(set(values.values())) > 1:
+            detail = ", ".join(
+                f"{os.path.basename(p)}={v}" for p, v in sorted(values.items())
+            )
+            for path in values:
+                diags.append(
+                    Diagnostic(
+                        "FX402",
+                        path,
+                        1,
+                        f"gate constant {const} disagrees across kernel "
+                        f"modules ({detail})",
+                    )
+                )
+
+    # FX403: cross-module kernel-entry calls must be gated
+    entry_owner: Dict[str, str] = {}
+    for path, entries in entries_by_module.items():
+        for name in entries:
+            entry_owner[name] = path
+    if entry_owner:
+        for path, tree in trees.items():
+            module_gated = _gate_present(_calls_in(tree))
+            # top-level functions and methods only: a nested closure's
+            # calls are attributed to its enclosing function, which owns
+            # the gate-or-not decision
+            top_level: List[ast.FunctionDef] = []
+            for n in tree.body:
+                if isinstance(n, ast.FunctionDef):
+                    top_level.append(n)
+                elif isinstance(n, ast.ClassDef):
+                    top_level.extend(
+                        m for m in n.body if isinstance(m, ast.FunctionDef)
+                    )
+            for fn in top_level:
+                calls = _calls_in(fn)
+                targets = {
+                    c
+                    for c in calls
+                    if c in entry_owner and entry_owner[c] != path
+                }
+                if not targets:
+                    continue
+                gated = _gate_present(calls) or (
+                    fn.name.startswith("_") and module_gated
+                )
+                if not gated:
+                    diags.append(
+                        Diagnostic(
+                            "FX403",
+                            path,
+                            fn.lineno,
+                            f"'{fn.name}' calls kernel entry "
+                            f"{sorted(targets)} without a supports()/"
+                            "use_kernel() gate — rejected geometries "
+                            "reach the kernel instead of the dense "
+                            "fallback",
+                        )
+                    )
+    return diags

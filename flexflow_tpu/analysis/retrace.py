@@ -1,0 +1,149 @@
+"""FX2xx — retrace-storm: patterns that retrigger XLA compilation.
+
+A jitted step on a serving hot path must compile a BOUNDED number of
+times (the engine's contract: 1 + #buckets + #draft-widths per
+session). These rules flag the ways that contract silently breaks:
+
+* **FX201** — a ``jax.jit(...)`` wrapper constructed inside a
+  ``for``/``while`` body: every loop iteration builds a fresh wrapper
+  with an empty trace cache, so every iteration recompiles.
+* **FX202** — ``jax.jit(f)(args)``: the wrapper is built and discarded
+  per call; same storm, one expression.
+* **FX203** — a tracked jitted callable invoked with a
+  shape-polymorphic argument (a slice bounded by a runtime value,
+  e.g. ``fn(x[:n])``): each distinct ``n`` is a new shape signature
+  and a new compile — per-request lengths must be padded/bucketed
+  before dispatch instead.
+* **FX204** — a tracked jitted callable with ``static_argnums``
+  receiving a computed expression at a static position: every
+  distinct value is a new cache entry, so per-request/per-iteration
+  values there recompile per step (and unhashable values raise).
+
+"Tracked" means bound from ``jax.jit(...)`` in the same module
+(``self._step = jax.jit(...)`` / ``step = jax.jit(...)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from flexflow_tpu.analysis.diagnostics import (
+    Diagnostic,
+    collect_jitted_names,
+    is_jit_call,
+    name_chain,
+)
+
+RULES = {
+    "FX201": "jax.jit wrapper constructed inside a loop body",
+    "FX202": "jax.jit wrapper immediately invoked (built per call)",
+    "FX203": "shape-polymorphic argument to a jitted callable",
+    "FX204": "computed value in a static_argnums position",
+}
+
+
+def _has_dynamic_slice(expr: ast.AST) -> bool:
+    """A Subscript slice with a runtime-valued bound (``x[:n]``,
+    ``x[: len(p)]``) — the shape depends on a per-call Python value.
+    Literals, unary-negated literals, and ALL_CAPS names (the module-
+    constant convention) are static."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Subscript):
+            continue
+        slices = (
+            node.slice.elts
+            if isinstance(node.slice, ast.Tuple)
+            else [node.slice]
+        )
+        for s in slices:
+            if not isinstance(s, ast.Slice):
+                continue
+            for bound in (s.lower, s.upper):
+                if bound is None:
+                    continue
+                if isinstance(bound, (ast.Constant, ast.UnaryOp)):
+                    continue
+                if isinstance(bound, ast.Name) and bound.id.isupper():
+                    continue
+                return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, jitted: Dict[str, tuple]):
+        self.path = path
+        self.jitted = jitted
+        self.loop_depth = 0
+        self.diags: List[Diagnostic] = []
+
+    def _loop(self, node: ast.AST) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if is_jit_call(node) and self.loop_depth > 0:
+            self.diags.append(
+                Diagnostic(
+                    "FX201",
+                    self.path,
+                    node.lineno,
+                    "jax.jit wrapper constructed inside a loop — every "
+                    "iteration recompiles; hoist the wrapper out and "
+                    "reuse it",
+                )
+            )
+        if isinstance(node.func, ast.Call) and is_jit_call(node.func):
+            self.diags.append(
+                Diagnostic(
+                    "FX202",
+                    self.path,
+                    node.lineno,
+                    "jax.jit(...)(...) builds and discards the wrapper "
+                    "per call — cache the jitted callable instead",
+                )
+            )
+        chain = name_chain(node.func)
+        if chain is not None and chain[-1] in self.jitted:
+            static = self.jitted[chain[-1]]
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Starred):
+                    continue
+                if _has_dynamic_slice(arg):
+                    self.diags.append(
+                        Diagnostic(
+                            "FX203",
+                            self.path,
+                            arg.lineno,
+                            f"shape-polymorphic argument to jitted "
+                            f"'{chain[-1]}' (slice bounded by a runtime "
+                            "value) — each distinct length recompiles; "
+                            "pad to a bucketed static shape",
+                        )
+                    )
+                if i in static and not isinstance(
+                    arg, (ast.Constant, ast.Name, ast.Attribute)
+                ):
+                    self.diags.append(
+                        Diagnostic(
+                            "FX204",
+                            self.path,
+                            arg.lineno,
+                            f"computed expression at static position "
+                            f"{i} of jitted '{chain[-1]}' — every "
+                            "distinct value is a fresh compile",
+                        )
+                    )
+        self.generic_visit(node)
+
+
+def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for path, tree in trees.items():
+        v = _Visitor(path, collect_jitted_names(tree))
+        v.visit(tree)
+        diags.extend(v.diags)
+    return diags

@@ -1,0 +1,337 @@
+"""FX3xx — strategy-validate: typed PCG/strategy diagnostics.
+
+Unity's leverage comes from validating parallelization decisions
+BEFORE execution; the failure mode it prevents is an infeasible
+annotation surfacing as an opaque XLA/GSPMD error (or worse, a silent
+wrong sharding) minutes into a lowering. `validate_graph_strategy`
+walks an annotated+propagated PCG and re-derives every constraint the
+lowering will rely on, producing typed diagnostics instead:
+
+* **FX301** bad-mesh-axis — a partitioned dim's ``parallel_idx`` names
+  no axis of the strategy's mesh.
+* **FX302** degree-mesh-mismatch — the degree is not expressible on
+  the mesh (not the size of its axis nor a consecutive-axis span
+  product; includes one axis claimed by two dims). Decided by the
+  SAME ``partition_spec`` lowering the executor runs, so the
+  validator never disagrees with the lowering.
+* **FX303** non-dividing-degree — a requested degree does not divide
+  the dimension it shards (strategy-doc replay; inside a built graph
+  ``ParallelDim`` already rejects this at construction).
+* **FX304** replica-dim-inconsistency — producer/consumer edges into a
+  multi-input elementwise op (or self-attention's q/k/v) disagree on
+  (degree, mesh axis, replica degree): GSPMD would insert a hidden
+  reshard — or miscompile the op — where the strategy promised none.
+* **FX305** machine-bounds — the mesh wants more devices than the
+  machine has (the MachineView/submesh bound).
+* **FX306** unknown-kind — a strategy file's strategy/site kind is not
+  one the loader can rebuild.
+* **FX307** bad-degree-value — a degree or mesh axis size below 1.
+* **FX308** unknown-op — a strategy file references an op name the
+  current graph does not contain.
+
+``FFModel.compile()`` runs the graph validator after the final shape
+propagation and raises `StrategyValidationError` (a ``ValueError``
+carrying ``.diagnostics``) on errors — before any XLA lowering. The
+``fxlint --strategy file.json`` mode replays `validate_strategy_doc`
+over exported ``search/strategy_io`` files.
+
+Severity: "error" exactly where the executor's lowering would raise
+(INPUT outputs and weight shapes — the tensors it materializes with
+``partition_spec`` — plus machine bounds); intermediate-activation and
+replica-consistency findings are "warning" (GSPMD may legally
+reshard). Pipelined strategies demote everything to warnings — the
+GPipe executor lowers block weights through its own stacked path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+RULES = {
+    "FX301": "partitioned dim references a mesh axis that does not exist",
+    "FX302": "degree not expressible on the strategy mesh",
+    "FX303": "degree does not divide the dimension it shards",
+    "FX304": "replica/parallel dims disagree across a producer/consumer edge",
+    "FX305": "mesh exceeds the machine's device count",
+    "FX306": "unknown strategy or site kind",
+    "FX307": "degree or mesh axis size below 1",
+    "FX308": "strategy file references an unknown op",
+}
+
+_DOC_KINDS = ("tp", "seq", "spatial", "pipeline", "mixed")
+_SITE_KINDS = (
+    "attention",
+    "conv_channel",
+    "embedding",
+    "expert_parallel",
+    "linear_chain",
+    "single_linear",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class StrategyDiagnostic:
+    """One graph/strategy-level finding (node names a PCG op or a
+    strategy-file field; '' for mesh-global findings)."""
+
+    rule_id: str
+    severity: str  # "error" | "warning"
+    node: str
+    message: str
+
+    def format(self) -> str:
+        where = self.node or "<mesh>"
+        return f"{where} {self.rule_id} {self.message}"
+
+
+class StrategyValidationError(ValueError):
+    """compile()-time strategy rejection, raised BEFORE any XLA
+    lowering. `.diagnostics` holds the typed findings."""
+
+    def __init__(self, diagnostics: Sequence[StrategyDiagnostic]):
+        self.diagnostics = list(diagnostics)
+        super().__init__(
+            "strategy validation failed:\n"
+            + "\n".join(d.format() for d in self.diagnostics)
+        )
+
+
+def validate_graph_strategy(
+    graph,
+    mesh_config,
+    num_devices: Optional[int] = None,
+    strict_all: bool = False,
+) -> List[StrategyDiagnostic]:
+    """Validate an annotated+propagated PCG against its mesh. Returns
+    every finding; callers decide what severity raises (compile()
+    raises on "error"). `num_devices` enables the machine-bounds
+    check; `strict_all` promotes intermediate-activation findings to
+    errors (the fxlint replay mode's posture)."""
+    from flexflow_tpu.core.types import OperatorType
+
+    diags: List[StrategyDiagnostic] = []
+    axis_names = tuple(mesh_config.axis_names)
+    axis_sizes = tuple(mesh_config.axis_sizes)
+
+    for name, size in zip(axis_names, axis_sizes):
+        if size < 1:
+            diags.append(
+                StrategyDiagnostic(
+                    "FX307",
+                    "error",
+                    "",
+                    f"mesh axis '{name}' has size {size} (must be >= 1)",
+                )
+            )
+    if num_devices is not None and mesh_config.num_devices > num_devices:
+        diags.append(
+            StrategyDiagnostic(
+                "FX305",
+                "error",
+                "",
+                f"mesh {dict(zip(axis_names, axis_sizes))} needs "
+                f"{mesh_config.num_devices} devices, machine has "
+                f"{num_devices}",
+            )
+        )
+
+    elementwise = {
+        t
+        for t in (
+            getattr(OperatorType, n, None)
+            for n in ("EW_ADD", "EW_SUB", "EW_MUL", "EW_DIV", "EW_MAX", "EW_MIN")
+        )
+        if t is not None
+    }
+
+    for guid in graph.topo_order():
+        node = graph.nodes[guid]
+        is_input = node.op_type == OperatorType.INPUT and not node.inputs
+        shapes = [("output", s) for s in node.output_shapes]
+        shapes += [("weight", s) for s in node.weight_shapes]
+        for kind, shape in shapes:
+            strict = strict_all or kind == "weight" or is_input
+            sev = "error" if strict else "warning"
+            bad_axis = False
+            for d in shape.dims:
+                if d.degree > 1 and not (
+                    0 <= d.parallel_idx < len(axis_names)
+                ):
+                    bad_axis = True
+                    diags.append(
+                        StrategyDiagnostic(
+                            "FX301",
+                            sev,
+                            node.name,
+                            f"{kind} dim (size {d.size}, degree "
+                            f"{d.degree}) references mesh axis "
+                            f"{d.parallel_idx} but the mesh has axes "
+                            f"{list(axis_names)}",
+                        )
+                    )
+            if bad_axis:
+                continue
+            # the executor's own lowering decides expressibility — the
+            # validator can never disagree with partition_spec
+            try:
+                shape.partition_spec(axis_names, axis_sizes)
+            except ValueError as e:
+                diags.append(
+                    StrategyDiagnostic(
+                        "FX302",
+                        sev,
+                        node.name,
+                        f"{kind} shape {shape} is not expressible on "
+                        f"mesh {dict(zip(axis_names, axis_sizes))}: {e}",
+                    )
+                )
+
+        # replica/parallel-dim agreement across the edges into ops whose
+        # inputs must be identically sharded
+        check_edges = node.op_type in elementwise or (
+            node.op_type == OperatorType.MULTIHEAD_ATTENTION
+            and len({(r.guid, r.out_idx) for r in node.inputs}) > 1
+        )
+        if check_edges and len(node.inputs) >= 2:
+            sigs = []
+            for ref in node.inputs:
+                s = graph.shape_of(ref)
+                sigs.append(
+                    (
+                        tuple((d.degree, d.parallel_idx) for d in s.dims),
+                        s.replica_degree,
+                    )
+                )
+            if len(set(sigs)) > 1:
+                producers = [
+                    graph.nodes[r.guid].name for r in node.inputs
+                ]
+                diags.append(
+                    StrategyDiagnostic(
+                        "FX304",
+                        "error" if strict_all else "warning",
+                        node.name,
+                        "inputs disagree on (degree, axis)/replica "
+                        f"annotations across producers {producers}: "
+                        f"{sigs}",
+                    )
+                )
+    return diags
+
+
+def validate_strategy_doc(
+    doc: Dict,
+    graph=None,
+    num_devices: Optional[int] = None,
+) -> List[StrategyDiagnostic]:
+    """Replay the validator over an exported strategy JSON document
+    (search/strategy_io format) — the ``fxlint --strategy`` mode. With
+    a graph, additionally checks site op names and dp divisibility."""
+    diags: List[StrategyDiagnostic] = []
+    kind = doc.get("kind", "tp")
+    if kind not in _DOC_KINDS:
+        diags.append(
+            StrategyDiagnostic(
+                "FX306",
+                "error",
+                "kind",
+                f"unknown strategy kind {kind!r} (known: {_DOC_KINDS})",
+            )
+        )
+    extra = doc.get("extra", {}) or {}
+    mesh_sizes = doc.get("mesh_sizes") or []
+
+    def _deg(value, default=1):
+        return default if value is None else int(value)
+
+    degrees = {
+        "dp": _deg(doc.get("dp", mesh_sizes[0] if mesh_sizes else None)),
+        "tp": _deg(doc.get("tp")),
+    }
+    for k in ("sp", "hp", "pp"):
+        if k in extra:
+            degrees[k] = int(extra[k])
+    for name, deg in degrees.items():
+        if deg < 1:
+            diags.append(
+                StrategyDiagnostic(
+                    "FX307", "error", name, f"{name}={deg} (must be >= 1)"
+                )
+            )
+    for size in mesh_sizes:
+        if int(size) < 1:
+            diags.append(
+                StrategyDiagnostic(
+                    "FX307",
+                    "error",
+                    "mesh_sizes",
+                    f"mesh axis size {size} (must be >= 1)",
+                )
+            )
+    if num_devices is not None:
+        want = max(1, degrees["dp"]) * max(
+            1,
+            degrees.get("tp", 1)
+            * degrees.get("sp", 1)
+            * degrees.get("hp", 1)
+            * degrees.get("pp", 1),
+        )
+        if want > num_devices:
+            diags.append(
+                StrategyDiagnostic(
+                    "FX305",
+                    "error",
+                    "",
+                    f"strategy wants {want} devices, machine has "
+                    f"{num_devices}",
+                )
+            )
+    names_in_graph = (
+        {n.name for n in graph.nodes.values()} if graph is not None else None
+    )
+    for i, site in enumerate(doc.get("sites", []) or []):
+        skind = site.get("kind")
+        if skind not in _SITE_KINDS:
+            diags.append(
+                StrategyDiagnostic(
+                    "FX306",
+                    "error",
+                    f"sites[{i}]",
+                    f"unknown site kind {skind!r} (known: {_SITE_KINDS})",
+                )
+            )
+        if names_in_graph is not None:
+            for nm in site.get("names", []):
+                if nm not in names_in_graph:
+                    diags.append(
+                        StrategyDiagnostic(
+                            "FX308",
+                            "error",
+                            f"sites[{i}]",
+                            f"references op {nm!r} not present in the "
+                            "graph",
+                        )
+                    )
+    if graph is not None and degrees["dp"] > 1:
+        from flexflow_tpu.core.types import OperatorType
+
+        for node in graph.nodes.values():
+            if node.op_type == OperatorType.INPUT and not node.inputs:
+                shape = node.params.get("shape") or (
+                    node.output_shapes[0] if node.output_shapes else None
+                )
+                if shape is None:
+                    continue
+                batch = shape.dims[0].size
+                if batch % degrees["dp"]:
+                    diags.append(
+                        StrategyDiagnostic(
+                            "FX303",
+                            "error",
+                            node.name,
+                            f"dp={degrees['dp']} does not divide input "
+                            f"batch {batch}",
+                        )
+                    )
+    return diags

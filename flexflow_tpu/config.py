@@ -128,6 +128,9 @@ class FFConfig:
     # --max-preemptions per request
     serve_admission: str = "reserve"
     serve_max_preemptions: int = 3
+    # --check-invariants: run cache.check_invariants() every scheduler
+    # iteration (the chaos harness's probe) — debugging/CI posture
+    serve_check_invariants: bool = False
 
     @property
     def num_devices(self) -> int:
@@ -263,6 +266,8 @@ class FFConfig:
                 cfg.serve_admission = take()
             elif a == "--max-preemptions":
                 cfg.serve_max_preemptions = int(take())
+            elif a == "--check-invariants":
+                cfg.serve_check_invariants = True
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

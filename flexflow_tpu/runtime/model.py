@@ -877,6 +877,31 @@ class FFModel:
             )
         else:
             from_logits = logits_node.op_type != OperatorType.SOFTMAX
+        # strategy validation (analysis/strategy_check.py): re-derive
+        # every constraint the lowering relies on — mesh axes exist,
+        # degrees are expressible, machine bounds hold — and raise ONE
+        # typed StrategyValidationError BEFORE any XLA work, instead of
+        # an opaque ValueError from deep inside partition_spec during
+        # executor construction. Pipelined strategies lower block
+        # weights through their own stacked path, so their findings are
+        # informational only.
+        from flexflow_tpu.analysis.strategy_check import (
+            StrategyValidationError,
+            validate_graph_strategy,
+        )
+
+        self.strategy_diagnostics = validate_graph_strategy(
+            self.graph,
+            self.strategy.mesh_config,
+            num_devices=len(devices),
+        )
+        if getattr(self.strategy, "pipeline", None) is None:
+            _strategy_errors = [
+                d for d in self.strategy_diagnostics if d.severity == "error"
+            ]
+            if _strategy_errors:
+                raise StrategyValidationError(_strategy_errors)
+
         executor_cls = Executor
         executor_kwargs = {}
         if getattr(self.strategy, "pipeline", None) is not None:

@@ -22,7 +22,7 @@ from flexflow_tpu.serving.api import (
     build_scheduler,
     generate,
 )
-from flexflow_tpu.serving.engine import GenerationEngine
+from flexflow_tpu.serving.engine import GenerationEngine, snapshot
 from flexflow_tpu.serving.faults import (
     DraftFault,
     FaultError,
@@ -60,6 +60,7 @@ __all__ = [
     "build_proposer",
     "build_scheduler",
     "GenerationEngine",
+    "snapshot",
     "KVCache",
     "KVCacheSpec",
     "PagedKVCache",

@@ -69,6 +69,11 @@ class ServeConfig:
     # request before hard FAILED. The slot layout ignores both.
     admission: str = "reserve"
     max_preemptions: int = 3
+    # debug: re-run cache.check_invariants() after every scheduler
+    # iteration (--check-invariants). Off by default — the full
+    # allocator re-derivation is O(slots × pages) per iteration, a
+    # debugging/CI posture rather than a serving one.
+    debug_invariants: bool = False
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -136,6 +141,7 @@ class ServeConfig:
             decode_kernel=cfg.serve_decode_kernel,
             admission=cfg.serve_admission,
             max_preemptions=cfg.serve_max_preemptions,
+            debug_invariants=cfg.serve_check_invariants,
         )
 
 
@@ -204,6 +210,7 @@ def build_scheduler(model, serve: ServeConfig, draft_model=None, injector=None):
         admission=serve.admission,
         max_preemptions=serve.max_preemptions,
         injector=injector,
+        debug_invariants=serve.debug_invariants,
     )
     return sched, engine, cache
 

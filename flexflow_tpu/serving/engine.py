@@ -67,6 +67,23 @@ import numpy as np
 from flexflow_tpu.core.types import OperatorType
 
 
+def snapshot(host_state: np.ndarray):
+    """Immutable device-ready snapshot of mutable host scheduler state.
+
+    ``jnp.asarray`` defers its host-buffer read behind the async
+    dispatch queue, so handing it live state the scheduler mutates
+    between steps (``cache.lengths``, allocator block tables) races
+    the deferred read and corrupts the step under load — the PR 3 bug
+    class. Every dispatch site routes mutable host arrays through this
+    ONE helper; fxlint's dispatch-race rule
+    (flexflow_tpu/analysis/dispatch_race.py) recognizes exactly this
+    idiom (or an explicit ``.copy()``/``np.array``) as the blessed
+    snapshot and flags everything else."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.array(host_state))
+
+
 class GenerationEngine:
     """Step functions over (params, cache); all scheduling lives in
     serving.scheduler."""
@@ -504,17 +521,15 @@ class GenerationEngine:
                 self.cache.ensure_position(
                     int(slot), int(self.cache.lengths[slot])
                 )
-            args = [jnp.asarray(self.cache.block_tables.copy())]
-        # .copy() on every mutable host array: jnp.asarray defers the
-        # host-buffer read behind the async dispatch queue, so handing it
-        # live scheduler state (lengths += 1 below, allocator table edits
-        # between iterations) races the read and corrupts the step under
-        # load — the snapshot temp is never mutated, so the deferred read
-        # is safe
+            args = [snapshot(self.cache.block_tables)]
+        # snapshot() every mutable host array (lengths += 1 below,
+        # allocator table edits between iterations mutate behind the
+        # async dispatch queue); the locals built above are fresh per
+        # call and safe to hand over directly
         step_args = (
             params,
             jnp.asarray(tokens, dtype=jnp.int32)[:, None],
-            jnp.asarray(self.cache.lengths.copy()),
+            snapshot(self.cache.lengths),
             jnp.asarray(active_mask),
             *args,
             self.cache.k,
@@ -702,14 +717,14 @@ class GenerationEngine:
                 start = int(self.cache.lengths[slot])
                 for p in range(start, start + int(draft_lens[slot])):
                     self.cache.ensure_position(int(slot), p)
-            args = [jnp.asarray(self.cache.block_tables.copy())]
-        # lengths/tables snapshot (.copy()): the caller truncates the
-        # cache right after this returns, and jnp.asarray's host read is
+            args = [snapshot(self.cache.block_tables)]
+        # snapshot() lengths/tables: the caller truncates the cache
+        # right after this returns, and jnp.asarray's host read is
         # deferred behind the dispatch queue — see decode()
         step_args = (
             params,
             jnp.asarray(tokens),
-            jnp.asarray(self.cache.lengths.copy()),
+            snapshot(self.cache.lengths),
             jnp.asarray(draft_lens),
             *args,
             self.cache.k,

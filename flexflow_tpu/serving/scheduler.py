@@ -267,6 +267,7 @@ class _SchedulerBase:
         admission: str = "reserve",
         max_preemptions: int = 3,
         injector=None,
+        debug_invariants: bool = False,
     ):
         self.engine = engine
         self.cache = engine.cache
@@ -283,6 +284,11 @@ class _SchedulerBase:
         self.admission = admission
         self.max_preemptions = int(max_preemptions)
         self.injector = injector
+        # ServeConfig.debug_invariants / --check-invariants: re-derive
+        # the cache/allocator accounting after EVERY iteration (what the
+        # chaos harness does), so an invariant violation surfaces at the
+        # iteration that caused it instead of steps later
+        self.debug_invariants = bool(debug_invariants)
         self.queue: deque = deque()
         self.running: Dict[int, Request] = {}  # slot -> request
         self.finished: List[Request] = []
@@ -713,6 +719,10 @@ class _SchedulerBase:
             self.injector.on_iteration(self._iter, self)
         self._reap_deadlines()
 
+    def _end_iteration(self) -> None:
+        if self.debug_invariants:
+            self.cache.check_invariants()
+
     def run(self, requests: Optional[Sequence[Request]] = None) -> List[Request]:
         """Drain the queue (plus `requests`, submitted first) to
         completion; returns requests in terminal order — check
@@ -738,6 +748,7 @@ class ContinuousBatchingScheduler(_SchedulerBase):
         self._admit()
         if self.running:
             self._generate_once()
+        self._end_iteration()
 
 
 class StaticBatchingScheduler(_SchedulerBase):
@@ -750,6 +761,7 @@ class StaticBatchingScheduler(_SchedulerBase):
             self._admit()
         if self.running:
             self._generate_once()
+        self._end_iteration()
 
 
 _LATENCY_METRICS = {

@@ -1,0 +1,32 @@
+"""fxlint fixture: the PR 3 dispatch-race bug class (positive cases).
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings:
+FX101 (raw mutable attribute into jnp.asarray) and FX102 (raw mutable
+attribute into a jitted callable).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RacyEngine:
+    def __init__(self):
+        self.lengths = np.zeros(8, dtype=np.int32)
+        self.tables = np.zeros((8, 4), dtype=np.int32)
+        self._step = jax.jit(lambda lens: lens + 1)
+
+    def advance(self, slot):
+        # host-side mutation between dispatches: the attribute is live
+        self.lengths[slot] += 1
+        self.tables[slot, 0] = slot
+
+    def dispatch(self):
+        # FX101: live host array handed to the deferred asarray read
+        lens = jnp.asarray(self.lengths)
+        tabs = jnp.asarray(self.tables)
+        return lens, tabs
+
+    def dispatch_jit(self):
+        # FX102: live host array committed by the jitted call itself
+        return self._step(self.lengths)

@@ -1,0 +1,38 @@
+"""fxlint fixture: the blessed snapshot idioms (negative cases).
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings: none.
+Every mutable attribute crosses the dispatch boundary through a
+snapshot — ``.copy()``, ``np.array``, or the repo's ``snapshot()``
+helper — and fresh per-call locals don't need one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.serving.engine import snapshot
+
+
+class SnapshottedEngine:
+    def __init__(self):
+        self.lengths = np.zeros(8, dtype=np.int32)
+        self.tables = np.zeros((8, 4), dtype=np.int32)
+        self._step = jax.jit(lambda lens: lens + 1)
+
+    def advance(self, slot):
+        self.lengths[slot] += 1
+        self.tables[slot, 0] = slot
+
+    def dispatch(self):
+        lens = jnp.asarray(self.lengths.copy())  # explicit snapshot
+        tabs = snapshot(self.tables)  # the blessed helper
+        arrd = jnp.asarray(np.array(self.lengths))  # np.array copies
+        return lens, tabs, arrd
+
+    def dispatch_jit(self):
+        return self._step(snapshot(self.lengths))
+
+    def dispatch_local(self):
+        # fresh per-call local: nothing mutates it after dispatch
+        tokens = np.zeros(8, dtype=np.int32)
+        return jnp.asarray(tokens)

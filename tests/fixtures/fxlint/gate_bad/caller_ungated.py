@@ -1,0 +1,13 @@
+"""fxlint fixture: a public caller dispatching a kernel with no gate.
+
+Linted by tests/test_fxlint.py — NOT imported. Expected finding:
+FX403 — `attend` calls a cross-module kernel entry without consulting
+supports()/use_kernel(), so rejected geometries reach the kernel
+instead of a dense fallback.
+"""
+
+from tests.fixtures.fxlint.gate_bad import kernel_driftgate
+
+
+def attend(q):
+    return kernel_driftgate.drifty_kernel(q)

@@ -1,0 +1,26 @@
+"""fxlint fixture: a kernel whose supports() ignores its own bounds.
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings:
+FX402 twice — the module defines _MAX_W but supports() never
+references it (the gate can drift from the kernel body), and SUBLANES
+disagrees with kernel_nogate.py's value.
+"""
+
+from jax.experimental import pallas as pl
+
+SUBLANES = 8
+_MAX_W = 64  # kernel-body bound the gate below forgets to enforce
+
+
+def _body(q_ref, o_ref):
+    o_ref[...] = q_ref[...] * 2.0
+
+
+def supports(w, head_dim):
+    # BUG under test: no `w <= _MAX_W` clause — the gate admits widths
+    # the kernel body cannot take
+    return head_dim % SUBLANES == 0
+
+
+def drifty_kernel(q):
+    return pl.pallas_call(_body, out_shape=q)(q)

@@ -1,0 +1,18 @@
+"""fxlint fixture: a Pallas kernel module with NO geometry gate.
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings:
+FX401 (pallas_call without supports()) and FX402 (SUBLANES disagrees
+with the sibling kernel module's value).
+"""
+
+from jax.experimental import pallas as pl
+
+SUBLANES = 16  # drifted: the sibling module says 8
+
+
+def _body(q_ref, o_ref):
+    o_ref[...] = q_ref[...] * 2.0
+
+
+def ungated_kernel(q):
+    return pl.pallas_call(_body, out_shape=q)(q)

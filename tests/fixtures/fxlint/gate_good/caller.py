@@ -1,0 +1,18 @@
+"""fxlint fixture: a caller with the gate-and-fallback contract.
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings:
+none — the public caller consults supports() in the same function and
+falls back to a dense path.
+"""
+
+from tests.fixtures.fxlint.gate_good import kernel
+
+
+def _dense(q):
+    return q * 2.0
+
+
+def attend(q, w):
+    if kernel.supports(w, q.shape[-1]):
+        return kernel.gated_kernel(q)
+    return _dense(q)

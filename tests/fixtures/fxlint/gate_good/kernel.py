@@ -1,0 +1,22 @@
+"""fxlint fixture: a properly gated Pallas kernel module.
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings:
+none — supports() enforces the module's own alignment/width constants.
+"""
+
+from jax.experimental import pallas as pl
+
+SUBLANES = 8
+_MAX_W = 64
+
+
+def _body(q_ref, o_ref):
+    o_ref[...] = q_ref[...] * 2.0
+
+
+def supports(w, head_dim):
+    return 1 <= w <= _MAX_W and head_dim % SUBLANES == 0
+
+
+def gated_kernel(q):
+    return pl.pallas_call(_body, out_shape=q)(q)

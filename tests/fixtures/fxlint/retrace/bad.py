@@ -1,0 +1,36 @@
+"""fxlint fixture: retrace storms (positive cases).
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings:
+FX201 (jit in loop), FX202 (immediately-invoked jit), FX203
+(shape-polymorphic arg), FX204 (computed static arg).
+"""
+
+import jax
+
+
+def per_step(xs):
+    out = []
+    for x in xs:
+        # FX201: a fresh wrapper (empty trace cache) per iteration
+        fn = jax.jit(lambda v: v * 2)
+        out.append(fn(x))
+    return out
+
+
+def one_shot(x):
+    # FX202: wrapper built and discarded in one expression
+    return jax.jit(lambda v: v + 1)(x)
+
+
+_scorer = jax.jit(lambda v: v.sum())
+_bucketed = jax.jit(lambda v, w: v * w, static_argnums=(1,))
+
+
+def score_prefix(arr, n):
+    # FX203: each distinct n is a new shape signature -> recompile
+    return _scorer(arr[:n])
+
+
+def weighted(arr, base):
+    # FX204: computed value at a static position -> cache entry per call
+    return _bucketed(arr, base + 1)

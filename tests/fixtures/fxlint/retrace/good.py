@@ -1,0 +1,27 @@
+"""fxlint fixture: bounded-compile jit usage (negative cases).
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings: none.
+Wrappers are hoisted and reused; shapes are static or padded to
+buckets; static positions receive stable names.
+"""
+
+import jax
+
+_step = jax.jit(lambda v: v * 2)
+_bucketed = jax.jit(lambda v, w: v * w, static_argnums=(1,))
+
+BUCKET = 16
+
+
+def per_step(xs):
+    return [_step(x) for x in xs]
+
+
+def score_bucketed(arr):
+    # constant-bounded slice: one shape signature
+    return _step(arr[:BUCKET])
+
+
+def weighted(arr, width):
+    # plain name at the static position (a stable config constant)
+    return _bucketed(arr, width)

@@ -1,0 +1,262 @@
+"""fxlint (flexflow_tpu.analysis): fixture-based positive/negative
+coverage for every AST rule family, the repo-is-clean contract (HEAD
+lints clean against the checked-in baseline, and the dispatch-race
+family is clean with NO baseline at all), the seeded-bug self-test
+(re-introducing the PR 3 race — dropping the snapshot on a dispatch
+path — must produce a finding, the property the CI job re-proves on
+every run), the baseline workflow, and the snapshot() helper's copy
+semantics. All pure-host/CPU-fast (tier 1)."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.analysis.cli import check_strategy_files, main, run_rules
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "flexflow_tpu")
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "fxlint"
+)
+BASELINE = os.path.join(REPO_ROOT, "fxlint_baseline.txt")
+
+pytestmark = pytest.mark.analysis
+
+
+def _by_file(diags):
+    out = {}
+    for d in diags:
+        out.setdefault(os.path.basename(d.path), []).append(d.rule_id)
+    return out
+
+
+# -- dispatch-race (FX1xx) ----------------------------------------------------
+
+
+def test_dispatch_race_fixtures():
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "dispatch")], ["dispatch-race"])
+    )
+    # seeded violations flagged: two raw asarray reads + one raw jit arg
+    assert diags.get("bad.py", []).count("FX101") == 2
+    assert diags.get("bad.py", []).count("FX102") == 1
+    # blessed idioms (.copy(), np.array, snapshot(), fresh locals) silent
+    assert "good.py" not in diags
+
+
+def test_dispatch_race_clean_on_head():
+    """The satellite contract: the baseline ships EMPTY for the
+    dispatch-race family — HEAD has zero findings even without a
+    baseline."""
+    diags = run_rules([PACKAGE], ["dispatch-race"])
+    assert diags == [], [d.format() for d in diags]
+
+
+def test_seeded_pr3_race_is_caught(tmp_path):
+    """Re-introduce the PR 3 bug (drop the snapshot on a decode
+    dispatch path) in a scratch copy: fxlint must flag it. This is the
+    same transformation the CI self-test step applies to a scratch
+    checkout."""
+    src_path = os.path.join(PACKAGE, "serving", "engine.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "snapshot(self.cache.lengths)",
+        "jnp.asarray(self.cache.lengths)",
+        1,
+    )
+    assert seeded != src, (
+        "engine.py no longer snapshots cache.lengths via snapshot() — "
+        "update this test AND the CI fxlint self-test recipe together"
+    )
+    scratch = tmp_path / "engine.py"
+    scratch.write_text(seeded)
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    assert any(
+        d.rule_id == "FX101" and "lengths" in d.message for d in diags
+    ), [d.format() for d in diags]
+    # the unmodified file stays clean
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "engine.py")
+    assert run_rules([str(clean)], ["dispatch-race"]) == []
+
+
+def test_seeded_block_table_race_is_caught(tmp_path):
+    src_path = os.path.join(PACKAGE, "serving", "engine.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "snapshot(self.cache.block_tables)",
+        "jnp.asarray(self.cache.block_tables)",
+    )
+    assert seeded != src
+    (tmp_path / "engine.py").write_text(seeded)
+    # the block-table MUTATIONS live in the allocator, not the engine —
+    # scan both, like a full-checkout lint does
+    shutil.copy(
+        os.path.join(PACKAGE, "serving", "kv_cache.py"),
+        tmp_path / "kv_cache.py",
+    )
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    assert sum(
+        d.rule_id == "FX101" and "block_tables" in d.message for d in diags
+    ) == 2
+
+
+# -- retrace-storm (FX2xx) ----------------------------------------------------
+
+
+def test_retrace_fixtures():
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "retrace")], ["retrace-storm"])
+    )
+    bad = diags.get("bad.py", [])
+    for rule in ("FX201", "FX202", "FX203", "FX204"):
+        assert rule in bad, (rule, bad)
+    assert "good.py" not in diags
+
+
+# -- pallas-gate (FX4xx) ------------------------------------------------------
+
+
+def test_pallas_gate_fixtures_positive():
+    diags = run_rules([os.path.join(FIXTURES, "gate_bad")], ["pallas-gate"])
+    by_file = _by_file(diags)
+    assert "FX401" in by_file.get("kernel_nogate.py", [])
+    # SUBLANES drift is reported on both disagreeing modules
+    assert "FX402" in by_file.get("kernel_nogate.py", [])
+    assert "FX402" in by_file.get("kernel_driftgate.py", [])
+    # _MAX_W defined but unenforced by supports()
+    assert any(
+        d.rule_id == "FX402" and "_MAX_W" in d.message for d in diags
+    )
+    assert "FX403" in by_file.get("caller_ungated.py", [])
+
+
+def test_pallas_gate_fixtures_negative():
+    diags = run_rules([os.path.join(FIXTURES, "gate_good")], ["pallas-gate"])
+    assert diags == [], [d.format() for d in diags]
+
+
+def test_pallas_gate_clean_on_head():
+    """ops/pallas and every kernel caller obey the gate contract."""
+    diags = run_rules([PACKAGE], ["pallas-gate"])
+    assert diags == [], [d.format() for d in diags]
+
+
+# -- repo/baseline contract ---------------------------------------------------
+
+
+def test_repo_lints_clean_against_baseline():
+    """The CI gate: all families over the whole package, every finding
+    baselined — fxlint exits 0 on HEAD."""
+    rc = main([PACKAGE, "--baseline", BASELINE])
+    assert rc == 0
+
+
+def test_baseline_workflow(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "class C:\n"
+        "    def mutate(self):\n"
+        "        self.state[0] = 1\n"
+        "    def dispatch(self):\n"
+        "        return jnp.asarray(self.state)\n"
+    )
+    baseline = tmp_path / "baseline.txt"
+    # new finding, no baseline -> fail
+    assert main([str(tmp_path), "--baseline", str(baseline)]) == 1
+    # accept it -> pass
+    assert (
+        main([str(tmp_path), "--baseline", str(baseline), "--update-baseline"])
+        == 0
+    )
+    assert main([str(tmp_path), "--baseline", str(baseline)]) == 0
+    # a NEW violation still fails against the old baseline
+    bad2 = tmp_path / "mod2.py"
+    bad2.write_text(
+        "import jax.numpy as jnp\n"
+        "class D:\n"
+        "    def mutate(self):\n"
+        "        self.other[0] = 1\n"
+        "    def dispatch(self):\n"
+        "        return jnp.asarray(self.other)\n"
+    )
+    assert main([str(tmp_path), "--baseline", str(baseline)]) == 1
+    # --no-baseline ignores the accepted set entirely
+    os.remove(str(bad2))
+    assert main([str(tmp_path), "--baseline", str(baseline), "--no-baseline"]) == 1
+
+
+def test_unparseable_file_is_a_finding(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    diags = run_rules([str(tmp_path)])
+    assert [d.rule_id for d in diags] == ["FX000"]
+
+
+def test_cli_list_rules_and_unknown_family(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("FX101", "FX201", "FX301", "FX401"):
+        assert rid in out
+    with pytest.raises(SystemExit):
+        run_rules([PACKAGE], ["no-such-family"])
+
+
+# -- strategy replay (FX3xx via CLI) ------------------------------------------
+
+
+def test_strategy_file_replay(tmp_path):
+    import json
+
+    good = tmp_path / "good.json"
+    good.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "kind": "tp",
+                "dp": 2,
+                "tp": 2,
+                "sites": [{"kind": "attention", "names": ["mha"]}],
+            }
+        )
+    )
+    assert check_strategy_files([str(good)]) == []
+    bad = tmp_path / "bad.json"
+    bad.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "kind": "warp",  # unknown strategy kind
+                "dp": 0,  # degree below 1
+                "sites": [{"kind": "hologram", "names": []}],
+            }
+        )
+    )
+    rules = [d.rule_id for d in check_strategy_files([str(bad)])]
+    assert "FX306" in rules and "FX307" in rules
+    assert main(["--strategy", str(bad), "--baseline", str(tmp_path / "b")]) == 1
+    unreadable = tmp_path / "nope.json"
+    unreadable.write_text("{not json")
+    assert [d.rule_id for d in check_strategy_files([str(unreadable)])] == [
+        "FX000"
+    ]
+
+
+# -- the snapshot() helper ----------------------------------------------------
+
+
+def test_snapshot_is_an_immutable_copy():
+    from flexflow_tpu.serving.engine import snapshot
+
+    host = np.arange(8, dtype=np.int32)
+    snap = snapshot(host)
+    host[:] = -1  # the post-dispatch mutation the race needs
+    np.testing.assert_array_equal(
+        np.asarray(snap), np.arange(8, dtype=np.int32)
+    )

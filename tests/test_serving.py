@@ -234,6 +234,37 @@ def test_serve_config_from_flags():
     assert (sc.max_seqs, sc.max_seq_len) == (4, 64)
     assert sc.scheduler == "static"
     assert sc.eos_token == 7
+    assert sc.debug_invariants is False
+    sc = ServeConfig.from_config(FFConfig.parse_args(["--check-invariants"]))
+    assert sc.debug_invariants is True
+
+
+def test_debug_invariants_runs_every_iteration(lm):
+    """ServeConfig.debug_invariants / --check-invariants: the scheduler
+    re-derives the cache/allocator accounting after EVERY iteration —
+    a clean run passes, and corrupted bookkeeping trips the very next
+    step instead of steps later."""
+    serve = ServeConfig(max_seqs=2, max_seq_len=32, debug_invariants=True)
+    sched, _, cache = build_scheduler(lm, serve)
+    sched.run(_requests([3, 3, 3]))
+    assert all(r.ok for r in sched.finished)
+    # corrupt the allocator behind the accounting: the next iteration's
+    # invariant probe must catch it
+    sched2, _, cache2 = build_scheduler(lm, serve)
+    for r in _requests([8]):
+        sched2.submit(r)
+    sched2.step()
+    cache2._free_pages.pop()  # a page vanishes outside the ledger
+    with pytest.raises(AssertionError):
+        sched2.step()
+    # without the flag the same corruption goes unnoticed
+    serve_off = ServeConfig(max_seqs=2, max_seq_len=32)
+    sched3, _, cache3 = build_scheduler(lm, serve_off)
+    for r in _requests([8]):
+        sched3.submit(r)
+    sched3.step()
+    cache3._free_pages.pop()
+    sched3.step()
 
 
 # -- continuous vs static batching -------------------------------------------

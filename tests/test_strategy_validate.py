@@ -1,0 +1,230 @@
+"""Strategy validator (flexflow_tpu.analysis.strategy_check): every
+negative path produces a TYPED diagnostic — bad mesh axis, degree not
+expressible on the mesh, non-dividing degree, inconsistent replica
+dims, machine bounds — instead of an opaque XLA/partition_spec error;
+compile() surfaces them as StrategyValidationError BEFORE lowering;
+and exported strategy files replay through the same checks. CPU-fast
+(tier 1)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu import (
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MeshConfig,
+    SGDOptimizer,
+)
+from flexflow_tpu.analysis.strategy_check import (
+    StrategyValidationError,
+    validate_graph_strategy,
+    validate_strategy_doc,
+)
+from flexflow_tpu.core.parallel_tensor import ParallelTensorShape
+from flexflow_tpu.core.pcg import PCGGraph, TensorRef
+from flexflow_tpu.core.types import OperatorType
+from flexflow_tpu.parallel.strategy import Strategy, data_parallel_strategy
+
+pytestmark = pytest.mark.analysis
+
+
+def _shape(sizes, degrees=None, parallel_idxs=None):
+    return ParallelTensorShape.make(
+        sizes, DataType.FLOAT, degrees=degrees, parallel_idxs=parallel_idxs
+    )
+
+
+def _graph_with_input(shape):
+    g = PCGGraph()
+    node = g.add_node(
+        OperatorType.INPUT, "x", [], {"shape": shape}, [shape]
+    )
+    return g, node
+
+
+# -- graph-level diagnostics --------------------------------------------------
+
+
+def test_bad_mesh_axis_is_typed():
+    """A partitioned dim pointing at a nonexistent mesh axis is FX301,
+    an error on an INPUT — not a partition_spec ValueError later."""
+    g, _ = _graph_with_input(
+        _shape([8, 4], degrees=[2, 1], parallel_idxs=[3, -1])
+    )
+    diags = validate_graph_strategy(g, MeshConfig(("data",), (2,)))
+    assert [(d.rule_id, d.severity) for d in diags] == [("FX301", "error")]
+    assert "mesh has axes" in diags[0].message
+
+
+def test_degree_mesh_mismatch_is_typed():
+    """Degree 3 on a size-2 axis: inexpressible -> FX302 (decided by
+    the SAME partition_spec lowering the executor runs)."""
+    g, _ = _graph_with_input(
+        _shape([6, 4], degrees=[3, 1], parallel_idxs=[0, -1])
+    )
+    diags = validate_graph_strategy(g, MeshConfig(("data",), (2,)))
+    assert [(d.rule_id, d.severity) for d in diags] == [("FX302", "error")]
+
+
+def test_valid_strategy_is_silent():
+    g, _ = _graph_with_input(
+        _shape([8, 4], degrees=[2, 1], parallel_idxs=[0, -1])
+    )
+    assert validate_graph_strategy(g, MeshConfig(("data",), (2,))) == []
+
+
+def test_span_sharding_is_not_a_false_positive():
+    """A degree spanning consecutive axes (the mixed-strategy
+    full-width batch shard) is legal and must stay silent."""
+    g, _ = _graph_with_input(
+        _shape([8, 4], degrees=[4, 1], parallel_idxs=[0, -1])
+    )
+    assert (
+        validate_graph_strategy(g, MeshConfig(("data", "model"), (2, 2)))
+        == []
+    )
+
+
+def test_replica_dim_inconsistency_is_typed():
+    """Two producers feeding one elementwise op with disagreeing
+    (degree, axis)/replica annotations -> FX304."""
+    sharded = _shape([8, 4], degrees=[2, 1], parallel_idxs=[0, -1])
+    replicated = _shape([8, 4])
+    g = PCGGraph()
+    a = g.add_node(OperatorType.INPUT, "a", [], {"shape": sharded}, [sharded])
+    b = g.add_node(
+        OperatorType.INPUT, "b", [], {"shape": replicated}, [replicated]
+    )
+    g.add_node(
+        OperatorType.EW_ADD,
+        "sum",
+        [TensorRef(a.guid, 0), TensorRef(b.guid, 0)],
+        {},
+        [sharded],
+    )
+    diags = validate_graph_strategy(g, MeshConfig(("data",), (2,)))
+    assert [d.rule_id for d in diags] == ["FX304"]
+    assert diags[0].node == "sum"
+    # identically-annotated producers stay silent
+    g2 = PCGGraph()
+    a2 = g2.add_node(OperatorType.INPUT, "a", [], {"shape": sharded}, [sharded])
+    b2 = g2.add_node(OperatorType.INPUT, "b", [], {"shape": sharded}, [sharded])
+    g2.add_node(
+        OperatorType.EW_ADD,
+        "sum",
+        [TensorRef(a2.guid, 0), TensorRef(b2.guid, 0)],
+        {},
+        [sharded],
+    )
+    assert validate_graph_strategy(g2, MeshConfig(("data",), (2,))) == []
+
+
+def test_machine_bounds_is_typed():
+    g, _ = _graph_with_input(_shape([8, 4]))
+    diags = validate_graph_strategy(
+        g, MeshConfig(("data",), (16,)), num_devices=8
+    )
+    assert [(d.rule_id, d.severity) for d in diags] == [("FX305", "error")]
+
+
+# -- compile() integration ----------------------------------------------------
+
+
+def _tiny_model():
+    cfg = FFConfig(batch_size=4)
+    model = FFModel(cfg)
+    x = model.create_tensor([4, 8], name="x")
+    model.dense(x, 4, use_bias=False)
+    return model
+
+
+def test_compile_raises_typed_strategy_error():
+    """An infeasible explicit strategy fails compile() with ONE typed
+    StrategyValidationError (a ValueError subclass) carrying the
+    diagnostics — before any executor/XLA work."""
+
+    def bad_apply(graph):
+        for node in graph.nodes.values():
+            if node.op_type == OperatorType.INPUT and not node.inputs:
+                shape = node.params["shape"].with_degree(0, 2, 5)
+                node.params["shape"] = shape
+                node.output_shapes = (shape,)
+
+    model = _tiny_model()
+    with pytest.raises(StrategyValidationError) as ei:
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.01),
+            loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+            metrics=[],
+            devices=jax.devices()[:1],
+            strategy=Strategy(
+                MeshConfig(("data",), (1,)), bad_apply, name="bad-axis"
+            ),
+        )
+    assert any(d.rule_id == "FX301" for d in ei.value.diagnostics)
+    assert isinstance(ei.value, ValueError)  # old except-clauses still work
+
+
+def test_compile_valid_strategy_records_diagnostics():
+    model = _tiny_model()
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+        devices=jax.devices()[:1],
+        strategy=data_parallel_strategy(1, model.graph),
+    )
+    assert model.strategy_diagnostics == []
+    # the compiled model still trains one step (validation is passive)
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    y = np.zeros((4, 4), dtype=np.float32)
+    model.fit(x, y, epochs=1)
+
+
+# -- strategy-doc replay ------------------------------------------------------
+
+
+def test_doc_non_dividing_degree():
+    """dp that does not divide the input batch -> FX303 from the doc
+    replay (inside a built graph ParallelDim rejects it at
+    construction, so the doc path is where this class surfaces)."""
+    g, _ = _graph_with_input(_shape([8, 4]))
+    diags = validate_strategy_doc({"version": 1, "dp": 3, "tp": 1}, graph=g)
+    assert [d.rule_id for d in diags] == ["FX303"]
+    assert validate_strategy_doc({"version": 1, "dp": 4, "tp": 1}, graph=g) == []
+
+
+def test_doc_machine_bounds_and_unknown_names():
+    g, _ = _graph_with_input(_shape([8, 4]))
+    diags = validate_strategy_doc(
+        {
+            "version": 1,
+            "kind": "tp",
+            "dp": 4,
+            "tp": 4,
+            "sites": [{"kind": "attention", "names": ["ghost_op"]}],
+        },
+        graph=g,
+        num_devices=8,
+    )
+    rules = {d.rule_id for d in diags}
+    assert rules == {"FX305", "FX308"}
+
+
+def test_exported_strategy_validates_clean(tmp_path):
+    """save_strategy -> validate_strategy_doc round-trip: the files the
+    repo itself exports replay clean through fxlint --strategy."""
+    from flexflow_tpu.search.strategy_io import save_strategy
+
+    path = tmp_path / "dp.json"
+    save_strategy(data_parallel_strategy(2), str(path))
+    with open(path) as f:
+        doc = json.load(f)
+    assert validate_strategy_doc(doc, num_devices=2) == []
+    assert validate_strategy_doc(doc, num_devices=1) != []  # bounds
