@@ -37,6 +37,14 @@ kernel runs in Pallas interpret mode and the artifact records
 CORRECTNESS (greedy streams identical, step counts equal); TPU runs
 fill in the real throughput ratio.
 
+--serve-async mode (writes BENCH_ASYNC.json): the double-buffered
+async engine vs the synchronous reference loop — token-identical
+greedy streams required; mean tokens/s over interleaved reps plus
+overlap_fraction (host work hidden behind device execution). Combined
+with --chaos, the chaos gate below runs the ASYNC loop instead
+(writes BENCH_CHAOS_ASYNC.json) — same zero-lost-requests and
+invariant assertions, now probed inside the in-flight window.
+
 --chaos mode (writes BENCH_CHAOS.json): a seeded FaultInjector
 (serving/faults.py) runs the mixed stream under OPTIMISTIC admission on
 an undersized page pool while injecting NaN logits, mid-flight
@@ -495,6 +503,93 @@ def run_decode_kernel(
     }
 
 
+def run_async(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    reps: int = 4,
+):
+    """Async double-buffered engine (--serve-async) vs the synchronous
+    reference loop on the SAME engine and the standard mixed stream.
+
+    Token identity: greedy streams must match the sync scheduler's
+    exactly (the step sequence per slot is unchanged — only the
+    dispatch/reconcile timing moves). Throughput compares MEANS over
+    interleaved reps, not best-of: on CPU the step is host-bound (the
+    device finishes each ~100µs step long before the ~ms of host
+    scheduling around it), so the async win there is tail behavior —
+    the pipeline absorbs host jitter that serializes into the sync
+    loop's wall clock — and best-of-N reports exactly the lucky run
+    where no jitter happened. overlap_fraction is the structural
+    number: fraction of each dispatch→reconcile window the host spent
+    working instead of blocked (sync ≈ half its tiny window by
+    construction of the measurement; async ≈ 1). The wall-clock ratio
+    on real accelerators — where the device step dwarfs host work and
+    overlap converts directly into throughput — awaits TPU hardware."""
+    from flexflow_tpu.serving import (
+        AsyncContinuousBatchingScheduler,
+        ContinuousBatchingScheduler,
+        ServeConfig,
+        build_scheduler,
+    )
+
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+
+    def requests():
+        return _mixed_requests(vocab, max_len, num_requests)
+
+    serve = ServeConfig(max_seqs=max_seqs, max_seq_len=max_len)
+    _, engine, _ = build_scheduler(model, serve)
+    modes = (
+        ("sync", ContinuousBatchingScheduler),
+        ("async", AsyncContinuousBatchingScheduler),
+    )
+    for _, cls in modes:  # warm every jit signature off the clock
+        cls(engine).run(requests()[: max_seqs + 1])
+    tps = {name: [] for name, _ in modes}
+    stats = {}
+    streams = {}
+    for _ in range(reps):  # interleaved: both modes see the same drift
+        for name, cls in modes:
+            sched = cls(engine)
+            done = sched.run(requests())
+            tps[name].append(sched.stats.tokens_per_s)
+            stats[name] = sched.stats
+            streams.setdefault(
+                name, {r.rid: tuple(r.generated) for r in done}
+            )
+    mean = {n: sum(v) / len(v) for n, v in tps.items()}
+    matched = sum(
+        1
+        for rid in streams["sync"]
+        if streams["async"].get(rid) == streams["sync"][rid]
+    )
+    return {
+        "metric": f"serve_async_engine_{layers}L_{hidden}h",
+        "value": round(mean["async"], 2),
+        "unit": "tokens/s",
+        # async over sync mean decode throughput, identical greedy
+        # streams (CPU target: >= 1.0 — parity plus jitter absorption;
+        # the overlap win in wall clock awaits TPU hardware)
+        "vs_baseline": round(mean["async"] / mean["sync"], 3),
+        "sync_tokens_per_s": round(mean["sync"], 2),
+        "best_async_tokens_per_s": round(max(tps["async"]), 2),
+        "best_sync_tokens_per_s": round(max(tps["sync"]), 2),
+        "reps": reps,
+        "overlap_fraction": round(stats["async"].overlap_fraction, 3),
+        "sync_overlap_fraction": round(stats["sync"].overlap_fraction, 3),
+        "mean_dispatch_gap_ms": round(
+            stats["async"].mean_dispatch_gap_s * 1e3, 3
+        ),
+        "streams_match": f"{matched}/{len(streams['sync'])}",
+        "tpu_ratio": "pending hardware",
+    }
+
+
 def run_chaos(
     layers: int,
     hidden: int,
@@ -505,6 +600,7 @@ def run_chaos(
     num_requests: int,
     reps: int = 2,
     seed: int = 0,
+    serve_async: bool = False,
 ):
     """Seeded chaos run: optimistic admission on a page pool sized to
     FORCE preemption, plus injected NaN logits, cancellations, latency
@@ -534,6 +630,7 @@ def run_chaos(
         kv_pages=num_pages,
         admission="optimistic",
         max_preemptions=6,
+        serve_async=serve_async,
     )
     plan = FaultPlan(
         nan_rate=0.01,
@@ -555,7 +652,10 @@ def run_chaos(
     import time as _time
 
     t0 = _time.perf_counter()
-    while sched.queue or sched.running:
+    # the async loop also drains its in-flight pipeline; invariants are
+    # probed INSIDE the in-flight window every iteration (pinned pages
+    # are part of the accounting, not an exemption)
+    while sched._work_pending():
         sched.step()
         cache.check_invariants(extra_free=injector.stolen_pages)
     sched.stats.elapsed_s += _time.perf_counter() - t0
@@ -579,7 +679,9 @@ def run_chaos(
             f"!= {s.submitted_requests} submitted"
         )
     return {
-        "metric": f"serve_chaos_{layers}L_{hidden}h",
+        "metric": f"serve_chaos_{layers}L_{hidden}h"
+        + ("_async" if serve_async else ""),
+        "serve_async": serve_async,
         # goodput under faults: tokens of successfully FINISHED requests
         "value": round(s.goodput_tokens_per_s, 2),
         "unit": "goodput_tokens/s",
@@ -627,6 +729,7 @@ def main():
     spec_k = 4
     seed = 0
     decode_kernel = "pallas"
+    serve_async = False
     argv = sys.argv[1:]
     i = 0
     while i < len(argv):
@@ -639,6 +742,10 @@ def main():
             mode = "spec"
         elif a == "--chaos":
             mode = "chaos"
+        elif a == "--serve-async":
+            # alone: the sync-vs-async comparison (BENCH_ASYNC.json);
+            # with --chaos: the chaos gate runs the async loop
+            serve_async = True
         elif a == "--seed":
             i += 1
             seed = int(argv[i])
@@ -675,10 +782,21 @@ def main():
             json.dump(result, f, indent=2)
             f.write("\n")
     elif mode == "chaos":
-        result = run_chaos(seed=seed, **args)
-        with open(os.path.join(here, "BENCH_CHAOS.json"), "w") as f:
+        result = run_chaos(seed=seed, serve_async=serve_async, **args)
+        name = "BENCH_CHAOS_ASYNC.json" if serve_async else "BENCH_CHAOS.json"
+        with open(os.path.join(here, name), "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
+    elif serve_async:
+        result = run_async(**args)
+        with open(os.path.join(here, "BENCH_ASYNC.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        if result["vs_baseline"] < 0.95 or result["overlap_fraction"] <= 0:
+            raise SystemExit(
+                f"async engine regressed: {result['vs_baseline']}x sync, "
+                f"overlap {result['overlap_fraction']}"
+            )
     else:
         result = run(**args)
     print(json.dumps(result))

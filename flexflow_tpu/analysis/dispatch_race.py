@@ -25,6 +25,16 @@ findings go to the baseline):
 * **FX102** — the same un-snapshotted attribute passed directly to a
   callable that was bound from ``jax.jit(...)`` (the array would be
   committed to the queue by the call itself).
+* **FX103** — reconcile-phase code (a function taking an
+  ``InflightStep`` — by annotation, or a parameter named ``step``/
+  ``inflight``) loading a mutated attribute through a ``cache`` object
+  instead of the step record. The async double-buffered engine commits
+  a step's results one iteration after its dispatch; by reconcile time
+  ``cache.lengths`` / ``cache.block_tables`` describe the NEXT step,
+  so acceptance/rollback/emit decisions made against them are wrong
+  exactly when the pipeline is full — the reconcile must read the
+  ``InflightStep`` snapshot (``step.lengths``, ``step.active``,
+  ``step.participants``) and nothing else.
 """
 
 from __future__ import annotations
@@ -41,7 +51,11 @@ from flexflow_tpu.analysis.diagnostics import (
 RULES = {
     "FX101": "mutable host attribute into jnp.asarray without a snapshot",
     "FX102": "mutable host attribute passed raw into a jitted callable",
+    "FX103": "reconcile reads live cache state instead of the "
+    "InflightStep snapshot",
 }
+
+_STEP_PARAM_NAMES = {"step", "inflight"}
 
 _ASARRAY_CHAINS = {("jnp", "asarray"), ("jax", "numpy", "asarray")}
 _SNAPSHOT_NAMES = {"snapshot"}
@@ -127,9 +141,88 @@ def _tainted_loads(
     return found
 
 
+def _annotation_names(node: ast.AST) -> Set[str]:
+    """Every dotted/string name appearing in an annotation expression
+    (handles Optional["InflightStep"], engine.InflightStep, etc.)."""
+    names: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            names.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            names.add(sub.attr)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            names.add(sub.value.rsplit(".", 1)[-1])
+    return names
+
+
+def _step_params(fn) -> Set[str]:
+    """Parameter names of `fn` that carry an InflightStep — annotated
+    as one, or conventionally named step/inflight. Non-empty marks the
+    function as reconcile-phase code — EXCEPT dispatch-side functions
+    ('dispatch' in the name): they take the snapshot, so they read live
+    state by definition (e.g. decode_dispatch's `chain` step is a
+    device-token source, not a commit target)."""
+    if "dispatch" in fn.name:
+        return set()
+    params: Set[str] = set()
+    args = list(fn.args.posonlyargs) + list(fn.args.args) + list(
+        fn.args.kwonlyargs
+    )
+    for a in args:
+        if a.arg in _STEP_PARAM_NAMES:
+            params.add(a.arg)
+        elif a.annotation is not None and (
+            "InflightStep" in _annotation_names(a.annotation)
+        ):
+            params.add(a.arg)
+    return params
+
+
+def _reconcile_violations(
+    fn, mutated: Set[str]
+) -> List[Tuple[str, int]]:
+    """(attr, line) for loads of a mutated attribute reached through a
+    `cache` object inside a reconcile-phase function — live allocator/
+    length state the snapshot on the step record exists to replace.
+    Loads through the step parameter (step.lengths) and non-cache state
+    (self.running, self.stats) are the sanctioned paths."""
+    found: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and node.attr in mutated
+        ):
+            continue
+        chain = name_chain(node)
+        if chain is not None and "cache" in chain[:-1]:
+            found.append((node.attr, node.lineno))
+    return found
+
+
 def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
     mutated = collect_mutated_attrs(trees)
     diags: List[Diagnostic] = []
+    for path, tree in trees.items():
+        for node in ast.walk(tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if not _step_params(node):
+                continue
+            for attr, line in _reconcile_violations(node, mutated):
+                diags.append(
+                    Diagnostic(
+                        "FX103",
+                        path,
+                        line,
+                        f"reconcile-phase function '{node.name}' reads "
+                        f"live 'cache.{attr}' — between dispatch and "
+                        "reconcile that state belongs to the NEXT step; "
+                        "read the InflightStep snapshot instead",
+                    )
+                )
     for path, tree in trees.items():
         jitted = collect_jitted_names(tree)
         for node in ast.walk(tree):

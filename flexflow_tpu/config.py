@@ -128,6 +128,9 @@ class FFConfig:
     # --max-preemptions per request
     serve_admission: str = "reserve"
     serve_max_preemptions: int = 3
+    # --serve-async: the double-buffered engine loop — dispatch step
+    # N+1 while N is in flight, reconcile terminal events one step late
+    serve_async: bool = False
     # --check-invariants: run cache.check_invariants() every scheduler
     # iteration (the chaos harness's probe) — debugging/CI posture
     serve_check_invariants: bool = False
@@ -266,6 +269,8 @@ class FFConfig:
                 cfg.serve_admission = take()
             elif a == "--max-preemptions":
                 cfg.serve_max_preemptions = int(take())
+            elif a == "--serve-async":
+                cfg.serve_async = True
             elif a == "--check-invariants":
                 cfg.serve_check_invariants = True
             # silently accept remaining legion-style flags with one value

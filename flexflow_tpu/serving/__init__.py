@@ -22,7 +22,11 @@ from flexflow_tpu.serving.api import (
     build_scheduler,
     generate,
 )
-from flexflow_tpu.serving.engine import GenerationEngine, snapshot
+from flexflow_tpu.serving.engine import (
+    GenerationEngine,
+    InflightStep,
+    snapshot,
+)
 from flexflow_tpu.serving.faults import (
     DraftFault,
     FaultError,
@@ -40,6 +44,7 @@ from flexflow_tpu.serving.kv_cache import (
 )
 from flexflow_tpu.serving.scheduler import (
     TERMINAL_STATUSES,
+    AsyncContinuousBatchingScheduler,
     ContinuousBatchingScheduler,
     Request,
     RequestStatus,
@@ -60,6 +65,7 @@ __all__ = [
     "build_proposer",
     "build_scheduler",
     "GenerationEngine",
+    "InflightStep",
     "snapshot",
     "KVCache",
     "KVCacheSpec",
@@ -69,6 +75,7 @@ __all__ = [
     "Request",
     "RequestStatus",
     "TERMINAL_STATUSES",
+    "AsyncContinuousBatchingScheduler",
     "ContinuousBatchingScheduler",
     "StaticBatchingScheduler",
     "SchedulerStats",

@@ -16,6 +16,7 @@ from typing import List, Optional, Sequence, Tuple
 from flexflow_tpu.serving.engine import GenerationEngine
 from flexflow_tpu.serving.kv_cache import KVCache, PagedKVCache
 from flexflow_tpu.serving.scheduler import (
+    AsyncContinuousBatchingScheduler,
     ContinuousBatchingScheduler,
     Request,
     StaticBatchingScheduler,
@@ -69,6 +70,12 @@ class ServeConfig:
     # request before hard FAILED. The slot layout ignores both.
     admission: str = "reserve"
     max_preemptions: int = 3
+    # async double-buffered engine (--serve-async): overlap host
+    # scheduling with device steps — dispatch step N+1 while N is in
+    # flight, reconcile terminal events one step late
+    # (AsyncContinuousBatchingScheduler). Continuous scheduler only;
+    # the sync loop stays the token-identical reference.
+    serve_async: bool = False
     # debug: re-run cache.check_invariants() after every scheduler
     # iteration (--check-invariants). Off by default — the full
     # allocator re-derivation is O(slots × pages) per iteration, a
@@ -83,6 +90,11 @@ class ServeConfig:
             )
         if self.max_seqs < 1 or self.max_seq_len < 2:
             raise ValueError("max_seqs >= 1 and max_seq_len >= 2 required")
+        if self.serve_async and self.scheduler != "continuous":
+            raise ValueError(
+                "serve_async requires the continuous scheduler (the "
+                "static baseline is deliberately synchronous)"
+            )
         if self.temperature < 0.0:
             raise ValueError(
                 f"temperature must be >= 0, got {self.temperature}"
@@ -141,6 +153,7 @@ class ServeConfig:
             decode_kernel=cfg.serve_decode_kernel,
             admission=cfg.serve_admission,
             max_preemptions=cfg.serve_max_preemptions,
+            serve_async=cfg.serve_async,
             debug_invariants=cfg.serve_check_invariants,
         )
 
@@ -203,7 +216,12 @@ def build_scheduler(model, serve: ServeConfig, draft_model=None, injector=None):
         decode_kernel=serve.decode_kernel,
         injector=injector,
     )
-    sched = _SCHEDULERS[serve.scheduler](
+    cls = _SCHEDULERS[serve.scheduler]
+    if serve.serve_async:
+        # __post_init__ already pinned serve_async to the continuous
+        # scheduler; the async loop is its double-buffered subclass
+        cls = AsyncContinuousBatchingScheduler
+    sched = cls(
         engine,
         proposer=build_proposer(serve, draft_model),
         spec_k=serve.spec_k,

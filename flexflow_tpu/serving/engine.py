@@ -50,6 +50,15 @@ verify at `[max_seqs, w]` per draft width, so compile count is
 does not change the compile-count contract (tables are data, not
 shape).
 
+Every decode/verify is split into **dispatch** (enqueue the jitted
+step, commit the functional cache arrays, snapshot the mutable host
+state onto an `InflightStep`) and **reconcile** (block on the device
+futures one call — or, under the async scheduler, one iteration —
+later). `decode()`/`verify()` are the synchronous wrappers; the async
+loop holds the `InflightStep` across an iteration and chains the next
+step's input tokens from its `device_next` so the inter-step data
+dependency resolves entirely on device.
+
 Greedy argmax is the default (temperature 0); temperature sampling
 derives a PRNG key per (serve seed, slot, cache position), so a
 request's sampled stream depends only on its slot and its own tokens —
@@ -60,7 +69,10 @@ rejection-sampling verify needs.
 
 from __future__ import annotations
 
-from typing import Dict, Sequence, Tuple
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -82,6 +94,39 @@ def snapshot(host_state: np.ndarray):
     import jax.numpy as jnp
 
     return jnp.asarray(np.array(host_state))
+
+
+@dataclasses.dataclass
+class InflightStep:
+    """One dispatched-but-not-reconciled engine step.
+
+    The async double-buffered loop splits every decode/verify into a
+    *dispatch* (enqueue the jitted step on the device queue, commit the
+    functional cache arrays, return immediately) and a *reconcile*
+    (block on the device outputs, emit tokens, retire requests) that
+    runs one iteration later. This record is the only thing allowed to
+    cross that gap: it carries an immutable HOST SNAPSHOT of everything
+    the reconcile needs — the pre-step lengths, the active mask, the
+    participating Request identities — so reconcile logic never reads
+    live scheduler/cache state the host has since mutated (fxlint FX103
+    enforces exactly that discipline), plus the device futures the
+    reconcile blocks on.
+    """
+
+    kind: str  # "decode" | "verify"
+    dispatch_t: float  # wall clock at dispatch (overlap accounting)
+    active: np.ndarray  # bool [max_seqs] — slots the step ran for
+    lengths: np.ndarray  # int32 [max_seqs] — cache lengths BEFORE the step
+    host_tokens: Optional[np.ndarray] = None  # decode: host-view input tokens
+    draft_lens: Optional[np.ndarray] = None  # verify: rows per slot
+    # device futures (JAX arrays still computing behind the queue)
+    device_next: object = None  # decode: sampled tokens [max_seqs]
+    device_logits: object = None  # [max_seqs, V] or [max_seqs, w, V]
+    # scheduler-side snapshot: slot -> Request identity at dispatch,
+    # verify draft plan, and the dispatching iteration (fault keying)
+    participants: Dict[int, object] = dataclasses.field(default_factory=dict)
+    plan: Optional[Dict[int, list]] = None
+    iteration: int = -1
 
 
 class GenerationEngine:
@@ -160,9 +205,39 @@ class GenerationEngine:
         )
         # one jitted prefill per length bucket / one jitted verify per
         # draft width (jit caches by shape anyway; the explicit dicts make
-        # the compile-count contract inspectable)
+        # the compile-count contract inspectable). The verify cache is a
+        # bounded LRU: draft widths vary with optimize_spec_k re-tuning
+        # and per-request budget caps, and an unbounded dict kept every
+        # width's jitted program (and its device executable) alive for
+        # the engine's whole life.
         self._prefill_cache: Dict[int, object] = {}
-        self._verify_cache: Dict[int, object] = {}
+        self._verify_cache: "OrderedDict[int, object]" = OrderedDict()
+        self.verify_cache_max = 8
+
+    @property
+    def verify_cache_entries(self) -> int:
+        """Live jitted verify programs (LRU-bounded by
+        `verify_cache_max`) — surfaced as a SchedulerStats field so a
+        width-churning workload's compile footprint is observable."""
+        return len(self._verify_cache)
+
+    def _verify_fn(self, w: int):
+        """The jitted verify program for draft width `w`, LRU-managed:
+        a hit refreshes recency, a miss traces a new program and evicts
+        the least-recently-used width past `verify_cache_max`."""
+        import jax
+
+        fn = self._verify_cache.get(w)
+        if fn is None:
+            fn = jax.jit(
+                self._verify_impl_paged if self.paged else self._verify_impl
+            )
+            self._verify_cache[w] = fn
+            while len(self._verify_cache) > max(1, self.verify_cache_max):
+                self._verify_cache.popitem(last=False)
+        else:
+            self._verify_cache.move_to_end(w)
+        return fn
 
     # -- kernel-failure fallback ---------------------------------------------
 
@@ -500,16 +575,29 @@ class GenerationEngine:
         slots = jnp.arange(lengths.shape[0])
         return new_k, new_v, self._pick(logits, slots, lengths + 1), logits
 
-    def decode(
+    def decode_dispatch(
         self,
         params,
         tokens: np.ndarray,
         active_mask: np.ndarray,
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """One decode iteration over every slot. tokens [max_seqs] (last
-        emitted token per slot; free slots can carry anything), active_mask
-        [max_seqs] bool. Writes the cache, bumps active lengths, returns
-        (next_tokens [max_seqs], logits [max_seqs, V])."""
+        chain: Optional[InflightStep] = None,
+        chain_mask: Optional[np.ndarray] = None,
+    ) -> InflightStep:
+        """Enqueue one decode iteration WITHOUT blocking on its outputs.
+
+        tokens [max_seqs] (last emitted token per slot; free slots can
+        carry anything), active_mask [max_seqs] bool. The functional
+        cache arrays commit immediately (they are device futures — the
+        next dispatch chains on them on-device) and active lengths bump,
+        so the host's view is reserved-one-step-ahead; the sampled
+        tokens/logits stay device futures on the returned InflightStep
+        until `decode_reconcile`.
+
+        `chain` + `chain_mask` pipeline two decode steps with no host
+        round-trip: where chain_mask is set, the input token comes from
+        the in-flight `chain` step's device_next instead of the host
+        `tokens` row — the data dependency between step N and N+1
+        resolves entirely on device."""
         import jax.numpy as jnp
 
         args = []
@@ -522,13 +610,34 @@ class GenerationEngine:
                     int(slot), int(self.cache.lengths[slot])
                 )
             args = [snapshot(self.cache.block_tables)]
+        host_tokens = np.asarray(tokens, dtype=np.int32)
+        mask = (
+            np.asarray(chain_mask, dtype=bool)
+            if chain is not None and chain_mask is not None
+            else None
+        )
+        if mask is None or not mask.any():
+            dev_tokens = jnp.asarray(host_tokens)
+        elif mask.all() or np.array_equal(
+            mask, np.asarray(active_mask, dtype=bool)
+        ):
+            # steady state: every stepped slot chains on the in-flight
+            # step — its device_next IS the token vector (inactive rows
+            # carry garbage the active mask already hides)
+            dev_tokens = chain.device_next
+        else:
+            # device_next is already int32 (_pick's contract)
+            dev_tokens = jnp.where(
+                jnp.asarray(mask), chain.device_next, jnp.asarray(host_tokens)
+            )
+        lengths_snap = np.array(self.cache.lengths)
         # snapshot() every mutable host array (lengths += 1 below,
         # allocator table edits between iterations mutate behind the
         # async dispatch queue); the locals built above are fresh per
         # call and safe to hand over directly
         step_args = (
             params,
-            jnp.asarray(tokens, dtype=jnp.int32)[:, None],
+            dev_tokens[:, None],
             snapshot(self.cache.lengths),
             jnp.asarray(active_mask),
             *args,
@@ -540,7 +649,47 @@ class GenerationEngine:
         )
         self.cache.commit(new_k, new_v)
         self.cache.lengths[np.asarray(active_mask)] += 1
-        return np.asarray(nxt), np.asarray(logits)
+        # the in-flight window pins pages this step's snapshot tables
+        # reference; decode_reconcile closes it
+        self.cache.begin_inflight()
+        return InflightStep(
+            kind="decode",
+            dispatch_t=time.perf_counter(),
+            active=np.array(active_mask, dtype=bool),
+            lengths=lengths_snap,
+            host_tokens=host_tokens,
+            device_next=nxt,
+            device_logits=logits,
+        )
+
+    def decode_reconcile(
+        self, step: InflightStep
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Block on a dispatched decode step's device outputs and close
+        its in-flight window. Returns (next_tokens [max_seqs], logits
+        [max_seqs, V]) as host arrays. Everything else the caller needs
+        lives on the step record's snapshots — by the time this runs,
+        live cache/scheduler state is one iteration ahead."""
+        try:
+            nxt = np.asarray(step.device_next)
+            logits = np.asarray(step.device_logits)
+        finally:
+            self.cache.end_inflight()
+        return nxt, logits
+
+    def decode(
+        self,
+        params,
+        tokens: np.ndarray,
+        active_mask: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One decode iteration over every slot — the synchronous wrapper
+        (dispatch + immediate reconcile); the async loop calls the two
+        halves an iteration apart. Writes the cache, bumps active
+        lengths, returns (next_tokens [max_seqs], logits [max_seqs, V])."""
+        return self.decode_reconcile(
+            self.decode_dispatch(params, tokens, active_mask)
+        )
 
     # -- verify (speculative decoding) ---------------------------------------
 
@@ -666,27 +815,25 @@ class GenerationEngine:
         logits = self._forward_logits(params, tokens, hook)
         return new_k, new_v, logits
 
-    def verify(
+    def verify_dispatch(
         self,
         params,
         tokens: np.ndarray,
         draft_lens: np.ndarray,
-    ) -> np.ndarray:
-        """Score w token positions per slot through the KV cache in one
-        prefill-shaped call (SpecInfer's verify step). tokens
-        [max_seqs, w]: column 0 is the slot's last emitted token (the
-        one plain decode would feed), columns 1..draft_lens[s]-1 its
-        drafted continuation; rows with draft_lens 0 are inactive.
-        Writes the w K/V rows into the cache (paged slots claim the
-        pages those rows need first — the admission reserve covers them
-        as long as the caller keeps drafts inside the request's declared
-        worst case) but does NOT advance lengths: the caller inspects
-        the returned logits [max_seqs, w, V], accepts a prefix of the
-        drafts, and commits/rolls back with cache.truncate(slot,
-        new_len) — the paged layout returns the pages past the accepted
-        length to the free pool there. One jitted program per draft
-        width w, cached like the prefill buckets."""
-        import jax
+    ) -> InflightStep:
+        """Enqueue one verify step (SpecInfer's scoring call) WITHOUT
+        blocking on its logits. tokens [max_seqs, w]: column 0 is the
+        slot's last emitted token (the one plain decode would feed),
+        columns 1..draft_lens[s]-1 its drafted continuation; rows with
+        draft_lens 0 are inactive. Writes the w K/V rows into the cache
+        (paged slots claim the pages those rows need first — the
+        admission reserve covers them as long as the caller keeps
+        drafts inside the request's declared worst case) but does NOT
+        advance lengths: `verify_reconcile` hands back the logits
+        [max_seqs, w, V], and the caller accepts a prefix of the drafts
+        against the step's SNAPSHOT lengths, committing/rolling back
+        with cache.truncate(slot, new_len). One jitted program per
+        draft width w, LRU-cached (`verify_cache_max`)."""
         import jax.numpy as jnp
 
         spec = self.cache.spec
@@ -718,9 +865,10 @@ class GenerationEngine:
                 for p in range(start, start + int(draft_lens[slot])):
                     self.cache.ensure_position(int(slot), p)
             args = [snapshot(self.cache.block_tables)]
+        lengths_snap = np.array(self.cache.lengths)
         # snapshot() lengths/tables: the caller truncates the cache
-        # right after this returns, and jnp.asarray's host read is
-        # deferred behind the dispatch queue — see decode()
+        # right after the reconcile, and jnp.asarray's host read is
+        # deferred behind the dispatch queue — see decode_dispatch()
         step_args = (
             params,
             jnp.asarray(tokens),
@@ -734,14 +882,37 @@ class GenerationEngine:
         def call():
             # resolved inside the dispatch so a kernel fallback's
             # cleared cache re-traces with the dense attention core
-            fn = self._verify_cache.get(w)
-            if fn is None:
-                fn = jax.jit(
-                    self._verify_impl_paged if self.paged else self._verify_impl
-                )
-                self._verify_cache[w] = fn
-            return fn(*step_args)
+            return self._verify_fn(w)(*step_args)
 
         new_k, new_v, logits = self._dispatch("verify", call)
         self.cache.commit(new_k, new_v)
-        return np.asarray(logits)
+        self.cache.begin_inflight()
+        return InflightStep(
+            kind="verify",
+            dispatch_t=time.perf_counter(),
+            active=np.asarray(draft_lens) > 0,
+            lengths=lengths_snap,
+            draft_lens=np.array(draft_lens),
+            device_logits=logits,
+        )
+
+    def verify_reconcile(self, step: InflightStep) -> np.ndarray:
+        """Block on a dispatched verify step's logits and close its
+        in-flight window. Acceptance/rollback decisions belong to the
+        caller, made against the step record's SNAPSHOT lengths."""
+        try:
+            return np.asarray(step.device_logits)
+        finally:
+            self.cache.end_inflight()
+
+    def verify(
+        self,
+        params,
+        tokens: np.ndarray,
+        draft_lens: np.ndarray,
+    ) -> np.ndarray:
+        """Synchronous verify (dispatch + immediate reconcile): returns
+        the logits [max_seqs, w, V] as a host array."""
+        return self.verify_reconcile(
+            self.verify_dispatch(params, tokens, draft_lens)
+        )

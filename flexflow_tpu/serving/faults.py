@@ -37,7 +37,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import Counter
-from typing import Dict, List, Mapping, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -136,9 +136,12 @@ class FaultInjector:
         # pages stolen from a paged cache's free pool: [(page, release_iter)]
         self._stolen: List[Tuple[int, int]] = []
 
-    def _rng(self, site: str, key: int = 0) -> np.random.Generator:
+    def _rng(
+        self, site: str, key: int = 0, iteration: Optional[int] = None
+    ) -> np.random.Generator:
+        it = self._iter if iteration is None else int(iteration)
         return np.random.default_rng(
-            [self.seed, self._iter, _SITE[site], int(key) & 0x7FFFFFFF]
+            [self.seed, it, _SITE[site], int(key) & 0x7FFFFFFF]
         )
 
     @property
@@ -204,7 +207,9 @@ class FaultInjector:
             heapq.heappush(cache._free_pages, page)
         self._stolen = []
 
-    def corrupt_logits(self, logits: np.ndarray, slots, rows=None) -> List[int]:
+    def corrupt_logits(
+        self, logits: np.ndarray, slots, rows=None, iteration=None
+    ) -> List[int]:
         """Overwrite the listed-or-drawn slots' logits rows with NaN in
         place (logits is a host-side array a step returned). The fault
         schedule is keyed by SLOT id; `rows` maps each slot to its row
@@ -212,16 +217,25 @@ class FaultInjector:
         per admitted request, decode/verify one row per slot). Returns
         the corrupted slots. The scheduler's finite guard — not this
         method — decides what happens next, exactly as it would for a
-        model-produced NaN."""
+        model-produced NaN.
+
+        `iteration` re-keys the schedule for the async engine's
+        in-flight window: a step DISPATCHED at iteration i reconciles —
+        and has its logits corrupted — an iteration later, so the async
+        scheduler passes the step's dispatch iteration and a seeded
+        `nan_iters={i: [slot]}` plan lands on the same step it would
+        hit under the sync loop."""
         plan = self.plan
+        it = self._iter if iteration is None else int(iteration)
         slots = [int(s) for s in slots]
         rows = slots if rows is None else [int(r) for r in rows]
         hit: List[int] = []
-        scheduled = set(plan.nan_iters.get(self._iter, ()))
+        scheduled = set(plan.nan_iters.get(it, ()))
         for slot, row in sorted(zip(slots, rows)):
             if slot in scheduled or (
                 plan.nan_rate > 0.0
-                and self._rng("nan", slot).random() < plan.nan_rate
+                and self._rng("nan", slot, iteration=it).random()
+                < plan.nan_rate
             ):
                 logits[row] = np.nan
                 hit.append(slot)

@@ -248,6 +248,29 @@ class KVCache:
         self.lengths = np.zeros(spec.max_seqs, dtype=np.int32)
         self._free: List[int] = list(range(spec.max_seqs))
         self._active: set = set()
+        self._inflight_depth = 0
+
+    # -- in-flight window (async dispatch) -----------------------------------
+
+    def begin_inflight(self) -> None:
+        """Open an in-flight window: a dispatched-but-not-reconciled step
+        references this cache's state. The slot layout needs no pinning
+        — a stale write from an in-flight step lands at a position the
+        next occupant overwrites before its lengths mask ever exposes it
+        — so the window is pure depth bookkeeping here; the paged twin
+        pins freed pages for the window's duration."""
+        self._inflight_depth += 1
+
+    def end_inflight(self) -> None:
+        if self._inflight_depth <= 0:
+            raise RuntimeError("end_inflight without a matching begin_inflight")
+        self._inflight_depth -= 1
+
+    @property
+    def pinned_pages(self) -> int:
+        """Signature parity with PagedKVCache (the slot layout pins
+        nothing)."""
+        return 0
 
     # -- slot management (host side) ----------------------------------------
 
@@ -440,6 +463,65 @@ class PagedKVCache:
         self._max_pages = np.zeros(spec.max_seqs, dtype=np.int64)
         self._reserved = 0
         self._optimistic: set = set()
+        # in-flight window (async dispatch): while a dispatched step's
+        # deferred device reads may still reference the block tables it
+        # was handed, pages released by free/truncate go to _limbo
+        # instead of the free heap — handing them to a new sequence
+        # would let its prefill race the in-flight step's stale write.
+        # Windows open at dispatch and close at reconcile IN ORDER, and
+        # the steady-state pipeline (dispatch N+1, then reconcile N)
+        # keeps one window open at all times — so limbo entries are
+        # tagged with the NEWEST window open at release time and drain
+        # as soon as that window closes, not when the (never-idle)
+        # depth hits zero.
+        self._window_seq = 0  # id of the most recently opened window
+        self._window_closed = 0  # window ids <= this have reconciled
+        self._limbo: List[Tuple[int, int]] = []  # (page, wait-for window id)
+
+    # -- in-flight window (async dispatch) -----------------------------------
+
+    @property
+    def _inflight_depth(self) -> int:
+        return self._window_seq - self._window_closed
+
+    def begin_inflight(self) -> None:
+        """Open an in-flight window: a dispatched-but-not-reconciled
+        step holds a snapshot of the block tables, so any page released
+        while the window is open is PINNED (moved to the limbo list,
+        not the free heap) until every step dispatched before the
+        release has reconciled — optimistic preemption or an EOS retire
+        during the window cannot hand an in-flight page to a new
+        sequence."""
+        self._window_seq += 1
+
+    def end_inflight(self) -> None:
+        """Close the oldest open window (steps reconcile in dispatch
+        order); limbo pages waiting only on it return to the free
+        heap."""
+        if self._window_closed >= self._window_seq:
+            raise RuntimeError("end_inflight without a matching begin_inflight")
+        self._window_closed += 1
+        if self._limbo:
+            kept: List[Tuple[int, int]] = []
+            for p, wid in self._limbo:
+                if wid <= self._window_closed:
+                    heapq.heappush(self._free_pages, p)
+                else:
+                    kept.append((p, wid))
+            self._limbo = kept
+
+    @property
+    def pinned_pages(self) -> int:
+        """Pages released during an open in-flight window, unavailable
+        until the steps that could reference them reconcile (the async
+        scheduler drains the pipeline when a claim needs them back)."""
+        return len(self._limbo)
+
+    def _release_page(self, p: int) -> None:
+        if self._window_seq > self._window_closed:
+            self._limbo.append((p, self._window_seq))
+        else:
+            heapq.heappush(self._free_pages, p)
 
     # -- page/slot management (host side) ------------------------------------
 
@@ -550,6 +632,12 @@ class PagedKVCache:
             self._max_pages[slot] = self._held[slot]
             return
         if not self._free_pages:
+            if self._limbo:
+                raise PagePoolExhausted(
+                    f"free-page pool exhausted: {len(self._limbo)} pages "
+                    "pinned by an in-flight step — reconcile the pipeline "
+                    "to release them"
+                )
             raise PagePoolExhausted(
                 "free-page pool exhausted despite the admission reserve — "
                 "allocator invariant violated"
@@ -588,7 +676,7 @@ class PagedKVCache:
         for pi in range(keep, self.spec.max_pages_per_seq):
             p = int(self.block_tables[slot, pi])
             if p != sentinel:
-                heapq.heappush(self._free_pages, p)
+                self._release_page(p)
                 self.block_tables[slot, pi] = sentinel
                 self._held[slot] -= 1
         if slot in self._optimistic:
@@ -609,7 +697,7 @@ class PagedKVCache:
         for pi in range(self.spec.max_pages_per_seq):
             p = int(self.block_tables[slot, pi])
             if p != sentinel:
-                heapq.heappush(self._free_pages, p)
+                self._release_page(p)
         self.block_tables[slot, :] = sentinel
         if slot in self._optimistic:
             self._optimistic.discard(slot)
@@ -649,21 +737,34 @@ class PagedKVCache:
                 assert int(self.lengths[s]) <= len(row) * spec.page_size
         # no double allocation anywhere in the table
         assert len(live) == len(set(live))
-        # conservation: live + free (+ injector-held) is the whole pool
+        # conservation: live + free + in-flight limbo (+ injector-held)
+        # is the whole pool
+        limbo = [p for p, _ in self._limbo]
+        assert len(limbo) == len(set(limbo))
         assert set(live).isdisjoint(self._free_pages)
-        assert len(live) + len(self._free_pages) + extra_free == (
-            spec.num_pages
-        )
+        assert set(live).isdisjoint(limbo)
+        assert set(limbo).isdisjoint(self._free_pages)
+        assert len(live) + len(self._free_pages) + len(limbo) + (
+            extra_free
+        ) == spec.num_pages
+        # limbo pages only exist while an in-flight window is open
+        assert self._inflight_depth >= 0
+        if self._limbo:
+            assert self._inflight_depth > 0
         # the reserve ledger re-derives from the per-slot worst cases,
         # counting only reserve-admitted slots, and never promises pages
-        # the pool doesn't have
+        # the pool doesn't have (limbo pages still honor the promise —
+        # they return to the heap before any claim that needs them, the
+        # async scheduler's drain-before-preempt rule)
         resv = sum(
             max(0, int(self._max_pages[s] - self._held[s]))
             for s in self._active
             if s not in self._optimistic
         )
         assert resv == self._reserved
-        assert 0 <= self._reserved <= len(self._free_pages) + extra_free
+        assert 0 <= self._reserved <= (
+            len(self._free_pages) + len(self._limbo) + extra_free
+        )
         # optimistic slots never carry a growth reserve
         for s in self._optimistic:
             assert s in self._active

@@ -51,6 +51,14 @@ one prefill-shaped step, so no swap-space subsystem is needed.
 and the comparison test measure against: admit a batch, decode until the
 WHOLE batch finishes, only then admit the next batch (the reference
 FFModel::generate shape, and every pre-Orca serving stack).
+
+**Async double-buffered loop** (`AsyncContinuousBatchingScheduler`,
+`--serve-async`): every decode/verify is split into a dispatch phase
+(live-state reads, snapshot taken, step enqueued) and a reconcile
+phase (device outputs committed against the snapshot) run one
+iteration apart, so host scheduling overlaps device execution. The
+synchronous schedulers run the same two phases back-to-back — ONE
+implementation, proved token-identical across both timings.
 """
 
 from __future__ import annotations
@@ -195,9 +203,27 @@ class SchedulerStats:
     draft_faults: int = 0  # proposer faults degraded to plain decode
     tokens_finished: int = 0  # Σ generated over FINISHED requests only
     # per-request latency accumulators (FINISHED requests only — a
-    # request failing before its first token has no TTFT to aggregate)
+    # request failing before its first token has no TTFT to aggregate).
+    # TTFT and decode latency are stamped at COMMIT (when _emit actually
+    # hands the token over), never at dispatch: under the async loop a
+    # token's step is enqueued an iteration before its value exists, and
+    # dispatch-time stamps would fake latencies exactly as deep as the
+    # pipeline.
     ttft_sum_s: float = 0.0
     decode_latency_sum_s: float = 0.0  # Σ of per-request decode_s_per_token
+    # dispatch/commit split (async double-buffered engine; the sync loop
+    # fills them too — its overlap window is just ~empty)
+    dispatch_count: int = 0  # decode/verify steps enqueued
+    dispatch_gap_sum_s: float = 0.0  # Σ wall time between consecutive dispatches
+    commit_wait_s: float = 0.0  # Σ time blocked on device outputs at reconcile
+    overlapped_host_s: float = 0.0  # Σ host work done while a step was in flight
+    # speculative pre-proposals drafted during the in-flight window
+    # (async spec mode): used as-is vs rolled back on reconcile mismatch
+    pre_proposal_hits: int = 0
+    pre_proposal_misses: int = 0
+    # live jitted verify programs in the engine's LRU (sampled at the
+    # end of each iteration — bounded by engine.verify_cache_max)
+    verify_cache_entries: int = 0
 
     @property
     def tokens_per_s(self) -> float:
@@ -234,6 +260,29 @@ class SchedulerStats:
         if not self.draft_tokens_proposed:
             return 0.0
         return self.draft_tokens_accepted / self.draft_tokens_proposed
+
+    @property
+    def mean_dispatch_gap_s(self) -> float:
+        """Mean wall time between consecutive step dispatches — the
+        host-side critical path per iteration. Under the async loop
+        this is what bounds throughput (the device works through the
+        gap); under the sync loop it includes the device wait."""
+        if self.dispatch_count <= 1:
+            return 0.0
+        return self.dispatch_gap_sum_s / (self.dispatch_count - 1)
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Of the wall time between a step's dispatch and the end of
+        its reconcile, the fraction the host spent doing useful work
+        (admission, page claims, drafting, the next dispatch) instead
+        of blocked on device outputs — the number the double-buffered
+        loop exists to push toward 1.0. The sync reference loop
+        reconciles immediately after dispatching, so it sits at ~0."""
+        window = self.overlapped_host_s + self.commit_wait_s
+        if window <= 0.0:
+            return 0.0
+        return self.overlapped_host_s / window
 
     @property
     def mean_ttft_s(self) -> float:
@@ -295,6 +344,7 @@ class _SchedulerBase:
         self.stats = SchedulerStats()
         self._by_rid: Dict[int, Request] = {}
         self._iter = 0
+        self._last_dispatch_t: Optional[float] = None
 
     # -- submission / cancellation -------------------------------------------
 
@@ -474,6 +524,12 @@ class _SchedulerBase:
                     self.cache.ensure_position(slot, pos)
                     pos += 1
                 except PagePoolExhausted as e:
+                    # pages pinned by an in-flight step return to the
+                    # pool once that step reconciles — drain the
+                    # pipeline (async loop; sync has nothing in flight)
+                    # and retry before resorting to preemption
+                    if self._reclaim_inflight_pages():
+                        continue
                     if self.admission != "optimistic":
                         self._fail(req, str(e))
                         break
@@ -484,6 +540,12 @@ class _SchedulerBase:
                     self._preempt(victim)
                     # preempting may have evicted `req` itself (it was
                     # the youngest); its requeue ends the claim loop
+
+    def _reclaim_inflight_pages(self) -> bool:
+        """Hook for the async loop: reconcile any in-flight step so its
+        pinned (limbo) pages return to the free pool. The sync
+        schedulers never have a step in flight — nothing to reclaim."""
+        return False
 
     # -- shared pieces -------------------------------------------------------
 
@@ -574,38 +636,130 @@ class _SchedulerBase:
         for req in list(self.running.values()):
             self._fail(req, error)
 
-    def _decode_once(self) -> None:
-        self._secure_pages({slot: 1 for slot in self.running})
-        if not self.running:
-            return
+    def _note_dispatch(self, step) -> None:
+        self.stats.dispatch_count += 1
+        if self._last_dispatch_t is not None:
+            self.stats.dispatch_gap_sum_s += (
+                step.dispatch_t - self._last_dispatch_t
+            )
+        self._last_dispatch_t = step.dispatch_t
+
+    def _decode_dispatch_step(self, chain=None):
+        """Dispatch phase of one decode iteration: claim every page the
+        step will touch, build the token/active arrays from the LIVE
+        view (this side of the dispatch/reconcile split may read
+        mutable state — the snapshot is taken here), and enqueue the
+        jitted step. `chain` device-chains input tokens from a
+        still-in-flight previous step (async loop): slots whose last
+        token is that step's not-yet-materialized output read it on
+        device instead of from the host. Returns the InflightStep, or
+        None when there is nothing to step."""
+        # predicted-view budget gate: a slot whose still-in-flight step
+        # will emit its FINAL budgeted token has nothing useful to
+        # compute here — the commit-phase identity check would discard
+        # the result anyway. EOS is not predictable at dispatch time, so
+        # an EOS retire still costs one wasted (discarded) slot-step.
+        stepped: Dict[int, Request] = {}
+        for slot, req in self.running.items():
+            chained = (
+                chain is not None
+                and chain.kind == "decode"
+                and chain.active[slot]
+                and chain.participants.get(slot) is req
+            )
+            if len(req.generated) + int(chained) >= req.max_new_tokens:
+                continue
+            stepped[slot] = req
+        self._secure_pages({slot: 1 for slot in stepped})
+        stepped = {s: r for s, r in stepped.items() if self.running.get(s) is r}
+        if not stepped:
+            return None
         spec = self.cache.spec
         tokens = np.zeros(spec.max_seqs, dtype=np.int32)
         active = np.zeros(spec.max_seqs, dtype=bool)
-        for slot, req in self.running.items():
+        chain_mask = np.zeros(spec.max_seqs, dtype=bool)
+        for slot, req in stepped.items():
             tokens[slot] = req.generated[-1]
             active[slot] = True
+            if (
+                chain is not None
+                and chain.kind == "decode"
+                and chain.active[slot]
+                and chain.participants.get(slot) is req
+            ):
+                chain_mask[slot] = True
         try:
-            nxt, logits = self.engine.decode(self.params, tokens, active)
+            step = self.engine.decode_dispatch(
+                self.params,
+                tokens,
+                active,
+                chain=chain,
+                chain_mask=chain_mask if chain is not None else None,
+            )
         except Exception as e:
             self._fail_all_running(f"decode step failed: {e!r}")
-            return
+            return None
+        step.iteration = self._iter
+        step.participants = stepped
+        self._note_dispatch(step)
         self.stats.decode_steps += 1
         self.stats.slot_steps += spec.max_seqs
         self.stats.busy_slot_steps += int(active.sum())
-        active_slots = [s for s, a in enumerate(active) if a]
+        return step
+
+    def _reconcile_step(self, step) -> None:
+        """Reconcile phase: block on the step's device outputs, then
+        commit its results — under the async loop this runs one
+        iteration after the dispatch, against the step's snapshot."""
+        t0 = time.perf_counter()
+        self.stats.overlapped_host_s += max(0.0, t0 - step.dispatch_t)
+        try:
+            if step.kind == "decode":
+                nxt, logits = self.engine.decode_reconcile(step)
+            else:
+                logits = self.engine.verify_reconcile(step)
+        except Exception as e:
+            self._fail_all_running(f"{step.kind} step failed: {e!r}")
+            return
+        self.stats.commit_wait_s += time.perf_counter() - t0
+        if step.kind == "decode":
+            self._commit_decode(step, nxt, logits)
+        else:
+            self._commit_verify(step, logits)
+
+    def _commit_decode(self, step, nxt, logits) -> None:
+        """Commit a reconciled decode step: NaN isolation, token emit,
+        EOS/budget retirement. Reads ONLY the step's snapshot — live
+        scheduler/cache state is an iteration ahead under the async
+        loop (fxlint FX103 holds this path to the snapshot). A
+        participant that retired, was preempted, or whose slot was
+        re-admitted while the step was in flight fails the identity
+        check and its speculative token is discarded."""
+        active_slots = [s for s, a in enumerate(step.active) if a]
         if self.injector is not None:
             logits = np.array(logits)  # writable copy for the injector
-            self.injector.corrupt_logits(logits, active_slots)
+            self.injector.corrupt_logits(
+                logits, active_slots, iteration=step.iteration
+            )
         for slot in active_slots:
-            req = self.running.get(slot)
-            if req is None:
+            req = step.participants.get(slot)
+            if req is None or self.running.get(slot) is not req:
                 continue
             if not np.isfinite(logits[slot]).all():
                 self._fail(
-                    req, f"non-finite logits at iteration {self._iter}"
+                    req,
+                    f"non-finite logits at iteration {step.iteration}",
                 )
                 continue
             self._emit(req, int(nxt[slot]))
+
+    def _decode_once(self) -> None:
+        """Synchronous decode iteration — dispatch + immediate
+        reconcile (the reference loop the async engine is proved
+        token-identical against)."""
+        step = self._decode_dispatch_step()
+        if step is not None:
+            self._reconcile_step(step)
 
     def _propose(self, k: int) -> Dict[int, List[int]]:
         """Draft tokens for the running slots; a proposer fault (real or
@@ -620,20 +774,15 @@ class _SchedulerBase:
             self.stats.draft_faults += 1
             return {}
 
-    def _verify_once(self) -> None:
-        """One speculative iteration: draft up to spec_k tokens per slot,
-        score every slot's (last token + drafts) in ONE batched verify,
-        then per slot accept a prefix, roll the cache to the accepted
-        length (paged slots return surplus pages), and emit
-        accepted + 1 tokens. A slot whose proposer has nothing degrades
-        to draft_lens 1 — exactly a decode step. EOS inside the accepted
-        run retires the request AT the EOS position: tokens past it are
-        never emitted."""
-        from flexflow_tpu.serving.spec import accept_drafts
-
+    def _verify_dispatch_step(self, proposals):
+        """Dispatch phase of one speculative iteration: cap each slot's
+        drafts to its remaining budget and the cache horizon (live
+        reads — this is the dispatch side), claim every page the verify
+        writes, and enqueue the batched verify. Returns the
+        InflightStep (carrying the draft plan + the pre-step lengths
+        snapshot acceptance needs), or None when nothing runs."""
         spec = self.cache.spec
         k = self.spec_k
-        proposals = self._propose(k)
         plan: Dict[int, List[int]] = {}
         for slot, req in self.running.items():
             old_len = int(self.cache.lengths[slot])
@@ -653,7 +802,7 @@ class _SchedulerBase:
         self._secure_pages({s: 1 + len(d) for s, d in plan.items()})
         plan = {s: d for s, d in plan.items() if s in self.running}
         if not plan:
-            return
+            return None
         tokens = np.zeros((spec.max_seqs, k + 1), dtype=np.int32)
         draft_lens = np.zeros(spec.max_seqs, dtype=np.int32)
         for slot, drafts in plan.items():
@@ -663,27 +812,49 @@ class _SchedulerBase:
                 tokens[slot, 1 + j] = int(t)
             draft_lens[slot] = 1 + len(drafts)
         try:
-            logits = self.engine.verify(self.params, tokens, draft_lens)
+            step = self.engine.verify_dispatch(
+                self.params, tokens, draft_lens
+            )
         except Exception as e:
             self._fail_all_running(f"verify step failed: {e!r}")
-            return
+            return None
+        step.iteration = self._iter
+        step.plan = plan
+        step.participants = {s: self.running[s] for s in plan}
+        self._note_dispatch(step)
         self.stats.verify_steps += 1
         self.stats.slot_steps += spec.max_seqs
         self.stats.busy_slot_steps += len(plan)
+        return step
+
+    def _commit_verify(self, step, logits) -> None:
+        """Commit a reconciled verify step: per slot accept a prefix of
+        the drafts, roll the cache to the accepted length (paged slots
+        return surplus pages), and emit accepted + 1 tokens. Acceptance
+        runs against the step's SNAPSHOT lengths — the committed
+        pre-step lengths — never the live cache view (fxlint FX103). A
+        slot whose proposer had nothing degraded to draft_lens 1 —
+        exactly a decode step. EOS inside the accepted run retires the
+        request AT the EOS position: tokens past it are never emitted."""
+        from flexflow_tpu.serving.spec import accept_drafts
+
         if self.injector is not None:
             logits = np.array(logits)  # writable copy for the injector
-            self.injector.corrupt_logits(logits, sorted(plan))
-        for slot in sorted(plan):
-            req = self.running.get(slot)
-            if req is None:
+            self.injector.corrupt_logits(
+                logits, sorted(step.plan), iteration=step.iteration
+            )
+        for slot in sorted(step.plan):
+            req = step.participants.get(slot)
+            if req is None or self.running.get(slot) is not req:
                 continue
-            drafts = plan[slot]
-            old_len = int(self.cache.lengths[slot])
+            drafts = step.plan[slot]
+            old_len = int(step.lengths[slot])
             if not np.isfinite(logits[slot, : 1 + len(drafts)]).all():
                 # lengths never advanced for this slot; freeing it
                 # returns its pages, stale verify rows and all
                 self._fail(
-                    req, f"non-finite logits at iteration {self._iter}"
+                    req,
+                    f"non-finite logits at iteration {step.iteration}",
                 )
                 continue
             accepted, emitted = accept_drafts(
@@ -706,6 +877,14 @@ class _SchedulerBase:
                 if req.finished:
                     break  # EOS mid-verify: nothing past it is emitted
 
+    def _verify_once(self) -> None:
+        """Synchronous speculative iteration: draft up to spec_k tokens
+        per slot, dispatch ONE batched verify, and reconcile it
+        immediately."""
+        step = self._verify_dispatch_step(self._propose(self.spec_k))
+        if step is not None:
+            self._reconcile_step(step)
+
     def _generate_once(self) -> None:
         if self.proposer is not None:
             self._verify_once()
@@ -720,8 +899,14 @@ class _SchedulerBase:
         self._reap_deadlines()
 
     def _end_iteration(self) -> None:
+        self.stats.verify_cache_entries = getattr(
+            self.engine, "verify_cache_entries", 0
+        )
         if self.debug_invariants:
             self.cache.check_invariants()
+
+    def _work_pending(self) -> bool:
+        return bool(self.queue or self.running)
 
     def run(self, requests: Optional[Sequence[Request]] = None) -> List[Request]:
         """Drain the queue (plus `requests`, submitted first) to
@@ -731,7 +916,7 @@ class _SchedulerBase:
         for r in requests or ():
             self.submit(r)
         t0 = time.perf_counter()
-        while self.queue or self.running:
+        while self._work_pending():
             self.step()
         self.stats.elapsed_s += time.perf_counter() - t0
         return self.finished
@@ -749,6 +934,214 @@ class ContinuousBatchingScheduler(_SchedulerBase):
         if self.running:
             self._generate_once()
         self._end_iteration()
+
+
+class AsyncContinuousBatchingScheduler(ContinuousBatchingScheduler):
+    """Double-buffered Orca loop: overlap host scheduling with device
+    steps (`--serve-async`; the synchronous ContinuousBatchingScheduler
+    stays the reference it is proved token-identical against).
+
+    The sync loop round-trips every iteration — host admission/paging/
+    bookkeeping while the device idles, then the jitted step while the
+    host idles. This loop splits each step into its dispatch and
+    reconcile halves (engine.InflightStep) and runs them one iteration
+    apart: while step N is in flight on the device, the host reaps
+    queued deadlines, admits newcomers, claims pages, and dispatches
+    step N+1 — chaining N+1's input tokens from N's device outputs so
+    the data dependency never touches the host — and only then blocks
+    on N's outputs to emit tokens and retire requests.
+
+    One-step-stale semantics: terminal events land at RECONCILE, so a
+    request that hits EOS/budget in step N is still (wastefully but
+    harmlessly) stepped in N+1 — the identity check in the commit phase
+    discards its speculative token, the slot layout's stale cache write
+    is overwritten before any lengths mask exposes it, and the paged
+    layout pins every page an in-flight step references (kv_cache
+    limbo) so the row cannot land in a page a new sequence owns.
+    `cancel()` of a RUNNING request and running-deadline reaping defer
+    to the next reconcile for the same reason; queued requests cancel/
+    reap immediately. When a page claim finds the pool dry because of
+    pinned pages, `_reclaim_inflight_pages` drains the pipeline (a
+    stall, traded for allocator soundness) before any preemption.
+
+    Speculative mode cannot pipeline two verifies (the next verify's
+    input tokens are acceptance DECISIONS, host logic, not a device
+    array) — instead the in-flight window hides the proposer: while
+    verify N runs, a stateless proposer drafts for N+1 against N's
+    predicted (full-accept) history, rolled back at reconcile when the
+    prediction misses (stats.pre_proposal_hits/misses)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inflight: deque = deque()  # InflightStep records, oldest first
+        self._pending_cancels: set = set()
+
+    # -- one-step-stale control surface --------------------------------------
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request. Queued requests finalize immediately; a
+        RUNNING request whose slot may be referenced by an in-flight
+        step defers to the next reconcile (it may receive at most one
+        more token's worth of device work, which is discarded)."""
+        req = self._by_rid.get(rid)
+        if req is None or req.status in TERMINAL_STATUSES:
+            return False
+        if req.slot is not None and self._inflight:
+            self._pending_cancels.add(rid)
+            return True
+        return super().cancel(rid)
+
+    def _reap_deadlines(self) -> None:
+        now = time.perf_counter()
+        for req in [r for r in self.queue if r.deadline_exceeded(now)]:
+            self._finalize(req, RequestStatus.TIMED_OUT)
+        if not self._inflight:
+            for req in [
+                r
+                for r in list(self.running.values())
+                if r.deadline_exceeded(now)
+            ]:
+                self._finalize(req, RequestStatus.TIMED_OUT)
+
+    def _after_reconcile(self) -> None:
+        """Deferred control events land at the commit boundary: cancels
+        queued during the in-flight window, then running-deadline
+        reaping."""
+        for rid in sorted(self._pending_cancels):
+            req = self._by_rid.get(rid)
+            if req is not None and req.status not in TERMINAL_STATUSES:
+                self._finalize(req, RequestStatus.CANCELLED)
+        self._pending_cancels.clear()
+        now = time.perf_counter()
+        for req in [
+            r for r in list(self.running.values()) if r.deadline_exceeded(now)
+        ]:
+            self._finalize(req, RequestStatus.TIMED_OUT)
+
+    # -- pipeline ------------------------------------------------------------
+
+    def _reconcile_front(self) -> None:
+        step = self._inflight.popleft()
+        self._reconcile_step(step)
+        self._after_reconcile()
+
+    def _drain_inflight(self) -> bool:
+        drained = bool(self._inflight)
+        while self._inflight:
+            self._reconcile_front()
+        return drained
+
+    def _reclaim_inflight_pages(self) -> bool:
+        # pages pinned for the in-flight step return at its reconcile —
+        # the drain stalls the pipeline but keeps the allocator sound
+        return self._drain_inflight()
+
+    def _work_pending(self) -> bool:
+        return bool(self.queue or self.running or self._inflight)
+
+    def step(self) -> None:
+        self._begin_iteration()
+        self._admit()
+        if self.proposer is not None:
+            self._verify_iteration_async()
+        else:
+            self._decode_iteration_async()
+        self._end_iteration()
+
+    def _decode_iteration_async(self) -> None:
+        """Dispatch decode N+1 (token-chained on the in-flight step N's
+        device outputs), THEN reconcile N — the double buffer."""
+        dispatched = False
+        if self.running:
+            chain = self._inflight[-1] if self._inflight else None
+            step = self._decode_dispatch_step(chain=chain)
+            if step is not None:
+                self._inflight.append(step)
+                dispatched = True
+        while len(self._inflight) > 1:
+            self._reconcile_front()
+        if not dispatched:
+            # nothing enqueued this iteration (drained queue tail,
+            # every slot budget-gated behind the in-flight step, or a
+            # whole-step fault) — flush the pipeline so its pinned
+            # pages and terminal events land instead of livelocking
+            self._drain_inflight()
+
+    def _verify_iteration_async(self) -> None:
+        """Speculative iteration: while verify N is in flight, draft
+        for N+1 against its predicted outcome; reconcile N; dispatch
+        N+1 with the surviving pre-proposals."""
+        pre = self._pre_propose()
+        self._drain_inflight()
+        if self.running:
+            step = self._verify_dispatch_step(self._merge_proposals(pre))
+            if step is not None:
+                self._inflight.append(step)
+
+    # -- speculative pre-proposals -------------------------------------------
+
+    def _pre_propose(self) -> Dict[int, Tuple[int, List[int]]]:
+        """Draft for the NEXT verify while the current one is still in
+        flight, against each slot's PREDICTED history: committed tokens
+        plus the in-flight drafts, assuming full acceptance (the
+        common case in the regimes speculation wins). Only stateless
+        proposers pre-draft — a model proposer's cache feeds would need
+        their own rollback story. Returns slot -> (predicted generated
+        length, proposal); `_merge_proposals` validates the prediction
+        at reconcile and rolls mispredictions back to a fresh draft."""
+        if (
+            not self._inflight
+            or self.proposer is None
+            or not getattr(self.proposer, "stateless", False)
+        ):
+            return {}
+        step = self._inflight[-1]
+        if step.kind != "verify" or not step.plan:
+            return {}
+        seqs: Dict[int, List[int]] = {}
+        basis: Dict[int, int] = {}
+        for slot, drafts in step.plan.items():
+            req = step.participants.get(slot)
+            if req is None or self.running.get(slot) is not req:
+                continue
+            seqs[slot] = list(req.prompt) + list(req.generated) + [
+                int(t) for t in drafts
+            ]
+            basis[slot] = len(req.generated) + len(drafts)
+        if not seqs:
+            return {}
+        # draft one EXTRA token: the prediction cannot know the verify's
+        # bonus/correction token, so a pre-proposal only survives when
+        # its first token turns out to BE that token — the rest aligns
+        proposals = self.proposer.propose_sequences(seqs, self.spec_k + 1)
+        return {
+            s: (basis[s], [int(t) for t in proposals.get(s) or ()])
+            for s in seqs
+        }
+
+    def _merge_proposals(
+        self, pre: Dict[int, Tuple[int, List[int]]]
+    ) -> Dict[int, List[int]]:
+        """Fresh proposals overlaid with the pre-proposals whose
+        prediction held: the in-flight verify fully accepted (generated
+        grew by exactly drafts + bonus) AND the pre-draft's first token
+        is the bonus token it could not see. Everything else is a
+        rolled-back misprediction and uses the fresh draft."""
+        proposals = self._propose(self.spec_k)
+        for slot, (basis, prop) in pre.items():
+            req = self.running.get(slot)
+            if req is None:
+                continue
+            if (
+                len(req.generated) == basis + 1
+                and len(prop) > 1
+                and prop[0] == int(req.generated[-1])
+            ):
+                proposals[slot] = prop[1:]
+                self.stats.pre_proposal_hits += 1
+            else:
+                self.stats.pre_proposal_misses += 1
+        return proposals
 
 
 class StaticBatchingScheduler(_SchedulerBase):

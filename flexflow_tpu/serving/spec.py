@@ -135,7 +135,17 @@ class DraftProposer:
     implementations are no-ops so stateless proposers only implement
     propose(). `retire` fires for EVERY slot release — terminal
     statuses and preemptions alike (a preempted request re-enters via
-    `admit` with its recompute history)."""
+    `admit` with its recompute history).
+
+    `stateless` marks proposers whose drafts are a pure function of the
+    token sequence they are shown — no per-slot cache to keep
+    consistent. The async engine only pre-drafts (proposing for verify
+    N+1 against N's PREDICTED outcome, while N is still in flight) on
+    stateless proposers, through `propose_sequences`: a misprediction
+    there costs nothing to roll back, where a stateful proposer would
+    have fed phantom tokens into its draft cache."""
+
+    stateless = False
 
     def admit(self, requests: Sequence) -> None:  # pragma: no cover
         pass
@@ -149,6 +159,17 @@ class DraftProposer:
     def propose(self, running: Dict[int, object], k: int) -> Dict[int, List[int]]:
         raise NotImplementedError
 
+    def propose_sequences(
+        self, seqs: Dict[int, List[int]], k: int
+    ) -> Dict[int, List[int]]:
+        """Draft up to k continuation tokens for explicit token
+        sequences (slot -> prompt+generated+predicted history) instead
+        of live Request state. Stateless proposers implement this; the
+        default refuses so stateful proposers are never pre-drafted."""
+        raise NotImplementedError(
+            "propose_sequences is only available on stateless proposers"
+        )
+
 
 class NGramDraftProposer(DraftProposer):
     """Weight-free prompt-lookup draft: propose the continuation that
@@ -159,30 +180,45 @@ class NGramDraftProposer(DraftProposer):
     match and the iteration degrades to plain decode. `max_history`
     bounds the backward scan so long sequences stay O(max_history)."""
 
+    stateless = True
+
     def __init__(self, n: int = 2, max_history: int = 4096):
         if n < 1:
             raise ValueError("n-gram size must be >= 1")
         self.n = int(n)
         self.max_history = int(max_history)
 
+    def _lookup(self, seq: List[int], k: int) -> List[int]:
+        if len(seq) > self.max_history:
+            seq = seq[-self.max_history :]
+        n = self.n
+        if len(seq) <= n:
+            return []
+        tail = seq[-n:]
+        # most recent earlier occurrence wins (locality: loops and
+        # copied spans repeat their NEAREST context)
+        for i in range(len(seq) - n - 1, -1, -1):
+            if seq[i : i + n] == tail:
+                return [int(t) for t in seq[i + n : i + n + k]]
+        return []
+
     def propose(self, running, k: int) -> Dict[int, List[int]]:
+        return self.propose_sequences(
+            {
+                slot: list(req.prompt) + list(req.generated)
+                for slot, req in running.items()
+            },
+            k,
+        )
+
+    def propose_sequences(
+        self, seqs: Dict[int, List[int]], k: int
+    ) -> Dict[int, List[int]]:
         out: Dict[int, List[int]] = {}
-        for slot, req in running.items():
-            seq = list(req.prompt) + list(req.generated)
-            if len(seq) > self.max_history:
-                seq = seq[-self.max_history :]
-            n = self.n
-            if len(seq) <= n:
-                continue
-            tail = seq[-n:]
-            # most recent earlier occurrence wins (locality: loops and
-            # copied spans repeat their NEAREST context)
-            for i in range(len(seq) - n - 1, -1, -1):
-                if seq[i : i + n] == tail:
-                    cont = seq[i + n : i + n + k]
-                    if cont:
-                        out[slot] = [int(t) for t in cont]
-                    break
+        for slot, seq in seqs.items():
+            cont = self._lookup(list(seq), k)
+            if cont:
+                out[slot] = cont
         return out
 
 

@@ -1,0 +1,31 @@
+"""fxlint fixture: FX103 — reconcile-phase code bypassing the
+InflightStep snapshot (positive cases).
+
+Linted by tests/test_fxlint.py — NOT imported. The async engine commits
+a step's results one iteration after its dispatch; reading live cache
+state there consumes the NEXT step's view. Expected findings: FX103 on
+every `cache.<mutated>` load inside the functions taking a step.
+"""
+
+import numpy as np
+
+
+class RacyReconciler:
+    def __init__(self, cache):
+        self.cache = cache
+        self.lengths = np.zeros(8, dtype=np.int32)
+
+    def advance(self, slot):
+        # host-side mutation: taints 'lengths' for the whole file set
+        self.lengths[slot] += 1
+        self.cache.lengths[slot] += 1
+
+    def commit_step(self, step, nxt):
+        # FX103: live allocator state read at reconcile time — by now
+        # cache.lengths describes the step dispatched AFTER this one
+        old_len = int(self.cache.lengths[0])
+        return old_len + int(nxt[0]) + int(step.iteration)
+
+    def reconcile(self, inflight, cache):
+        # FX103: same bypass through a bare cache parameter
+        return [int(x) for x in cache.lengths] + list(inflight.active)

@@ -1,0 +1,31 @@
+"""fxlint fixture: FX103 negative cases — reconcile code reading ONLY
+the InflightStep snapshot (plus non-cache scheduler state, which is
+sanctioned), and dispatch-side code reading live state where the
+snapshot is taken.
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings: none.
+"""
+
+import numpy as np
+
+
+class SnapshottedReconciler:
+    def __init__(self, cache):
+        self.cache = cache
+        self.running = {}
+
+    def advance(self, slot):
+        self.cache.lengths[slot] += 1
+        self.running[slot] = slot
+
+    def commit_step(self, step, nxt):
+        # reconcile reads the step record's snapshot, never the cache
+        old_len = int(step.lengths[0])
+        req = self.running.get(0)  # non-cache state: sanctioned
+        return old_len + int(nxt[0]) + (0 if req is None else 1)
+
+    def decode_dispatch_phase(self, step):
+        # dispatch-side ('dispatch' in the name): the snapshot is taken
+        # HERE, so live reads are the point
+        lengths = np.array(self.cache.lengths)
+        return lengths, step

@@ -1,0 +1,420 @@
+"""Async double-buffered engine (--serve-async;
+serving/scheduler.AsyncContinuousBatchingScheduler + the
+dispatch/reconcile split in serving/engine.py).
+
+The load-bearing proofs: async greedy streams are TOKEN-IDENTICAL to
+the synchronous reference loop on both kv layouts, with speculation on
+and off, under forced preemption, and through a seeded chaos schedule
+whose NaN fault and mid-flight cancel land inside the in-flight window;
+the paged allocator pins every page an in-flight step references (limbo)
+and its full accounting holds INSIDE the window; and the dispatch/commit
+stats split (overlap_fraction, mean_dispatch_gap_s) plus the
+verify-cache LRU bound are observable. All CPU-fast (tier 1).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu import (
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import build_decoder_lm
+from flexflow_tpu.serving import (
+    AsyncContinuousBatchingScheduler,
+    ContinuousBatchingScheduler,
+    FaultInjector,
+    FaultPlan,
+    InflightStep,
+    KVCacheSpec,
+    PagedKVCache,
+    Request,
+    RequestStatus,
+    ServeConfig,
+    TERMINAL_STATUSES,
+    build_scheduler,
+)
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 50
+
+
+def _lm(batch=4, seq=32, seed=0):
+    cfg = FFConfig(batch_size=batch, seed=seed)
+    model = FFModel(cfg)
+    tok = model.create_tensor([batch, seq], dtype=DataType.INT32, name="tokens")
+    build_decoder_lm(
+        model, tok, vocab_size=VOCAB, hidden=32, num_heads=4, num_layers=2,
+        ff_dim=64,
+    )
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[],
+        devices=jax.devices()[:1],
+    )
+    return model
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _lm()
+
+
+_PROMPTS = [[1, 2, 3], [4, 5, 6, 7], [8, 9], [3, 1, 4, 1, 5], [7, 7, 2]]
+
+
+def _requests(n=6, max_new=8, **kw):
+    return [
+        Request(rid=i, prompt=list(_PROMPTS[i % len(_PROMPTS)]),
+                max_new_tokens=max_new, **kw)
+        for i in range(n)
+    ]
+
+
+def _run(lm, serve_async, layout="slot", n=6, max_new=8, reqs=None,
+         injector=None, **cfg_kw):
+    serve = ServeConfig(
+        max_seqs=4, max_seq_len=32, kv_layout=layout,
+        serve_async=serve_async, debug_invariants=True, **cfg_kw,
+    )
+    sched, engine, cache = build_scheduler(lm, serve, injector=injector)
+    done = sched.run(reqs if reqs is not None else _requests(n, max_new))
+    return sched, engine, cache, {r.rid: r for r in done}
+
+
+# -- token-identity parity ----------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["slot", "paged"])
+def test_async_matches_sync_greedy_streams(lm, layout):
+    _, _, _, sync = _run(lm, False, layout)
+    _, _, _, asy = _run(lm, True, layout)
+    assert set(sync) == set(asy)
+    for rid in sync:
+        assert sync[rid].ok and asy[rid].ok
+        assert sync[rid].generated == asy[rid].generated, rid
+
+
+@pytest.mark.parametrize("layout", ["slot", "paged"])
+def test_async_matches_sync_with_speculation(lm, layout):
+    kw = dict(spec_draft="ngram", spec_k=3)
+    _, _, _, sync = _run(lm, False, layout, max_new=12, **kw)
+    sched, _, _, asy = _run(lm, True, layout, max_new=12, **kw)
+    for rid in sync:
+        assert sync[rid].generated == asy[rid].generated, rid
+    # the in-flight window drafted ahead: every verify after the first
+    # either reused a pre-proposal or rolled a misprediction back
+    s = sched.stats
+    assert s.pre_proposal_hits + s.pre_proposal_misses > 0
+    assert s.verify_steps > 0 and s.draft_tokens_proposed > 0
+
+
+def test_async_matches_sync_with_model_draft(lm):
+    # a STATEFUL proposer never pre-drafts (its cache feeds have no
+    # rollback story) — the async loop must stay token-identical while
+    # recording zero pre-proposal traffic
+    draft = _lm(seed=1)
+    kw = dict(spec_draft="model", spec_k=3)
+    serve = ServeConfig(max_seqs=4, max_seq_len=32, **kw)
+    sync_sched, _, _ = build_scheduler(lm, serve, draft_model=draft)
+    sync_done = {r.rid: r for r in sync_sched.run(_requests(6, 10))}
+    serve = ServeConfig(max_seqs=4, max_seq_len=32, serve_async=True, **kw)
+    asy_sched, _, _ = build_scheduler(lm, serve, draft_model=draft)
+    asy_done = {r.rid: r for r in asy_sched.run(_requests(6, 10))}
+    for rid in sync_done:
+        assert sync_done[rid].generated == asy_done[rid].generated, rid
+    assert asy_sched.stats.pre_proposal_hits == 0
+    assert asy_sched.stats.pre_proposal_misses == 0
+
+
+def test_async_matches_sync_with_eos_mid_stream(lm):
+    # find a token the greedy continuation actually emits, then retire
+    # on it: the EOS lands mid-window, the in-flight extra step's token
+    # must be discarded, and streams must still match the sync loop
+    _, _, _, plain = _run(lm, False, n=4, max_new=10)
+    eos = int(plain[0].generated[len(plain[0].generated) // 2])
+    _, _, _, sync = _run(lm, False, n=4, max_new=10, eos_token=eos)
+    _, _, _, asy = _run(lm, True, n=4, max_new=10, eos_token=eos)
+    assert any(
+        r.generated and r.generated[-1] == eos for r in sync.values()
+    )
+    for rid in sync:
+        assert sync[rid].generated == asy[rid].generated, rid
+
+
+def test_async_no_wasted_slot_steps_on_budget_streams(lm):
+    # without EOS the budget gate predicts every retirement, so the
+    # async loop does exactly the sync loop's useful slot-work
+    sync_sched, _, _, _ = _run(lm, False, n=8)
+    asy_sched, _, _, _ = _run(lm, True, n=8)
+    assert asy_sched.stats.busy_slot_steps == sync_sched.stats.busy_slot_steps
+    assert asy_sched.stats.tokens_generated == (
+        sync_sched.stats.tokens_generated
+    )
+
+
+# -- dispatch/commit stats ----------------------------------------------------
+
+
+def test_overlap_and_dispatch_gap_stats(lm):
+    sync_sched, _, _, sync = _run(lm, False)
+    asy_sched, _, _, _ = _run(lm, True)
+    for sched in (sync_sched, asy_sched):
+        s = sched.stats
+        assert s.dispatch_count > 0
+        assert s.mean_dispatch_gap_s > 0.0
+        assert 0.0 <= s.overlap_fraction <= 1.0
+        assert s.commit_wait_s >= 0.0
+    # the async loop interleaves a full iteration of host work between
+    # dispatch and reconcile; the sync loop reconciles immediately
+    assert (
+        asy_sched.stats.overlapped_host_s
+        > sync_sched.stats.overlapped_host_s
+    )
+    assert (
+        asy_sched.stats.overlap_fraction > sync_sched.stats.overlap_fraction
+    )
+    # TTFT is stamped at commit: every finished request's TTFT is real
+    # wall time, never the zero a dispatch-time stamp would produce
+    assert all(r.ttft_s > 0.0 for r in sync.values())
+    assert asy_sched.stats.mean_ttft_s > 0.0
+
+
+# -- one-step-stale control events -------------------------------------------
+
+
+def test_async_cancel_of_running_defers_to_reconcile(lm):
+    serve = ServeConfig(max_seqs=4, max_seq_len=32, serve_async=True,
+                        debug_invariants=True)
+    sched, _, cache = build_scheduler(lm, serve)
+    for r in _requests(4, max_new=12):
+        sched.submit(r)
+    for _ in range(3):  # fill the pipeline
+        sched.step()
+    assert sched._inflight
+    victim = next(iter(sched.running.values()))
+    assert sched.cancel(victim.rid) is True
+    # deferred: still officially running until the next reconcile
+    assert victim.status == RequestStatus.RUNNING
+    assert victim.rid in sched._pending_cancels
+    sched.run([])
+    assert victim.status == RequestStatus.CANCELLED
+    assert victim.slot is None
+    assert all(
+        r.status in (RequestStatus.FINISHED, RequestStatus.CANCELLED)
+        for r in sched.finished
+    )
+    cache.check_invariants()
+
+
+def test_async_chaos_window_loses_nothing(lm):
+    """Seeded chaos whose NaN fault and cancel land INSIDE the in-flight
+    window (keyed by dispatch iteration): the hit request fails/cancels,
+    every other stream is token-identical to a fault-free async run, no
+    request is lost, and the paged accounting holds every iteration."""
+    for layout in ("slot", "paged"):
+        _, _, _, clean = _run(lm, True, layout, n=6, max_new=10)
+        plan = FaultPlan(
+            nan_iters={4: [1]},  # slot 1's step DISPATCHED at iter 4
+            cancel_iters={5: [3]},  # rid 3 cancelled mid-window
+        )
+        injector = FaultInjector(plan, seed=7)
+        sched, _, cache, done = _run(
+            lm, True, layout, n=6, max_new=10, injector=injector,
+        )
+        assert injector.injected["nan"] >= 1
+        assert injector.injected["cancel"] == 1
+        lost = [r for r in done.values() if r.status not in TERMINAL_STATUSES]
+        assert not lost
+        assert done[3].status == RequestStatus.CANCELLED
+        failed = [r.rid for r in done.values()
+                  if r.status == RequestStatus.FAILED]
+        assert len(failed) == 1
+        affected = set(failed) | {3}
+        for rid, req in clean.items():
+            if rid in affected:
+                continue
+            assert done[rid].ok
+            assert done[rid].generated == req.generated, (layout, rid)
+        cache.check_invariants()
+
+
+def test_async_forced_preemption_completes_all(lm):
+    serve = ServeConfig(
+        max_seqs=4, max_seq_len=32, kv_layout="paged",
+        kv_page_size=4, kv_pages=8,  # minimum legal pool: forces preemption
+        admission="optimistic", max_preemptions=8,
+        serve_async=True, debug_invariants=True,
+    )
+    sched, _, cache = build_scheduler(lm, serve)
+    done = sched.run(_requests(6, max_new=10))
+    assert all(r.ok for r in done), [(r.rid, r.status, r.error) for r in done]
+    assert sched.stats.preemptions > 0
+    # parity against the sync loop under the same pressure
+    serve_sync = ServeConfig(
+        max_seqs=4, max_seq_len=32, kv_layout="paged",
+        kv_page_size=4, kv_pages=8, admission="optimistic",
+        max_preemptions=8, debug_invariants=True,
+    )
+    sync_sched, _, _ = build_scheduler(lm, serve_sync)
+    sync_done = {r.rid: r.generated for r in sync_sched.run(_requests(6, 10))}
+    for r in done:
+        assert sync_done[r.rid] == r.generated, r.rid
+    cache.check_invariants()
+
+
+# -- in-flight page pinning ---------------------------------------------------
+
+
+def _paged_cache(num_pages=12, page_size=4, max_seqs=3, max_len=16):
+    spec = KVCacheSpec(
+        layer_guids=(0,), max_seqs=max_seqs, max_len=max_len,
+        num_heads=2, head_dim=4, buckets=(max_len,),
+        page_size=page_size, num_pages=num_pages,
+    )
+    import jax.numpy as jnp
+
+    return PagedKVCache(spec, jnp.float32)
+
+
+def test_inflight_window_pins_released_pages():
+    cache = _paged_cache()
+    slot = cache.alloc(8, 8)
+    free_before = cache.num_free_pages
+    cache.begin_inflight()
+    cache.free(slot)
+    # the window pins the released pages: not free, not allocatable
+    assert cache.pinned_pages == 2
+    assert cache.num_free_pages == free_before
+    cache.check_invariants()  # accounting holds INSIDE the window
+    cache.end_inflight()
+    assert cache.pinned_pages == 0
+    assert cache.num_free_pages == free_before + 2
+    cache.check_invariants()
+
+
+def test_inflight_release_waits_for_the_window_open_at_release():
+    """Steady-state pipeline shape: window 1 (step N) open, window 2
+    (step N+1) opens, window 1 closes, THEN pages release — they must
+    stay pinned until window 2 (whose snapshot tables reference them)
+    closes, not drain at window 1's close."""
+    cache = _paged_cache()
+    s0 = cache.alloc(8, 8)
+    cache.begin_inflight()  # window 1 = step N
+    cache.begin_inflight()  # window 2 = step N+1 (dispatched first)
+    cache.end_inflight()  # step N reconciles
+    cache.free(s0)  # retire lands during window 2
+    assert cache.pinned_pages == 2
+    cache.check_invariants()
+    cache.end_inflight()  # step N+1 reconciles
+    assert cache.pinned_pages == 0
+    cache.check_invariants()
+
+
+def test_inflight_window_balance_is_enforced():
+    cache = _paged_cache()
+    with pytest.raises(RuntimeError):
+        cache.end_inflight()
+
+
+def test_reserve_claim_inside_window_names_pinned_pages():
+    cache = _paged_cache(num_pages=4, page_size=4, max_seqs=2)
+    s0 = cache.alloc(4, 16)  # reserve-mode: worst case 4 pages
+    cache.ensure_position(s0, 4)
+    cache.begin_inflight()
+    cache.truncate(s0, 4)  # page released into limbo
+    assert cache.pinned_pages == 1
+    from flexflow_tpu.serving import PagePoolExhausted
+
+    # 2 free + 1 limbo; growing back to 16 needs 3 claims — the one
+    # that needs the pinned page back must say so (the async
+    # scheduler's drain-then-retry path keys off this)
+    for pos in (4, 8):
+        cache.ensure_position(s0, pos)
+    with pytest.raises(PagePoolExhausted, match="pinned by an in-flight"):
+        cache.ensure_position(s0, 12)
+    cache.end_inflight()
+    cache.ensure_position(s0, 12)  # the released page satisfies it
+    cache.check_invariants()
+
+
+# -- verify-cache LRU ---------------------------------------------------------
+
+
+def test_verify_cache_is_lru_bounded(lm):
+    serve = ServeConfig(max_seqs=4, max_seq_len=32)
+    _, engine, cache = build_scheduler(lm, serve)
+    engine.verify_cache_max = 3
+    slot = cache.alloc(2, 32)
+    engine.prefill(lm.params, [[1, 2]], [slot])
+    draft_lens = np.zeros(4, dtype=np.int32)
+    draft_lens[slot] = 1
+    for w in (1, 2, 3, 4, 5):
+        tokens = np.zeros((4, w), dtype=np.int32)
+        engine.verify(lm.params, tokens, draft_lens)
+        cache.truncate(slot, 2)
+        assert engine.verify_cache_entries <= 3
+    # LRU, not FIFO: touching width 4 then adding width 6 evicts 5
+    tokens = np.zeros((4, 4), dtype=np.int32)
+    engine.verify(lm.params, tokens, draft_lens)
+    cache.truncate(slot, 2)
+    tokens = np.zeros((4, 6), dtype=np.int32)
+    engine.verify(lm.params, tokens, draft_lens)
+    cache.truncate(slot, 2)
+    assert sorted(engine._verify_cache) == [4, 5, 6] or sorted(
+        engine._verify_cache
+    ) == [3, 4, 6]
+    assert 4 in engine._verify_cache and 6 in engine._verify_cache
+    assert engine.verify_cache_entries == 3
+
+
+def test_verify_cache_entries_stat_flows_to_scheduler(lm):
+    sched, _, _, _ = _run(lm, True, max_new=10, spec_draft="ngram", spec_k=3)
+    assert sched.stats.verify_cache_entries >= 1
+
+
+# -- wiring -------------------------------------------------------------------
+
+
+def test_serve_async_flag_and_builder_wiring(lm):
+    cfg = FFConfig.parse_args(["--serve-async"])
+    assert cfg.serve_async is True
+    serve = ServeConfig.from_config(cfg)
+    assert serve.serve_async is True
+    sched, _, _ = build_scheduler(lm, ServeConfig(
+        max_seqs=4, max_seq_len=32, serve_async=True))
+    assert isinstance(sched, AsyncContinuousBatchingScheduler)
+    sched, _, _ = build_scheduler(lm, ServeConfig(
+        max_seqs=4, max_seq_len=32))
+    assert not isinstance(sched, AsyncContinuousBatchingScheduler)
+    assert isinstance(sched, ContinuousBatchingScheduler)
+    with pytest.raises(ValueError, match="continuous"):
+        ServeConfig(scheduler="static", serve_async=True)
+
+
+def test_inflight_step_snapshot_is_immutable_view(lm):
+    """The record the reconcile runs against must be HOST COPIES: later
+    scheduler mutation of cache.lengths cannot leak into a dispatched
+    step's snapshot."""
+    serve = ServeConfig(max_seqs=4, max_seq_len=32)
+    _, engine, cache = build_scheduler(lm, serve)
+    slot = cache.alloc(2, 32)
+    engine.prefill(lm.params, [[1, 2]], [slot])
+    tokens = np.zeros(4, dtype=np.int32)
+    active = np.zeros(4, dtype=bool)
+    active[slot] = True
+    step = engine.decode_dispatch(lm.params, tokens, active)
+    assert isinstance(step, InflightStep)
+    pre = int(step.lengths[slot])
+    cache.lengths[slot] = 31  # hostile post-dispatch mutation
+    assert int(step.lengths[slot]) == pre
+    nxt, logits = engine.decode_reconcile(step)
+    assert np.isfinite(logits[slot]).all()
+    assert nxt.shape == (4,)

@@ -106,6 +106,57 @@ def test_seeded_block_table_race_is_caught(tmp_path):
     ) == 2
 
 
+def test_reconcile_snapshot_fixtures():
+    """FX103: reconcile-phase code (functions taking an InflightStep)
+    reading live cache state instead of the step's snapshot — the bug
+    class the async double-buffered engine creates."""
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "dispatch")], ["dispatch-race"])
+    )
+    assert diags.get("reconcile_bad.py", []).count("FX103") == 2
+    # snapshot reads (step.lengths), non-cache state (self.running), and
+    # dispatch-side functions stay silent
+    assert "reconcile_good.py" not in diags
+
+
+def test_seeded_reconcile_bypass_is_caught(tmp_path):
+    """Re-introduce the async-reconcile bug FX103 exists for: make the
+    verify commit read LIVE cache lengths (one iteration ahead under
+    the pipeline) instead of the InflightStep snapshot."""
+    src_path = os.path.join(PACKAGE, "serving", "scheduler.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "old_len = int(step.lengths[slot])",
+        "old_len = int(self.cache.lengths[slot])",
+        1,
+    )
+    assert seeded != src, (
+        "scheduler.py's verify commit no longer reads the step snapshot "
+        "— update this test alongside the refactor"
+    )
+    (tmp_path / "scheduler.py").write_text(seeded)
+    # the lengths MUTATIONS live in the allocator/engine — scan both,
+    # like a full-checkout lint does
+    shutil.copy(
+        os.path.join(PACKAGE, "serving", "kv_cache.py"),
+        tmp_path / "kv_cache.py",
+    )
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    assert any(
+        d.rule_id == "FX103" and "lengths" in d.message for d in diags
+    ), [d.format() for d in diags]
+    # the unmodified pair stays clean
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "scheduler.py")
+    shutil.copy(
+        os.path.join(PACKAGE, "serving", "kv_cache.py"),
+        clean / "kv_cache.py",
+    )
+    assert run_rules([str(clean)], ["dispatch-race"]) == []
+
+
 # -- retrace-storm (FX2xx) ----------------------------------------------------
 
 
