@@ -5,7 +5,10 @@ and serves a mixed-length prompt stream through the continuous-batching
 scheduler, printing generations and the scheduler's occupancy — run with
 `--serve-scheduler static` to watch the occupancy (and tokens/s) drop on
 the same stream. Serving flags ride FFConfig: `--max-seqs 4
---max-seq-len 128 --eos-token 0`.
+--max-seq-len 128 --eos-token 0`. Telemetry flags ride along too — try
+`--trace /tmp/serve_trace.json --metrics-out /tmp/serve_metrics.prom
+--slo-ttft-ms 200` and load the trace at https://ui.perfetto.dev
+(docs/observability.md).
 """
 
 from __future__ import annotations
@@ -77,6 +80,15 @@ def main():
         f"{s.decode_steps} decode steps, occupancy {s.occupancy:.2f}, "
         f"peak in-flight {s.peak_in_flight}, {s.tokens_per_s:.0f} tokens/s"
     )
+    if sched.telemetry is not None:
+        slo = sched.telemetry.slo.snapshot()
+        print(
+            f"telemetry: p95 TTFT {slo['ttft_ms']['p95']:.1f}ms, "
+            f"p95 ITL {slo['itl_ms']['p95']:.2f}ms, "
+            f"violations {slo['violations']}"
+            + (f", trace -> {serve.trace}" if serve.trace else "")
+            + (f", metrics -> {serve.metrics_out}" if serve.metrics_out else "")
+        )
 
 
 if __name__ == "__main__":

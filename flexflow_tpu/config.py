@@ -134,6 +134,20 @@ class FFConfig:
     # --check-invariants: run cache.check_invariants() every scheduler
     # iteration (the chaos harness's probe) — debugging/CI posture
     serve_check_invariants: bool = False
+    # telemetry (flexflow_tpu.telemetry): --metrics-out writes
+    # Prometheus text exposition at the end of a serve run,
+    # --metrics-jsonl streams one sample row per scheduler iteration,
+    # --trace writes a Chrome trace-event JSON (Perfetto-loadable),
+    # --slo-ttft-ms / --slo-itl-ms set rolling-window SLO thresholds
+    # (milliseconds; 0 = observe but never count violations), and
+    # --serve-telemetry force-enables the in-memory bundle without any
+    # output path
+    serve_metrics_out: str = ""
+    serve_metrics_jsonl: str = ""
+    serve_trace: str = ""
+    serve_slo_ttft_ms: float = 0.0
+    serve_slo_itl_ms: float = 0.0
+    serve_telemetry: bool = False
 
     @property
     def num_devices(self) -> int:
@@ -273,6 +287,18 @@ class FFConfig:
                 cfg.serve_async = True
             elif a == "--check-invariants":
                 cfg.serve_check_invariants = True
+            elif a == "--metrics-out":
+                cfg.serve_metrics_out = take()
+            elif a == "--metrics-jsonl":
+                cfg.serve_metrics_jsonl = take()
+            elif a == "--trace":
+                cfg.serve_trace = take()
+            elif a == "--slo-ttft-ms":
+                cfg.serve_slo_ttft_ms = float(take())
+            elif a == "--slo-itl-ms":
+                cfg.serve_slo_itl_ms = float(take())
+            elif a == "--serve-telemetry":
+                cfg.serve_telemetry = True
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

@@ -13,15 +13,22 @@ cancellation, and optimistic-admission preemption-by-recompute
 and the `FFModel.generate` / ServeConfig surface (api). The decode
 regime also has its own cost family in search/cost_model.py so the
 auto-parallel search can pick a serving strategy (TP over heads at
-small batch) distinct from the training one.
+small batch) distinct from the training one. Observability lives in
+its own package (flexflow_tpu.telemetry — metrics registry, Chrome
+trace export, rolling-window SLO monitor) and threads through every
+seam here via `build_scheduler`'s ServeConfig telemetry knobs
+(--metrics-out/--metrics-jsonl/--trace/--slo-ttft-ms/--slo-itl-ms);
+SchedulerStats is a façade over the same registry the exporters read.
 """
 
 from flexflow_tpu.serving.api import (
     ServeConfig,
     build_proposer,
     build_scheduler,
+    build_telemetry,
     generate,
 )
+from flexflow_tpu.telemetry import Telemetry
 from flexflow_tpu.serving.engine import (
     GenerationEngine,
     InflightStep,
@@ -64,6 +71,8 @@ __all__ = [
     "generate",
     "build_proposer",
     "build_scheduler",
+    "build_telemetry",
+    "Telemetry",
     "GenerationEngine",
     "InflightStep",
     "snapshot",

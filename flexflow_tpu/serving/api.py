@@ -81,6 +81,22 @@ class ServeConfig:
     # allocator re-derivation is O(slots × pages) per iteration, a
     # debugging/CI posture rather than a serving one.
     debug_invariants: bool = False
+    # telemetry (flexflow_tpu.telemetry): setting ANY of these attaches
+    # a Telemetry bundle to the engine + scheduler. metrics_out writes
+    # Prometheus text exposition at flush; metrics_jsonl streams one
+    # sample row per scheduler iteration; trace writes a Chrome
+    # trace-event JSON (Perfetto-loadable) of engine phases + request
+    # lifecycles; slo_ttft_ms / slo_itl_ms (milliseconds, 0 = no
+    # threshold) feed serve_slo_violations_total from rolling windows
+    # of slo_window observations. `telemetry=True` force-enables the
+    # in-memory bundle with no output paths (tests, embedding callers).
+    metrics_out: str = ""
+    metrics_jsonl: str = ""
+    trace: str = ""
+    slo_ttft_ms: float = 0.0
+    slo_itl_ms: float = 0.0
+    slo_window: int = 1024
+    telemetry: bool = False
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -133,6 +149,24 @@ class ServeConfig:
                 f"decode_kernel must be one of {MODES}, "
                 f"got {self.decode_kernel!r}"
             )
+        if self.slo_ttft_ms < 0 or self.slo_itl_ms < 0:
+            raise ValueError("SLO thresholds must be >= 0 (0 = disabled)")
+        if self.slo_window < 1:
+            raise ValueError(
+                f"slo_window must be >= 1, got {self.slo_window}"
+            )
+
+    @property
+    def telemetry_requested(self) -> bool:
+        """True when any telemetry knob asks for the bundle."""
+        return bool(
+            self.telemetry
+            or self.metrics_out
+            or self.metrics_jsonl
+            or self.trace
+            or self.slo_ttft_ms
+            or self.slo_itl_ms
+        )
 
     @staticmethod
     def from_config(cfg) -> "ServeConfig":
@@ -155,7 +189,33 @@ class ServeConfig:
             max_preemptions=cfg.serve_max_preemptions,
             serve_async=cfg.serve_async,
             debug_invariants=cfg.serve_check_invariants,
+            metrics_out=cfg.serve_metrics_out,
+            metrics_jsonl=cfg.serve_metrics_jsonl,
+            trace=cfg.serve_trace,
+            slo_ttft_ms=cfg.serve_slo_ttft_ms,
+            slo_itl_ms=cfg.serve_slo_itl_ms,
+            telemetry=cfg.serve_telemetry,
         )
+
+
+def build_telemetry(serve: ServeConfig):
+    """The Telemetry bundle a ServeConfig asks for, or None when every
+    telemetry knob is off — the scheduler/engine then skip every
+    instrument point on a single predicate (the ≤2%-overhead contract
+    bench_serve.py --telemetry gates)."""
+    if not serve.telemetry_requested:
+        return None
+    from flexflow_tpu.telemetry import Telemetry
+
+    return Telemetry(
+        metrics_out=serve.metrics_out,
+        metrics_jsonl=serve.metrics_jsonl,
+        trace=serve.trace,
+        trace_enabled=bool(serve.trace) or serve.telemetry or None,
+        slo_ttft_ms=serve.slo_ttft_ms,
+        slo_itl_ms=serve.slo_itl_ms,
+        slo_window=serve.slo_window,
+    )
 
 
 def build_proposer(serve: ServeConfig, draft_model=None):
@@ -185,13 +245,22 @@ def build_proposer(serve: ServeConfig, draft_model=None):
     )
 
 
-def build_scheduler(model, serve: ServeConfig, draft_model=None, injector=None):
+def build_scheduler(
+    model,
+    serve: ServeConfig,
+    draft_model=None,
+    injector=None,
+    telemetry=None,
+):
     """(scheduler, engine, cache) wired to a compiled model — the pieces
     generate() uses, exposed for callers that drive iterations themselves
     (bench_serve.py, tests). With serve.spec_draft set, the scheduler
     runs the speculative draft/verify loop (serving/spec.py). `injector`
     threads a faults.FaultInjector through the engine and scheduler
-    seams — the chaos harness's entry point."""
+    seams — the chaos harness's entry point. `telemetry` threads a
+    flexflow_tpu.telemetry.Telemetry bundle through the same seams
+    (built from the serve config's telemetry knobs when omitted); the
+    attached bundle is reachable as `scheduler.telemetry`."""
     if serve.kv_layout == "paged":
         cache = PagedKVCache.from_model(
             model,
@@ -208,6 +277,8 @@ def build_scheduler(model, serve: ServeConfig, draft_model=None, injector=None):
             max_len=serve.max_seq_len,
             buckets=serve.prefill_buckets or None,
         )
+    if telemetry is None:
+        telemetry = build_telemetry(serve)
     engine = GenerationEngine(
         model,
         cache,
@@ -215,6 +286,7 @@ def build_scheduler(model, serve: ServeConfig, draft_model=None, injector=None):
         seed=serve.seed,
         decode_kernel=serve.decode_kernel,
         injector=injector,
+        telemetry=telemetry,
     )
     cls = _SCHEDULERS[serve.scheduler]
     if serve.serve_async:
@@ -229,6 +301,7 @@ def build_scheduler(model, serve: ServeConfig, draft_model=None, injector=None):
         max_preemptions=serve.max_preemptions,
         injector=injector,
         debug_invariants=serve.debug_invariants,
+        telemetry=telemetry,
     )
     return sched, engine, cache
 

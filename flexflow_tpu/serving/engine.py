@@ -127,6 +127,10 @@ class InflightStep:
     participants: Dict[int, object] = dataclasses.field(default_factory=dict)
     plan: Optional[Dict[int, list]] = None
     iteration: int = -1
+    # dispatch sequence number (scheduler._note_dispatch): the trace
+    # layer's step index — device in-flight windows alternate lanes by
+    # its parity so overlapping async windows still render
+    seq: int = -1
 
 
 class GenerationEngine:
@@ -141,6 +145,7 @@ class GenerationEngine:
         seed: int = 0,
         decode_kernel: str = "auto",
         injector=None,
+        telemetry=None,
     ):
         import jax
 
@@ -162,6 +167,14 @@ class GenerationEngine:
         self.injector = injector
         self.kernel_fallbacks = 0
         self.kernel_fallback_error: str = ""
+        # telemetry (flexflow_tpu.telemetry.Telemetry): None when
+        # disabled — engine instrument points (prefill span, kernel
+        # fallback) each cost one predicate on the disabled path
+        self.telemetry = (
+            telemetry
+            if telemetry is not None and getattr(telemetry, "enabled", False)
+            else None
+        )
         # how the decode/verify attention core runs (threaded into every
         # ops.attention call below): "auto" = Pallas decode kernel on TPU
         # when the geometry supports() it, "pallas" = force the kernel
@@ -269,6 +282,15 @@ class GenerationEngine:
 
         self.kernel_fallbacks += 1
         self.kernel_fallback_error = repr(error)
+        if self.telemetry is not None:
+            self.telemetry.registry.counter(
+                "serve_kernel_fallbacks_total",
+                help="Pallas dispatch failures answered by permanent "
+                "dense fallback",
+            ).inc()
+            self.telemetry.tracer.instant(
+                "kernel_fallback", "engine", args={"error": repr(error)}
+            )
         self.decode_kernel = "dense"
         # the jitted steps baked the failed mode in at trace time;
         # rebuild them so the retry traces the dense attention cores
@@ -430,6 +452,7 @@ class GenerationEngine:
         import jax
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         spec = self.cache.spec
         n = len(prompts)
         if n == 0:
@@ -475,7 +498,18 @@ class GenerationEngine:
         self.cache.commit(new_k, new_v)
         for p, s in zip(prompts, slots):
             self.cache.lengths[s] = len(p)
-        return np.asarray(nxt[:n]), np.asarray(last[:n])
+        out_nxt, out_last = np.asarray(nxt[:n]), np.asarray(last[:n])
+        if self.telemetry is not None:
+            # prefill is synchronous (the np.asarray reads above block
+            # on the device), so one host-lane span covers it whole
+            self.telemetry.tracer.complete(
+                "prefill",
+                "engine",
+                t0,
+                time.perf_counter(),
+                args={"prompts": n, "bucket": bucket},
+            )
+        return out_nxt, out_last
 
     # -- decode --------------------------------------------------------------
 

@@ -269,3 +269,17 @@ class FaultInjector:
 
     def summary(self) -> Dict[str, int]:
         return dict(self.injected)
+
+    def publish_metrics(self, registry) -> None:
+        """Mirror the injected-fault ledger into a
+        telemetry.MetricsRegistry as
+        `serve_fault_injections_total{site=...}` — a fault the
+        observability layer cannot see is a bug, so the chaos bench
+        asserts every site that fired here appears in the exported
+        metrics with the same count."""
+        for site, n in self.injected.items():
+            registry.counter(
+                "serve_fault_injections_total",
+                help="faults the injector actually fired, by site",
+                labels={"site": site},
+            ).set_monotonic(n)

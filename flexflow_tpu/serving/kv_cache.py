@@ -358,6 +358,29 @@ class KVCache:
         self.k = dict(new_k)
         self.v = dict(new_v)
 
+    def telemetry_gauges(self) -> Dict[str, float]:
+        """Point-in-time allocator gauges the per-iteration telemetry
+        sampler exports (`kv_*` series). Reads the same ledgers
+        `check_invariants` re-derives its truth from, so the KV-gauge
+        tests can hold the two to exact agreement. The slot layout has
+        no pages: occupancy is row-based (a slot pins max_len rows, so
+        `kv_occupancy` is the fraction of reserved rows actually
+        holding tokens) and the page gauges sit at zero for series
+        parity with the paged layout."""
+        spec = self.spec
+        used = int(self.lengths.sum())
+        return {
+            "kv_slots_active": len(self._active),
+            "kv_slots_free": len(self._free),
+            "kv_rows_used": used,
+            "kv_occupancy": used / spec.total_rows if spec.total_rows else 0.0,
+            "kv_pages_live": 0,
+            "kv_pages_pinned": 0,
+            "kv_free_heap_depth": 0,
+            "kv_pages_reserved": 0,
+            "kv_inflight_depth": self._inflight_depth,
+        }
+
     def check_invariants(self, extra_free: int = 0) -> None:
         """Assert the slot bookkeeping is consistent — the chaos-harness
         probe (tests/test_resilience.py, bench_serve.py --chaos) calls
@@ -714,6 +737,28 @@ class PagedKVCache:
         """Swap in the pools a jitted step returned."""
         self.k = dict(new_k)
         self.v = dict(new_v)
+
+    def telemetry_gauges(self) -> Dict[str, float]:
+        """Point-in-time allocator gauges for the telemetry sampler:
+        pages live in block tables (`Σ _held`), pages pinned in the
+        in-flight limbo list, free-heap depth, the reserve ledger, and
+        pool occupancy. These are the SAME ledgers `check_invariants`
+        audits, so live + pinned + free (+ injector-stolen) always
+        covers the pool — the conservation law the KV-gauge tests
+        re-derive from the block tables themselves."""
+        spec = self.spec
+        live = int(self._held.sum())
+        return {
+            "kv_slots_active": len(self._active),
+            "kv_slots_free": len(self._free_slots),
+            "kv_rows_used": int(self.lengths.sum()),
+            "kv_occupancy": live / spec.num_pages if spec.num_pages else 0.0,
+            "kv_pages_live": live,
+            "kv_pages_pinned": len(self._limbo),
+            "kv_free_heap_depth": len(self._free_pages),
+            "kv_pages_reserved": int(self._reserved),
+            "kv_inflight_depth": self._inflight_depth,
+        }
 
     def check_invariants(self, extra_free: int = 0) -> None:
         """Assert the page allocator's full accounting is consistent —

@@ -71,6 +71,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from flexflow_tpu.serving.kv_cache import PagePoolExhausted
+from flexflow_tpu.telemetry import MetricsRegistry
+from flexflow_tpu.telemetry.slo import percentiles as _percentiles
 
 
 class RequestStatus:
@@ -105,13 +107,17 @@ class Request:
     a wall-clock budget from submit — queued or running, the request is
     TIMED_OUT once it elapses. `events` is the per-request audit log:
     (wall time, event, detail) for submit/admit/first_token/preempt/
-    terminal transitions."""
+    terminal transitions — a RING buffer bounded by `events_max`, so a
+    long-running request cannot grow it without bound: past the cap the
+    OLDEST entry drops and `events_dropped` counts it (surfaced as the
+    `serve_request_events_dropped_total` telemetry counter)."""
 
     rid: int
     prompt: List[int]
     max_new_tokens: int = 16
     eos_token: Optional[int] = None
     deadline_s: Optional[float] = None
+    events_max: int = 64
 
     generated: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
@@ -127,8 +133,15 @@ class Request:
     events: List[Tuple[float, str, str]] = dataclasses.field(
         default_factory=list
     )
+    events_dropped: int = 0
+    # inter-token-latency stamp (telemetry only): wall time of the last
+    # emitted token — 0.0 until telemetry observes the first one
+    last_token_time: float = 0.0
 
     def log(self, event: str, detail: str = "") -> None:
+        if len(self.events) >= max(1, self.events_max):
+            del self.events[0]
+            self.events_dropped += 1
         self.events.append((time.perf_counter(), event, detail))
 
     @property
@@ -178,30 +191,32 @@ class Request:
         ) or len(self.generated) >= self.max_new_tokens
 
 
-@dataclasses.dataclass
-class SchedulerStats:
-    iterations: int = 0
-    decode_steps: int = 0
-    prefill_batches: int = 0
-    tokens_generated: int = 0
-    slot_steps: int = 0  # Σ over decode/verify iterations of max_seqs
-    busy_slot_steps: int = 0  # Σ of actually-active slots
-    peak_in_flight: int = 0  # max concurrent running requests observed
-    elapsed_s: float = 0.0
+#: SchedulerStats fields, name -> default. Each is backed by a
+#: `serve_stats_<name>` gauge in a telemetry.MetricsRegistry — comments
+#: that used to annotate the dataclass fields live here.
+_STAT_FIELDS: Dict[str, object] = dict(
+    iterations=0,
+    decode_steps=0,
+    prefill_batches=0,
+    tokens_generated=0,
+    slot_steps=0,  # Σ over decode/verify iterations of max_seqs
+    busy_slot_steps=0,  # Σ of actually-active slots
+    peak_in_flight=0,  # max concurrent running requests observed
+    elapsed_s=0.0,
     # speculative decoding (verify iterations only)
-    verify_steps: int = 0
-    draft_tokens_proposed: int = 0
-    draft_tokens_accepted: int = 0
+    verify_steps=0,
+    draft_tokens_proposed=0,
+    draft_tokens_accepted=0,
     # request lifecycle (filled at terminal transitions)
-    submitted_requests: int = 0
-    finished_requests: int = 0  # FINISHED only — not failures
-    failed_requests: int = 0
-    cancelled_requests: int = 0
-    timed_out_requests: int = 0
-    preemptions: int = 0  # preempt-and-requeue events
-    step_faults: int = 0  # whole-step engine faults (all slots retired)
-    draft_faults: int = 0  # proposer faults degraded to plain decode
-    tokens_finished: int = 0  # Σ generated over FINISHED requests only
+    submitted_requests=0,
+    finished_requests=0,  # FINISHED only — not failures
+    failed_requests=0,
+    cancelled_requests=0,
+    timed_out_requests=0,
+    preemptions=0,  # preempt-and-requeue events
+    step_faults=0,  # whole-step engine faults (all slots retired)
+    draft_faults=0,  # proposer faults degraded to plain decode
+    tokens_finished=0,  # Σ generated over FINISHED requests only
     # per-request latency accumulators (FINISHED requests only — a
     # request failing before its first token has no TTFT to aggregate).
     # TTFT and decode latency are stamped at COMMIT (when _emit actually
@@ -209,21 +224,117 @@ class SchedulerStats:
     # token's step is enqueued an iteration before its value exists, and
     # dispatch-time stamps would fake latencies exactly as deep as the
     # pipeline.
-    ttft_sum_s: float = 0.0
-    decode_latency_sum_s: float = 0.0  # Σ of per-request decode_s_per_token
+    ttft_sum_s=0.0,
+    decode_latency_sum_s=0.0,  # Σ of per-request decode_s_per_token
     # dispatch/commit split (async double-buffered engine; the sync loop
     # fills them too — its overlap window is just ~empty)
-    dispatch_count: int = 0  # decode/verify steps enqueued
-    dispatch_gap_sum_s: float = 0.0  # Σ wall time between consecutive dispatches
-    commit_wait_s: float = 0.0  # Σ time blocked on device outputs at reconcile
-    overlapped_host_s: float = 0.0  # Σ host work done while a step was in flight
+    dispatch_count=0,  # decode/verify steps enqueued
+    dispatch_gap_sum_s=0.0,  # Σ wall time between consecutive dispatches
+    commit_wait_s=0.0,  # Σ time blocked on device outputs at reconcile
+    overlapped_host_s=0.0,  # Σ host work done while a step was in flight
     # speculative pre-proposals drafted during the in-flight window
     # (async spec mode): used as-is vs rolled back on reconcile mismatch
-    pre_proposal_hits: int = 0
-    pre_proposal_misses: int = 0
+    pre_proposal_hits=0,
+    pre_proposal_misses=0,
     # live jitted verify programs in the engine's LRU (sampled at the
     # end of each iteration — bounded by engine.verify_cache_max)
-    verify_cache_entries: int = 0
+    verify_cache_entries=0,
+    # kernel-failure dense fallbacks (mirrored from the engine's ledger
+    # at each iteration end)
+    kernel_fallbacks=0,
+    # per-request audit-log ring-buffer drops, summed at finalize
+    events_dropped=0,
+)
+
+#: derived SchedulerStats properties `publish_derived` exports as
+#: gauges so the JSONL time series and text exposition carry them
+_STAT_DERIVED = (
+    "tokens_per_s",
+    "goodput_tokens_per_s",
+    "terminal_requests",
+    "occupancy",
+    "acceptance_rate",
+    "mean_dispatch_gap_s",
+    "overlap_fraction",
+    "mean_ttft_s",
+    "mean_decode_s_per_token",
+)
+
+
+class _StatField:
+    """Descriptor backing one SchedulerStats field with its registry
+    gauge: reads and writes go straight to the gauge's value, so
+    `stats.tokens_generated += 1` and the exported
+    `serve_stats_tokens_generated` series can never disagree."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return obj._metrics[self.name].value
+
+    def __set__(self, obj, value):
+        obj._metrics[self.name].value = value
+
+
+class SchedulerStats:
+    """Scheduler counters/aggregates — a façade over a
+    telemetry.MetricsRegistry. Every field is a `serve_stats_<name>`
+    gauge; with telemetry attached the scheduler passes the shared
+    registry, so `--metrics-out` exposition and the JSONL time series
+    read the SAME storage the tests and benches read through this
+    class. Without telemetry each instance owns a private registry —
+    the field surface and update syntax are unchanged from the old
+    dataclass, and the cost per update is one dict lookup plus an
+    attribute write."""
+
+    __slots__ = ("_registry", "_metrics", "_derived")
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._registry = registry if registry is not None else MetricsRegistry()
+        self._metrics = {}
+        for name, default in _STAT_FIELDS.items():
+            gauge = self._registry.gauge("serve_stats_" + name)
+            # a fresh stats object owns its series: re-zero so a reused
+            # registry (new scheduler, same Telemetry) starts clean
+            gauge.value = default
+            self._metrics[name] = gauge
+        # derived-property gauge handles, resolved once — the
+        # per-iteration publish is then pure attribute writes
+        self._derived = {
+            name: self._registry.gauge("serve_stats_" + name)
+            for name in _STAT_DERIVED
+        }
+        for gauge in self._derived.values():
+            gauge.value = 0.0
+
+    def publish_derived(self) -> None:
+        """Refresh the derived-property gauges
+        (`serve_stats_<property>`) — the per-iteration sampler's hook,
+        so ratios like occupancy and overlap_fraction ride the time
+        series without consumers re-deriving them."""
+        for name, gauge in self._derived.items():
+            gauge.value = round(float(getattr(self, name)), 9)
+
+    def as_dict(self) -> Dict[str, object]:
+        """Fields + derived properties as one plain dict (bench
+        artifacts embed it)."""
+        out: Dict[str, object] = {
+            name: self._metrics[name].value for name in _STAT_FIELDS
+        }
+        for name in _STAT_DERIVED:
+            out[name] = float(getattr(self, name))
+        return out
+
+    def __repr__(self) -> str:
+        inner = ", ".join(
+            f"{name}={self._metrics[name].value!r}" for name in _STAT_FIELDS
+        )
+        return f"SchedulerStats({inner})"
 
     @property
     def tokens_per_s(self) -> float:
@@ -297,6 +408,11 @@ class SchedulerStats:
         return self.decode_latency_sum_s / self.finished_requests
 
 
+for _name in _STAT_FIELDS:
+    setattr(SchedulerStats, _name, _StatField(_name))
+del _name
+
+
 class _SchedulerBase:
     """Shared admission/decode/verify machinery. `proposer` switches the
     per-iteration generation step from plain decode to speculative
@@ -317,6 +433,7 @@ class _SchedulerBase:
         max_preemptions: int = 3,
         injector=None,
         debug_invariants: bool = False,
+        telemetry=None,
     ):
         self.engine = engine
         self.cache = engine.cache
@@ -338,12 +455,25 @@ class _SchedulerBase:
         # chaos harness does), so an invariant violation surfaces at the
         # iteration that caused it instead of steps later
         self.debug_invariants = bool(debug_invariants)
+        # telemetry (flexflow_tpu.telemetry.Telemetry): `_tele` is the
+        # hot-path handle — None when disabled, so every instrument
+        # point costs exactly one predicate when telemetry is off
+        self.telemetry = telemetry
+        self._tele = (
+            telemetry
+            if telemetry is not None and getattr(telemetry, "enabled", False)
+            else None
+        )
         self.queue: deque = deque()
         self.running: Dict[int, Request] = {}  # slot -> request
         self.finished: List[Request] = []
-        self.stats = SchedulerStats()
+        self.stats = SchedulerStats(
+            registry=self._tele.registry if self._tele is not None else None
+        )
         self._by_rid: Dict[int, Request] = {}
         self._iter = 0
+        self._iter_t0 = 0.0
+        self._gauge_handles: Optional[Dict[str, object]] = None
         self._last_dispatch_t: Optional[float] = None
 
     # -- submission / cancellation -------------------------------------------
@@ -434,6 +564,7 @@ class _SchedulerBase:
                     break
         self.finished.append(req)
         stats = self.stats
+        stats.events_dropped += req.events_dropped
         if status == RequestStatus.FINISHED:
             stats.finished_requests += 1
             stats.tokens_finished += len(req.generated)
@@ -449,6 +580,28 @@ class _SchedulerBase:
             stats.cancelled_requests += 1
         elif status == RequestStatus.TIMED_OUT:
             stats.timed_out_requests += 1
+        tele = self._tele
+        if tele is not None:
+            reg = tele.registry
+            reg.counter(
+                "serve_requests_total",
+                help="terminal request transitions by status",
+                labels={"status": status},
+            ).inc()
+            if req.events_dropped:
+                reg.counter(
+                    "serve_request_events_dropped_total",
+                    help="audit-log ring-buffer drops (events_max cap)",
+                ).inc(req.events_dropped)
+            if status == RequestStatus.FINISHED:
+                # the SLO view aggregates FINISHED requests only, same
+                # rule as the stats accumulators above
+                if req.generated:
+                    tele.slo.observe_ttft(req.ttft_s)
+                tele.slo.observe_finished(
+                    req.finish_time, len(req.generated)
+                )
+            tele.tracer.request_lifecycle(req)
 
     def _fail(self, req: Request, error: str) -> None:
         self._finalize(req, RequestStatus.FAILED, error=error)
@@ -492,6 +645,11 @@ class _SchedulerBase:
             return
         req.status = RequestStatus.PREEMPTED
         req.log("preempt", f"iteration {self._iter}")
+        if self._tele is not None:
+            self._tele.registry.counter(
+                "serve_preemptions_total",
+                help="preempt-and-requeue events (optimistic admission)",
+            ).inc()
         if self.proposer is not None:
             self.proposer.retire(req)
         del self.running[req.slot]
@@ -624,6 +782,18 @@ class _SchedulerBase:
         if len(req.generated) == 1:
             req.first_token_time = time.perf_counter()
             req.log("first_token")
+            req.last_token_time = req.first_token_time
+        elif self._tele is not None:
+            # inter-token latency: the gap between consecutive COMMITs
+            # of one request's tokens (verify emits several per gap —
+            # each counts, which is exactly how speculation compresses
+            # the latency a user streams at). Telemetry-only: the
+            # per-token clock read is the kind of hot-path cost the
+            # disabled path must not pay.
+            now = time.perf_counter()
+            if req.last_token_time:
+                self._tele.slo.observe_itl(now - req.last_token_time)
+            req.last_token_time = now
         self.stats.tokens_generated += 1
         if req._done_after(token):
             self._finalize(req, RequestStatus.FINISHED)
@@ -638,6 +808,9 @@ class _SchedulerBase:
 
     def _note_dispatch(self, step) -> None:
         self.stats.dispatch_count += 1
+        # dispatch sequence number: the trace layer's step index (device
+        # in-flight windows alternate lanes by its parity)
+        step.seq = int(self.stats.dispatch_count)
         if self._last_dispatch_t is not None:
             self.stats.dispatch_gap_sum_s += (
                 step.dispatch_t - self._last_dispatch_t
@@ -688,6 +861,7 @@ class _SchedulerBase:
                 and chain.participants.get(slot) is req
             ):
                 chain_mask[slot] = True
+        t0 = time.perf_counter()
         try:
             step = self.engine.decode_dispatch(
                 self.params,
@@ -699,6 +873,14 @@ class _SchedulerBase:
         except Exception as e:
             self._fail_all_running(f"decode step failed: {e!r}")
             return None
+        if self._tele is not None:
+            self._tele.tracer.complete(
+                "dispatch:decode",
+                "host",
+                t0,
+                time.perf_counter(),
+                args={"iter": self._iter, "active": int(active.sum())},
+            )
         step.iteration = self._iter
         step.participants = stepped
         self._note_dispatch(step)
@@ -721,11 +903,33 @@ class _SchedulerBase:
         except Exception as e:
             self._fail_all_running(f"{step.kind} step failed: {e!r}")
             return
-        self.stats.commit_wait_s += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        self.stats.commit_wait_s += t1 - t0
         if step.kind == "decode":
             self._commit_decode(step, nxt, logits)
         else:
             self._commit_verify(step, logits)
+        if self._tele is not None:
+            # trace the step's whole in-flight window (dispatch →
+            # outputs materialized) on a device lane, and the host-side
+            # reconcile (block + commit) on the host lane — everything
+            # read here comes off the step record, never live cache
+            # state (fxlint FX103)
+            tr = self._tele.tracer
+            tr.device_window(
+                step.kind,
+                step.seq,
+                step.dispatch_t,
+                t1,
+                args={"iter": step.iteration},
+            )
+            tr.complete(
+                f"reconcile:{step.kind}",
+                "host",
+                t0,
+                time.perf_counter(),
+                args={"iter": step.iteration, "step": step.seq},
+            )
 
     def _commit_decode(self, step, nxt, logits) -> None:
         """Commit a reconciled decode step: NaN isolation, token emit,
@@ -766,13 +970,23 @@ class _SchedulerBase:
         injected) degrades THIS iteration to plain decode — empty
         proposals make every verify a w=1 decode — instead of killing
         the run."""
+        t0 = time.perf_counter()
         try:
             if self.injector is not None:
                 self.injector.maybe_draft_fault()
-            return self.proposer.propose(self.running, k)
+            proposals = self.proposer.propose(self.running, k)
         except Exception:
             self.stats.draft_faults += 1
             return {}
+        if self._tele is not None:
+            self._tele.tracer.complete(
+                "draft:propose",
+                "host",
+                t0,
+                time.perf_counter(),
+                args={"iter": self._iter, "slots": len(proposals)},
+            )
+        return proposals
 
     def _verify_dispatch_step(self, proposals):
         """Dispatch phase of one speculative iteration: cap each slot's
@@ -811,6 +1025,7 @@ class _SchedulerBase:
             for j, t in enumerate(drafts):
                 tokens[slot, 1 + j] = int(t)
             draft_lens[slot] = 1 + len(drafts)
+        t0 = time.perf_counter()
         try:
             step = self.engine.verify_dispatch(
                 self.params, tokens, draft_lens
@@ -818,6 +1033,14 @@ class _SchedulerBase:
         except Exception as e:
             self._fail_all_running(f"verify step failed: {e!r}")
             return None
+        if self._tele is not None:
+            self._tele.tracer.complete(
+                "dispatch:verify",
+                "host",
+                t0,
+                time.perf_counter(),
+                args={"iter": self._iter, "slots": len(plan)},
+            )
         step.iteration = self._iter
         step.plan = plan
         step.participants = {s: self.running[s] for s in plan}
@@ -894,6 +1117,8 @@ class _SchedulerBase:
     def _begin_iteration(self) -> None:
         self._iter += 1
         self.stats.iterations += 1
+        if self._tele is not None:
+            self._iter_t0 = time.perf_counter()
         if self.injector is not None:
             self.injector.on_iteration(self._iter, self)
         self._reap_deadlines()
@@ -902,8 +1127,55 @@ class _SchedulerBase:
         self.stats.verify_cache_entries = getattr(
             self.engine, "verify_cache_entries", 0
         )
+        self.stats.kernel_fallbacks = getattr(
+            self.engine, "kernel_fallbacks", 0
+        )
         if self.debug_invariants:
             self.cache.check_invariants()
+        if self._tele is not None:
+            self._sample_telemetry()
+
+    def _sample_telemetry(self) -> None:
+        """One iteration's telemetry sample: KV-pool gauges straight
+        from the allocator's ledgers, scheduler queue gauges, the fault
+        injector's ledger, the derived stats ratios, then one JSONL row
+        and the iteration's host span. Runs only with telemetry
+        attached — the disabled path never gets here — and resolves
+        every gauge handle ONCE, so the steady-state cost is attribute
+        writes, not registry lookups."""
+        tele = self._tele
+        handles = self._gauge_handles
+        if handles is None:
+            reg = tele.registry
+            handles = {
+                name: reg.gauge(name)
+                for name in self.cache.telemetry_gauges()
+            }
+            handles["serve_queue_depth"] = reg.gauge(
+                "serve_queue_depth", help="requests waiting for admission"
+            )
+            handles["serve_running_requests"] = reg.gauge(
+                "serve_running_requests", help="requests holding a slot"
+            )
+            self._gauge_handles = handles
+        for name, value in self.cache.telemetry_gauges().items():
+            handles[name].value = value
+        handles["serve_queue_depth"].value = len(self.queue)
+        handles["serve_running_requests"].value = len(self.running)
+        if self.injector is not None:
+            self.injector.publish_metrics(tele.registry)
+        if self.proposer is not None:
+            for name, value in self.proposer.telemetry_counters().items():
+                tele.registry.counter(name).set_monotonic(value)
+        self.stats.publish_derived()
+        tele.sample(self._iter)
+        tele.tracer.complete(
+            "iteration",
+            "host",
+            self._iter_t0,
+            time.perf_counter(),
+            args={"iter": self._iter},
+        )
 
     def _work_pending(self) -> bool:
         return bool(self.queue or self.running)
@@ -919,6 +1191,9 @@ class _SchedulerBase:
         while self._work_pending():
             self.step()
         self.stats.elapsed_s += time.perf_counter() - t0
+        if self._tele is not None:
+            self.stats.publish_derived()
+            self.telemetry.flush()
         return self.finished
 
 
@@ -1113,7 +1388,19 @@ class AsyncContinuousBatchingScheduler(ContinuousBatchingScheduler):
         # draft one EXTRA token: the prediction cannot know the verify's
         # bonus/correction token, so a pre-proposal only survives when
         # its first token turns out to BE that token — the rest aligns
+        t0 = time.perf_counter()
         proposals = self.proposer.propose_sequences(seqs, self.spec_k + 1)
+        if self._tele is not None:
+            # the draft/verify overlap the async spec loop exists for:
+            # this host span sits INSIDE the in-flight verify's device
+            # window in the exported trace
+            self._tele.tracer.complete(
+                "draft:pre_propose",
+                "host",
+                t0,
+                time.perf_counter(),
+                args={"iter": self._iter, "slots": len(seqs)},
+            )
         return {
             s: (basis[s], [int(t) for t in proposals.get(s) or ()])
             for s in seqs
@@ -1173,13 +1460,15 @@ def latency_percentiles(
     (submit→finish, the default), "ttft" (submit→first token), or
     "decode_per_token" (per-generated-token decode latency after the
     first — where speculative decoding's win shows up as latency rather
-    than throughput)."""
+    than throughput).
+
+    The percentile math itself lives in telemetry.slo.percentiles —
+    the ONE implementation the rolling SLO windows also use, so this
+    post-hoc view and the live `serve_slo_*` gauges agree exactly
+    whenever the window still holds every sample."""
     if metric not in _LATENCY_METRICS:
         raise ValueError(
             f"metric must be one of {sorted(_LATENCY_METRICS)}, got {metric!r}"
         )
     fn = _LATENCY_METRICS[metric]
-    lats = [fn(r) for r in requests if r.ok]
-    if not lats:
-        return {p: 0.0 for p in pcts}
-    return {p: float(np.percentile(lats, p)) for p in pcts}
+    return _percentiles((fn(r) for r in requests if r.ok), pcts)

@@ -147,6 +147,13 @@ class DraftProposer:
 
     stateless = False
 
+    def telemetry_counters(self) -> Dict[str, int]:
+        """Monotone proposer-side counters for the metrics registry
+        (`serve_draft_*` series) — the per-iteration sampler mirrors
+        them via set_monotonic, so a proposer only needs to keep plain
+        int ledgers. Base: nothing to report."""
+        return {}
+
     def admit(self, requests: Sequence) -> None:  # pragma: no cover
         pass
 
@@ -187,6 +194,17 @@ class NGramDraftProposer(DraftProposer):
             raise ValueError("n-gram size must be >= 1")
         self.n = int(n)
         self.max_history = int(max_history)
+        # telemetry ledgers: lookups attempted vs lookups that found a
+        # continuation — the hit rate is the "is prompt-lookup even
+        # firing on this workload" signal, upstream of acceptance
+        self.lookups = 0
+        self.lookup_hits = 0
+
+    def telemetry_counters(self) -> Dict[str, int]:
+        return {
+            "serve_draft_lookups_total": self.lookups,
+            "serve_draft_lookup_hits_total": self.lookup_hits,
+        }
 
     def _lookup(self, seq: List[int], k: int) -> List[int]:
         if len(seq) > self.max_history:
@@ -216,8 +234,10 @@ class NGramDraftProposer(DraftProposer):
     ) -> Dict[int, List[int]]:
         out: Dict[int, List[int]] = {}
         for slot, seq in seqs.items():
+            self.lookups += 1
             cont = self._lookup(list(seq), k)
             if cont:
+                self.lookup_hits += 1
                 out[slot] = cont
         return out
 
@@ -260,6 +280,20 @@ class ModelDraftProposer(DraftProposer):
             decode_kernel=decode_kernel,
         )
         self.params = draft_model.params
+        # telemetry ledgers: draft-engine decode steps, split into
+        # catch-up feeds (replaying tokens the target committed) vs
+        # fresh draft tokens — the catch-up share is the price of a
+        # rollback, invisible in acceptance_rate alone
+        self.draft_steps = 0
+        self.catchup_feeds = 0
+        self.draft_tokens = 0
+
+    def telemetry_counters(self) -> Dict[str, int]:
+        return {
+            "serve_draft_steps_total": self.draft_steps,
+            "serve_draft_catchup_feeds_total": self.catchup_feeds,
+            "serve_draft_tokens_total": self.draft_tokens,
+        }
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -326,10 +360,13 @@ class ModelDraftProposer(DraftProposer):
                 tokens[slot] = tok
                 active[slot] = True
             nxt, _ = self.engine.decode(self.params, tokens, active)
+            self.draft_steps += 1
             for slot in feeds:
                 if pending[slot]:
                     pending[slot].pop(0)
+                    self.catchup_feeds += 1
                     if pending[slot]:
                         continue  # catch-up feed: prediction is known
+                self.draft_tokens += 1
                 drafts[slot].append(int(nxt[slot]))
         return {s: d for s, d in drafts.items() if d}

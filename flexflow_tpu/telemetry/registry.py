@@ -1,0 +1,365 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms.
+
+FlexFlow's defining loop is *measure, then decide* — the simulator is
+calibrated from profiled kernels before the search commits to a
+strategy. The serving stack makes the same kind of decisions at
+iteration granularity (admission, preemption, draft length), so it
+needs the same posture at runtime: every decision input is a metric
+something can read back. This module is the storage layer — the
+instrument points live in serving/, the thresholds in telemetry/slo.py.
+
+Three metric kinds, Prometheus semantics:
+
+* `Counter` — monotone accumulator (`inc`). Mirroring pre-counted host
+  ledgers (a `FaultInjector.injected` Counter, a per-request drop
+  count) goes through `set_monotonic`, which enforces the monotone
+  contract instead of trusting the caller.
+* `Gauge` — point-in-time value (`set`/`inc`/`dec`): page occupancy,
+  queue depth, in-flight pinned pages.
+* `Histogram` — FIXED buckets chosen at creation (`observe` is a
+  bisect + two adds — no allocation, no resort). Exposition renders
+  the cumulative `_bucket`/`_sum`/`_count` family; `percentile`
+  interpolates within a bucket, the standard histogram_quantile
+  estimate (the EXACT rolling percentiles live in slo.RollingWindow —
+  the histogram is the unbounded-horizon aggregate, the window the SLO
+  view).
+
+Labels are first-class but deliberately minimal: a metric instance is
+keyed by (name, sorted label items), e.g. the chaos ledger
+`serve_fault_injections_total{site="nan"}`.
+
+Two export surfaces:
+
+* `render_prometheus()` — the text exposition format (`--metrics-out`),
+  scrapeable or diffable.
+* `sample()` — one flat `{series: value}` dict per call, the row format
+  the per-iteration JSONL time series (`--metrics-jsonl`) streams; a
+  `JsonlWriter` appends rows as they are taken so a long-running server
+  never buffers the series in memory.
+
+Everything here is stdlib-only and import-light: serving's hot path
+touches metric objects, so they are __slots__ classes whose update cost
+is an attribute add — near-zero against a jitted step dispatch, zero
+when telemetry is disabled (the scheduler then never calls in).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from bisect import bisect_left
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "JsonlWriter",
+    "DEFAULT_LATENCY_BUCKETS_MS",
+    "series_name",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: default histogram buckets for millisecond latencies (TTFT,
+#: inter-token): roughly log-spaced from sub-ms to minutes, the range a
+#: CPU smoke test and a TPU pod both land inside.
+DEFAULT_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+    1000, 2500, 5000, 10000, 30000, 60000,
+)
+
+
+def series_name(name: str, labels: Optional[Mapping[str, str]]) -> str:
+    """`name{k="v",...}` — the flat key a JSONL row / sample dict uses
+    for a labelled series (label order is sorted, so the key is
+    stable)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotone accumulator."""
+
+    kind = "counter"
+    __slots__ = ("name", "help", "labels", "value")
+
+    def __init__(self, name: str, help: str = "", labels=None):
+        self.name = name
+        self.help = help
+        self.labels = labels
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: inc({amount}) < 0")
+        self.value += amount
+
+    def set_monotonic(self, value: float) -> None:
+        """Mirror an externally-counted monotone ledger (e.g.
+        FaultInjector.injected): the new value may equal but never
+        undercut the current one."""
+        if value < self.value:
+            raise ValueError(
+                f"counter {self.name}: set_monotonic({value}) would "
+                f"decrease from {self.value}"
+            )
+        self.value = value
+
+
+class Gauge:
+    """Point-in-time value; goes up and down."""
+
+    kind = "gauge"
+    __slots__ = ("name", "help", "labels", "value")
+
+    def __init__(self, name: str, help: str = "", labels=None):
+        self.name = name
+        self.help = help
+        self.labels = labels
+        self.value = 0.0
+
+    def set(self, value) -> None:
+        self.value = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.value -= amount
+
+
+class Histogram:
+    """Fixed-bucket histogram. `bounds` are the finite upper bounds,
+    strictly increasing; observations above the last bound land in the
+    implicit +Inf bucket. `observe` is O(log buckets) with zero
+    allocation — hot-path safe."""
+
+    kind = "histogram"
+    __slots__ = ("name", "help", "labels", "bounds", "counts", "sum", "count")
+
+    def __init__(
+        self,
+        name: str,
+        bounds: Sequence[float],
+        help: str = "",
+        labels=None,
+    ):
+        bounds = tuple(float(b) for b in bounds)
+        if not bounds:
+            raise ValueError(f"histogram {name}: needs at least one bucket")
+        if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+            raise ValueError(
+                f"histogram {name}: bounds must be strictly increasing"
+            )
+        self.name = name
+        self.help = help
+        self.labels = labels
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # last = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def percentile(self, pct: float) -> float:
+        """histogram_quantile-style estimate: find the bucket holding
+        the pct-th observation and interpolate linearly inside it. The
+        +Inf bucket clamps to the last finite bound (same convention as
+        Prometheus). 0.0 with no observations."""
+        if not self.count:
+            return 0.0
+        rank = pct / 100.0 * self.count
+        cum = 0
+        lo = 0.0
+        for i, c in enumerate(self.counts):
+            if not c:
+                lo = self.bounds[i] if i < len(self.bounds) else lo
+                continue
+            if cum + c >= rank:
+                if i >= len(self.bounds):
+                    return self.bounds[-1]
+                hi = self.bounds[i]
+                frac = (rank - cum) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+            cum += c
+            lo = self.bounds[i] if i < len(self.bounds) else lo
+        return self.bounds[-1]
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Get-or-create store for metric instances, keyed by
+    (name, labels). One registry per Telemetry facade; SchedulerStats
+    binds its fields to gauges in the same registry, so the exported
+    text IS the stats surface."""
+
+    def __init__(self):
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object] = {}
+        self._help: Dict[str, str] = {}
+        self._kind: Dict[str, str] = {}
+
+    # -- get-or-create -------------------------------------------------------
+
+    def _get(self, cls, name, help, labels, **kw):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        labels = dict(labels) if labels else None
+        if labels:
+            for k in labels:
+                if not _LABEL_RE.match(k):
+                    raise ValueError(f"invalid label name {k!r}")
+            labels = {k: str(v) for k, v in labels.items()}
+        key = (name, tuple(sorted(labels.items())) if labels else ())
+        existing = self._metrics.get(key)
+        if existing is not None:
+            if existing.kind != cls.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{existing.kind}, not {cls.kind}"
+                )
+            return existing
+        if name in self._kind and self._kind[name] != cls.kind:
+            raise ValueError(
+                f"metric family {name!r} already registered as "
+                f"{self._kind[name]}, not {cls.kind}"
+            )
+        metric = cls(name, help=help, labels=labels, **kw)
+        self._metrics[key] = metric
+        self._kind[name] = cls.kind
+        if help and name not in self._help:
+            self._help[name] = help
+        return metric
+
+    def counter(self, name: str, help: str = "", labels=None) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", labels=None) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(
+        self,
+        name: str,
+        bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS,
+        help: str = "",
+        labels=None,
+    ) -> Histogram:
+        return self._get(Histogram, name, help, labels, bounds=bounds)
+
+    # -- introspection -------------------------------------------------------
+
+    def metrics(self) -> List[object]:
+        """All metric instances, sorted by (name, labels) — the
+        deterministic order both exporters render in."""
+        return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def get(self, name: str, labels=None):
+        """The metric instance, or None — for tests and assertions."""
+        labels = {k: str(v) for k, v in labels.items()} if labels else None
+        key = (name, tuple(sorted(labels.items())) if labels else ())
+        return self._metrics.get(key)
+
+    # -- exposition ----------------------------------------------------------
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (version 0.0.4): HELP/TYPE
+        headers once per family, then one sample line per series;
+        histograms expand to the cumulative _bucket/_sum/_count
+        family."""
+        lines: List[str] = []
+        seen_header = set()
+        for m in self.metrics():
+            if m.name not in seen_header:
+                seen_header.add(m.name)
+                if self._help.get(m.name):
+                    lines.append(f"# HELP {m.name} {self._help[m.name]}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
+            if m.kind == "histogram":
+                cum = 0
+                for bound, c in zip(m.bounds, m.counts):
+                    cum += c
+                    lbl = dict(m.labels or {})
+                    lbl["le"] = _fmt(bound)
+                    lines.append(
+                        f"{series_name(m.name + '_bucket', lbl)} {cum}"
+                    )
+                lbl = dict(m.labels or {})
+                lbl["le"] = "+Inf"
+                lines.append(
+                    f"{series_name(m.name + '_bucket', lbl)} {m.count}"
+                )
+                lines.append(
+                    f"{series_name(m.name + '_sum', m.labels)} "
+                    f"{_fmt(m.sum)}"
+                )
+                lines.append(
+                    f"{series_name(m.name + '_count', m.labels)} {m.count}"
+                )
+            else:
+                lines.append(
+                    f"{series_name(m.name, m.labels)} {_fmt(m.value)}"
+                )
+        return "\n".join(lines) + "\n"
+
+    def write_prometheus(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.render_prometheus())
+
+    def sample(self, **extra) -> Dict[str, object]:
+        """One flat {series: value} snapshot — the JSONL row shape.
+        Histograms contribute their _count/_sum (the series a
+        time-series consumer can rate()); `extra` keys (iteration
+        number, wall time) ride along verbatim."""
+        row: Dict[str, object] = dict(extra)
+        for m in self.metrics():
+            if m.kind == "histogram":
+                row[series_name(m.name + "_count", m.labels)] = m.count
+                row[series_name(m.name + "_sum", m.labels)] = round(
+                    m.sum, 9
+                )
+            else:
+                v = m.value
+                row[series_name(m.name, m.labels)] = (
+                    round(v, 9) if isinstance(v, float) else v
+                )
+        return row
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+class JsonlWriter:
+    """Streams sample rows to a JSONL file as they are taken — no
+    in-memory buffering of the series, so a long-running server's
+    telemetry footprint stays flat. The file opens lazily on the first
+    row and closes at `close()` (idempotent)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.rows_written = 0
+        self._f = None
+
+    def write(self, row: Mapping[str, object]) -> None:
+        if self._f is None:
+            # truncate on the FIRST open only: a write after close()
+            # (flush mid-run, then more iterations) appends
+            self._f = open(self.path, "w" if not self.rows_written else "a")
+        self._f.write(json.dumps(row, sort_keys=True) + "\n")
+        self.rows_written += 1
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -1,0 +1,284 @@
+"""Validators for the telemetry export formats.
+
+Three artifact formats leave this package, and each has a checked-in
+contract CI gates on:
+
+* **Chrome trace-event JSON** (`--trace`) — `schemas/trace.schema.json`
+  describes the document shape; `validate_trace` additionally enforces
+  the semantic rules a schema language cannot: per-lane spans properly
+  nest (contained or disjoint — what Perfetto's stacking assumes), no
+  negative durations, and timestamps are finite.
+* **metrics JSONL** (`--metrics-jsonl`) —
+  `schemas/metrics_jsonl.schema.json`: every row is a flat object of
+  numeric series keyed by `name{label="v"}` plus the `iteration`/`t_s`
+  sample coordinates.
+* **Prometheus text** (`--metrics-out`) — a line grammar, not JSON, so
+  `validate_metrics_text` checks it directly: HELP/TYPE headers,
+  sample-line syntax, histogram `_bucket` cumulativity ending at the
+  `_count` value.
+
+The schema checker is a deliberate subset of JSON Schema (type,
+required, properties, additionalProperties, items, enum, minimum) —
+enough to express the checked-in contracts without adding a dependency
+the container doesn't have.
+
+All validators raise `ValidationError` with a path-qualified message;
+`errors="list"` collects instead (the bench gate reports all findings
+at once).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Mapping, Optional, Sequence
+
+__all__ = [
+    "ValidationError",
+    "load_schema",
+    "check_schema",
+    "validate_trace",
+    "validate_metrics_jsonl",
+    "validate_metrics_text",
+]
+
+SCHEMA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "schemas")
+
+
+class ValidationError(ValueError):
+    """A telemetry artifact violates its checked-in contract."""
+
+
+def load_schema(name: str) -> dict:
+    """A checked-in schema by file name (e.g. 'trace.schema.json')."""
+    with open(os.path.join(SCHEMA_DIR, name)) as f:
+        return json.load(f)
+
+
+# -- subset-of-JSON-Schema checker --------------------------------------------
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "boolean": bool,
+    "null": type(None),
+}
+
+
+def _type_ok(value, tname: str) -> bool:
+    if tname == "number":
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if tname == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    return isinstance(value, _TYPES[tname])
+
+
+def check_schema(value, schema: Mapping, path: str = "$") -> List[str]:
+    """Errors (empty = valid) for `value` against the schema subset."""
+    errs: List[str] = []
+    t = schema.get("type")
+    if t is not None:
+        types = t if isinstance(t, list) else [t]
+        if not any(_type_ok(value, name) for name in types):
+            return [f"{path}: expected {t}, got {type(value).__name__}"]
+    if "enum" in schema and value not in schema["enum"]:
+        errs.append(f"{path}: {value!r} not in enum {schema['enum']}")
+    if "minimum" in schema and isinstance(value, (int, float)) and not (
+        isinstance(value, bool)
+    ):
+        if value < schema["minimum"]:
+            errs.append(
+                f"{path}: {value} below minimum {schema['minimum']}"
+            )
+    if isinstance(value, dict):
+        for req in schema.get("required", ()):
+            if req not in value:
+                errs.append(f"{path}: missing required key {req!r}")
+        props = schema.get("properties", {})
+        addl = schema.get("additionalProperties", True)
+        for k, v in value.items():
+            if k in props:
+                errs.extend(check_schema(v, props[k], f"{path}.{k}"))
+            elif addl is False:
+                errs.append(f"{path}: unexpected key {k!r}")
+            elif isinstance(addl, dict):
+                errs.extend(check_schema(v, addl, f"{path}.{k}"))
+    if isinstance(value, list) and "items" in schema:
+        for i, item in enumerate(value):
+            errs.extend(check_schema(item, schema["items"], f"{path}[{i}]"))
+    return errs
+
+
+def _raise_or_return(errs: List[str], errors: str) -> List[str]:
+    if errs and errors == "raise":
+        raise ValidationError("; ".join(errs[:20]))
+    return errs
+
+
+# -- trace validation ---------------------------------------------------------
+
+
+def validate_trace(doc: Mapping, errors: str = "raise") -> List[str]:
+    """Schema + semantics for a trace-event document: every event
+    matches the checked-in schema; 'X' spans have finite ts and
+    non-negative dur; spans sharing a (pid, tid) lane properly nest
+    (for any two spans, disjoint or one contains the other)."""
+    errs = check_schema(doc, load_schema("trace.schema.json"))
+    if errs:
+        return _raise_or_return(errs, errors)
+    lanes: Dict[tuple, List[tuple]] = {}
+    for i, ev in enumerate(doc["traceEvents"]):
+        ph = ev.get("ph")
+        if ph == "X":
+            ts, dur = ev["ts"], ev["dur"]
+            if dur < 0:
+                errs.append(f"event[{i}] {ev.get('name')!r}: negative dur {dur}")
+                continue
+            lanes.setdefault((ev["pid"], ev["tid"]), []).append(
+                (float(ts), float(ts) + float(dur), i, ev.get("name"))
+            )
+    eps = 1e-3  # trace ts are rounded to 1e-3 us — tolerate the rounding
+    for lane, spans in lanes.items():
+        spans.sort(key=lambda s: (s[0], -s[1]))
+        stack: List[tuple] = []
+        for start, end, i, name in spans:
+            while stack and start >= stack[-1][1] - eps:
+                stack.pop()
+            if stack and end > stack[-1][1] + eps:
+                errs.append(
+                    f"lane pid={lane[0]} tid={lane[1]}: span "
+                    f"{name!r} [{start}, {end}] partially overlaps "
+                    f"{stack[-1][3]!r} [{stack[-1][0]}, {stack[-1][1]}] "
+                    "— spans on one lane must nest"
+                )
+                continue
+            stack.append((start, end, i, name))
+    return _raise_or_return(errs, errors)
+
+
+def validate_trace_file(path: str, errors: str = "raise") -> List[str]:
+    with open(path) as f:
+        return validate_trace(json.load(f), errors=errors)
+
+
+# -- metrics JSONL validation -------------------------------------------------
+
+
+def validate_metrics_jsonl(
+    lines: Sequence[str], errors: str = "raise"
+) -> List[str]:
+    """Every row parses and matches the row schema; `iteration` is
+    non-decreasing (it is a time series, not a bag)."""
+    schema = load_schema("metrics_jsonl.schema.json")
+    errs: List[str] = []
+    last_iter: Optional[int] = None
+    for n, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError as e:
+            errs.append(f"line {n + 1}: not JSON ({e})")
+            continue
+        errs.extend(
+            f"line {n + 1}: {e}" for e in check_schema(row, schema)
+        )
+        it = row.get("iteration")
+        if isinstance(it, int):
+            if last_iter is not None and it < last_iter:
+                errs.append(
+                    f"line {n + 1}: iteration {it} < previous {last_iter}"
+                )
+            last_iter = it
+    return _raise_or_return(errs, errors)
+
+
+def validate_metrics_jsonl_file(path: str, errors: str = "raise") -> List[str]:
+    with open(path) as f:
+        return validate_metrics_jsonl(f.readlines(), errors=errors)
+
+
+# -- Prometheus text validation -----------------------------------------------
+
+_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram|summary|untyped)$"
+)
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r" (-?[0-9.eE+-]+|NaN|\+Inf|-Inf)$"
+)
+_LE_RE = re.compile(r'le="([^"]*)"')
+
+
+def validate_metrics_text(text: str, errors: str = "raise") -> List[str]:
+    """Prometheus exposition grammar + histogram semantics: every line
+    is a HELP/TYPE header or a sample; every sampled family has a TYPE;
+    `_bucket` series are cumulative and end at the family's `_count`."""
+    errs: List[str] = []
+    typed: Dict[str, str] = {}
+    buckets: Dict[str, List[tuple]] = {}
+    counts: Dict[str, float] = {}
+    for n, line in enumerate(text.splitlines()):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            if not _HELP_RE.match(line):
+                errs.append(f"line {n + 1}: malformed HELP line")
+            continue
+        if line.startswith("# TYPE "):
+            m = _TYPE_RE.match(line)
+            if not m:
+                errs.append(f"line {n + 1}: malformed TYPE line")
+            else:
+                typed[m.group(1)] = m.group(2)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errs.append(f"line {n + 1}: malformed sample line {line!r}")
+            continue
+        name, labels = m.group(1), m.group(2) or ""
+        value = float(m.group(4).replace("+Inf", "inf").replace("-Inf", "-inf"))
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        if family not in typed and name not in typed:
+            errs.append(f"line {n + 1}: sample {name!r} has no TYPE header")
+        if name.endswith("_bucket"):
+            le = _LE_RE.search(labels)
+            if le is None:
+                errs.append(f"line {n + 1}: _bucket sample without le label")
+            else:
+                bound = float(le.group(1).replace("+Inf", "inf"))
+                buckets.setdefault(
+                    family + _labels_without_le(labels), []
+                ).append((bound, value))
+        elif name.endswith("_count"):
+            counts[family + labels] = value
+    for key, series in buckets.items():
+        series.sort()
+        vals = [v for _, v in series]
+        if any(prev > nxt for prev, nxt in zip(vals, vals[1:])):
+            errs.append(f"{key}: _bucket series is not cumulative")
+        if series and series[-1][0] != float("inf"):
+            errs.append(f"{key}: missing le=\"+Inf\" bucket")
+        total = counts.get(key)
+        if series and total is not None and vals[-1] != total:
+            errs.append(
+                f"{key}: +Inf bucket {vals[-1]} != _count {total}"
+            )
+    return _raise_or_return(errs, errors)
+
+
+_LABEL_PAIR_RE = re.compile(r'[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"')
+
+
+def _labels_without_le(labels: str) -> str:
+    rest = [
+        p for p in _LABEL_PAIR_RE.findall(labels) if not p.startswith("le=")
+    ]
+    return "{" + ",".join(rest) + "}" if rest else ""
