@@ -1,20 +1,41 @@
-"""Benchmark driver: flagship Transformer training throughput on TPU.
+"""Benchmark driver: flagship Transformer training throughput on TPU,
+plus the training-observability gates.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-
-The workload is the reference's headline Transformer benchmark
+Default mode prints ONE JSON line: {"metric", "value", "unit",
+"vs_baseline"} — the reference's headline Transformer benchmark
 (reference: examples/cpp/Transformer/transformer.cc — 12 layers, hidden
-1024, 16 heads, seq 512, bs 8/chip, SGD, MSE; prints THROUGHPUT samples/s).
-`vs_baseline` is measured against BASELINE_SAMPLES_PER_SEC, the f32
-data-parallel number of this rebuild measured with the same methodology.
+1024, 16 heads, seq 512, bs 8/chip, SGD, MSE; prints THROUGHPUT
+samples/s). `vs_baseline` is measured against
+BASELINE_SAMPLES_PER_SEC, the f32 data-parallel number of this rebuild
+measured with the same methodology. Timing methodology (round 2):
+on-device lax.scan chain differencing with min-over-reps —
+flexflow_tpu/utils/benchmark.py has the details.
 
-Timing methodology (round 2): on-device lax.scan chain differencing
-with min-over-reps — flexflow_tpu/utils/benchmark.py has the details.
+Two additional modes back the search/training observability CI job:
+
+* ``--train-telemetry [--smoke]`` — the fit-loop overhead gate
+  (BENCH_TRAIN_TELEMETRY.json): three identically-seeded models train
+  interleaved with telemetry off / in-memory / full-export. The
+  in-memory configuration must hold >= 0.98x the uninstrumented
+  throughput (the same <=2% contract bench_serve.py --telemetry holds
+  for serving), final parameters must be BIT-IDENTICAL across modes
+  (observation must not perturb training), and the full-export
+  artifacts must validate against the checked-in schemas. Exits
+  nonzero on any violation.
+* ``--audit [--smoke]`` — the predicted-vs-measured cost-model audit
+  (BENCH_COST_AUDIT.json): compile the bench model, price it with the
+  search's CostModel, measure the real executor step, and export
+  cost_model_error_ratio per op family plus the calibration-table
+  write-back. Exits nonzero when the audit produces no per-family
+  ratios (the artifact is the deliverable — on CPU the analytic model
+  predicts TPU times, so the RATIO is informative, not gated; on TPU
+  with --measure-costs it converges toward 1).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 # f32 single-chip data-parallel throughput of this framework measured with
@@ -23,9 +44,10 @@ import sys
 # self-relative).
 BASELINE_SAMPLES_PER_SEC = 238.0
 
+HERE = os.path.dirname(os.path.abspath(__file__))
 
-def main():
-    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+def run_flagship():
     from examples.transformer import build_transformer, synthetic_batch
     from flexflow_tpu import FFConfig
     from flexflow_tpu.utils.benchmark import measure_train_step
@@ -55,6 +77,214 @@ def main():
             }
         )
     )
+
+
+def _build_train_model(seed=0, batch=32, hidden=128, layers=3, classes=8):
+    """Small dense stack for the CPU-fast observability gates; one
+    model per telemetry mode, identical seeds → identical init."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.core.types import LossType
+
+    cfg = FFConfig(batch_size=batch, seed=seed)
+    model = FFModel(cfg)
+    x = model.create_tensor([batch, hidden], name="x")
+    t = x
+    for i in range(layers):
+        t = model.dense(t, hidden, activation=ActiMode.RELU, name=f"d{i}")
+    t = model.dense(t, classes, name="head")
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    )
+    return model
+
+
+def run_train_telemetry(smoke: bool = False):
+    """Fit-loop telemetry gate; writes BENCH_TRAIN_TELEMETRY.json."""
+    import tempfile
+
+    import numpy as np
+
+    from flexflow_tpu.telemetry import (
+        Telemetry,
+        validate_metrics_jsonl_file,
+        validate_metrics_text,
+        validate_trace_file,
+    )
+
+    batch, hidden, layers = 32, (96 if smoke else 192), 3
+    iters = 24 if smoke else 64
+    reps = 2 if smoke else 3
+    n = batch * iters
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((n, hidden)).astype(np.float32)
+    y = rng.integers(0, 8, size=(n,)).astype(np.int32)
+
+    tmp = tempfile.mkdtemp(prefix="flexflow_train_tele_")
+    paths = {
+        "metrics_out": os.path.join(tmp, "train.prom"),
+        "metrics_jsonl": os.path.join(tmp, "train.jsonl"),
+        "trace": os.path.join(tmp, "train_trace.json"),
+    }
+    modes = ("off", "on", "full")
+    models = {
+        m: _build_train_model(seed=0, batch=batch, hidden=hidden,
+                              layers=layers)
+        for m in modes
+    }
+    def make_tele(mode):
+        # a fresh bundle per rep: fit()'s iteration counter is
+        # per-call, and the full mode's writers truncate on open, so
+        # the LAST rep's files are the validated artifact
+        if mode == "off":
+            return None
+        if mode == "on":  # in-memory metrics only, no tracer, no I/O
+            return Telemetry()
+        return Telemetry(**paths)
+
+    for m in modes:  # warm the jit off the clock
+        models[m].init_operators()
+
+    tps = {m: [] for m in modes}
+    last_tele = {}
+    for rep in range(reps):  # interleaved: all modes see the same drift
+        for m in modes:
+            tele = make_tele(m)
+            last_tele[m] = tele
+            hist = models[m].fit(
+                X, y, epochs=1, batch_size=batch, verbose=False,
+                telemetry=tele,
+            )
+            tps[m].append(hist[0]["throughput"])
+    mean = {m: sum(v) / len(v) for m, v in tps.items()}
+    on_ratio = mean["on"] / mean["off"]
+    full_ratio = mean["full"] / mean["off"]
+
+    # observation must not perturb training: final params bit-identical
+    ref = models["off"].executor.export_host_params(models["off"].params)
+    mismatched = []
+    for m in ("on", "full"):
+        got = models[m].executor.export_host_params(models[m].params)
+        same = set(ref) == set(got) and all(
+            len(ref[g]) == len(got[g])
+            and all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(ref[g], got[g])
+            )
+            for g in ref
+        )
+        if not same:
+            mismatched.append(m)
+    if mismatched:
+        raise SystemExit(
+            f"telemetry perturbed training in mode(s) {mismatched}: "
+            "final params differ from the uninstrumented run"
+        )
+
+    last_tele["full"].flush()
+    errs = (
+        validate_trace_file(paths["trace"], errors="list")
+        + validate_metrics_text(
+            open(paths["metrics_out"]).read(), errors="list"
+        )
+        + validate_metrics_jsonl_file(paths["metrics_jsonl"], errors="list")
+    )
+    if errs:
+        raise SystemExit(
+            f"training telemetry artifacts failed validation: {errs[:5]}"
+        )
+    text = open(paths["metrics_out"]).read()
+    missing = [
+        s
+        for s in (
+            "train_loss", "train_step_time_s", "train_iterations_total",
+            "train_examples_total", "train_jit_builds",
+            "train_recompiles_total",
+        )
+        if s not in text
+    ]
+    if missing:
+        raise SystemExit(f"train_* series missing from exposition: {missing}")
+
+    doc = {
+        "preset": "smoke" if smoke else "medium",
+        "iterations_per_rep": iters,
+        "reps": reps,
+        "samples_per_s": {m: round(mean[m], 2) for m in modes},
+        "on_off_ratio": round(on_ratio, 4),
+        "full_off_ratio": round(full_ratio, 4),
+        "params_identical": True,
+        "artifacts_valid": True,
+        "jsonl_rows": sum(1 for _ in open(paths["metrics_jsonl"])),
+    }
+    with open(os.path.join(HERE, "BENCH_TRAIN_TELEMETRY.json"), "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc))
+    if on_ratio < 0.98:
+        raise SystemExit(
+            f"in-memory training telemetry costs more than 2%: "
+            f"on/off ratio {on_ratio:.4f} < 0.98"
+        )
+
+
+def run_audit(smoke: bool = False):
+    """Predicted-vs-measured audit; writes BENCH_COST_AUDIT.json."""
+    import tempfile
+
+    from flexflow_tpu.telemetry import MetricsRegistry
+
+    model = _build_train_model(
+        seed=0, batch=32, hidden=96 if smoke else 256,
+        layers=2 if smoke else 4,
+    )
+    calib = os.path.join(
+        tempfile.mkdtemp(prefix="flexflow_audit_"), "calibration.json"
+    )
+    reg = MetricsRegistry()
+    res = model.audit_cost_model(
+        registry=reg,
+        reps=2 if smoke else 4,
+        profile_iters=2 if smoke else 5,
+        calibration_file=calib,
+    )
+    print(res.describe())
+    ratios = {
+        f.family: f.error_ratio
+        for f in res.families.values()
+        if f.measured_s > 0
+    }
+    if not ratios:
+        raise SystemExit("audit produced no per-family error ratios")
+    if reg.get("cost_model_error_ratio", labels={"family": "_step"}) is None:
+        raise SystemExit("cost_model_error_ratio{family=_step} not exported")
+    with open(calib) as f:
+        caldoc = json.load(f)
+    if "audit" not in caldoc:
+        raise SystemExit("audit write-back missing from calibration table")
+    doc = {
+        "preset": "smoke" if smoke else "medium",
+        **res.to_doc(),
+        "calibration_written": True,
+    }
+    with open(os.path.join(HERE, "BENCH_COST_AUDIT.json"), "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"metric": "cost_model_step_error_ratio",
+                      "value": round(res.step_error_ratio, 6),
+                      "unit": "predicted/measured"}))
+
+
+def main():
+    sys.path.insert(0, HERE)
+    args = sys.argv[1:]
+    smoke = "--smoke" in args
+    if "--train-telemetry" in args:
+        run_train_telemetry(smoke=smoke)
+    elif "--audit" in args:
+        run_audit(smoke=smoke)
+    else:
+        run_flagship()
 
 
 if __name__ == "__main__":

@@ -35,6 +35,16 @@ findings go to the baseline):
   exactly when the pipeline is full — the reconcile must read the
   ``InflightStep`` snapshot (``step.lengths``, ``step.active``,
   ``step.participants``) and nothing else.
+* **FX104** — a search-trace recording call (a ``candidate``/
+  ``header``/``event``/``result``/``phase`` method on an object whose
+  access path names ``trace``) whose argument loads a mutated
+  attribute without a copy. Trace rows are a HISTORY: the searcher
+  keeps mutating its view maps / graph tables after the record is
+  taken, so a captured live reference lets rows rewrite themselves
+  retroactively — the exported artifact then describes a search that
+  never happened. Same deferred-read shape as FX101, different queue
+  (the JSONL writer instead of the jit dispatch). Pass scalars or a
+  fresh ``dict(...)``/``list(...)``/``.copy()``.
 """
 
 from __future__ import annotations
@@ -53,12 +63,20 @@ RULES = {
     "FX102": "mutable host attribute passed raw into a jitted callable",
     "FX103": "reconcile reads live cache state instead of the "
     "InflightStep snapshot",
+    "FX104": "search-trace hook captures live mutable state without a "
+    "copy",
 }
 
 _STEP_PARAM_NAMES = {"step", "inflight"}
 
 _ASARRAY_CHAINS = {("jnp", "asarray"), ("jax", "numpy", "asarray")}
 _SNAPSHOT_NAMES = {"snapshot"}
+# builtins that materialize a fresh container — a copy by construction
+_COPYING_BUILTINS = {"dict", "list", "tuple", "sorted", "set", "frozenset"}
+
+#: SearchTrace recording surface (telemetry/search_trace.py); `phase`
+#: is included for its kwargs
+_TRACE_METHODS = {"candidate", "header", "event", "result", "phase"}
 
 
 def _is_asarray(func: ast.AST) -> bool:
@@ -67,14 +85,17 @@ def _is_asarray(func: ast.AST) -> bool:
 
 def _is_snapshot_call(node: ast.Call) -> bool:
     """A call that yields an immutable copy: ``x.copy()``,
-    ``np.array(x)`` (copies by default), or the blessed
-    ``snapshot(x)`` helper."""
+    ``np.array(x)`` (copies by default), a fresh-container builtin
+    (``dict(x)``/``list(x)``/...), or the blessed ``snapshot(x)``
+    helper."""
     if isinstance(node.func, ast.Attribute) and node.func.attr == "copy":
         return True
     chain = name_chain(node.func)
     if chain is None:
         return False
     if chain[-1] in _SNAPSHOT_NAMES:
+        return True
+    if len(chain) == 1 and chain[0] in _COPYING_BUILTINS:
         return True
     return len(chain) >= 2 and chain[-2] in ("np", "numpy") and (
         chain[-1] == "array"
@@ -200,6 +221,25 @@ def _reconcile_violations(
     return found
 
 
+def _is_trace_hook(node: ast.Call) -> bool:
+    """A SearchTrace recording call: `<...>.trace.candidate(...)`,
+    `trace.result(...)`, `self._trace.event(...)` — the method is one
+    of the recording surface and the object path names a trace.
+    `tracer` objects (telemetry/trace.py, a different API) don't
+    match."""
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr not in _TRACE_METHODS:
+        return False
+    chain = name_chain(node.func)
+    if chain is None or len(chain) < 2:
+        return False
+    owner = chain[-2]
+    return owner in ("trace", "_trace", "search_trace") or (
+        owner.endswith("_trace")
+    )
+
+
 def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
     mutated = collect_mutated_attrs(trees)
     diags: List[Diagnostic] = []
@@ -241,6 +281,28 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                                 "(.copy()/np.array/snapshot) — the "
                                 "deferred host read races later "
                                 "mutation behind the dispatch queue",
+                            )
+                        )
+                continue
+            if _is_trace_hook(node):
+                args = list(node.args) + [
+                    kw.value for kw in node.keywords if kw.arg is not None
+                ]
+                for arg in args:
+                    if isinstance(arg, ast.Starred):
+                        continue
+                    for attr, line in _tainted_loads(arg, mutated):
+                        diags.append(
+                            Diagnostic(
+                                "FX104",
+                                path,
+                                line,
+                                f"search-trace hook captures mutable "
+                                f"attribute '{attr}' without a copy — "
+                                "the searcher mutates it after the "
+                                "record is taken, so the exported row "
+                                "would rewrite itself; pass a scalar "
+                                "or dict(...)/list(...)/.copy()",
                             )
                         )
                 continue

@@ -81,6 +81,15 @@ class FFConfig:
     # calibration_file persists the measured table across runs.
     measure_costs: bool = False
     calibration_file: str = ""
+    # search observability (flexflow_tpu.telemetry.search_trace):
+    # --search-trace exports every candidate the strategy search
+    # considered as schema-validated JSONL (plus a Chrome trace-event
+    # timeline of the search phases as <path>.trace.json);
+    # --explain prints the explain_strategy() report — why the winning
+    # strategy won — after the search (and alongside any exported
+    # trace, which `python -m flexflow_tpu.search.explain` re-reads)
+    search_trace_file: str = ""
+    search_explain: bool = False
 
     # runtime
     perform_fusion: bool = False  # reference: --fusion
@@ -135,13 +144,15 @@ class FFConfig:
     # iteration (the chaos harness's probe) — debugging/CI posture
     serve_check_invariants: bool = False
     # telemetry (flexflow_tpu.telemetry): --metrics-out writes
-    # Prometheus text exposition at the end of a serve run,
-    # --metrics-jsonl streams one sample row per scheduler iteration,
-    # --trace writes a Chrome trace-event JSON (Perfetto-loadable),
-    # --slo-ttft-ms / --slo-itl-ms set rolling-window SLO thresholds
-    # (milliseconds; 0 = observe but never count violations), and
-    # --serve-telemetry force-enables the in-memory bundle without any
-    # output path
+    # Prometheus text exposition at the end of a serve OR fit run,
+    # --metrics-jsonl streams one sample row per scheduler/training
+    # iteration, --trace writes a Chrome trace-event JSON
+    # (Perfetto-loadable), --slo-ttft-ms / --slo-itl-ms set
+    # rolling-window SLO thresholds (milliseconds; 0 = observe but
+    # never count violations), and --serve-telemetry force-enables the
+    # in-memory bundle without any output path. The same knobs drive
+    # FFModel.fit's training telemetry (train_* series) — the fields
+    # keep their historical serve_ prefix
     serve_metrics_out: str = ""
     serve_metrics_jsonl: str = ""
     serve_trace: str = ""
@@ -239,6 +250,10 @@ class FFConfig:
                 cfg.measure_costs = True
             elif a == "--calibration-file":
                 cfg.calibration_file = take()
+            elif a == "--search-trace":
+                cfg.search_trace_file = take()
+            elif a == "--explain":
+                cfg.search_explain = True
             elif a == "--fusion":
                 cfg.perform_fusion = True
             elif a == "--allow-tensor-op-math-conversion":

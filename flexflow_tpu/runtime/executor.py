@@ -133,6 +133,12 @@ class Executor:
         self._eval_step = None
         self._fwd = None
         self._grad_fn = None
+        # jit-cache telemetry: how many step callables this executor
+        # built (each is one XLA compile on first call) and how many
+        # times a cached step was dropped (seq-length change, LR
+        # rebind) — mirrored into train_jit_* series by FFModel.fit
+        self.jit_builds = 0
+        self.jit_invalidations = 0
 
     # -- shardings -----------------------------------------------------------
 
@@ -474,6 +480,13 @@ class Executor:
         distinct length is one XLA recompile, like a new Legion trace."""
         if seq_length != self.seq_length:
             self.seq_length = seq_length
+            self.jit_invalidations += sum(
+                f is not None
+                for f in (
+                    self._train_step, self._eval_step, self._fwd,
+                    self._grad_fn,
+                )
+            )
             self._train_step = None
             self._eval_step = None
             self._fwd = None
@@ -482,6 +495,7 @@ class Executor:
     def train_step(self):
         if self._train_step is None:
             self._train_step = jax.jit(self.train_step_fn(), donate_argnums=(0, 1))
+            self.jit_builds += 1
         return self._train_step
 
     def eval_step(self):
@@ -491,6 +505,7 @@ class Executor:
                 return self._loss_and_metrics(params, batch, None, train=False)
 
             self._eval_step = jax.jit(step)
+            self.jit_builds += 1
         return self._eval_step
 
     def grad_fn(self):
@@ -508,6 +523,7 @@ class Executor:
                 return jax.grad(loss_fn)(params)
 
             self._grad_fn = jax.jit(grads)
+            self.jit_builds += 1
         return self._grad_fn
 
     def forward_fn(self):
@@ -519,6 +535,7 @@ class Executor:
                 return values[(self.logits_ref.guid, self.logits_ref.out_idx)]
 
             self._fwd = jax.jit(fwd)
+            self.jit_builds += 1
         return self._fwd
 
     # -- data placement ------------------------------------------------------

@@ -99,6 +99,10 @@ class FFModel:
         self._rng = jax.random.PRNGKey(self.config.seed)
         self._logits: Optional[Tensor] = None
         self.strategy = None  # filled by compile()
+        self.search_trace = None  # filled by search_strategy (--search-trace)
+        # recompile_on_condition fires (runtime/recompile.py) — mirrored
+        # into the train_recompiles_total telemetry counter by fit()
+        self.recompile_events = 0
 
     # ------------------------------------------------------------------ util
 
@@ -992,18 +996,36 @@ class FFModel:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
         callbacks=None,
+        telemetry=None,
     ):
         """Training loop (reference: flexflow_cffi.py:1916-1958 fit —
         per-iter begin_trace; next_batch; forward; zero_gradients; backward;
         update; end_trace. Here one jitted step does all of it). Callback
         hooks follow the reference keras loop (base_model.py:374-430):
         set_model, on_train_begin, per-epoch and per-batch hooks; a True
-        return from on_epoch_end stops training early."""
+        return from on_epoch_end stops training early.
+
+        telemetry: a flexflow_tpu.telemetry.Telemetry bundle, or None to
+        build one from the config's --metrics-out/--metrics-jsonl/
+        --trace knobs (the serving flags now drive training too). With
+        the bundle attached, fit exports per-iteration train_* series
+        (step time, examples/s, loss, recompiles, jit-cache builds) and
+        a Chrome trace of iteration/epoch spans; the hot loop pays one
+        predicate branch plus two appends per iteration — losses and
+        rows are materialized at epoch end, AFTER the existing
+        block_until_ready, so telemetry adds no device syncs."""
         if self.executor is None:
             raise RuntimeError("call compile() before fit()")
         epochs = epochs or self.config.epochs
         batch_size = batch_size or self.config.batch_size
         callbacks = list(callbacks or [])
+        tele = telemetry
+        if tele is None:
+            from flexflow_tpu.telemetry import build_telemetry
+
+            tele = build_telemetry(self.config)
+        self._telemetry = tele
+        train_iters = 0  # global iteration counter across epochs
         for cb in callbacks:
             # the keras frontend pre-binds its own Model wrapper; direct
             # FFModel.fit users get the FFModel itself
@@ -1029,9 +1051,12 @@ class FFModel:
             perf = PerfMetrics()
             loader.reset()
             t0 = time.perf_counter()
+            epoch_t0 = t0
             samples = 0
             step_results = []  # device arrays; converted once per epoch so
             # the loop stays async (no per-iteration host sync)
+            stamps = []  # host clock at each dispatch (telemetry only)
+            sample_counts = []
             for it in range(loader.num_batches):
                 for cb in callbacks:
                     cb.on_batch_begin(it)
@@ -1041,6 +1066,13 @@ class FFModel:
                 self.params, self.opt_state, loss, mets = step(
                     self.params, self.opt_state, batch, key
                 )
+                if tele is not None:
+                    # dispatch-to-dispatch host stamps; rows/spans are
+                    # built at epoch end, off the hot loop
+                    stamps.append(time.perf_counter())
+                    sample_counts.append(
+                        len(next(iter(np_batch.values())))
+                    )
                 if self._cache_specs:
                     # surface cache-op inputs to the host memoizer
                     # (syncs; only models that built cache() ops pay it)
@@ -1074,10 +1106,18 @@ class FFModel:
                     )
             jax.block_until_ready(self.params)
             elapsed = time.perf_counter() - t0
+            losses = []
             for loss, mets in step_results:
-                perf.update(jax.tree_util.tree_map(float, mets), float(loss))
+                fl = float(loss)
+                perf.update(jax.tree_util.tree_map(float, mets), fl)
+                losses.append(fl)
             self._perf_metrics = perf
             thpt = samples / elapsed if elapsed > 0 else 0.0
+            if tele is not None:
+                train_iters = self._record_training_epoch(
+                    tele, epoch, epoch_t0, stamps, sample_counts, losses,
+                    train_iters,
+                )
             history.append({"epoch": epoch, "throughput": thpt, **perf.__dict__})
             if verbose:
                 print(f"epoch {epoch}: {perf.report()}")
@@ -1098,7 +1138,78 @@ class FFModel:
                 break
         for cb in callbacks:
             cb.on_train_end()
+        if tele is not None:
+            tele.flush()
         return history
+
+    def _record_training_epoch(
+        self, tele, epoch, epoch_t0, stamps, sample_counts, losses,
+        train_iters,
+    ) -> int:
+        """Materialize one epoch's telemetry AFTER the epoch-end device
+        sync: per-iteration train_* gauges + counters, one JSONL sample
+        row per iteration, iteration/epoch spans on the trace, and the
+        recompile/jit-cache mirrors. Returns the advanced global
+        iteration counter. Registry handles are get-or-create dict
+        lookups — cheap at epoch granularity."""
+        reg = tele.registry
+        g_loss = reg.gauge("train_loss", help="training loss (last step)")
+        g_step = reg.gauge(
+            "train_step_time_s",
+            help="per-iteration wall time, host dispatch-to-dispatch",
+        )
+        g_eps = reg.gauge(
+            "train_examples_per_s",
+            help="instantaneous examples/s of the last iteration",
+        )
+        g_epoch = reg.gauge("train_epoch", help="current epoch index")
+        c_iters = reg.counter(
+            "train_iterations_total", help="training iterations run"
+        )
+        c_examples = reg.counter(
+            "train_examples_total", help="training examples consumed"
+        )
+        c_recompiles = reg.counter(
+            "train_recompiles_total",
+            help="recompile_on_condition fires (model mutations)",
+        )
+        g_jit = reg.gauge(
+            "train_jit_builds",
+            help="step callables built by the executor "
+            "(each first call is one XLA compile)",
+        )
+        g_inval = reg.gauge(
+            "train_jit_invalidations",
+            help="cached step callables dropped (seq-length change, "
+            "LR rebind)",
+        )
+        tracer = tele.tracer
+        g_epoch.set(epoch)
+        prev = epoch_t0
+        for i, t_end in enumerate(stamps):
+            fl = losses[i] if i < len(losses) else float("nan")
+            dt = t_end - prev
+            g_loss.set(fl)
+            g_step.set(dt)
+            g_eps.set(sample_counts[i] / dt if dt > 0 else 0.0)
+            c_iters.inc()
+            c_examples.inc(sample_counts[i])
+            c_recompiles.set_monotonic(float(self.recompile_events))
+            g_jit.set(float(self.executor.jit_builds))
+            g_inval.set(float(self.executor.jit_invalidations))
+            tracer.complete(
+                "iteration", "train", prev, t_end,
+                args={"epoch": epoch, "iteration": train_iters,
+                      "loss": fl},
+            )
+            tele.sample(train_iters)
+            prev = t_end
+            train_iters += 1
+        tracer.complete(
+            "epoch", "train", epoch_t0, prev if stamps else epoch_t0,
+            args={"epoch": epoch},
+        )
+        return train_iters
 
     def evaluate(self, x, y, batch_size: Optional[int] = None, callbacks=None):
         batch_size = batch_size or self.config.batch_size
@@ -1318,6 +1429,16 @@ class FFModel:
 
         return profile_operators(self, batch, iters=iters, verbose=verbose)
 
+    def audit_cost_model(self, batch=None, **kwargs):
+        """Predicted-vs-measured cost-model audit (search/audit.py):
+        price the compiled graph with the search's own CostModel, time
+        the real executor step, export cost_model_error_ratio gauges
+        per op family, and feed the residuals back through the
+        calibration table's read-merge-write path."""
+        from flexflow_tpu.search.audit import audit_cost_model
+
+        return audit_cost_model(self, batch=batch, **kwargs)
+
     def recompile_on_condition(self, state) -> bool:
         """Mid-training model mutation + recompile (reference:
         FFModel::recompile_on_condition, model.cc:2416-2420; MoE expert
@@ -1423,6 +1544,8 @@ class FFModel:
         self.optimizer = _dc.replace(self.optimizer, **{field: lr})
         if self.executor is not None:
             self.executor.optimizer = self.optimizer
+            if self.executor._train_step is not None:
+                self.executor.jit_invalidations += 1
             self.executor._train_step = None
 
     def restore_checkpoint(self, directory: str, step: Optional[int] = None) -> int:

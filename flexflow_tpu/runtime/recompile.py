@@ -145,4 +145,5 @@ def recompile_on_condition(model, state: RecompileState) -> bool:
     # opt_state from compile() stays valid: placement preserves shapes,
     # and a recompile resets momenta by design (the reference re-inits
     # optimizer tasks after recompile too)
+    model.recompile_events = getattr(model, "recompile_events", 0) + 1
     return True

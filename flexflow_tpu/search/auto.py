@@ -353,15 +353,36 @@ def extra_axis_candidates(
     spec: MachineSpec,
     attribute_parallel: bool = False,
     verbose: bool = False,
+    trace=None,
 ):
     """The strategy families BEYOND the dp×tp grid — mixed (heterogeneous
     per-op), sequence (ring/Ulysses), spatial, pipeline. Shared by the
     mesh engine's optimize() and by the unity/mcmc entries, so every
     engine covers the whole space its runtime can execute (the reference
     has ONE search over everything its runtime does,
-    substitution.cc:1721-1862). Returns (results, evals)."""
+    substitution.cc:1721-1862). Returns (results, evals). `trace`
+    (telemetry.SearchTrace) records each feasible candidate with its
+    GraphCost breakdown."""
     results = []
     evals = 0
+
+    def _rec(cur: "SearchResult") -> None:
+        if trace is None:
+            return
+        c = cur.cost
+        descr = cur.describe()  # fresh string — rows hold no live state
+        trace.candidate(
+            "extra_axis",
+            name=descr,
+            dp=cur.dp,
+            step_time=c.step_time,
+            compute_time=c.compute_time,
+            comm_time=c.comm_time,
+            sync_time=c.sync_time,
+            update_time=c.update_time,
+            memory_per_chip=float(c.memory_per_chip),
+            feasible=bool(c.feasible(spec)),
+        )
 
     # heterogeneous candidates: TP sites on the model axis, everything
     # else full-width data-parallel (reference: per-op MachineViews,
@@ -390,6 +411,7 @@ def extra_axis_candidates(
             )
             if verbose:
                 print(f"[search] {cur.describe()}")
+            _rec(cur)
             results.append(cur)
 
     # sequence-parallel candidates: (dp, sp) meshes with ring attention
@@ -414,6 +436,7 @@ def extra_axis_candidates(
             )
             if verbose:
                 print(f"[search] {cur.describe()}")
+            _rec(cur)
             results.append(cur)
 
     # attribute/spatial candidates: image H over the second axis
@@ -431,6 +454,7 @@ def extra_axis_candidates(
             )
             if verbose:
                 print(f"[search] {cur.describe()}")
+            _rec(cur)
             results.append(cur)
 
     # pipeline candidates: (dp, pipe) meshes over a repeated-block trunk
@@ -460,6 +484,7 @@ def extra_axis_candidates(
                 )
                 if verbose:
                     print(f"[search] {cur.describe()}")
+                _rec(cur)
                 results.append(cur)
 
     return results, evals
@@ -480,6 +505,7 @@ def optimize(
     attribute_parallel: bool = False,
     sparse_embedding: bool = True,
     _explore_fuse: bool = True,
+    trace=None,
 ) -> SearchResult:
     """Run the search on a PCG; returns the best found configuration.
 
@@ -487,7 +513,11 @@ def optimize(
     (peephole.fuse_linear_activation — create_linear_relu_merge analog)
     and keep whichever graph's best strategy wins; the winning result
     carries extra={"fuse": True} so the lowering fuses before applying
-    sites (whose guids were found on the fused graph)."""
+    sites (whose guids were found on the fused graph).
+
+    trace: an optional telemetry.SearchTrace — every candidate the
+    mesh × rewrite-site search scores lands in it with its full
+    GraphCost breakdown (via estimate_graph_cost's trace hook)."""
     cm = CostModel(
         spec,
         measure=measure,
@@ -507,7 +537,10 @@ def optimize(
         if g is None:
             return None
         mesh_sizes = (dp, tp) if tp > 1 else (dp,)
-        cost = estimate_graph_cost(g, cm, mesh_sizes)
+        cost = estimate_graph_cost(
+            g, cm, mesh_sizes, trace=trace,
+            trace_label=f"mesh(dp={dp},tp={tp},sites_on={sum(on)})",
+        )
         if not cost.feasible(spec):
             return None
         return cost
@@ -590,6 +623,7 @@ def optimize(
                 attribute_parallel=attribute_parallel,
                 sparse_embedding=sparse_embedding,
                 _explore_fuse=False,
+                trace=trace,
             )
             if fbest.cost.step_time < best.cost.step_time:
                 fbest.extra["fuse"] = True
@@ -1294,6 +1328,29 @@ def search_serving_strategy(
     )
 
 
+def _record_search_result_trace(trace, sr: SearchResult, spec) -> None:
+    """Record a SearchResult (mesh / extra-axis winner) as the trace's
+    result. Mesh strategies have no per-op view map, so the breakdown is
+    the GraphCost aggregate and the whole total rides the residual —
+    the explain identity (sum(ops) + residual == total) still holds."""
+    c = sr.cost
+    descr = sr.describe()  # fresh string — rows hold no live state
+    trace.result(
+        total_cost=c.step_time,
+        ops=[],
+        residual=c.step_time,
+        kind=sr.kind,
+        name=descr,
+        dp=sr.dp,
+        compute_time=c.compute_time,
+        comm_time=c.comm_time,
+        sync_time=c.sync_time,
+        update_time=c.update_time,
+        memory_per_chip=float(c.memory_per_chip),
+        feasible=bool(c.feasible(spec)),
+    )
+
+
 def search_strategy(model, num_devices: int) -> Strategy:
     """compile()-time entry (reference: graph_optimize_task,
     graph.cc:1545-1613)."""
@@ -1310,6 +1367,32 @@ def search_strategy(model, num_devices: int) -> Strategy:
         chip=cfg.chip,
     )
     if n <= 1:
+        # nothing to search on one device — but a requested trace must
+        # still produce a valid artifact (a silently-missing export
+        # breaks explain/CI workflows on single-chip boxes)
+        if cfg.search_trace_file or cfg.search_explain:
+            from flexflow_tpu.telemetry.search_trace import SearchTrace
+
+            trace = SearchTrace(
+                engine=cfg.search_engine, path=cfg.search_trace_file
+            )
+            trace.header(
+                engine=cfg.search_engine, seed=cfg.seed,
+                budget=cfg.search_budget, measure=bool(cfg.measure_costs),
+            )
+            trace.event("search_skipped", reason="single device")
+            trace.result(
+                total_cost=0.0, ops=[], residual=0.0,
+                kind="data-parallel",
+                name="data-parallel (single device — search skipped)",
+            )
+            model.search_trace = trace
+            if cfg.search_trace_file:
+                trace.save()
+            if cfg.search_explain:
+                from flexflow_tpu.search.explain import explain_strategy
+
+                print(explain_strategy(trace.rows()).text())
         return data_parallel_strategy(num_devices, model.graph)
 
     if cfg.search_engine not in ("mesh", "unity", "mcmc"):
@@ -1323,6 +1406,47 @@ def search_strategy(model, num_devices: int) -> Strategy:
     sparse_ok = cfg.sparse_embedding_update and (
         model.optimizer is None or model.optimizer.supports_sparse()
     )
+    # search observability (--search-trace / --explain): one SearchTrace
+    # threads through whichever engine runs; the exported JSONL +
+    # timeline reconstruct every candidate considered, and the explain
+    # report reconstructs why the winner won (search/explain.py)
+    trace = None
+    if cfg.search_trace_file or cfg.search_explain:
+        from flexflow_tpu.telemetry.search_trace import SearchTrace
+
+        trace = SearchTrace(
+            engine=cfg.search_engine, path=cfg.search_trace_file
+        )
+        n_nodes = len(model.graph.nodes)  # scalar precomputed: trace
+        # rows must not touch live graph state (fxlint FX104)
+        trace.header(
+            engine=cfg.search_engine,
+            seed=cfg.seed,
+            budget=cfg.search_budget,
+            alpha=cfg.search_alpha,
+            measure=bool(cfg.measure_costs),
+            machine={
+                "num_nodes": spec.num_nodes,
+                "chips_per_node": spec.chips_per_node,
+                "chip": spec.chip,
+            },
+            graph={
+                "nodes": n_nodes,
+                "batch_size": cfg.batch_size,
+            },
+        )
+        model.search_trace = trace
+
+    def _finish_trace() -> None:
+        """Export + explain once the winner is known."""
+        if trace is None:
+            return
+        if cfg.search_trace_file:
+            trace.save()
+        if cfg.search_explain:
+            from flexflow_tpu.search.explain import explain_strategy
+
+            print(explain_strategy(trace.rows()).text())
     if cfg.search_engine in ("unity", "mcmc"):
         from flexflow_tpu.search import unity as unity_mod
 
@@ -1335,6 +1459,7 @@ def search_strategy(model, num_devices: int) -> Strategy:
                 measure=cfg.measure_costs,
                 calibration_file=cfg.calibration_file,
                 sparse_embedding=sparse_ok,
+                trace=trace,
             ).optimize()
         else:
             from flexflow_tpu.search.mcmc import mcmc_optimize
@@ -1351,6 +1476,7 @@ def search_strategy(model, num_devices: int) -> Strategy:
                 measure=cfg.measure_costs,
                 calibration_file=cfg.calibration_file,
                 sparse_embedding=sparse_ok,
+                trace=trace,
             )
         # every engine must cover the whole strategy space the runtime
         # executes (VERDICT r2 item 6; the reference has one search over
@@ -1372,6 +1498,7 @@ def search_strategy(model, num_devices: int) -> Strategy:
             spec,
             attribute_parallel=cfg.enable_attribute_parallel,
             verbose=cfg.profiling,
+            trace=trace,
         )
         extra_best = (
             min(extra, key=lambda r: r.cost.step_time) if extra else None
@@ -1391,7 +1518,17 @@ def search_strategy(model, num_devices: int) -> Strategy:
                 save_search_result(
                     extra_best, model.graph, cfg.export_strategy_file
                 )
-            return result_to_strategy(extra_best, model.graph)
+            if trace is not None:
+                # the extra-axis gate overrode the engine's pick: the
+                # result record must describe the strategy actually
+                # lowered (the engine's own record is replaced)
+                _record_search_result_trace(trace, extra_best, spec)
+            _finish_trace()
+            s = result_to_strategy(extra_best, model.graph)
+            # the audit (search/audit.py) compares this prediction
+            # against the executor's measured step after compile()
+            s.predicted_step_time = extra_best.cost.step_time
+            return s
         print(f"Optimal cost: {result.cost * 1e3:.6f}")
         if cfg.export_strategy_file:
             unity_mod.save_views(
@@ -1400,9 +1537,12 @@ def search_strategy(model, num_devices: int) -> Strategy:
                 cfg.export_strategy_file,
                 engine=cfg.search_engine,
             )
-        return unity_mod.result_to_strategy(
+        _finish_trace()
+        s = unity_mod.result_to_strategy(
             result, model.graph, num_devices, engine=cfg.search_engine
         )
+        s.predicted_step_time = result.cost
+        return s
 
     result = optimize(
         model.graph,
@@ -1420,10 +1560,16 @@ def search_strategy(model, num_devices: int) -> Strategy:
         # mirror the executor's full gate: flag AND an optimizer that
         # implements sparse rows (Executor._sparse_embedding_guids)
         sparse_embedding=sparse_ok,
+        trace=trace,
     )
     print(f"[flexflow_tpu] search: best strategy = {result.describe()}")
     if cfg.export_strategy_file:
         from flexflow_tpu.search.strategy_io import save_search_result
 
         save_search_result(result, model.graph, cfg.export_strategy_file)
-    return result_to_strategy(result, model.graph)
+    if trace is not None:
+        _record_search_result_trace(trace, result, spec)
+    _finish_trace()
+    s = result_to_strategy(result, model.graph)
+    s.predicted_step_time = result.cost.step_time
+    return s

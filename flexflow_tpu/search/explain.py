@@ -1,0 +1,409 @@
+"""explain_strategy: why the winning strategy won.
+
+``UnityResult.describe()`` compressed a whole search into one line —
+a cost and a grid histogram. This module is its upgrade: given the
+search-trace artifact (`telemetry.search_trace.SearchTrace`, exported
+via ``--search-trace`` / built in-process by ``--explain``), it
+reconstructs the decision:
+
+* the run header (engine, seed, budget, temperature schedule, machine)
+  — everything needed to reproduce the search;
+* the winning total, rebuilt EXACTLY from the per-op breakdown: the
+  result record stores each op's ``(op_cost, xfer_cost)`` plus a
+  ``residual`` defined as ``total - sum(breakdown in order)``, so
+  summing in the same order and adding the residual inverts the
+  subtraction to within a float ulp (asserted at 1e-9 by
+  tests/test_search_trace.py on both the native and python DP paths);
+* where the time goes — top ops by cost share, per-family and
+  per-(dp, ch)-grid totals, transfer vs compute split;
+* how hard the search worked — candidates considered, accept/reject
+  tallies (MCMC), measured-LUT hits vs analytic roofline estimates,
+  phase durations;
+* the near misses — the best rejected proposals, the margin the winner
+  won by over the runner-up whole-config candidates.
+
+CLI::
+
+    python -m flexflow_tpu.search.explain TRACE.jsonl [STRATEGY.json ...]
+        [--no-validate]
+
+Accepts search-trace JSONL files and exported strategy files
+(``--export-strategy``: unity per-op view docs and mesh SearchResult
+docs) in any mix; traces are schema-validated first (exit 2 on a
+violation — a corrupt artifact must not explain anything).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Union
+
+__all__ = ["ExplainReport", "explain_strategy", "load_search_trace", "main"]
+
+
+def load_search_trace(path: str) -> List[dict]:
+    """Rows of an exported search-trace JSONL file."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+@dataclasses.dataclass
+class ExplainReport:
+    """The reconstructed decision record of one strategy search."""
+
+    engine: str
+    header: dict
+    result: dict
+    ops: List[dict]
+    total_cost: float           # the winner's recorded total (seconds)
+    reconstructed_total: float  # sum(breakdown in order) + residual
+    residual: float
+    candidates: List[dict]
+    phases: List[dict]
+    events: List[dict]
+
+    # -- derived views --------------------------------------------------------
+
+    def per_family(self) -> Dict[str, float]:
+        """op_cost + xfer_cost grouped by cost-model family (falls back
+        to the op type when the family map doesn't know the op)."""
+        from flexflow_tpu.core.types import OperatorType
+        from flexflow_tpu.search.cost_model import op_family
+
+        out: Dict[str, float] = {}
+        for entry in self.ops:
+            fam = None
+            op = entry.get("op")
+            if op is not None and hasattr(OperatorType, op):
+                fam = op_family(getattr(OperatorType, op))
+            key = fam or (op or "other").lower()
+            out[key] = out.get(key, 0.0) + (
+                entry.get("op_cost", 0.0) + entry.get("xfer_cost", 0.0)
+            )
+        return out
+
+    def per_grid(self) -> Dict[str, float]:
+        """Cost share per (dp, ch) factorization."""
+        out: Dict[str, float] = {}
+        for entry in self.ops:
+            key = f"dp{entry.get('dp', '?')}xch{entry.get('ch', '?')}"
+            out[key] = out.get(key, 0.0) + (
+                entry.get("op_cost", 0.0) + entry.get("xfer_cost", 0.0)
+            )
+        return out
+
+    def top_ops(self, k: int = 5) -> List[dict]:
+        return sorted(
+            self.ops,
+            key=lambda e: e.get("op_cost", 0.0) + e.get("xfer_cost", 0.0),
+            reverse=True,
+        )[:k]
+
+    def near_misses(self, k: int = 3) -> List[dict]:
+        """The best REJECTED proposals — what the search almost took
+        (smallest positive delta), the TASO-style justification that
+        the winner beat concrete alternatives."""
+        rejected = [
+            c
+            for c in self.candidates
+            if c.get("accepted") is False and c.get("delta") is not None
+        ]
+        return sorted(rejected, key=lambda c: c["delta"])[:k]
+
+    def runner_up(self) -> Optional[dict]:
+        """The cheapest whole-config candidate that is NOT the winner
+        (graph_cost / extra_axis records carry step_time)."""
+        configs = [
+            c
+            for c in self.candidates
+            if c.get("step_time") is not None
+            and c.get("feasible", True)
+            and c.get("step_time") > self.total_cost * (1 + 1e-12)
+        ]
+        return min(configs, key=lambda c: c["step_time"]) if configs else None
+
+    # -- rendering ------------------------------------------------------------
+
+    def text(self) -> str:
+        h = self.header
+        r = self.result
+        ms = self.total_cost * 1e3
+        lines = [
+            f"strategy explain — engine {self.engine or '?'}, "
+            f"simulated step {ms:.3f} ms",
+        ]
+        meta = []
+        for key in ("seed", "budget", "alpha"):
+            if h.get(key) is not None:
+                meta.append(f"{key}={h[key]}")
+        temp = h.get("temperature")
+        if isinstance(temp, dict):
+            meta.append(
+                f"temperature={temp.get('kind', '?')}"
+                f"(accept {temp.get('acceptance', '?')}, "
+                f"reset every {temp.get('reset_every', '?')})"
+            )
+        machine = h.get("machine")
+        if isinstance(machine, dict):
+            meta.append(
+                f"machine={machine.get('num_nodes', '?')}x"
+                f"{machine.get('chips_per_node', '?')} "
+                f"{machine.get('chip', '')}"
+            )
+        if meta:
+            lines.append("  run: " + ", ".join(meta))
+        # live tallies over the candidate rows (the result record's
+        # snapshot can predate late extra-axis candidates)
+        cands = self.candidates
+        lines.append(
+            "  search effort: "
+            f"{len(cands)} candidates "
+            f"({sum(1 for c in cands if c.get('accepted') is True)} "
+            "accepted / "
+            f"{sum(1 for c in cands if c.get('accepted') is False)} "
+            "rejected), "
+            f"{sum(1 for c in cands if c.get('source') == 'measured')} "
+            "measured-LUT leaf costs vs "
+            f"{sum(1 for c in cands if c.get('source') == 'analytic')} "
+            "analytic"
+        )
+        if r.get("path") or r.get("kind"):
+            lines.append(
+                f"  winner: {r.get('name', '(per-op view map)')} "
+                f"[{r.get('path') or r.get('kind')}]"
+            )
+        if self.ops:
+            lines.append(
+                f"  cost reconstruction: {len(self.ops)} ops sum to "
+                f"{(self.reconstructed_total - self.residual) * 1e3:.3f} ms "
+                f"+ residual {self.residual * 1e3:.3f} ms "
+                "(DP concurrency / dispatch floor) "
+                f"= {self.reconstructed_total * 1e3:.3f} ms"
+            )
+            grids = self.per_grid()
+            lines.append(
+                "  (dp, ch) grids: "
+                + ", ".join(
+                    f"{g}: {v * 1e3:.3f} ms"
+                    for g, v in sorted(
+                        grids.items(), key=lambda kv: -kv[1]
+                    )
+                )
+            )
+            fams = self.per_family()
+            lines.append(
+                "  per family: "
+                + ", ".join(
+                    f"{f}: {v * 1e3:.3f} ms"
+                    for f, v in sorted(
+                        fams.items(), key=lambda kv: -kv[1]
+                    )
+                )
+            )
+            lines.append("  top ops:")
+            denom = max(self.reconstructed_total, 1e-30)
+            for e in self.top_ops():
+                c = e.get("op_cost", 0.0) + e.get("xfer_cost", 0.0)
+                lines.append(
+                    f"    {e.get('name', '?'):<28} "
+                    f"dp{e.get('dp', '?')}xch{e.get('ch', '?')}  "
+                    f"{c * 1e3:9.3f} ms ({100 * c / denom:5.1f}%)"
+                    + (
+                        f"  [xfer {e['xfer_cost'] * 1e3:.3f} ms]"
+                        if e.get("xfer_cost", 0.0) > 0
+                        else ""
+                    )
+                )
+        ru = self.runner_up()
+        if ru is not None:
+            lines.append(
+                f"  runner-up config: {ru.get('name', ru.get('kind', '?'))} "
+                f"at {ru['step_time'] * 1e3:.3f} ms "
+                f"(+{(ru['step_time'] - self.total_cost) * 1e3:.3f} ms)"
+            )
+        for c in self.near_misses():
+            lines.append(
+                "  near miss (rejected): "
+                f"{c.get('kind', '?')} on guid {c.get('guid', '?')} "
+                f"delta +{c.get('delta', 0.0) * 1e3:.4f} ms "
+                f"at iter {c.get('iteration', '?')}"
+            )
+        if self.phases:
+            lines.append(
+                "  phases: "
+                + ", ".join(
+                    f"{p['name']} "
+                    f"{(p['t_end_s'] - p['t_start_s']) * 1e3:.1f} ms"
+                    for p in self.phases
+                )
+            )
+        return "\n".join(lines)
+
+
+def explain_strategy(
+    source: Union[str, Sequence[dict], "object"],
+) -> ExplainReport:
+    """Build the explain report from a search trace: a JSONL path, the
+    row list, or a live SearchTrace. The reconstructed total is the
+    in-order breakdown sum plus the recorded residual — equal to the
+    winning result's total cost (the exactness contract the tests hold
+    at 1e-9)."""
+    if hasattr(source, "rows"):
+        rows = source.rows()
+    elif isinstance(source, str):
+        rows = load_search_trace(source)
+    else:
+        rows = list(source)
+    header: dict = {}
+    result: Optional[dict] = None
+    candidates: List[dict] = []
+    phases: List[dict] = []
+    events: List[dict] = []
+    for row in rows:
+        t = row.get("type")
+        if t == "header":
+            header = row
+        elif t == "candidate":
+            candidates.append(row)
+        elif t == "phase":
+            phases.append(row)
+        elif t == "event":
+            events.append(row)
+        elif t == "result":
+            result = row
+    if result is None:
+        raise ValueError(
+            "search trace has no result record — the search did not "
+            "finish (or the artifact was truncated)"
+        )
+    ops = list(result.get("ops", ()))
+    residual = float(result.get("residual", 0.0))
+    listed = 0.0
+    for entry in ops:  # SAME order as the recorder summed in
+        listed += entry.get("op_cost", 0.0) + entry.get("xfer_cost", 0.0)
+    return ExplainReport(
+        engine=result.get("engine") or header.get("engine", ""),
+        header=header,
+        result=result,
+        ops=ops,
+        total_cost=float(result["total_cost"]),
+        reconstructed_total=listed + residual,
+        residual=residual,
+        candidates=candidates,
+        phases=phases,
+        events=events,
+    )
+
+
+# -- exported strategy files ---------------------------------------------------
+
+
+def describe_strategy_file(path: str) -> str:
+    """Human-readable summary of an exported strategy file: the unity
+    per-op view doc (unity.save_views) or the mesh SearchResult doc
+    (strategy_io.save_search_result)."""
+    with open(path) as f:
+        doc = json.load(f)
+    lines = [f"strategy file {path}:"]
+    if "ops" in doc:  # unity per-op view map
+        lines.append(
+            f"  engine {doc.get('engine', '?')}, simulated step "
+            f"{doc.get('simulated_step_ms', float('nan')):.3f} ms, "
+            f"{len(doc['ops'])} op views"
+        )
+        grids: Dict[str, int] = {}
+        for spec in doc["ops"].values():
+            key = f"dp{spec.get('dp', '?')}xch{spec.get('ch', '?')}"
+            grids[key] = grids.get(key, 0) + 1
+        lines.append(
+            "  (dp, ch) grids: "
+            + ", ".join(f"{k}: {v} ops" for k, v in sorted(grids.items()))
+        )
+        for name, spec in list(sorted(doc["ops"].items()))[:8]:
+            lines.append(
+                f"    {name:<28} dp{spec.get('dp')}xch{spec.get('ch')} "
+                f"view start={spec.get('start_device_id')} "
+                f"dims={spec.get('dims')}"
+            )
+        if len(doc["ops"]) > 8:
+            lines.append(f"    ... {len(doc['ops']) - 8} more")
+    else:  # mesh SearchResult doc
+        lines.append(
+            f"  kind {doc.get('kind', 'tp')}: mesh(data={doc.get('dp')}, "
+            f"model={doc.get('tp')}), {len(doc.get('sites', []))} sites "
+            f"on, simulated step "
+            f"{doc.get('simulated_step_ms', float('nan')):.3f} ms"
+        )
+        for site in doc.get("sites", [])[:8]:
+            lines.append(
+                f"    site {site.get('kind')}: "
+                f"{', '.join(site.get('names', []))}"
+            )
+    return "\n".join(lines)
+
+
+def _is_trace_file(path: str) -> bool:
+    with open(path) as f:
+        first = f.readline().strip()
+    if not first:
+        return False
+    try:
+        row = json.loads(first)
+    except ValueError:
+        return False
+    return isinstance(row, dict) and "type" in row
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m flexflow_tpu.search.explain",
+        description="Explain a strategy search from its exported "
+        "artifacts (search-trace JSONL and/or strategy JSON files).",
+    )
+    parser.add_argument(
+        "files", nargs="+",
+        help="search-trace .jsonl exports (--search-trace) and/or "
+        "strategy .json exports (--export-strategy)",
+    )
+    parser.add_argument(
+        "--no-validate", action="store_true",
+        help="skip schema validation of trace files",
+    )
+    args = parser.parse_args(argv)
+    rc = 0
+    for path in args.files:
+        if _is_trace_file(path):
+            if not args.no_validate:
+                from flexflow_tpu.telemetry.validate import (
+                    validate_search_trace_file,
+                )
+
+                errs = validate_search_trace_file(path, errors="list")
+                if errs:
+                    print(f"{path}: INVALID search trace:")
+                    for e in errs[:10]:
+                        print(f"  {e}")
+                    rc = 2
+                    continue
+            try:
+                report = explain_strategy(path)
+            except ValueError as e:
+                print(f"{path}: {e}")
+                rc = 2
+                continue
+            print(report.text())
+        else:
+            print(describe_strategy_file(path))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -157,15 +157,46 @@ def mcmc_optimize(
     calibration_file: str = "",
     sparse_embedding: bool = True,
     use_propagation: bool = True,
+    trace=None,
 ) -> UnityResult:
     """reference: mcmc_optimize (model.cc:3271) — budget proposals, periodic
-    reset to best every budget/10 non-improving steps."""
+    reset to best every budget/10 non-improving steps.
+
+    All randomness flows from the explicit `seed` through one private
+    `random.Random` — no global RNG state is read, so a run is
+    reproducible from its arguments alone, and the `trace`
+    (telemetry.SearchTrace) records seed + temperature schedule +
+    accept/reject tallies so it is reproducible from the ARTIFACT
+    alone: every proposal lands in the trace with its cost delta and
+    verdict."""
+    reset_every = max(budget // 10, 10)
+    if trace is not None:
+        # the header carries everything a rerun needs: the acceptance
+        # rule is exp(-alpha * delta / current_cost) at constant alpha
+        # (the reference's annealing "temperature" is this fixed alpha
+        # over a cost-relative delta), reset-to-best every reset_every
+        # non-improving proposals
+        trace.header(
+            engine="mcmc",
+            seed=seed,
+            budget=budget,
+            alpha=alpha,
+            temperature={
+                "kind": "constant-alpha",
+                "alpha": alpha,
+                "acceptance": "exp(-alpha*delta/cur_cost)",
+                "reset_every": reset_every,
+            },
+            propagation=bool(use_propagation),
+            measure=bool(measure),
+        )
     search = UnitySearch(
         graph, spec, machine_model=machine_model,
         mixed_precision=mixed_precision,
         measure=measure,
         calibration_file=calibration_file,
         sparse_embedding=sparse_embedding,
+        trace=trace,
     )
     resource = search.resource
     rng = random.Random(seed)
@@ -186,17 +217,27 @@ def mcmc_optimize(
         ]
         return full[0] if full else cands[0]
 
-    cur = {g: default_view(g) for g in guids}
-    cur_cost = simulate_config(search, cur)
-    best, best_cost = dict(cur), cur_cost
-    since_best = 0
-    reset_every = max(budget // 10, 10)
+    from contextlib import nullcontext
 
+    def _phase(name):
+        return trace.phase(name) if trace is not None else nullcontext()
+
+    with _phase("mcmc:init"):
+        cur = {g: default_view(g) for g in guids}
+        cur_cost = simulate_config(search, cur)
+    best, best_cost = dict(cur), cur_cost
+
+    since_best = 0
+    # the anneal loop is one phase span; entered/exited manually so the
+    # (long) loop body keeps its indentation
+    anneal_cm = _phase("mcmc:anneal")
+    anneal_cm.__enter__()
     for it in range(budget):
         # reference: rewrite() (model.cc:3247-3269) — with probability
         # PROPAGATION_CHANCE propose a frontier propagation instead of a
         # single-op flip
         if use_propagation and rng.random() < PROPAGATION_CHANCE:
+            kind = "propagate"
             g = rng.choice(guids)
             assigns = propagate_views(search, cur, g, rng)
             if not assigns:
@@ -206,7 +247,10 @@ def mcmc_optimize(
             for n, v in assigns.items():
                 delta += config_delta(search, trial, n, v)
                 trial[n] = v
+            new_dp = new_ch = None
+            ops_changed = len(assigns)
         else:
+            kind = "flip"
             g = rng.choice(guids)
             cands = search.valid_views(g, resource)
             nxt_view = rng.choice(cands)
@@ -215,8 +259,13 @@ def mcmc_optimize(
             trial = dict(cur)
             trial[g] = nxt_view
             delta = config_delta(search, cur, g, nxt_view)
+            new_dp, new_ch = nxt_view.dp, nxt_view.ch
+            ops_changed = 1
         scale = max(cur_cost, 1e-9)
-        if delta < 0 or rng.random() < math.exp(-alpha * delta / scale):
+        accepted = bool(
+            delta < 0 or rng.random() < math.exp(-alpha * delta / scale)
+        )
+        if accepted:
             cur = trial
             cur_cost += delta
         if cur_cost < best_cost:
@@ -227,13 +276,33 @@ def mcmc_optimize(
             if since_best >= reset_every:  # reference: periodic reset to best
                 cur, cur_cost = dict(best), best_cost
                 since_best = 0
+                if trace is not None:
+                    trace.event("reset", iteration=it, best_cost=best_cost)
+        if trace is not None:
+            rec = {
+                "iteration": it,
+                "guid": g,
+                "ops_changed": ops_changed,
+                "delta": delta,
+                "cur_cost": cur_cost,
+            }
+            if new_dp is not None:
+                rec["dp"] = new_dp
+                rec["ch"] = new_ch
+            trace.candidate(
+                kind, accepted=accepted, best_cost=best_cost, **rec
+            )
         if verbose and it % max(budget // 10, 1) == 0:
             print(
                 f"[mcmc] iter {it}: current {cur_cost * 1e3:.3f} ms, "
                 f"best {best_cost * 1e3:.3f} ms"
             )
+    anneal_cm.__exit__(None, None, None)
     if search.cm.measure:
         # one program launch per step (estimate_graph_cost's step_floor
         # basis) — keeps the cross-engine gate comparable
         best_cost += search.cm.dispatch_floor()
-    return UnityResult(best_cost, best)
+    result = UnityResult(best_cost, best)
+    if trace is not None:
+        search._trace_result(result, "mcmc")
+    return result

@@ -182,11 +182,22 @@ def estimate_graph_cost(
     optimizer_state_factor: float = 3.0,
     mode: str = "taskgraph",
     export: Optional[Dict] = None,
+    trace=None,
+    trace_label: str = "",
 ) -> GraphCost:
     """Estimate one training-iteration time for an annotated PCG.
 
     optimizer_state_factor: weights + grads + momentum ≈ 3× weight bytes
     (Adam: 4×) — feeds the HBM feasibility check.
+
+    export: when a dict is passed, it is filled with the SimTask arrays
+    (taskgraph mode) AND a per-node ``node_costs`` list ({guid, name,
+    op, family, forward, backward, memory}) — the breakdown the
+    predicted-vs-measured audit (search/audit.py) groups by op family.
+
+    trace: an optional telemetry.SearchTrace — records ONE candidate
+    row carrying this estimate's full GraphCost breakdown (compute /
+    comm / sync / update / memory feasibility), labeled `trace_label`.
     """
     cm = cost_model
     total = GraphCost()
@@ -493,6 +504,45 @@ def estimate_graph_cost(
 
     total.memory_per_chip = int(weight_bytes * optimizer_state_factor + act_bytes)
 
+    if export is not None:
+        # per-node predicted compute costs keyed for the audit's
+        # family grouping (cost_model.op_family); parallel ops carry
+        # zero compute and are omitted — their traffic is the comm_time
+        # aggregate above
+        from flexflow_tpu.search.cost_model import op_family
+
+        export["node_costs"] = [
+            {
+                "guid": guid,
+                "name": graph.nodes[guid].name,
+                "op": graph.nodes[guid].op_type.name,
+                "family": op_family(graph.nodes[guid].op_type) or "other",
+                "forward": per_node_cost[guid].forward_time,
+                "backward": per_node_cost[guid].backward_time,
+                "memory": per_node_cost[guid].memory,
+            }
+            for guid in topo
+            if guid in per_node_cost
+            and not graph.nodes[guid].is_parallel_op
+        ]
+
+    def _traced(result: GraphCost) -> GraphCost:
+        if trace is not None:
+            # scalars only — the GraphCost is rebuilt per candidate, but
+            # the discipline (FX104) is uniform: no live state in rows
+            trace.candidate(
+                "graph_cost",
+                name=trace_label or "estimate_graph_cost",
+                step_time=result.step_time,
+                compute_time=result.compute_time,
+                comm_time=result.comm_time,
+                sync_time=result.sync_time,
+                update_time=result.update_time,
+                memory_per_chip=float(result.memory_per_chip),
+                feasible=bool(result.feasible(cm.spec)),
+            )
+        return result
+
     # the real train step is ONE XLA program and pays one program launch
     # — the same overhead CostModel.dispatch_floor measures and subtracts
     # per-op. Invisible for ms-scale steps; for DLRM-class us-scale steps
@@ -510,7 +560,7 @@ def estimate_graph_cost(
             + total.update_time
             + step_floor
         )
-        return total
+        return _traced(total)
 
     if export is not None:
         export.update(
@@ -534,4 +584,4 @@ def estimate_graph_cost(
     else:
         total.step_time = sim[0]
     total.step_time += step_floor
-    return total
+    return _traced(total)

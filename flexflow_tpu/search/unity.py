@@ -125,6 +125,7 @@ class UnitySearch:
         calibration_file: str = "",
         sparse_embedding: bool = True,
         allow_subblock_views: bool = False,
+        trace=None,
     ):
         """allow_subblock_views: let the nonsequence (parallel-branch)
         recursion place concurrent branches on vertical/horizontal
@@ -140,8 +141,15 @@ class UnitySearch:
         concurrent branches exists (parallel/submesh.concurrent_branches
         — shard_map + lax.switch over a block axis, SPMD-restricted to
         shape-unified branches); wiring it into the PCG lowering is
-        future work."""
+        future work.
+
+        trace: an optional telemetry.SearchTrace — every (node, view)
+        leaf cost the DP evaluates is recorded once (tagged measured /
+        analytic / sparse), plus the search phases and the winning
+        per-op breakdown (the explain-report artifact)."""
         self.graph = graph
+        self.trace = trace
+        self._trace_seen = set()
         self.allow_subblock_views = allow_subblock_views
         self.spec = spec
         self.cm = CostModel(
@@ -322,10 +330,12 @@ class UnitySearch:
         # touched-rows-update terms there (and in the native solver's
         # ubytes arrays) still apply
         t = self._sparse_embedding_time(guid, node, opt)
+        source = "sparse" if t is not None else "analytic"
         if t is None and self.cm.measure:
             mt = self._measured_times(node, in_shapes, opt)
             if mt is not None:
                 t = mt[0] + (mt[1] if self.include_backward else 0.0)
+                source = "measured"
         if t is None:
             flops = op_flops(node.op_type, in_shapes, node.params) / n
             data = sum(s.volume() * eb(s) for s in in_shapes)
@@ -367,7 +377,76 @@ class UnitySearch:
             # mesh candidates and weight-heavy dp looks free
             per_chip = ub / opt.ch / (opt.dp if sparse_rows is not None else 1)
             t += self.cm.update_time_from_bytes(per_chip)
+        if self.trace is not None:
+            self._trace_leaf("op_view", guid, opt, t, source)
         return t
+
+    def _trace_leaf(
+        self, kind: str, guid: int, opt: ViewOption, cost: float, source: str
+    ) -> None:
+        """Record one (node, view) leaf evaluation — once per key (the
+        memoless DP re-evaluates leaves constantly). Only precomputed
+        scalars cross into the record: trace rows must never hold live
+        graph/search state (fxlint FX104)."""
+        key = (kind, guid, opt.key())
+        if key in self._trace_seen:
+            return
+        self._trace_seen.add(key)
+        node = self.graph.nodes[guid]
+        op_name = node.name
+        op_type = node.op_type.name
+        self.trace.candidate(
+            kind,
+            source=source,
+            guid=guid,
+            name=op_name,
+            op=op_type,
+            dp=opt.dp,
+            ch=opt.ch,
+            cost=cost,
+        )
+
+    def _trace_result(self, result: "UnityResult", path_kind: str) -> None:
+        """Record the winning strategy with its per-op breakdown. The
+        residual (DP concurrency credit, dispatch floor) is defined as
+        total minus the in-order breakdown sum, so the explain report
+        reconstructs `result.cost` exactly by inverting the
+        subtraction."""
+        ops = []
+        listed = 0.0
+        for guid in sorted(result.views):
+            node = self.graph.nodes.get(guid)
+            if node is None:
+                continue
+            v = result.views[guid]
+            oc = self.op_cost(guid, v)
+            xc = 0.0
+            for r in node.inputs:
+                src = result.views.get(r.guid)
+                if src is not None:
+                    xc += self.xfer_cost(r, src, v)
+            op_name = node.name
+            op_type = node.op_type.name
+            ops.append(
+                {
+                    "guid": guid,
+                    "name": op_name,
+                    "op": op_type,
+                    "dp": v.dp,
+                    "ch": v.ch,
+                    "op_cost": oc,
+                    "xfer_cost": xc,
+                }
+            )
+            listed += oc + xc
+        grids = Counter((v.dp, v.ch) for v in result.views.values())
+        self.trace.result(
+            total_cost=result.cost,
+            ops=ops,
+            residual=result.cost - listed,
+            path=path_kind,
+            grids={f"dp{d}xch{c}": n for (d, c), n in sorted(grids.items())},
+        )
 
     def _update_bytes(self, guid: int) -> Tuple[float, Optional[float]]:
         """(bytes basis, touched rows | None) for the optimizer-update
@@ -429,7 +508,7 @@ class UnitySearch:
         (native/src/unity_dp.cc — SURVEY §7's prescription that the
         compute-bound tree search be native); everything else uses the
         Python recursion with identical semantics."""
-        result = self._optimize_inner()
+        result, path_kind = self._optimize_inner()
         if self.cm.measure:
             # one program launch per step — the same basis term
             # estimate_graph_cost adds, so the cross-engine gate in
@@ -437,10 +516,21 @@ class UnitySearch:
             result = UnityResult(
                 result.cost + self.cm.dispatch_floor(), result.views
             )
+        if self.trace is not None:
+            self._trace_result(result, path_kind)
         return result
 
-    def _optimize_inner(self) -> UnityResult:
+    def _optimize_inner(self) -> Tuple[UnityResult, str]:
+        from contextlib import nullcontext
+
         from flexflow_tpu import native as native_mod
+
+        def _phase(name):
+            return (
+                self.trace.phase(name)
+                if self.trace is not None
+                else nullcontext()
+            )
 
         sinks = self.graph.sinks()
         if (
@@ -457,11 +547,15 @@ class UnitySearch:
             # the real calibrated kernels, then hands the table to the
             # native solver — the calibration table and the 33x native
             # solver compose (VERDICT r2 item 9)
-            lut = self._measured_lut() if self.cm.measure else None
-            native_result = self._optimize_native(sinks[0], measured=lut)
+            with _phase("unity:measured_lut" if self.cm.measure
+                        else "unity:native_prep"):
+                lut = self._measured_lut() if self.cm.measure else None
+            with _phase("unity:native_dp"):
+                native_result = self._optimize_native(sinks[0], measured=lut)
             if native_result is not None:
-                return native_result
-        return self._optimize_python(sinks)
+                return native_result, "native"
+        with _phase("unity:python_dp"):
+            return self._optimize_python(sinks), "python"
 
     def _measured_lut(self):
         """{guid: [(dp, ch, fwd+bwd seconds)]} for every node/view the
@@ -481,17 +575,16 @@ class UnitySearch:
                 st = self._sparse_embedding_time(guid, node, opt)
                 if st is not None:
                     entries.append((opt.dp, opt.ch, st))
+                    if self.trace is not None:
+                        self._trace_leaf("lut_entry", guid, opt, st, "sparse")
                     continue
                 mt = self._measured_times(node, in_shapes, opt)
                 if mt is None:
                     continue
-                entries.append(
-                    (
-                        opt.dp,
-                        opt.ch,
-                        mt[0] + (mt[1] if self.include_backward else 0.0),
-                    )
-                )
+                cost = mt[0] + (mt[1] if self.include_backward else 0.0)
+                entries.append((opt.dp, opt.ch, cost))
+                if self.trace is not None:
+                    self._trace_leaf("lut_entry", guid, opt, cost, "measured")
             if entries:
                 lut[guid] = entries
         return lut

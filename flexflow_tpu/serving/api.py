@@ -202,20 +202,12 @@ def build_telemetry(serve: ServeConfig):
     """The Telemetry bundle a ServeConfig asks for, or None when every
     telemetry knob is off — the scheduler/engine then skip every
     instrument point on a single predicate (the ≤2%-overhead contract
-    bench_serve.py --telemetry gates)."""
-    if not serve.telemetry_requested:
-        return None
-    from flexflow_tpu.telemetry import Telemetry
+    bench_serve.py --telemetry gates). Thin wrapper over the generic
+    telemetry.build_telemetry, which also accepts an FFConfig or plain
+    kwargs (the training/search entry points use it directly)."""
+    from flexflow_tpu.telemetry import build_telemetry as _build
 
-    return Telemetry(
-        metrics_out=serve.metrics_out,
-        metrics_jsonl=serve.metrics_jsonl,
-        trace=serve.trace,
-        trace_enabled=bool(serve.trace) or serve.telemetry or None,
-        slo_ttft_ms=serve.slo_ttft_ms,
-        slo_itl_ms=serve.slo_itl_ms,
-        slo_window=serve.slo_window,
-    )
+    return _build(serve)
 
 
 def build_proposer(serve: ServeConfig, draft_model=None):

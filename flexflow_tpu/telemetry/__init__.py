@@ -44,6 +44,7 @@ from flexflow_tpu.telemetry.registry import (
     MetricsRegistry,
     series_name,
 )
+from flexflow_tpu.telemetry.search_trace import SearchTrace
 from flexflow_tpu.telemetry.slo import RollingWindow, SLOMonitor, percentiles
 from flexflow_tpu.telemetry.trace import (
     PID_ENGINE,
@@ -59,13 +60,17 @@ from flexflow_tpu.telemetry.validate import (
     validate_metrics_jsonl,
     validate_metrics_jsonl_file,
     validate_metrics_text,
+    validate_search_trace,
+    validate_search_trace_file,
     validate_trace,
     validate_trace_file,
 )
 
 __all__ = [
     "Telemetry",
+    "build_telemetry",
     "NullTracer",
+    "SearchTrace",
     "MetricsRegistry",
     "Counter",
     "Gauge",
@@ -85,6 +90,8 @@ __all__ = [
     "validate_metrics_jsonl",
     "validate_metrics_jsonl_file",
     "validate_metrics_text",
+    "validate_search_trace",
+    "validate_search_trace_file",
     "PID_ENGINE",
     "PID_REQUESTS",
     "TID_HOST",
@@ -220,3 +227,57 @@ class Telemetry:
         if self._jsonl is not None:
             self._jsonl.close()
         self._flushed = True
+
+
+def _cfg_field(cfg, name, default):
+    """Read a telemetry knob off either surface: ServeConfig spells
+    them bare (`metrics_out`), FFConfig with the serve_ prefix the CLI
+    flags historically filled (`serve_metrics_out` — the SAME
+    --metrics-out/--metrics-jsonl/--trace flags now drive training and
+    search too)."""
+    if hasattr(cfg, name):
+        return getattr(cfg, name)
+    return getattr(cfg, "serve_" + name, default)
+
+
+def build_telemetry(config=None, **kwargs) -> Optional[Telemetry]:
+    """The Telemetry bundle a config asks for, or None when every knob
+    is off (callers then skip every instrument point on one predicate —
+    the ≤2%-overhead contract both bench gates hold).
+
+    `config` may be a serving.ServeConfig, an FFConfig, or omitted
+    entirely; explicit kwargs (`metrics_out=`, `metrics_jsonl=`,
+    `trace=`, `trace_enabled=`, `slo_ttft_ms=`, `slo_itl_ms=`,
+    `slo_window=`, `telemetry=True` to force the in-memory bundle)
+    override the config's fields. Training and search callers no
+    longer fake a serving config to get a registry."""
+    fields = {
+        "metrics_out": "",
+        "metrics_jsonl": "",
+        "trace": "",
+        "slo_ttft_ms": 0.0,
+        "slo_itl_ms": 0.0,
+        "slo_window": 1024,
+        "telemetry": False,
+    }
+    if config is not None:
+        for name, default in list(fields.items()):
+            fields[name] = _cfg_field(config, name, default)
+    trace_enabled = kwargs.pop("trace_enabled", None)
+    unknown = set(kwargs) - set(fields)
+    if unknown:
+        raise TypeError(
+            f"build_telemetry: unknown knob(s) {sorted(unknown)}"
+        )
+    fields.update(kwargs)
+    force = bool(fields.pop("telemetry"))
+    requested = force or any(
+        bool(fields[k])
+        for k in ("metrics_out", "metrics_jsonl", "trace",
+                  "slo_ttft_ms", "slo_itl_ms")
+    )
+    if not requested:
+        return None
+    if trace_enabled is None:
+        trace_enabled = bool(fields["trace"]) or force or None
+    return Telemetry(trace_enabled=trace_enabled, **fields)

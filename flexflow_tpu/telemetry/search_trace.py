@@ -1,0 +1,328 @@
+"""Search tracing: every candidate the strategy search considered.
+
+FlexFlow's defining loop is *measure, then decide* — the cost simulator
+(calibrated against profiled kernels) drives the substitution +
+MachineView search. Until now the decision half was a black box:
+``UnitySearch.optimize()`` and ``mcmc_optimize`` returned one winner
+and discarded every candidate they rejected on the way, so nothing
+could answer "why THIS strategy?" the way TASO-style systems justify
+rewrites by exposing per-candidate cost deltas.
+
+``SearchTrace`` is the recorder the search engines
+(`search/unity.py`, `search/mcmc.py`, `search/auto.py`) and the
+simulator (`search/simulator.py`) emit into:
+
+* a **header** — engine, seed, budget, temperature schedule, machine
+  description, graph summary — enough to reproduce the run from the
+  artifact alone;
+* **candidate** records, one per considered option with a monotone
+  ``id``: per-(op, ViewOption) leaf costs tagged ``measured`` /
+  ``analytic`` / ``sparse``, MCMC proposals with their cost delta and
+  accept/reject verdict, whole-config ``GraphCost`` breakdowns
+  (compute / comm / sync / update / memory feasibility);
+* **phase** records mirrored as Chrome trace-event spans (reusing
+  `telemetry.trace.Tracer`) so the search timeline — view enumeration,
+  native vs python DP, MCMC sweep, lowering — renders in Perfetto;
+* one **result** record carrying the winning total plus a per-op
+  ``(op_cost, xfer_cost)`` breakdown and an explicit ``residual`` term
+  (DP concurrency credit, dispatch floor, incremental-delta drift)
+  such that summing the breakdown in record order and adding the
+  residual reproduces the winner's total cost exactly —
+  `search.explain.explain_strategy` relies on this identity.
+
+Export is JSONL (one record per line) validated by
+``schemas/search_trace.schema.json`` via
+`telemetry.validate.validate_search_trace`; ``save()`` also writes the
+phase timeline as ``<path>.trace.json`` when any phase was recorded.
+
+Discipline: record values are SCALARS and freshly-built containers —
+never references to live search state (the searcher keeps mutating its
+view maps after the record is taken; a captured reference would let
+rows rewrite themselves retroactively). fxlint FX104 enforces this the
+same way FX101 guards jit dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+__all__ = ["SearchTrace"]
+
+#: process lane for the search timeline in the Chrome trace export
+PID_SEARCH = 3
+TID_SEARCH = 1
+
+
+class SearchTrace:
+    """Append-only recorder for one strategy-search run."""
+
+    def __init__(
+        self,
+        engine: str = "",
+        path: str = "",
+        registry=None,
+        timeline: bool = True,
+        max_records: int = 2_000_000,
+    ):
+        """`registry`: an optional telemetry.MetricsRegistry to mirror
+        the serve-style ``search_*`` counters/gauges into. `timeline`:
+        record phase spans into an owned Tracer (exported as a sibling
+        ``.trace.json``)."""
+        self.engine = engine
+        self.path = path
+        self.t0 = time.perf_counter()
+        self.records: List[dict] = []
+        self.dropped_records = 0
+        self.max_records = int(max_records)
+        self._header: Optional[dict] = None
+        self._result: Optional[dict] = None
+        self._next_id = 0
+        # accept/reject + cost-source tallies (mirrored into the result
+        # record and, when a registry is attached, into search_* metrics)
+        self.candidates = 0
+        self.accepted = 0
+        self.rejected = 0
+        self.measured_hits = 0
+        self.analytic_estimates = 0
+        self.registry = registry
+        self._metrics = None
+        if registry is not None:
+            self._metrics = {
+                "candidates": registry.counter(
+                    "search_candidates_total",
+                    help="candidates considered by the strategy search",
+                ),
+                "accepted": registry.counter(
+                    "search_accepted_total",
+                    help="candidates accepted (improvements + annealing)",
+                ),
+                "rejected": registry.counter(
+                    "search_rejected_total",
+                    help="candidates rejected by the strategy search",
+                ),
+                "measured": registry.counter(
+                    "search_measured_lut_hits_total",
+                    help="leaf costs served by calibrated kernel "
+                    "measurements",
+                ),
+                "analytic": registry.counter(
+                    "search_analytic_estimates_total",
+                    help="leaf costs served by the analytic roofline",
+                ),
+                "best_cost": registry.gauge(
+                    "search_best_cost_ms",
+                    help="best simulated step time found so far (ms)",
+                ),
+                "seed": registry.gauge(
+                    "search_seed", help="RNG seed of the search run"
+                ),
+                "resets": registry.counter(
+                    "search_resets_total",
+                    help="MCMC resets to the best-so-far configuration",
+                ),
+            }
+        self.tracer = None
+        if timeline:
+            from flexflow_tpu.telemetry.trace import Tracer
+
+            self.tracer = Tracer()
+            self.tracer._meta(
+                PID_SEARCH, None, "process_name", "flexflow_tpu.search"
+            )
+            self.tracer._meta(
+                PID_SEARCH, TID_SEARCH, "thread_name", "strategy search"
+            )
+            # share the clock origin so search spans and any sibling
+            # telemetry line up
+            self.tracer.t0 = self.t0
+
+    # -- low level -------------------------------------------------------------
+
+    def now(self) -> float:
+        return time.perf_counter()
+
+    def _push(self, rec: dict) -> None:
+        if len(self.records) >= self.max_records:
+            self.dropped_records += 1
+            return
+        self.records.append(rec)
+
+    # -- recording -------------------------------------------------------------
+
+    def header(self, **fields) -> None:
+        """Set/merge the run header (engine, seed, budget, temperature
+        schedule, machine, graph summary). Mergeable so the entry point
+        and the engine can each contribute their fields; always emitted
+        as the FIRST record."""
+        if self._header is None:
+            self._header = {"type": "header", "version": 1}
+        self._header.update(fields)
+        if "seed" in fields and self._metrics is not None:
+            seed = fields["seed"]
+            if seed is not None:
+                self._metrics["seed"].set(float(seed))
+
+    @contextmanager
+    def phase(self, name: str, **fields):
+        """One search phase: a record with [t_start_s, t_end_s] plus a
+        span on the search lane of the Chrome timeline."""
+        t_start = self.now()
+        try:
+            yield
+        finally:
+            t_end = self.now()
+            rec = {
+                "type": "phase",
+                "name": name,
+                "t_start_s": round(t_start - self.t0, 9),
+                "t_end_s": round(t_end - self.t0, 9),
+            }
+            rec.update(fields)
+            self._push(rec)
+            if self.tracer is not None:
+                self.tracer.complete(
+                    name, "search", t_start, t_end,
+                    pid=PID_SEARCH, tid=TID_SEARCH,
+                )
+
+    def candidate(
+        self,
+        kind: str,
+        accepted: Optional[bool] = None,
+        source: Optional[str] = None,
+        best_cost: Optional[float] = None,
+        **fields,
+    ) -> int:
+        """One considered option. Returns its monotone id. `kind` names
+        the candidate class ("op_view" leaf, "flip"/"propagate" MCMC
+        proposal, "graph_cost" whole-config estimate, "extra_axis"
+        family candidate). `source` tags where a leaf cost came from
+        ("measured" | "analytic" | "sparse"). Pass SCALARS or freshly
+        built containers only — never live search state (FX104)."""
+        cid = self._next_id
+        self._next_id += 1
+        self.candidates += 1
+        rec = {"type": "candidate", "id": cid, "kind": kind}
+        if accepted is not None:
+            rec["accepted"] = bool(accepted)
+            if accepted:
+                self.accepted += 1
+            else:
+                self.rejected += 1
+        if source is not None:
+            rec["source"] = source
+            if source == "measured":
+                self.measured_hits += 1
+            elif source == "analytic":
+                self.analytic_estimates += 1
+        if best_cost is not None:
+            rec["best_cost"] = best_cost
+        rec.update(fields)
+        self._push(rec)
+        m = self._metrics
+        if m is not None:
+            m["candidates"].inc()
+            if accepted is not None:
+                (m["accepted"] if accepted else m["rejected"]).inc()
+            if source == "measured":
+                m["measured"].inc()
+            elif source == "analytic":
+                m["analytic"].inc()
+            if best_cost is not None:
+                m["best_cost"].set(best_cost * 1e3)
+        return cid
+
+    def event(self, name: str, **fields) -> None:
+        """A point event (e.g. an MCMC reset-to-best)."""
+        rec = {"type": "event", "name": name}
+        rec.update(fields)
+        self._push(rec)
+        if name == "reset" and self._metrics is not None:
+            self._metrics["resets"].inc()
+        if self.tracer is not None:
+            self.tracer.instant(
+                name, "search", pid=PID_SEARCH, tid=TID_SEARCH,
+                args={k: v for k, v in fields.items()
+                      if isinstance(v, (int, float, str, bool))},
+            )
+
+    def result(
+        self,
+        total_cost: float,
+        ops: Optional[List[dict]] = None,
+        residual: float = 0.0,
+        **fields,
+    ) -> None:
+        """The winning strategy. `ops` is the per-op breakdown (each
+        entry {guid, name, op, dp, ch, op_cost, xfer_cost}); summing
+        op_cost + xfer_cost over the entries IN ORDER and adding
+        `residual` must reproduce `total_cost` (the explain-report
+        identity — callers compute residual as the difference, which
+        floating-point addition then inverts to within 1 ulp). Emitted
+        LAST; calling again replaces the record (a later stage — the
+        extra-axis gate — may override the engine's pick)."""
+        rec = {
+            "type": "result",
+            "engine": self.engine,
+            "total_cost": total_cost,
+            "residual": residual,
+            "candidates": self.candidates,
+            "accepted_count": self.accepted,
+            "rejected_count": self.rejected,
+            "measured_hits": self.measured_hits,
+            "analytic_estimates": self.analytic_estimates,
+            "duration_s": round(self.now() - self.t0, 9),
+        }
+        if ops is not None:
+            rec["ops"] = list(ops)
+        rec.update(fields)
+        self._result = rec
+        if self._metrics is not None:
+            self._metrics["best_cost"].set(total_cost * 1e3)
+
+    # -- export ----------------------------------------------------------------
+
+    def rows(self) -> List[dict]:
+        """Header first, candidates/phases/events in record order, the
+        result last — the JSONL line order and the order explain
+        consumes."""
+        header = dict(self._header) if self._header is not None else {
+            "type": "header", "version": 1
+        }
+        header.setdefault("engine", self.engine)
+        if self.dropped_records:
+            header["dropped_records"] = self.dropped_records
+        out = [header]
+        out.extend(self.records)
+        if self._result is not None:
+            out.append(self._result)
+        return out
+
+    def to_jsonl(self) -> str:
+        return "\n".join(
+            json.dumps(r, sort_keys=True) for r in self.rows()
+        ) + "\n"
+
+    def timeline_path(self, path: Optional[str] = None) -> str:
+        """Sibling path for the Chrome timeline export."""
+        path = path or self.path
+        base = path[: -len(".jsonl")] if path.endswith(".jsonl") else path
+        return base + ".trace.json"
+
+    def save(self, path: Optional[str] = None) -> str:
+        """Write the JSONL artifact (and the phase timeline as
+        `<path>.trace.json` when phases were recorded). Returns the
+        JSONL path."""
+        path = path or self.path
+        if not path:
+            raise ValueError("SearchTrace.save: no path configured")
+        with open(path, "w") as f:
+            f.write(self.to_jsonl())
+        if self.tracer is not None and any(
+            e.get("ph") in ("X", "i") for e in self.tracer.events
+        ):
+            self.tracer.save(self.timeline_path(path))
+        return path

@@ -16,6 +16,12 @@ contract CI gates on:
   `validate_metrics_text` checks it directly: HELP/TYPE headers,
   sample-line syntax, histogram `_bucket` cumulativity ending at the
   `_count` value.
+* **search trace JSONL** (`--search-trace`) —
+  `schemas/search_trace.schema.json` per row (negative costs are a
+  schema violation), plus the semantics: the header comes first,
+  candidate ids are strictly increasing (out-of-order ids mean the
+  recorder — or a hand-edited artifact — lied about consideration
+  order), and at most one result record closes the stream.
 
 The schema checker is a deliberate subset of JSON Schema (type,
 required, properties, additionalProperties, items, enum, minimum) —
@@ -41,6 +47,8 @@ __all__ = [
     "validate_trace",
     "validate_metrics_jsonl",
     "validate_metrics_text",
+    "validate_search_trace",
+    "validate_search_trace_file",
 ]
 
 SCHEMA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "schemas")
@@ -199,6 +207,67 @@ def validate_metrics_jsonl(
 def validate_metrics_jsonl_file(path: str, errors: str = "raise") -> List[str]:
     with open(path) as f:
         return validate_metrics_jsonl(f.readlines(), errors=errors)
+
+
+# -- search trace JSONL validation --------------------------------------------
+
+
+def validate_search_trace(
+    lines: Sequence[str], errors: str = "raise"
+) -> List[str]:
+    """Every row parses and matches the search-trace schema (costs are
+    non-negative by schema `minimum`); the first row is the header;
+    candidate `id`s are strictly increasing; at most one `result`."""
+    schema = load_schema("search_trace.schema.json")
+    errs: List[str] = []
+    last_id: Optional[int] = None
+    saw_rows = 0
+    results = 0
+    for n, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError as e:
+            errs.append(f"line {n + 1}: not JSON ({e})")
+            continue
+        errs.extend(
+            f"line {n + 1}: {e}" for e in check_schema(row, schema)
+        )
+        if not isinstance(row, dict):
+            continue
+        saw_rows += 1
+        rtype = row.get("type")
+        if saw_rows == 1 and rtype != "header":
+            errs.append(
+                f"line {n + 1}: first record must be the header, "
+                f"got {rtype!r}"
+            )
+        if rtype == "candidate":
+            cid = row.get("id")
+            if isinstance(cid, int):
+                if last_id is not None and cid <= last_id:
+                    errs.append(
+                        f"line {n + 1}: candidate id {cid} out of order "
+                        f"(previous {last_id}) — consideration order is "
+                        "the artifact's contract"
+                    )
+                last_id = cid
+        elif rtype == "result":
+            results += 1
+            if results > 1:
+                errs.append(
+                    f"line {n + 1}: more than one result record"
+                )
+    if saw_rows == 0:
+        errs.append("empty search trace (no records)")
+    return _raise_or_return(errs, errors)
+
+
+def validate_search_trace_file(path: str, errors: str = "raise") -> List[str]:
+    with open(path) as f:
+        return validate_search_trace(f.readlines(), errors=errors)
 
 
 # -- Prometheus text validation -----------------------------------------------

@@ -1,0 +1,26 @@
+"""FX104 positives: search-trace hooks capturing live mutable state.
+
+The searcher mutates `self.views` / `self.costs` after the record is
+taken; a captured reference lets the exported row rewrite itself."""
+
+
+class Searcher:
+    def __init__(self, trace):
+        self.trace = trace
+        self.views = {}
+        self.costs = {}
+
+    def step(self, guid, view, cost):
+        self.views[guid] = view  # subscript mutation outside __init__
+        self.costs[guid] = cost
+        # FX104: the live dict flows into the record
+        self.trace.candidate("flip", guid=guid, views=self.views)
+
+    def finish(self, total):
+        # FX104: positional arg, same live state
+        self.trace.result(total, self.costs)
+
+
+def record_free(trace, searcher):
+    # FX104 through a bare `trace` name and a kwarg
+    trace.event("reset", costs=searcher.costs)

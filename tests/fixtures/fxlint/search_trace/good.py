@@ -1,0 +1,24 @@
+"""FX104 negatives: the sanctioned idioms — scalars and fresh copies."""
+
+
+class Searcher:
+    def __init__(self, trace):
+        self.trace = trace
+        self.views = {}
+        self.costs = {}
+
+    def step(self, guid, view, cost):
+        self.views[guid] = view
+        self.costs[guid] = cost
+        # fresh containers / precomputed scalars: fine
+        self.trace.candidate("flip", guid=guid, views=dict(self.views))
+        n_views = len(self.views)
+        self.trace.event("progress", n=n_views, cost=cost)
+
+    def finish(self, total):
+        self.trace.result(total, self.costs.copy())
+
+
+def tracer_is_not_trace(tracer, searcher):
+    # the telemetry Tracer API (different surface) is not a trace hook
+    tracer.complete("span", "search", 0.0, 1.0)

@@ -119,6 +119,53 @@ def test_reconcile_snapshot_fixtures():
     assert "reconcile_good.py" not in diags
 
 
+def test_search_trace_hook_fixtures():
+    """FX104: search-trace recording calls capturing live mutable
+    state — a captured reference lets exported rows rewrite themselves
+    after the searcher mutates its tables."""
+    diags = _by_file(
+        run_rules(
+            [os.path.join(FIXTURES, "search_trace")], ["dispatch-race"]
+        )
+    )
+    assert diags.get("bad.py", []).count("FX104") == 3
+    # fresh dict()/copy()/scalars and the (different-API) Tracer silent
+    assert "good.py" not in diags
+
+
+def test_seeded_search_trace_violation_is_caught(tmp_path):
+    """Seed an FX104 violation into the REAL search-trace hook
+    (unity.py's _trace_leaf): capture the live _views_cache — mutated
+    by valid_views after records are taken — in the candidate row. The
+    lint must flag it; the unmodified file stays clean."""
+    src_path = os.path.join(PACKAGE, "search", "unity.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "            name=op_name,\n",
+        "            name=op_name,\n"
+        "            views=self._views_cache,\n",
+        1,
+    )
+    assert seeded != src, (
+        "unity.py's _trace_leaf no longer passes name=op_name — update "
+        "this seeding recipe alongside the refactor"
+    )
+    (tmp_path / "unity.py").write_text(seeded)
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    assert any(
+        d.rule_id == "FX104" and "_views_cache" in d.message
+        for d in diags
+    ), [d.format() for d in diags]
+    # the unmodified searcher stays clean
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "unity.py")
+    assert run_rules([str(clean)], ["dispatch-race"]) == [], [
+        d.format() for d in run_rules([str(clean)], ["dispatch-race"])
+    ]
+
+
 def test_seeded_reconcile_bypass_is_caught(tmp_path):
     """Re-introduce the async-reconcile bug FX103 exists for: make the
     verify commit read LIVE cache lengths (one iteration ahead under

@@ -1,0 +1,607 @@
+"""Search & training observability (PR 9): SearchTrace recording
+across the unity / mcmc / mesh engines, the checked-in
+search_trace.schema.json contract (accepts real exports, rejects
+out-of-order candidate ids and negative costs), the explain-report
+exactness identity (reconstructed total == winning UnityResult cost at
+1e-9 on BOTH the native and python `_optimize_inner` paths), the
+`--search-trace`/`--explain` compile path + CLI, training fit-loop
+telemetry (train_* series, artifact validity, loss/params identity
+with telemetry on vs off), the generic build_telemetry entry, and the
+predicted-vs-measured cost-model audit."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.core.machine import MachineSpec
+from flexflow_tpu.search.explain import explain_strategy
+from flexflow_tpu.search.mcmc import mcmc_optimize
+from flexflow_tpu.search.unity import UnitySearch
+from flexflow_tpu.telemetry import (
+    MetricsRegistry,
+    SearchTrace,
+    build_telemetry,
+    validate_metrics_jsonl_file,
+    validate_metrics_text,
+    validate_search_trace,
+    validate_trace_file,
+)
+
+pytestmark = pytest.mark.telemetry
+
+SPEC = MachineSpec(num_nodes=2, chips_per_node=4, chip="v4")
+
+
+def chain_model(batch=32, hidden=64, layers=3):
+    model = FFModel(FFConfig(batch_size=batch))
+    x = model.create_tensor([batch, hidden], name="x")
+    t = x
+    for i in range(layers):
+        t = model.dense(t, hidden, activation=ActiMode.RELU, name=f"d{i}")
+    t = model.dense(t, 8, name="head")
+    return model
+
+
+def trained_model(batch=16, hidden=32, seed=0, cfg=None):
+    cfg = cfg or FFConfig(batch_size=batch, seed=seed)
+    model = FFModel(cfg)
+    x = model.create_tensor([batch, hidden], name="x")
+    t = model.dense(x, hidden, activation=ActiMode.RELU)
+    t = model.dense(t, 8)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    )
+    return model
+
+
+def rows_jsonl(trace):
+    return [json.dumps(r) for r in trace.rows()]
+
+
+def _force_python_path(monkeypatch):
+    import flexflow_tpu.native as native_mod
+
+    monkeypatch.setattr(native_mod, "get_lib", lambda: None)
+
+
+# -- schema / validator contract ----------------------------------------------
+
+
+class TestSearchTraceSchema:
+    def test_exported_unity_trace_validates(self):
+        m = chain_model()
+        tr = SearchTrace(engine="unity")
+        UnitySearch(m.graph, SPEC, trace=tr).optimize()
+        assert validate_search_trace(rows_jsonl(tr), errors="list") == []
+
+    def test_exported_mcmc_trace_validates(self):
+        m = chain_model()
+        tr = SearchTrace(engine="mcmc")
+        mcmc_optimize(m.graph, SPEC, budget=30, seed=3, trace=tr)
+        assert validate_search_trace(rows_jsonl(tr), errors="list") == []
+
+    def test_out_of_order_candidate_ids_rejected(self):
+        m = chain_model()
+        tr = SearchTrace(engine="mcmc")
+        mcmc_optimize(m.graph, SPEC, budget=20, seed=0, trace=tr)
+        rows = [json.loads(l) for l in rows_jsonl(tr)]
+        cand_idx = [
+            i for i, r in enumerate(rows) if r["type"] == "candidate"
+        ]
+        assert len(cand_idx) >= 2
+        a, b = cand_idx[0], cand_idx[1]
+        rows[a]["id"], rows[b]["id"] = rows[b]["id"], rows[a]["id"]
+        errs = validate_search_trace(
+            [json.dumps(r) for r in rows], errors="list"
+        )
+        assert any("out of order" in e for e in errs), errs
+
+    def test_negative_cost_rejected(self):
+        m = chain_model()
+        tr = SearchTrace(engine="unity")
+        UnitySearch(m.graph, SPEC, trace=tr).optimize()
+        rows = [json.loads(l) for l in rows_jsonl(tr)]
+        cand = next(
+            r for r in rows
+            if r["type"] == "candidate" and "cost" in r
+        )
+        cand["cost"] = -1e-6
+        errs = validate_search_trace(
+            [json.dumps(r) for r in rows], errors="list"
+        )
+        assert any("minimum" in e for e in errs), errs
+        # and a negative total on the result record too
+        rows2 = [json.loads(l) for l in rows_jsonl(tr)]
+        rows2[-1]["total_cost"] = -0.5
+        errs2 = validate_search_trace(
+            [json.dumps(r) for r in rows2], errors="list"
+        )
+        assert any("minimum" in e for e in errs2), errs2
+
+    def test_header_must_come_first(self):
+        m = chain_model()
+        tr = SearchTrace(engine="unity")
+        UnitySearch(m.graph, SPEC, trace=tr).optimize()
+        rows = [json.loads(l) for l in rows_jsonl(tr)]
+        shuffled = rows[1:] + rows[:1]
+        errs = validate_search_trace(
+            [json.dumps(r) for r in shuffled], errors="list"
+        )
+        assert any("header" in e for e in errs), errs
+
+
+# -- explain exactness ---------------------------------------------------------
+
+
+class TestExplainExactness:
+    def test_unity_native_path_total_exact(self):
+        from flexflow_tpu import native as native_mod
+
+        if native_mod.get_lib() is None:
+            pytest.skip("native library unavailable")
+        m = chain_model()
+        tr = SearchTrace(engine="unity")
+        res = UnitySearch(m.graph, SPEC, trace=tr).optimize()
+        rep = explain_strategy(tr.rows())
+        assert rep.result["path"] == "native"
+        assert abs(rep.reconstructed_total - res.cost) < 1e-9
+        assert rep.total_cost == res.cost
+
+    def test_unity_python_path_total_exact(self, monkeypatch):
+        _force_python_path(monkeypatch)
+        m = chain_model()
+        tr = SearchTrace(engine="unity")
+        res = UnitySearch(m.graph, SPEC, trace=tr).optimize()
+        rep = explain_strategy(tr.rows())
+        assert rep.result["path"] == "python"
+        assert abs(rep.reconstructed_total - res.cost) < 1e-9
+
+    def test_mcmc_total_exact(self):
+        m = chain_model()
+        tr = SearchTrace(engine="mcmc")
+        res = mcmc_optimize(m.graph, SPEC, budget=50, seed=11, trace=tr)
+        rep = explain_strategy(tr.rows())
+        assert abs(rep.reconstructed_total - res.cost) < 1e-9
+
+    def test_exactness_survives_json_round_trip(self, tmp_path):
+        """The identity must hold over the ARTIFACT, not just the live
+        rows — floats survive json round-trips exactly in Python."""
+        m = chain_model()
+        tr = SearchTrace(engine="unity", path=str(tmp_path / "t.jsonl"))
+        res = UnitySearch(m.graph, SPEC, trace=tr).optimize()
+        path = tr.save()
+        rep = explain_strategy(path)
+        assert abs(rep.reconstructed_total - res.cost) < 1e-9
+
+    def test_explain_text_mentions_top_ops_and_grids(self):
+        m = chain_model()
+        tr = SearchTrace(engine="unity")
+        UnitySearch(m.graph, SPEC, trace=tr).optimize()
+        text = explain_strategy(tr.rows()).text()
+        assert "top ops" in text
+        assert "(dp, ch) grids" in text
+        assert "d0" in text
+
+
+# -- unity / mcmc recording ----------------------------------------------------
+
+
+class TestEngineRecording:
+    def test_unity_python_records_leaf_sources(self, monkeypatch):
+        _force_python_path(monkeypatch)
+        m = chain_model()
+        tr = SearchTrace(engine="unity")
+        UnitySearch(m.graph, SPEC, trace=tr).optimize()
+        leaves = [
+            r for r in tr.rows()
+            if r["type"] == "candidate" and r["kind"] == "op_view"
+        ]
+        assert leaves, "python DP recorded no leaf evaluations"
+        assert all(r["source"] == "analytic" for r in leaves)
+        # every compute node appears, with multiple views for some
+        names = {r["name"] for r in leaves}
+        assert {"d0", "d1", "d2", "head"} <= names
+        assert len(leaves) > len(names), "only one view per op recorded"
+
+    def test_mcmc_header_and_tallies(self):
+        m = chain_model()
+        tr = SearchTrace(engine="mcmc")
+        mcmc_optimize(
+            m.graph, SPEC, budget=60, seed=42, alpha=2.0, trace=tr
+        )
+        rows = tr.rows()
+        header = rows[0]
+        assert header["type"] == "header"
+        assert header["seed"] == 42
+        assert header["alpha"] == 2.0
+        assert header["temperature"]["kind"] == "constant-alpha"
+        assert header["temperature"]["reset_every"] == 10
+        result = rows[-1]
+        assert result["type"] == "result"
+        proposals = [
+            r for r in rows
+            if r["type"] == "candidate" and r["kind"] in ("flip", "propagate")
+        ]
+        n_acc = sum(1 for r in proposals if r["accepted"])
+        n_rej = sum(1 for r in proposals if not r["accepted"])
+        assert result["accepted_count"] == n_acc
+        assert result["rejected_count"] == n_rej
+        assert n_acc + n_rej == len(proposals) > 0
+
+    def test_mcmc_trace_reproducible_from_seed(self):
+        """The artifact alone reproduces the run: same seed, same
+        proposal sequence and verdicts (all randomness flows from the
+        explicit seed=)."""
+        def run(seed):
+            m = chain_model()
+            tr = SearchTrace(engine="mcmc")
+            mcmc_optimize(m.graph, SPEC, budget=40, seed=seed, trace=tr)
+            return [
+                (r["kind"], r.get("guid"), r.get("accepted"),
+                 round(r.get("delta", 0.0), 15))
+                for r in tr.rows()
+                if r["type"] == "candidate"
+                and r["kind"] in ("flip", "propagate")
+            ]
+
+        assert run(5) == run(5)
+        assert run(5) != run(6)
+
+    def test_search_metrics_registry_mirror(self):
+        reg = MetricsRegistry()
+        m = chain_model()
+        tr = SearchTrace(engine="mcmc", registry=reg)
+        mcmc_optimize(m.graph, SPEC, budget=30, seed=1, trace=tr)
+        total = reg.get("search_candidates_total")
+        acc = reg.get("search_accepted_total")
+        rej = reg.get("search_rejected_total")
+        best = reg.get("search_best_cost_ms")
+        seed = reg.get("search_seed")
+        assert total is not None and total.value > 0
+        assert acc.value + rej.value <= total.value
+        assert best.value > 0
+        assert seed.value == 1.0
+
+    def test_unity_phases_and_timeline(self, tmp_path):
+        m = chain_model()
+        path = str(tmp_path / "unity.jsonl")
+        tr = SearchTrace(engine="unity", path=path)
+        UnitySearch(m.graph, SPEC, trace=tr).optimize()
+        tr.save()
+        phases = [r for r in tr.rows() if r["type"] == "phase"]
+        assert phases and all(
+            r["t_end_s"] >= r["t_start_s"] for r in phases
+        )
+        timeline = tr.timeline_path()
+        assert os.path.exists(timeline)
+        validate_trace_file(timeline)
+
+    def test_graph_cost_candidates_carry_breakdown(self):
+        """estimate_graph_cost's trace hook: the mesh engine's
+        whole-config candidates expose the compute/comm/sync/update
+        split and the memory feasibility verdict."""
+        from flexflow_tpu.search.auto import optimize
+
+        m = chain_model()
+        tr = SearchTrace(engine="mesh")
+        optimize(m.graph, 8, SPEC, budget=4, trace=tr)
+        configs = [
+            r for r in tr.rows()
+            if r["type"] == "candidate" and r["kind"] == "graph_cost"
+        ]
+        assert configs
+        for r in configs:
+            assert r["step_time"] >= 0
+            for part in ("compute_time", "comm_time", "sync_time",
+                         "update_time", "memory_per_chip"):
+                assert part in r
+            assert isinstance(r["feasible"], bool)
+
+
+# -- compile()-level flags + CLI ----------------------------------------------
+
+
+class TestCompilePathAndCLI:
+    def _compiled_with_trace(self, tmp_path, engine="unity"):
+        cfg = FFConfig.parse_args(
+            ["--budget", "4", "--search-engine", engine,
+             "--search-trace", str(tmp_path / "search.jsonl")]
+        )
+        cfg.batch_size = 32
+        model = FFModel(cfg)
+        x = model.create_tensor([32, 64], name="x")
+        t = x
+        for i in range(2):
+            t = model.dense(t, 64, activation=ActiMode.RELU, name=f"d{i}")
+        t = model.dense(t, 8, name="head")
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.01),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        )
+        return model, str(tmp_path / "search.jsonl")
+
+    def test_flag_parsing(self):
+        cfg = FFConfig.parse_args(
+            ["--search-trace", "/tmp/x.jsonl", "--explain"]
+        )
+        assert cfg.search_trace_file == "/tmp/x.jsonl"
+        assert cfg.search_explain is True
+
+    @pytest.mark.parametrize("engine", ["unity", "mcmc", "mesh"])
+    def test_compile_exports_valid_artifact(self, tmp_path, engine):
+        model, path = self._compiled_with_trace(tmp_path, engine)
+        assert os.path.exists(path)
+        with open(path) as f:
+            lines = f.readlines()
+        assert validate_search_trace(lines, errors="list") == []
+        assert model.search_trace is not None
+        # the strategy carries its prediction for the audit
+        assert model.strategy.predicted_step_time > 0
+
+    def test_single_device_still_exports_artifact(self, tmp_path):
+        """n <= 1 skips the search entirely — but a requested
+        --search-trace must still produce a valid (minimal) artifact,
+        not silently nothing (the explain/CI workflow on single-chip
+        boxes)."""
+        import jax
+
+        from flexflow_tpu.search.auto import search_strategy
+
+        cfg = FFConfig.parse_args(
+            ["--budget", "4", "--search-trace",
+             str(tmp_path / "single.jsonl")]
+        )
+        cfg.batch_size = 32
+        model = FFModel(cfg)
+        x = model.create_tensor([32, 16], name="x")
+        model.dense(x, 8, name="head")
+        strategy = search_strategy(model, 1)
+        assert strategy.name.startswith("data-parallel")
+        with open(tmp_path / "single.jsonl") as f:
+            lines = f.readlines()
+        assert validate_search_trace(lines, errors="list") == []
+        rows = [json.loads(l) for l in lines]
+        assert rows[-1]["type"] == "result"
+        assert any(
+            r.get("name") == "search_skipped" for r in rows
+        )
+        rep = explain_strategy(str(tmp_path / "single.jsonl"))
+        assert rep.total_cost == 0.0
+
+    def test_explain_cli_over_export(self, tmp_path, capsys):
+        from flexflow_tpu.search.explain import main
+
+        _, path = self._compiled_with_trace(tmp_path)
+        assert main([path]) == 0
+        out = capsys.readouterr().out
+        assert "strategy explain" in out
+        assert "search effort" in out
+
+    def test_explain_cli_rejects_corrupt_trace(self, tmp_path, capsys):
+        from flexflow_tpu.search.explain import main
+
+        _, path = self._compiled_with_trace(tmp_path)
+        rows = [json.loads(l) for l in open(path)]
+        for r in rows:
+            if r["type"] == "result":
+                r["total_cost"] = -1.0
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(
+            "\n".join(json.dumps(r) for r in rows) + "\n"
+        )
+        assert main([str(bad)]) == 2
+        assert "INVALID" in capsys.readouterr().out
+
+    def test_explain_cli_strategy_file(self, tmp_path, capsys):
+        from flexflow_tpu.search.explain import main
+        from flexflow_tpu.search.unity import save_views
+
+        m = chain_model()
+        res = UnitySearch(m.graph, SPEC).optimize()
+        path = str(tmp_path / "views.json")
+        save_views(res, m.graph, path)
+        assert main([path]) == 0
+        out = capsys.readouterr().out
+        assert "(dp, ch) grids" in out
+
+
+# -- training telemetry --------------------------------------------------------
+
+
+class TestTrainingTelemetry:
+    def _data(self, n=64, hidden=32):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((n, hidden)).astype(np.float32)
+        y = rng.integers(0, 8, size=(n,)).astype(np.int32)
+        return X, y
+
+    def test_fit_exports_all_artifacts(self, tmp_path):
+        cfg = FFConfig(batch_size=16)
+        cfg.serve_metrics_out = str(tmp_path / "train.prom")
+        cfg.serve_metrics_jsonl = str(tmp_path / "train.jsonl")
+        cfg.serve_trace = str(tmp_path / "train_trace.json")
+        model = trained_model(cfg=cfg)
+        X, y = self._data()
+        model.fit(X, y, epochs=2, batch_size=16, verbose=False)
+        validate_metrics_text(open(tmp_path / "train.prom").read())
+        validate_metrics_jsonl_file(str(tmp_path / "train.jsonl"))
+        validate_trace_file(str(tmp_path / "train_trace.json"))
+        text = open(tmp_path / "train.prom").read()
+        for series in (
+            "train_loss", "train_step_time_s", "train_examples_per_s",
+            "train_iterations_total", "train_examples_total",
+            "train_jit_builds", "train_recompiles_total", "train_epoch",
+        ):
+            assert series in text, series
+        rows = [json.loads(l) for l in open(tmp_path / "train.jsonl")]
+        assert len(rows) == 8  # 2 epochs x 4 iterations
+        assert [r["iteration"] for r in rows] == list(range(8))
+        assert rows[-1]["train_iterations_total"] == 8
+        assert rows[-1]["train_examples_total"] == 128
+        doc = json.load(open(tmp_path / "train_trace.json"))
+        names = [e.get("name") for e in doc["traceEvents"]]
+        assert names.count("epoch") == 2
+        assert names.count("iteration") == 8
+
+    def test_jsonl_loss_matches_history(self, tmp_path):
+        cfg = FFConfig(batch_size=16)
+        cfg.serve_metrics_jsonl = str(tmp_path / "t.jsonl")
+        model = trained_model(cfg=cfg)
+        X, y = self._data()
+        model.fit(X, y, epochs=1, batch_size=16, verbose=False)
+        rows = [json.loads(l) for l in open(tmp_path / "t.jsonl")]
+        perf = model.get_perf_metrics()
+        # the last row's train_loss is the epoch's final step loss —
+        # finite and positive for fresh random data
+        assert rows[-1]["train_loss"] > 0
+        assert np.isfinite(rows[-1]["train_loss"])
+        assert perf is not None
+
+    def test_telemetry_does_not_perturb_training(self, tmp_path):
+        X, y = self._data()
+        m_off = trained_model(seed=0)
+        m_on = trained_model(seed=0)
+        tele = build_telemetry(telemetry=True)
+        m_off.fit(X, y, epochs=2, batch_size=16, verbose=False)
+        m_on.fit(X, y, epochs=2, batch_size=16, verbose=False,
+                 telemetry=tele)
+        p_off = m_off.executor.export_host_params(m_off.params)
+        p_on = m_on.executor.export_host_params(m_on.params)
+        for g in p_off:
+            for a, b in zip(p_off[g], p_on[g]):
+                assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_no_telemetry_attaches_nothing(self):
+        model = trained_model()
+        X, y = self._data()
+        model.fit(X, y, epochs=1, batch_size=16, verbose=False)
+        assert model._telemetry is None
+
+    def test_jit_build_counters(self):
+        model = trained_model()
+        X, y = self._data()
+        model.fit(X, y, epochs=1, batch_size=16, verbose=False)
+        assert model.executor.jit_builds >= 1
+        model.set_learning_rate(0.5)
+        assert model.executor.jit_invalidations >= 1
+
+
+class TestBuildTelemetry:
+    def test_ffconfig_off_is_none(self):
+        assert build_telemetry(FFConfig()) is None
+
+    def test_ffconfig_knobs(self, tmp_path):
+        cfg = FFConfig()
+        cfg.serve_metrics_jsonl = str(tmp_path / "m.jsonl")
+        tele = build_telemetry(cfg)
+        assert tele is not None and tele.wants_samples
+
+    def test_serve_config_still_works(self):
+        from flexflow_tpu.serving.api import ServeConfig, build_telemetry as bt
+
+        assert bt(ServeConfig()) is None
+        tele = bt(ServeConfig(telemetry=True))
+        assert tele is not None and tele.tracing
+
+    def test_plain_kwargs_no_config(self, tmp_path):
+        tele = build_telemetry(
+            metrics_out=str(tmp_path / "x.prom"), slo_window=16
+        )
+        assert tele is not None
+        assert tele.slo.ttft_window.size == 16  # kwargs reach the monitor
+        assert build_telemetry() is None
+
+    def test_kwargs_override_config(self, tmp_path):
+        cfg = FFConfig()
+        cfg.serve_metrics_out = str(tmp_path / "a.prom")
+        tele = build_telemetry(cfg, metrics_out="")
+        assert tele is None
+
+    def test_unknown_kwarg_rejected(self):
+        with pytest.raises(TypeError):
+            build_telemetry(metrics_outt="/tmp/x")
+
+
+# -- predicted-vs-measured audit ----------------------------------------------
+
+
+class TestCostAudit:
+    def test_audit_exports_family_ratios(self, tmp_path):
+        cfg = FFConfig(batch_size=16)
+        cfg.calibration_file = str(tmp_path / "calib.json")
+        model = trained_model(cfg=cfg)
+        reg = MetricsRegistry()
+        res = model.audit_cost_model(
+            registry=reg, reps=2, profile_iters=2
+        )
+        assert res.measured_step_s > 0
+        assert res.predicted_step_s > 0
+        assert "dense" in res.families
+        g = reg.get("cost_model_error_ratio", labels={"family": "dense"})
+        assert g is not None and g.value > 0
+        g_step = reg.get(
+            "cost_model_error_ratio", labels={"family": "_step"}
+        )
+        assert g_step is not None
+        assert abs(g_step.value - res.step_error_ratio) < 1e-12
+        # the write-back went through the read-merge-write path
+        doc = json.load(open(tmp_path / "calib.json"))
+        assert doc["audit"]["families"]["dense"]["error_ratio"] > 0
+        assert "dense" in res.describe()
+
+    def test_audit_merge_preserves_sibling_keys(self, tmp_path):
+        """The calibration feedback must ride update_calibration_doc's
+        merge semantics — a pre-existing ops table survives."""
+        path = str(tmp_path / "calib.json")
+        with open(path, "w") as f:
+            json.dump(
+                {"version": 1, "chip": "v4",
+                 "ops": {"k1": [1e-6, 2e-6]}}, f
+            )
+        cfg = FFConfig(batch_size=16)
+        model = trained_model(cfg=cfg)
+        model.audit_cost_model(
+            reps=2, profile_iters=2, calibration_file=path
+        )
+        doc = json.load(open(path))
+        assert doc["ops"] == {"k1": [1e-06, 2e-06]}
+        assert "audit" in doc
+
+    def test_apply_family_scale_opt_in(self, tmp_path):
+        path = str(tmp_path / "calib.json")
+        cfg = FFConfig(batch_size=16)
+        model = trained_model(cfg=cfg)
+        model.audit_cost_model(
+            reps=2, profile_iters=2, calibration_file=path,
+            apply_family_scale=True,
+        )
+        doc = json.load(open(path))
+        assert doc["family_scale"]["dense"] > 0
+
+    def test_node_costs_export(self):
+        from flexflow_tpu.core.machine import MachineSpec as MS
+        from flexflow_tpu.search.cost_model import CostModel
+        from flexflow_tpu.search.simulator import estimate_graph_cost
+
+        m = chain_model()
+        export = {}
+        cost = estimate_graph_cost(
+            m.graph, CostModel(MS(1, 8, chip="v4")), (1,), export=export
+        )
+        nodes = export["node_costs"]
+        assert {e["name"] for e in nodes} >= {"d0", "d1", "d2", "head"}
+        dense_fwd = sum(
+            e["forward"] for e in nodes if e["family"] == "dense"
+        )
+        assert dense_fwd > 0
+        assert cost.step_time > 0
+
+    def test_audit_requires_compile(self):
+        model = FFModel(FFConfig(batch_size=8))
+        model.create_tensor([8, 4], name="x")
+        with pytest.raises(RuntimeError):
+            model.audit_cost_model()

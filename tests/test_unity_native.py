@@ -124,7 +124,8 @@ def test_native_solver_composes_with_measured_mode(tmp_path):
     s2.cm._dispatch_floor = 0.0
     # the INNER entries compare python vs native on one basis; public
     # optimize() additionally adds the per-step dispatch floor
-    r2 = s2._optimize_inner()  # native path, LUT from the same table
+    r2, path_kind = s2._optimize_inner()  # native, LUT from the same table
+    assert path_kind == "native"
     assert np.isclose(r1.cost, r2.cost, rtol=1e-9), (r1.cost, r2.cost)
     v1 = {g: (v.dp, v.ch) for g, v in r1.views.items()}
     v2 = {g: (v.dp, v.ch) for g, v in r2.views.items()}
