@@ -45,6 +45,16 @@ with --chaos, the chaos gate below runs the ASYNC loop instead
 (writes BENCH_CHAOS_ASYNC.json) — same zero-lost-requests and
 invariant assertions, now probed inside the in-flight window.
 
+--chunked mode (writes BENCH_CHUNKED.json): chunked prefill +
+token-budget scheduling vs the unchunked scheduler on the SAME engine
+over a head-of-line stream (short decoders with a long prompt landing
+every third request). Gates — EXIT NONZERO on miss: p95 TTFT of short
+requests admitted alongside a long prompt >= 1.3x better, p95
+inter-token latency of in-flight decoders >= 1.3x better, decode
+throughput >= 0.95x unchunked, and greedy streams token-identical
+(chunked prefill replays the same staircase-masked computation, so
+logits — and therefore tokens — must not move).
+
 --chaos mode (writes BENCH_CHAOS.json): a seeded FaultInjector
 (serving/faults.py) runs the mixed stream under OPTIMISTIC admission on
 an undersized page pool while injecting NaN logits, kernel faults,
@@ -600,6 +610,175 @@ def run_async(
     }
 
 
+def _hol_requests(vocab, max_len, n):
+    """Short decoders with a long-prompt request every third rid — the
+    head-of-line regime chunked prefill exists for: by the time a long
+    prompt is admitted, short requests are decoding in flight, and a
+    monolithic prefill stalls every one of them for a full prompt's
+    worth of compute. Short generation lengths are staggered so slots
+    free at different iterations and later longs land mid-decode."""
+    from flexflow_tpu.serving import Request
+
+    long_prompt = max_len // 2
+    short_gen = max(6, max_len // 16)
+    out = []
+    for i in range(n):
+        if i % 3 == 2:
+            out.append(
+                Request(
+                    rid=i,
+                    prompt=[(i * 11 + j) % vocab
+                            for j in range(long_prompt)],
+                    max_new_tokens=2,
+                )
+            )
+        else:
+            out.append(
+                Request(
+                    rid=i,
+                    prompt=[(i * 7 + j) % vocab for j in range(1 + i % 3)],
+                    max_new_tokens=short_gen + 2 * (i % 3),
+                )
+            )
+    return out
+
+
+def run_chunked(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    reps: int = 3,
+):
+    """Chunked prefill (--chunked) vs the unchunked continuous
+    scheduler on the SAME engine over the head-of-line stream.
+
+    Two latency populations, both pooled over interleaved reps:
+
+    * blocked shorts — short requests admitted in the same iteration a
+      long prompt was; unchunked, their first token waits on the whole
+      monolithic prefill, chunked it arrives after one budget-sized
+      iteration. TTFT here is admission→first-token (from the request
+      event log), not submit→first-token: in a closed-loop bench every
+      request is submitted at t0, so submit-relative TTFT for a
+      late-admitted request is all queue wait and would measure total
+      elapsed time, not the head-of-line block this mode removes.
+    * in-flight decoders — every inter-token gap the SLO window
+      observed; a monolithic prefill inflates one gap per decoder per
+      long admission, chunking spreads that cost across budget-capped
+      iterations.
+
+    Throughput is the guard rail, not the headline: chunking pays more
+    dispatches for the same token work, and the gate holds the decode
+    tokens/s MEAN to >= 0.95x unchunked. Token identity is asserted in
+    main() — the chunk path replays the identical staircase-masked
+    computation, so streams must not move at all."""
+    from flexflow_tpu.serving import (
+        ContinuousBatchingScheduler,
+        ServeConfig,
+        Telemetry,
+        build_scheduler,
+    )
+    from flexflow_tpu.telemetry.slo import percentiles as _pcts
+
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+    chunk = max(8, max_len // 4)
+    budget = max_seqs + chunk  # full decode reserve + one whole chunk
+    long_rids = {i for i in range(num_requests) if i % 3 == 2}
+
+    def admit_ttft(r):
+        t_admit = next(t for t, e, _ in r.events if e == "admit")
+        return r.first_token_time - t_admit
+
+    def requests():
+        return _hol_requests(vocab, max_len, num_requests)
+
+    serve = ServeConfig(max_seqs=max_seqs, max_seq_len=max_len)
+    _, engine, _ = build_scheduler(model, serve)
+    modes = (
+        ("unchunked", {}),
+        ("chunked", dict(token_budget=budget, chunk_size=chunk)),
+    )
+    for _, kw in modes:  # full warm run: every jit width off the clock
+        ContinuousBatchingScheduler(engine, **kw).run(requests())
+
+    tps = {name: [] for name, _ in modes}
+    ttft = {name: [] for name, _ in modes}
+    itl = {name: [] for name, _ in modes}
+    streams: dict = {}
+    chunk_stats = None
+    for _ in range(reps):  # interleaved: both modes see the same drift
+        for name, kw in modes:
+            tele = Telemetry(slo_window=8192)
+            sched = ContinuousBatchingScheduler(
+                engine, telemetry=tele, **kw
+            )
+            done = sched.run(requests())
+            tps[name].append(sched.stats.tokens_per_s)
+            long_admits = {
+                r.admit_iter for r in done if r.rid in long_rids
+            }
+            ttft[name].extend(
+                admit_ttft(r)
+                for r in done
+                if r.rid not in long_rids
+                and r.ok
+                and r.admit_iter in long_admits
+            )
+            itl[name].extend(tele.slo.itl_window.values().tolist())
+            streams.setdefault(
+                name, {r.rid: tuple(r.generated) for r in done}
+            )
+            if name == "chunked":
+                chunk_stats = sched.stats
+    if not ttft["chunked"] or not ttft["unchunked"]:
+        raise SystemExit(
+            "head-of-line stream produced no blocked shorts — the "
+            "TTFT gate has nothing to measure"
+        )
+    mean_tps = {n_: sum(v) / len(v) for n_, v in tps.items()}
+    ttft_p95 = {n_: _pcts(v, (95,))[95] for n_, v in ttft.items()}
+    itl_p95 = {n_: _pcts(v, (95,))[95] for n_, v in itl.items()}
+    matched = sum(
+        1
+        for rid in streams["unchunked"]
+        if streams["chunked"].get(rid) == streams["unchunked"][rid]
+    )
+    ttft_ratio = ttft_p95["unchunked"] / ttft_p95["chunked"]
+    s = chunk_stats
+    return {
+        "metric": f"serve_chunked_prefill_{layers}L_{hidden}h",
+        "value": round(ttft_ratio, 3),
+        "unit": "x_blocked_short_p95_ttft_vs_unchunked",
+        # how much faster a short request behind a long prompt sees its
+        # first token (acceptance floor: 1.3x; ITL gate rides along)
+        "vs_baseline": round(ttft_ratio, 3),
+        "token_budget": budget,
+        "chunk_size": chunk,
+        "reps": reps,
+        "blocked_short_p95_ttft_ms": {
+            n_: round(v * 1e3, 3) for n_, v in ttft_p95.items()
+        },
+        "ttft_p95_ratio": round(ttft_ratio, 3),
+        "itl_p95_ms": {n_: round(v, 3) for n_, v in itl_p95.items()},
+        "itl_p95_ratio": round(
+            itl_p95["unchunked"] / itl_p95["chunked"], 3
+        ),
+        "chunked_tokens_per_s": round(mean_tps["chunked"], 2),
+        "unchunked_tokens_per_s": round(mean_tps["unchunked"], 2),
+        "throughput_ratio": round(
+            mean_tps["chunked"] / mean_tps["unchunked"], 3
+        ),
+        "chunk_steps": s.chunk_steps,
+        "chunk_tokens": s.chunk_tokens,
+        "budget_deferrals": s.budget_deferrals,
+        "streams_match": f"{matched}/{len(streams['unchunked'])}",
+    }
+
+
 def run_telemetry(
     layers: int,
     hidden: int,
@@ -967,6 +1146,8 @@ def main():
             mode = "spec"
         elif a == "--chaos":
             mode = "chaos"
+        elif a == "--chunked":
+            mode = "chunked"
         elif a == "--telemetry":
             mode = "telemetry"
         elif a == "--serve-async":
@@ -1008,6 +1189,31 @@ def main():
         with open(os.path.join(here, "BENCH_DECODE_KERNEL.json"), "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
+    elif mode == "chunked":
+        result = run_chunked(**args)
+        with open(os.path.join(here, "BENCH_CHUNKED.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        n_match, n_all = result["streams_match"].split("/")
+        if n_match != n_all:
+            raise SystemExit(
+                f"chunked prefill moved greedy streams: "
+                f"{result['streams_match']} matched"
+            )
+        if (
+            result["ttft_p95_ratio"] < 1.3
+            or result["itl_p95_ratio"] < 1.3
+        ):
+            raise SystemExit(
+                f"chunked prefill missed the latency gates: "
+                f"p95 TTFT {result['ttft_p95_ratio']}x, "
+                f"p95 ITL {result['itl_p95_ratio']}x (floor 1.3x)"
+            )
+        if result["throughput_ratio"] < 0.95:
+            raise SystemExit(
+                f"chunked prefill regressed decode throughput: "
+                f"{result['throughput_ratio']}x unchunked (floor 0.95x)"
+            )
     elif mode == "telemetry":
         result = run_telemetry(**args)
         with open(os.path.join(here, "BENCH_TELEMETRY.json"), "w") as f:

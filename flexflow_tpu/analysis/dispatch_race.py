@@ -45,6 +45,16 @@ findings go to the baseline):
   never happened. Same deferred-read shape as FX101, different queue
   (the JSONL writer instead of the jit dispatch). Pass scalars or a
   fresh ``dict(...)``/``list(...)``/``.copy()``.
+* **FX105** — reconcile-phase code loading chunked-prefill progress
+  state (``prefill_seq`` / ``prefill_pos`` / ``prefill_dispatched``)
+  from anywhere but the step record. A chunk step's cursor travels
+  WITH the step (``step.chunks[slot] = (start, size, final)``): the
+  dispatcher advances the live ``prefill_dispatched`` cursor the
+  moment the NEXT chunk leaves, so by reconcile time the request
+  attrs describe a later dispatch — final-chunk / emit decisions made
+  against them double-emit or drop the prompt's sampled token. Stores
+  are the commit itself (``req.prefill_pos = start + size``) and stay
+  sanctioned; loads must come through the step parameter.
 """
 
 from __future__ import annotations
@@ -65,9 +75,15 @@ RULES = {
     "InflightStep snapshot",
     "FX104": "search-trace hook captures live mutable state without a "
     "copy",
+    "FX105": "reconcile reads live chunk-progress attrs instead of the "
+    "InflightStep chunk record",
 }
 
 _STEP_PARAM_NAMES = {"step", "inflight"}
+
+#: chunked-prefill cursor state on Request — the live view a chunk
+#: reconcile must never read (FX105); the snapshot is `step.chunks`
+_CHUNK_PROGRESS_ATTRS = {"prefill_seq", "prefill_pos", "prefill_dispatched"}
 
 _ASARRAY_CHAINS = {("jnp", "asarray"), ("jax", "numpy", "asarray")}
 _SNAPSHOT_NAMES = {"snapshot"}
@@ -221,6 +237,29 @@ def _reconcile_violations(
     return found
 
 
+def _chunk_progress_violations(
+    fn, step_params: Set[str]
+) -> List[Tuple[str, int]]:
+    """(attr, line) for loads of chunked-prefill cursor state inside a
+    reconcile-phase function that do not come through the step
+    parameter. Stores (the commit: ``req.prefill_pos = start + size``)
+    are the sanctioned write-back and never match; the sanctioned read
+    is the step's own record (``step.chunks[slot]``)."""
+    found: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and node.attr in _CHUNK_PROGRESS_ATTRS
+        ):
+            continue
+        chain = name_chain(node)
+        if chain is not None and chain[0] in step_params:
+            continue
+        found.append((node.attr, node.lineno))
+    return found
+
+
 def _is_trace_hook(node: ast.Call) -> bool:
     """A SearchTrace recording call: `<...>.trace.candidate(...)`,
     `trace.result(...)`, `self._trace.event(...)` — the method is one
@@ -249,7 +288,8 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                 node, (ast.FunctionDef, ast.AsyncFunctionDef)
             ):
                 continue
-            if not _step_params(node):
+            steps = _step_params(node)
+            if not steps:
                 continue
             for attr, line in _reconcile_violations(node, mutated):
                 diags.append(
@@ -261,6 +301,19 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                         f"live 'cache.{attr}' — between dispatch and "
                         "reconcile that state belongs to the NEXT step; "
                         "read the InflightStep snapshot instead",
+                    )
+                )
+            for attr, line in _chunk_progress_violations(node, steps):
+                diags.append(
+                    Diagnostic(
+                        "FX105",
+                        path,
+                        line,
+                        f"reconcile-phase function '{node.name}' reads "
+                        f"live chunk-progress attr '{attr}' — the "
+                        "dispatcher advances it for later chunks while "
+                        "this step is in flight; read the step's own "
+                        "cursor record (step.chunks) instead",
                     )
                 )
     for path, tree in trees.items():

@@ -127,6 +127,12 @@ class FFConfig:
     # decoder LM passed to build_scheduler) and draft length per verify
     serve_spec_draft: str = ""
     serve_spec_k: int = 4
+    # chunked prefill (Sarathi-style; serving/scheduler.py):
+    # --token-budget > 0 caps each iteration's token work and streams
+    # prompts in via --chunk-size-aligned chunks interleaved with
+    # decodes; 0 keeps the monolithic admission prefill
+    serve_token_budget: int = 0
+    serve_chunk_size: int = 16
     # decode/verify attention core (ops/pallas/decode_kernel.py):
     # "auto" = Pallas flash-decode kernel on TPU when supported,
     # "pallas" = force it (interpret mode off-TPU), "dense" = jnp paths
@@ -292,6 +298,10 @@ class FFConfig:
                 cfg.serve_spec_draft = take()
             elif a == "--spec-k":
                 cfg.serve_spec_k = int(take())
+            elif a == "--token-budget":
+                cfg.serve_token_budget = int(take())
+            elif a == "--chunk-size":
+                cfg.serve_chunk_size = int(take())
             elif a == "--decode-kernel":
                 cfg.serve_decode_kernel = take()
             elif a == "--admission":

@@ -1172,6 +1172,215 @@ def optimize_spec_k(
     return best
 
 
+def estimate_chunk_step(
+    graph: PCGGraph,
+    cm: CostModel,
+    dp: int,
+    tp: int,
+    batch: int,
+    cursor: int,
+    chunk: int,
+    page_size: int = 0,
+    decode_kernel: str = "dense",
+) -> Optional[GraphCost]:
+    """Cost one chunked-prefill step of the whole PCG under a (dp, tp)
+    mesh: `chunk` prompt positions appended at cache cursor `cursor`
+    for each of `batch` chunking sequences — the chunk twin of
+    estimate_verify_step (a chunk IS a verify with nothing to accept),
+    priced through CostModel.prefill_chunk_cost. Same feasibility rules
+    and conservative one-all-reduce-per-node TP sync charge."""
+    if batch % dp != 0:
+        return None
+    b_chip = batch // dp
+    compute = 0.0
+    sync = 0.0
+    mem = 0.0
+    for node in graph.nodes.values():
+        if node.op_type == OperatorType.INPUT or node.is_parallel_op:
+            continue
+        width = _DECODE_TP_OPS.get(node.op_type)
+        node_tp = tp
+        if width is not None and tp > 1:
+            if width(node) % tp != 0:
+                return None
+        elif width is None:
+            node_tp = 1
+        c = cm.prefill_chunk_cost(
+            node, b_chip, cursor, chunk, tp=node_tp, page_size=page_size,
+            kernel=decode_kernel,
+        )
+        compute += c.forward_time
+        mem += c.memory
+        if node_tp > 1 and node.output_shapes:
+            out = node.output_shapes[0]
+            act = b_chip * chunk * out.logical_sizes[-1] * cm.elem_bytes(out)
+            sync += cm.all_reduce(float(act), node_tp)
+    return GraphCost(
+        step_time=compute + sync,
+        compute_time=compute,
+        sync_time=sync,
+        memory_per_chip=int(mem),
+    )
+
+
+class TokenBudgetResult:
+    """The per-iteration token budget optimize_token_budget picked,
+    with the prediction it was picked on. `meets_slo` reports whether
+    the predicted latencies clear the thresholds — False means no
+    candidate could, and the returned budget is the least-violating
+    one (scheduling cannot beat physics: if one decode iteration
+    already exceeds slo_itl_ms, no budget fixes it)."""
+
+    def __init__(
+        self,
+        token_budget: int,
+        chunk_size: int,
+        predicted_ttft_s: float,
+        predicted_itl_s: float,
+        n_chunks: int,
+        meets_slo: bool,
+        slo_ttft_s: float,
+        slo_itl_s: float,
+    ):
+        self.token_budget = token_budget
+        self.chunk_size = chunk_size
+        self.predicted_ttft_s = predicted_ttft_s
+        self.predicted_itl_s = predicted_itl_s
+        self.n_chunks = n_chunks
+        self.meets_slo = meets_slo
+        self.slo_ttft_s = slo_ttft_s
+        self.slo_itl_s = slo_itl_s
+
+    def describe(self) -> str:
+        verdict = "meets SLO" if self.meets_slo else "SLO infeasible"
+        return (
+            f"token-budget {self.token_budget} (chunk {self.chunk_size}, "
+            f"{self.n_chunks} chunks): predicted TTFT "
+            f"{self.predicted_ttft_s * 1e3:.2f} ms, ITL "
+            f"{self.predicted_itl_s * 1e3:.2f} ms — {verdict}"
+        )
+
+
+def optimize_token_budget(
+    graph: PCGGraph,
+    spec: MachineSpec,
+    prompt_len: int,
+    batch: int = 1,
+    kv_len: int = 1024,
+    chunk_size: int = 16,
+    slo_ttft_ms: float = 0.0,
+    slo_itl_ms: float = 0.0,
+    dp: int = 1,
+    tp: int = 1,
+    page_size: int = 0,
+    machine_model=None,
+    mixed_precision: bool = False,
+    decode_kernel: str = "dense",
+    measured_decode_step_s: float = 0.0,
+) -> TokenBudgetResult:
+    """Pick the smallest per-iteration token budget whose PREDICTED
+    p95 latencies meet the SLO thresholds — the enforcement half of the
+    SLO story (PR 8's rolling `serve_slo_*` windows are the
+    measurement half; `--slo-ttft-ms`/`--slo-itl-ms` feed both).
+
+    The model mirrors the scheduler's fair-share planner: with `batch`
+    decodes in flight (1 token each, reserved first), a budget B leaves
+    floor((B - batch) / chunk_size) chunk_size-units per iteration for
+    a `prompt_len` prompt, so the prompt lands in n_chunks iterations.
+    Each iteration is priced as one decode step over the in-flight
+    batch (estimate_decode_step) plus one chunk step at the advancing
+    cursor (estimate_chunk_step / CostModel.prefill_chunk_cost):
+    predicted TTFT = Σ iterations until the last chunk, predicted ITL =
+    the widest single iteration a decode waits through. Smaller budgets
+    lower ITL and raise TTFT; the smallest feasible budget is the
+    SLO-safest point of that trade. When NO budget meets both
+    thresholds the least-violating one returns with meets_slo=False.
+
+    `measured_decode_step_s` calibrates the analytic clock against a
+    measured per-iteration time (the rolling ITL window's p95 from an
+    unchunked run, or SchedulerStats.mean_dispatch_gap_s): every
+    predicted time scales by measured / analytic-decode-step, so the
+    roofline model contributes the RATIOS between candidate budgets
+    while the measurement pins the absolute scale — measure-then-decide
+    applied to the scheduler itself."""
+    if prompt_len < 1:
+        raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    cm = CostModel(
+        spec,
+        measure=False,
+        machine_model=machine_model,
+        mixed_precision=mixed_precision,
+    )
+    dec_batch = max(0, int(batch))
+    t_dec = 0.0
+    if dec_batch:
+        base = estimate_decode_step(
+            graph, cm, dp, tp, dec_batch, kv_len, page_size=page_size,
+            decode_kernel=decode_kernel,
+        )
+        if base is None:
+            raise ValueError(
+                f"(dp={dp}, tp={tp}) is infeasible for this graph"
+            )
+        t_dec = base.step_time
+    scale = 1.0
+    if measured_decode_step_s > 0.0 and t_dec > 0.0:
+        scale = measured_decode_step_s / t_dec
+    slo_ttft_s = slo_ttft_ms / 1e3
+    slo_itl_s = slo_itl_ms / 1e3
+    n_units_max = -(-prompt_len // chunk_size)
+    best: Optional[TokenBudgetResult] = None
+    best_score = float("inf")
+    for m in range(1, n_units_max + 1):
+        c = m * chunk_size  # chunk tokens granted per iteration
+        budget = dec_batch + c
+        n_chunks = -(-prompt_len // c)
+        ttft = 0.0
+        itl = t_dec
+        for i in range(n_chunks):
+            cursor = i * c
+            w = min(c, prompt_len - cursor)
+            ch = estimate_chunk_step(
+                graph, cm, dp, tp, 1, cursor, w, page_size=page_size,
+                decode_kernel=decode_kernel,
+            )
+            if ch is None:
+                raise ValueError(
+                    f"(dp={dp}, tp={tp}) is infeasible for this graph"
+                )
+            ttft += t_dec + ch.step_time
+            itl = max(itl, t_dec + ch.step_time)
+        ttft *= scale
+        itl *= scale
+        # score: worst normalized SLO ratio (an unset threshold does
+        # not constrain); <= 1 means both thresholds are met
+        score = 0.0
+        if slo_ttft_s:
+            score = max(score, ttft / slo_ttft_s)
+        if slo_itl_s:
+            score = max(score, itl / slo_itl_s)
+        cand = TokenBudgetResult(
+            token_budget=budget,
+            chunk_size=chunk_size,
+            predicted_ttft_s=ttft,
+            predicted_itl_s=itl,
+            n_chunks=n_chunks,
+            meets_slo=score <= 1.0,
+            slo_ttft_s=slo_ttft_s,
+            slo_itl_s=slo_itl_s,
+        )
+        if cand.meets_slo:
+            # smallest feasible budget: the SLO-safest point — later
+            # (larger) candidates only raise the per-iteration stall
+            return cand
+        if score < best_score:
+            best, best_score = cand, score
+    assert best is not None  # m = 1 always produced a candidate
+    return best
+
+
 def optimize_serving(
     graph: PCGGraph,
     num_devices: int,

@@ -641,6 +641,38 @@ class CostModel:
             kernel=kernel,
         )
 
+    def prefill_chunk_cost(
+        self,
+        node,
+        batch: int,
+        cursor: int,
+        chunk: int,
+        tp: int = 1,
+        page_size: int = 0,
+        kernel: str = "dense",
+    ) -> OpCost:
+        """Forward cost of ONE chunked-prefill step of this op on one
+        chip: `chunk` prompt positions appended at cache cursor
+        `cursor` (tokens already prefilled — the staircase mask's
+        query_offset). This is exactly the verify shape the engine
+        routes chunks through (a chunk is a wide verify with nothing to
+        accept), so it prices as verify_op_cost with kv_len = cursor
+        and w = chunk positions. The whole-prompt prefill is the
+        cursor=0, chunk=seq_len special case (prefill_op_cost), and the
+        SUM over a prompt's chunks exceeds the monolithic cost by one
+        weight-stream per extra chunk — the price auto.
+        optimize_token_budget weighs against the head-of-line latency
+        the chunking removes."""
+        return self.verify_op_cost(
+            node,
+            batch,
+            kv_len=int(cursor),
+            k=max(0, int(chunk) - 1),
+            tp=tp,
+            page_size=page_size,
+            kernel=kernel,
+        )
+
     # -- measured mode ------------------------------------------------------
     #
     # The direct analog of the reference's inner_measure_operator_cost

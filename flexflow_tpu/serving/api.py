@@ -56,6 +56,15 @@ class ServeConfig:
     spec_draft: str = ""
     spec_k: int = 4
     spec_ngram: int = 2
+    # chunked prefill (Sarathi-Serve; serving/scheduler.py):
+    # token_budget > 0 caps each iteration's token work — prompts
+    # stream into the cache in chunk_size-aligned chunks interleaved
+    # with in-flight decodes instead of one monolithic admission
+    # prefill (the head-of-line blocking fix). 0 = off. Requires the
+    # continuous scheduler; auto.optimize_token_budget picks a budget
+    # that meets slo_ttft_ms / slo_itl_ms from the cost model.
+    token_budget: int = 0
+    chunk_size: int = 16
     # decode/verify attention core (ops/pallas/decode_kernel.py):
     # "auto" = the Pallas flash-decode kernel on TPU when the geometry
     # supports() it (dense otherwise), "pallas" = force the kernel
@@ -142,6 +151,36 @@ class ServeConfig:
             raise ValueError("spec_k must be >= 1 when spec_draft is set")
         if self.spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
+        if self.token_budget < 0 or self.chunk_size < 1:
+            raise ValueError(
+                "token_budget must be >= 0 and chunk_size >= 1, got "
+                f"token_budget={self.token_budget} "
+                f"chunk_size={self.chunk_size}"
+            )
+        if self.token_budget:
+            if self.scheduler != "continuous":
+                raise ValueError(
+                    "token_budget (chunked prefill) requires the "
+                    "continuous scheduler"
+                )
+            if self.token_budget < self.chunk_size:
+                raise ValueError(
+                    f"token_budget {self.token_budget} < chunk_size "
+                    f"{self.chunk_size}: an iteration could never fit "
+                    f"one chunk"
+                )
+            # mirror decode_kernel.supports(): a kernel-active config
+            # with a misaligned chunk width would route every chunk to
+            # the dense fallback — reject it here, where the flag
+            # surface can still tell the operator which knob to turn
+            from flexflow_tpu.ops.pallas.decode_kernel import SUBLANES
+
+            if self.decode_kernel != "dense" and self.chunk_size % SUBLANES:
+                raise ValueError(
+                    f"chunk_size {self.chunk_size} must be a multiple "
+                    f"of {SUBLANES} when decode_kernel is "
+                    f"{self.decode_kernel!r}"
+                )
         from flexflow_tpu.ops.pallas.decode_kernel import MODES
 
         if self.decode_kernel not in MODES:
@@ -184,6 +223,8 @@ class ServeConfig:
             kv_pages=cfg.serve_kv_pages,
             spec_draft=cfg.serve_spec_draft,
             spec_k=cfg.serve_spec_k,
+            token_budget=cfg.serve_token_budget,
+            chunk_size=cfg.serve_chunk_size,
             decode_kernel=cfg.serve_decode_kernel,
             admission=cfg.serve_admission,
             max_preemptions=cfg.serve_max_preemptions,
@@ -294,6 +335,8 @@ def build_scheduler(
         injector=injector,
         debug_invariants=serve.debug_invariants,
         telemetry=telemetry,
+        token_budget=serve.token_budget,
+        chunk_size=serve.chunk_size,
     )
     return sched, engine, cache
 

@@ -43,6 +43,19 @@ runs the staircase-masked w-query attention, and the caller accepts a
 prefix of the drafts and commits/rolls back via
 `cache.truncate(slot, new_len)` (verify itself never advances lengths).
 
+A fourth family serves **chunked prefill** (Sarathi-style, the
+scheduler's `--token-budget` path): a prompt chunk is exactly a wide
+verify with nothing to accept — w prompt tokens per slot scatter into
+the cache at the slot's prefill cursor and attend through the SAME
+staircase-masked verify path (query_offset = tokens already
+prefilled), so chunked prefill is token- and logit-identical to the
+monolithic prefill above. Unlike verify, chunk rows ARE the prompt —
+accepted by construction — so `prefill_chunk_dispatch` advances
+`cache.lengths` at dispatch (no host data dependency between a
+request's consecutive chunks: they pipeline under the async loop), and
+only the FINAL chunk's sampled token means anything (the scheduler
+discards the rest).
+
 All steps are jitted with static shapes: decode always runs at
 `[max_seqs, 1]`, prefill at `[max_seqs, bucket]` per length bucket,
 verify at `[max_seqs, w]` per draft width, so compile count is
@@ -113,12 +126,16 @@ class InflightStep:
     reconcile blocks on.
     """
 
-    kind: str  # "decode" | "verify"
+    kind: str  # "decode" | "verify" | "chunk"
     dispatch_t: float  # wall clock at dispatch (overlap accounting)
     active: np.ndarray  # bool [max_seqs] — slots the step ran for
     lengths: np.ndarray  # int32 [max_seqs] — cache lengths BEFORE the step
     host_tokens: Optional[np.ndarray] = None  # decode: host-view input tokens
-    draft_lens: Optional[np.ndarray] = None  # verify: rows per slot
+    draft_lens: Optional[np.ndarray] = None  # verify/chunk: rows per slot
+    # chunked prefill: slot -> (start, size, final) — the prefill-cursor
+    # snapshot the commit phase reads INSTEAD of live Request attrs
+    # (fxlint FX105 holds reconcile code to this record)
+    chunks: Optional[Dict[int, tuple]] = None
     # device futures (JAX arrays still computing behind the queue)
     device_next: object = None  # decode: sampled tokens [max_seqs]
     device_logits: object = None  # [max_seqs, V] or [max_seqs, w, V]
@@ -226,6 +243,11 @@ class GenerationEngine:
         self._prefill_cache: Dict[int, object] = {}
         self._verify_cache: "OrderedDict[int, object]" = OrderedDict()
         self.verify_cache_max = 8
+        # chunked-prefill programs, one per chunk width — the scheduler
+        # pads widths to multiples of chunk_size, so the population is
+        # budget/chunk_size distinct widths at most
+        self._chunk_cache: "OrderedDict[int, object]" = OrderedDict()
+        self.chunk_cache_max = 8
 
     @property
     def verify_cache_entries(self) -> int:
@@ -250,6 +272,24 @@ class GenerationEngine:
                 self._verify_cache.popitem(last=False)
         else:
             self._verify_cache.move_to_end(w)
+        return fn
+
+    def _chunk_fn(self, key):
+        """The jitted chunked-prefill program for compact batch shape
+        `key` = (B, w) — same LRU discipline as `_verify_fn` over its
+        own cache."""
+        import jax
+
+        fn = self._chunk_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                self._chunk_impl_paged if self.paged else self._chunk_impl
+            )
+            self._chunk_cache[key] = fn
+            while len(self._chunk_cache) > max(1, self.chunk_cache_max):
+                self._chunk_cache.popitem(last=False)
+        else:
+            self._chunk_cache.move_to_end(key)
         return fn
 
     # -- kernel-failure fallback ---------------------------------------------
@@ -299,6 +339,7 @@ class GenerationEngine:
             self._decode_impl_paged if self.paged else self._decode_impl
         )
         self._verify_cache.clear()
+        self._chunk_cache.clear()
 
     # -- shared forward ------------------------------------------------------
 
@@ -727,14 +768,21 @@ class GenerationEngine:
 
     # -- verify (speculative decoding) ---------------------------------------
 
-    def _verify_scatter_dest(self, w, lengths, draft_lens, tables, jnp):
-        """Flattened-cache destinations [max_seqs * w] for the verify
-        write: row j of slot s lands at cache position lengths[s] + j
-        when j < draft_lens[s] and the position is inside max_len; every
-        other row routes out of bounds (JAX drops OOB scatter rows), so
-        pad rows, inactive slots, and overflow never touch live cache."""
+    def _verify_scatter_dest(
+        self, w, lengths, draft_lens, tables, jnp, slot_ids=None
+    ):
+        """Flattened-cache destinations [batch * w] for the verify
+        write: row j of batch row b lands at cache position
+        lengths[b] + j when j < draft_lens[b] and the position is
+        inside max_len; every other row routes out of bounds (JAX
+        drops OOB scatter rows), so pad rows, inactive slots, and
+        overflow never touch live cache. The batch is slot-indexed
+        (batch row == slot) unless `slot_ids` maps a COMPACT batch's
+        rows to their slots (the chunked-prefill path); the paged
+        branch needs no ids because `tables` rows arrive already
+        batch-aligned."""
         spec = self.cache.spec
-        pos = lengths[:, None] + jnp.arange(w)[None, :]  # [max_seqs, w]
+        pos = lengths[:, None] + jnp.arange(w)[None, :]  # [batch, w]
         valid = (jnp.arange(w)[None, :] < draft_lens[:, None]) & (
             pos < spec.max_len
         )
@@ -746,9 +794,10 @@ class GenerationEngine:
             flat = entry * ps + pos % ps
             oob = spec.num_pages * ps
         else:
-            flat = (
-                jnp.arange(spec.max_seqs)[:, None] * spec.max_len + pos
+            rows = (
+                jnp.arange(spec.max_seqs) if slot_ids is None else slot_ids
             )
+            flat = rows[:, None] * spec.max_len + pos
             oob = spec.max_seqs * spec.max_len
         return jnp.where(valid, flat, oob).reshape(-1)
 
@@ -949,4 +998,273 @@ class GenerationEngine:
         the logits [max_seqs, w, V] as a host array."""
         return self.verify_reconcile(
             self.verify_dispatch(params, tokens, draft_lens)
+        )
+
+    # -- chunked prefill -----------------------------------------------------
+
+    def _chunk_impl(
+        self, params, tokens, slot_ids, all_lengths, chunk_lens, ck, cv
+    ):
+        """tokens [B, w] int32 — the next chunk_lens[b] PROMPT tokens
+        of each ACTIVE prefilling slot slot_ids[b] (0-padded);
+        all_lengths [max_seqs] = every slot's cache cursor (the impl
+        gathers its own rows). The batch is COMPACTED to chunking
+        slots: a lone long prompt streaming through the budget costs
+        B=1 rows of transformer compute per chunk step instead of
+        max_seqs — the full-slot verify-style batch taxed every chunk
+        step max_seqs/B x and erased the head-of-line win in wall
+        clock. The verify core is otherwise verbatim — staircase mask
+        with query_offset = cursor gives exact causal prefill
+        semantics, and the same fp32 accumulation / -1e30 fill keeps
+        chunked prefill logit-identical to the monolithic path (each
+        batch row's reduction is independent, so compaction cannot
+        move a logit) — plus the monolithic prefill's tail: the last
+        valid position's logits are sampled at position cursor + chunk
+        (== prompt length on the final chunk, so the first generated
+        token matches _prefill_impl's exactly). Returns (ck', cv',
+        next_tokens [B], last_logits [B, V]) in compact order;
+        prefill_chunk_reconcile scatters them back to slot-indexed
+        arrays."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            mha_project_qkv,
+            mha_project_out,
+            verify_attention,
+        )
+
+        spec = self.cache.spec
+        w = tokens.shape[1]
+        lengths = all_lengths[slot_ids]  # [B] cursor per active slot
+        dest = self._verify_scatter_dest(
+            w, lengths, chunk_lens, None, jnp, slot_ids=slot_ids
+        )
+        new_k = dict(ck)
+        new_v = dict(cv)
+
+        def row_update(cache, new):
+            flat = cache.reshape(-1, spec.num_heads, spec.head_dim)
+            rows = new.astype(cache.dtype).reshape(
+                -1, spec.num_heads, spec.head_dim
+            )
+            return flat.at[dest].set(rows).reshape(cache.shape)
+
+        def hook(node, ins, ws, ctx):
+            g = node.guid
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            kc = row_update(ck[g], k)
+            vc = row_update(cv[g], v)
+            new_k[g] = kc
+            new_v[g] = vc
+            # attention sees only the active slots' cache rows — the
+            # update above already wrote the full cache for commit
+            attn = verify_attention(
+                q, kc[slot_ids], vc[slot_ids], lengths,
+                kernel=self.decode_kernel,
+            )
+            return [
+                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
+            ]
+
+        logits = self._forward_logits(params, tokens, hook)
+        last = jnp.take_along_axis(
+            logits, jnp.clip(chunk_lens - 1, 0, w - 1)[:, None, None], axis=1
+        )[:, 0]
+        # the sampling key matches _prefill_impl's _pick(last, slot_ids,
+        # prompt_lens): on the final chunk cursor + chunk == prompt_len
+        return (
+            new_k,
+            new_v,
+            self._pick(last, slot_ids, lengths + chunk_lens),
+            last,
+        )
+
+    def _chunk_impl_paged(
+        self, params, tokens, slot_ids, all_lengths, chunk_lens, tables,
+        ck, cv,
+    ):
+        """Paged twin of _chunk_impl: rows route through the block
+        tables into the flattened pools, attention gathers pages via
+        ops.attention.paged_verify_attention. Same compact batch —
+        tables arrive full [max_seqs, pages] and the active rows are
+        gathered here, so dest and attention both see batch-aligned
+        tables."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            mha_project_qkv,
+            mha_project_out,
+            paged_verify_attention,
+        )
+
+        spec = self.cache.spec
+        w = tokens.shape[1]
+        lengths = all_lengths[slot_ids]  # [B] cursor per active slot
+        tables_g = tables[slot_ids]  # [B, pages] batch-aligned
+        dest = self._verify_scatter_dest(
+            w, lengths, chunk_lens, tables_g, jnp
+        )
+        new_k = dict(ck)
+        new_v = dict(cv)
+
+        def row_update(pool, new):
+            flat = pool.reshape(-1, spec.num_heads, spec.head_dim)
+            rows = new.astype(pool.dtype).reshape(
+                -1, spec.num_heads, spec.head_dim
+            )
+            return flat.at[dest].set(rows).reshape(pool.shape)
+
+        def hook(node, ins, ws, ctx):
+            g = node.guid
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            kc = row_update(ck[g], k)
+            vc = row_update(cv[g], v)
+            new_k[g] = kc
+            new_v[g] = vc
+            attn = paged_verify_attention(
+                q, kc, vc, tables_g, lengths, kernel=self.decode_kernel
+            )
+            return [
+                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
+            ]
+
+        logits = self._forward_logits(params, tokens, hook)
+        last = jnp.take_along_axis(
+            logits, jnp.clip(chunk_lens - 1, 0, w - 1)[:, None, None], axis=1
+        )[:, 0]
+        return (
+            new_k,
+            new_v,
+            self._pick(last, slot_ids, lengths + chunk_lens),
+            last,
+        )
+
+    def prefill_chunk_dispatch(
+        self,
+        params,
+        tokens: np.ndarray,
+        chunk_lens: np.ndarray,
+    ) -> InflightStep:
+        """Enqueue one chunked-prefill step WITHOUT blocking on its
+        outputs. tokens [max_seqs, w]: the next chunk_lens[s] prompt
+        tokens per chunking slot (rows with chunk_lens 0 are inactive).
+        Writes the chunk K/V rows at each slot's cursor (paged slots
+        claim the pages those rows need first) and — unlike verify —
+        ADVANCES lengths at dispatch: the rows are prompt tokens,
+        accepted by construction, so the next chunk for the same slot
+        can dispatch before this one reconciles (chunks pipeline with
+        no host data dependency). The sampled token on the returned
+        step is meaningful only for a slot's FINAL chunk; the caller
+        decides which via its own cursor snapshot (InflightStep.chunks,
+        filled by the scheduler)."""
+        import jax.numpy as jnp
+
+        spec = self.cache.spec
+        tokens = np.asarray(tokens, dtype=np.int32)
+        chunk_lens = np.asarray(chunk_lens, dtype=np.int32)
+        if tokens.ndim != 2 or tokens.shape[0] != spec.max_seqs:
+            raise ValueError(
+                f"tokens must be [max_seqs={spec.max_seqs}, w], "
+                f"got {tokens.shape}"
+            )
+        w = tokens.shape[1]
+        if w < 1:
+            raise ValueError("chunk step needs at least one token column")
+        if chunk_lens.shape != (spec.max_seqs,):
+            raise ValueError("chunk_lens must be [max_seqs]")
+        for slot in np.nonzero(chunk_lens)[0]:
+            need = int(self.cache.lengths[slot]) + int(chunk_lens[slot])
+            if chunk_lens[slot] > w or need > spec.max_len:
+                raise ValueError(
+                    f"slot {int(slot)}: chunk_lens {int(chunk_lens[slot])} "
+                    f"overruns width {w} or max_len {spec.max_len}"
+                )
+        slot_ids = np.nonzero(chunk_lens)[0]
+        if slot_ids.size == 0:
+            raise ValueError("chunk step needs at least one active slot")
+        args = []
+        if self.paged:
+            # claim every page the chunk rows touch BEFORE the jitted
+            # step (host-side allocator, like verify's claim loop)
+            for slot in slot_ids:
+                start = int(self.cache.lengths[slot])
+                for p in range(start, start + int(chunk_lens[slot])):
+                    self.cache.ensure_position(int(slot), p)
+            args = [snapshot(self.cache.block_tables)]
+        lengths_snap = np.array(self.cache.lengths)
+        # snapshot() lengths/tables: the cursor bump below mutates
+        # lengths right after dispatch, and jnp.asarray's host read is
+        # deferred behind the dispatch queue — see decode_dispatch().
+        # The batch compacts to the chunking slots (tokens/chunk_lens
+        # rows); the jitted impl gathers its lengths/tables rows from
+        # the full snapshots by slot_ids.
+        step_args = (
+            params,
+            jnp.asarray(tokens[slot_ids]),
+            jnp.asarray(slot_ids.astype(np.int32)),
+            snapshot(self.cache.lengths),
+            jnp.asarray(chunk_lens[slot_ids]),
+            *args,
+            self.cache.k,
+            self.cache.v,
+        )
+
+        def call():
+            # resolved inside the dispatch so a kernel fallback's
+            # cleared cache re-traces with the dense attention core
+            return self._chunk_fn((slot_ids.size, w))(*step_args)
+
+        new_k, new_v, nxt, last = self._dispatch("chunk", call)
+        self.cache.commit(new_k, new_v)
+        # prompt rows are committed by construction — advance the
+        # cursors now so the NEXT chunk step dispatches against them
+        active = chunk_lens > 0
+        self.cache.lengths[active] += chunk_lens[active]
+        self.cache.begin_inflight()
+        return InflightStep(
+            kind="chunk",
+            dispatch_t=time.perf_counter(),
+            active=np.array(active, dtype=bool),
+            lengths=lengths_snap,
+            draft_lens=np.array(chunk_lens),
+            device_next=nxt,
+            device_logits=last,
+        )
+
+    def prefill_chunk_reconcile(
+        self, step: InflightStep
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Block on a dispatched chunk step's device outputs and close
+        its in-flight window. The device arrays are in compact-batch
+        order; this scatters them back to slot-indexed (next_tokens
+        [max_seqs], logits [max_seqs, V]) via the step's own active
+        mask — rows for slots that were not chunking are zero. Only
+        final-chunk rows carry meaning either way; the caller's cursor
+        snapshot on the step record says which."""
+        try:
+            nxt_c = np.asarray(step.device_next)
+            logits_c = np.asarray(step.device_logits)
+        finally:
+            self.cache.end_inflight()
+        spec = self.cache.spec
+        slot_ids = np.nonzero(step.active)[0]  # == dispatch's compaction
+        nxt = np.zeros(spec.max_seqs, dtype=nxt_c.dtype)
+        logits = np.zeros(
+            (spec.max_seqs, logits_c.shape[-1]), dtype=logits_c.dtype
+        )
+        nxt[slot_ids] = nxt_c
+        logits[slot_ids] = logits_c
+        return nxt, logits
+
+    def prefill_chunk(
+        self,
+        params,
+        tokens: np.ndarray,
+        chunk_lens: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Synchronous chunk step (dispatch + immediate reconcile)."""
+        return self.prefill_chunk_reconcile(
+            self.prefill_chunk_dispatch(params, tokens, chunk_lens)
         )

@@ -59,6 +59,21 @@ phase (device outputs committed against the snapshot) run one
 iteration apart, so host scheduling overlaps device execution. The
 synchronous schedulers run the same two phases back-to-back — ONE
 implementation, proved token-identical across both timings.
+
+**Chunked prefill** (`--token-budget`, Sarathi-Serve-style): with a
+token budget set, admission claims a slot but runs NO monolithic
+prefill — the prompt streams into the cache in `--chunk-size`-aligned
+chunks over the following iterations, interleaved with the in-flight
+decode/verify work, so no single iteration processes more than
+~token_budget tokens and a long prompt can no longer head-of-line
+block every in-flight decode. Chunk grants are fair-share round-robin
+over the prefill-pending slots (FIFO-ordered passes of one chunk
+each), so short prompts finish their prefill in one iteration even
+while a long prompt is mid-stream. A chunked request starts decoding
+only after its LAST chunk lands (that chunk's sampled token is the
+first generated token — exactly the monolithic prefill's tail), and
+under the async loop chunk progress commits only at reconcile, from
+the `InflightStep.chunks` cursor snapshot (fxlint FX105).
 """
 
 from __future__ import annotations
@@ -137,6 +152,17 @@ class Request:
     # inter-token-latency stamp (telemetry only): wall time of the last
     # emitted token — 0.0 until telemetry observes the first one
     last_token_time: float = 0.0
+    # chunked prefill (token_budget > 0): the sequence being prefilled
+    # (prompt + recompute tokens, fixed at admission), the dispatch
+    # cursor (tokens handed to a chunk step, possibly still in flight)
+    # and the committed cursor (tokens whose chunk reconciled).
+    # prefill_pos < len(prefill_seq) means the request is still
+    # prefilling — it neither decodes nor drafts until its last chunk
+    # lands. Reconcile-phase code reads cursor state from the
+    # InflightStep.chunks snapshot, never these live attrs (FX105).
+    prefill_seq: List[int] = dataclasses.field(default_factory=list)
+    prefill_pos: int = 0
+    prefill_dispatched: int = 0
 
     def log(self, event: str, detail: str = "") -> None:
         if len(self.events) >= max(1, self.events_max):
@@ -207,6 +233,12 @@ _STAT_FIELDS: Dict[str, object] = dict(
     verify_steps=0,
     draft_tokens_proposed=0,
     draft_tokens_accepted=0,
+    # chunked prefill (token_budget > 0)
+    chunk_steps=0,  # chunk steps dispatched
+    chunk_tokens=0,  # Σ prompt tokens streamed in via chunks
+    budget_deferrals=0,  # prefill-pending slots granted no tokens
+    budget_used=0,  # tokens the LAST iteration charged to its budget
+
     # request lifecycle (filled at terminal transitions)
     submitted_requests=0,
     finished_requests=0,  # FINISHED only — not failures
@@ -434,6 +466,8 @@ class _SchedulerBase:
         injector=None,
         debug_invariants: bool = False,
         telemetry=None,
+        token_budget: int = 0,
+        chunk_size: int = 16,
     ):
         self.engine = engine
         self.cache = engine.cache
@@ -449,6 +483,42 @@ class _SchedulerBase:
             )
         self.admission = admission
         self.max_preemptions = int(max_preemptions)
+        # chunked prefill: token_budget > 0 switches admission to the
+        # chunk-streaming path and caps each iteration's token work.
+        # Bad combinations don't raise here — they park an error that
+        # _validate raises per-request, so a serving surface built on
+        # strict=False degrades to per-request FAILED (the PR 5
+        # contract) instead of dying at construction.
+        self.token_budget = int(token_budget)
+        self.chunk_size = int(chunk_size)
+        self._chunk_config_error: Optional[str] = None
+        if token_budget < 0:
+            self._chunk_config_error = (
+                f"token_budget must be >= 0, got {token_budget}"
+            )
+            self.token_budget = 0
+        elif self.token_budget:
+            from flexflow_tpu.ops.pallas.decode_kernel import SUBLANES
+
+            if self.chunk_size < 1:
+                self._chunk_config_error = (
+                    f"chunk_size must be >= 1, got {chunk_size}"
+                )
+            elif self.token_budget < self.chunk_size:
+                self._chunk_config_error = (
+                    f"token_budget {token_budget} < chunk_size "
+                    f"{chunk_size}: an iteration could never fit one "
+                    f"chunk"
+                )
+            elif self.chunk_size % SUBLANES and self._kernel_active():
+                # mirror decode_kernel.supports(): chunk widths are the
+                # kernel's query-tile dim, so a misaligned chunk_size
+                # would silently route EVERY chunk to the dense fallback
+                self._chunk_config_error = (
+                    f"chunk_size {chunk_size} must be a multiple of "
+                    f"{SUBLANES} when decode_kernel is "
+                    f"{engine.decode_kernel!r}"
+                )
         self.injector = injector
         # ServeConfig.debug_invariants / --check-invariants: re-derive
         # the cache/allocator accounting after EVERY iteration (what the
@@ -475,6 +545,13 @@ class _SchedulerBase:
         self._iter_t0 = 0.0
         self._gauge_handles: Optional[Dict[str, object]] = None
         self._last_dispatch_t: Optional[float] = None
+        # per-iteration budget ledger: zeroed by _begin_iteration,
+        # published as the `budget_used` gauge by _end_iteration
+        self._budget_used_iter = 0
+        # slots whose FINAL chunk committed this iteration: their first
+        # decode/verify waits for the next one, so the chunk planner's
+        # grants alone bound the iteration's token work
+        self._chunk_unlocked: set = set()
 
     # -- submission / cancellation -------------------------------------------
 
@@ -505,6 +582,11 @@ class _SchedulerBase:
         return True
 
     def _validate(self, request: Request) -> None:
+        if self._chunk_config_error is not None:
+            # rejected chunked-prefill config: every request fails with
+            # the parked error — ValueError under strict submit, a
+            # per-request FAILED under strict=False
+            raise ValueError(self._chunk_config_error)
         if not request.prompt:
             raise ValueError("empty prompt")
         if request.max_new_tokens < 1:
@@ -726,8 +808,11 @@ class _SchedulerBase:
                 break
             req = self.queue[0]
             seq = list(req.prompt) + list(req.generated)
+            # chunked admission claims pages chunk by chunk (the step's
+            # page claims), so nothing is needed NOW — the reserve
+            # policy still gates on the same worst case either way
             slot = self.cache.alloc(
-                len(seq),
+                0 if self.token_budget else len(seq),
                 len(req.prompt) + req.max_new_tokens,
                 optimistic=optimistic,
             )
@@ -747,6 +832,17 @@ class _SchedulerBase:
         if admitted:
             if self.proposer is not None:
                 self.proposer.admit(admitted)
+            if self.token_budget:
+                # chunked admission: NO monolithic prefill — arm the
+                # chunk cursors and let the per-iteration planner
+                # stream the sequence in. A preempted request re-admits
+                # here too: its recompute sequence (prompt + generated)
+                # replaces the old prefill_seq and the cursors restart.
+                for req, seq in zip(admitted, seqs):
+                    req.prefill_seq = [int(t) for t in seq]
+                    req.prefill_pos = 0
+                    req.prefill_dispatched = 0
+                return admitted
             try:
                 nxt, last = self.engine.prefill(
                     self.params, seqs, [r.slot for r in admitted]
@@ -834,6 +930,11 @@ class _SchedulerBase:
         # an EOS retire still costs one wasted (discarded) slot-step.
         stepped: Dict[int, Request] = {}
         for slot, req in self.running.items():
+            if self._prefill_pending(req) or slot in self._chunk_unlocked:
+                continue  # chunked prefill: no decode until the last
+                #            chunk's token has committed, and none in
+                #            the commit's own iteration (its tokens
+                #            were never in this budget's plan)
             chained = (
                 chain is not None
                 and chain.kind == "decode"
@@ -887,6 +988,7 @@ class _SchedulerBase:
         self.stats.decode_steps += 1
         self.stats.slot_steps += spec.max_seqs
         self.stats.busy_slot_steps += int(active.sum())
+        self._budget_used_iter += int(active.sum())
         return step
 
     def _reconcile_step(self, step) -> None:
@@ -898,6 +1000,8 @@ class _SchedulerBase:
         try:
             if step.kind == "decode":
                 nxt, logits = self.engine.decode_reconcile(step)
+            elif step.kind == "chunk":
+                nxt, logits = self.engine.prefill_chunk_reconcile(step)
             else:
                 logits = self.engine.verify_reconcile(step)
         except Exception as e:
@@ -907,6 +1011,8 @@ class _SchedulerBase:
         self.stats.commit_wait_s += t1 - t0
         if step.kind == "decode":
             self._commit_decode(step, nxt, logits)
+        elif step.kind == "chunk":
+            self._commit_chunk(step, nxt, logits)
         else:
             self._commit_verify(step, logits)
         if self._tele is not None:
@@ -971,10 +1077,17 @@ class _SchedulerBase:
         proposals make every verify a w=1 decode — instead of killing
         the run."""
         t0 = time.perf_counter()
+        # chunked prefill: a slot mid-prefill has no committed history
+        # to draft from — exclude it until its last chunk lands
+        draftable = {
+            s: r
+            for s, r in self.running.items()
+            if not self._prefill_pending(r) and s not in self._chunk_unlocked
+        }
         try:
             if self.injector is not None:
                 self.injector.maybe_draft_fault()
-            proposals = self.proposer.propose(self.running, k)
+            proposals = self.proposer.propose(draftable, k)
         except Exception:
             self.stats.draft_faults += 1
             return {}
@@ -998,7 +1111,15 @@ class _SchedulerBase:
         spec = self.cache.spec
         k = self.spec_k
         plan: Dict[int, List[int]] = {}
-        for slot, req in self.running.items():
+        # chunked mode: the iteration's token budget also caps draft
+        # widths — every verifying slot keeps its 1-token floor (the
+        # budget can pace speculation, not starve decoding), then
+        # drafts fit in what remains, first-come by slot id
+        budget_left = self.token_budget if self.token_budget else None
+        for slot, req in sorted(self.running.items()):
+            if self._prefill_pending(req) or slot in self._chunk_unlocked:
+                continue  # still streaming its prompt in (or its last
+                #            chunk committed THIS iteration) — no verify
             old_len = int(self.cache.lengths[slot])
             # the verify emits up to k_s + 1 tokens and writes k_s + 1
             # rows, so k_s is capped by the request's remaining token
@@ -1010,7 +1131,11 @@ class _SchedulerBase:
                 req.max_new_tokens - len(req.generated) - 1,
                 spec.max_len - old_len - 1,
             )
+            if budget_left is not None:
+                k_s = min(k_s, max(0, budget_left - 1))
             plan[slot] = list(proposals.get(slot) or ())[: max(0, k_s)]
+            if budget_left is not None:
+                budget_left -= 1 + len(plan[slot])
         # claim pages for every row the verify writes; optimistic
         # preemption may evict plan slots, so the arrays build AFTER
         self._secure_pages({s: 1 + len(d) for s, d in plan.items()})
@@ -1048,6 +1173,7 @@ class _SchedulerBase:
         self.stats.verify_steps += 1
         self.stats.slot_steps += spec.max_seqs
         self.stats.busy_slot_steps += len(plan)
+        self._budget_used_iter += int(draft_lens.sum())
         return step
 
     def _commit_verify(self, step, logits) -> None:
@@ -1108,6 +1234,221 @@ class _SchedulerBase:
         if step is not None:
             self._reconcile_step(step)
 
+    # -- chunked prefill (token_budget > 0) ----------------------------------
+
+    def _kernel_active(self) -> bool:
+        """Whether the engine's decode-kernel mode can actually take the
+        Pallas path — `use_kernel`'s mode resolution: "pallas" always
+        can, "auto" only on a real TPU backend, "dense" never."""
+        mode = getattr(self.engine, "decode_kernel", "dense")
+        if mode == "pallas":
+            return True
+        if mode != "auto":
+            return False
+        import jax
+
+        return jax.default_backend() == "tpu"
+
+    def _prefill_pending(self, req: Request) -> bool:
+        """True while a chunked request still has prompt tokens whose
+        chunk has not COMMITTED — it neither decodes nor drafts until
+        the last chunk lands. Monolithic admissions (empty prefill_seq)
+        are never pending, so every non-chunked path is unaffected."""
+        return bool(req.prefill_seq) and req.prefill_pos < len(
+            req.prefill_seq
+        )
+
+    def _reserved_step_tokens(self) -> int:
+        """Tokens this iteration's decode/verify step may consume for
+        the slots already past prefill — 1 per slot, plus up to spec_k
+        drafts each under speculation. The chunk planner budgets around
+        this reservation so chunks + decode work stay inside
+        token_budget together, which is the whole point: decodes keep
+        their cadence WHILE a prompt streams in."""
+        per = 1 + (self.spec_k if self.proposer is not None else 0)
+        return per * sum(
+            1
+            for r in self.running.values()
+            if not self._prefill_pending(r)
+            and len(r.generated) < r.max_new_tokens
+        )
+
+    def _plan_chunks(self, reserved: int) -> Dict[int, int]:
+        """Fair-share chunk grants for one iteration: round-robin
+        passes over the prefill-pending slots in admission order,
+        granting one chunk_size unit (or the remainder) per pass until
+        the budget left over from `reserved` runs out. Round-robin —
+        not head-of-queue-until-done — is what kills head-of-line
+        blocking among prefills themselves: a short prompt admitted
+        behind a long one still completes in its first iteration. A
+        grant that FINISHES a prompt costs only its own tokens: the
+        slot's first decode/verify is deferred one iteration
+        (`_chunk_unlocked`), so grants alone bound the iteration's
+        token work — charging the unlocked decode here instead would
+        wedge the planner when token_budget == chunk_size (a full
+        final chunk could never fit). Pending slots granted nothing
+        count as budget deferrals (`serve_budget_deferrals_total`)."""
+        budget = self.token_budget - int(reserved)
+        pending = sorted(
+            (
+                r
+                for r in self.running.values()
+                if r.prefill_dispatched < len(r.prefill_seq)
+            ),
+            key=lambda r: (r.admit_iter, r.rid),
+        )
+        if not pending:
+            return {}
+        # keep the chunk step's width inside the Pallas kernel's query
+        # tile when a kernel mode is on — a wider grant would silently
+        # route the whole step to the dense fallback
+        max_grant = self.token_budget
+        if self._kernel_active():
+            from flexflow_tpu.ops.pallas.decode_kernel import _MAX_W
+
+            max_grant = _MAX_W
+        plan: Dict[int, int] = {r.slot: 0 for r in pending}
+        progress = True
+        while progress and budget > 0:
+            progress = False
+            for req in pending:
+                rem = (
+                    len(req.prefill_seq)
+                    - req.prefill_dispatched
+                    - plan[req.slot]
+                )
+                if rem <= 0 or plan[req.slot] >= max_grant:
+                    continue
+                unit = min(self.chunk_size, rem, max_grant - plan[req.slot])
+                if unit > budget:
+                    continue
+                plan[req.slot] += unit
+                budget -= unit
+                progress = True
+        deferred = sum(1 for c in plan.values() if c == 0)
+        if deferred:
+            self.stats.budget_deferrals += deferred
+            if self._tele is not None:
+                self._tele.registry.counter(
+                    "serve_budget_deferrals_total",
+                    help="prefill-pending slots granted no chunk tokens "
+                    "by an iteration's budget",
+                ).inc(deferred)
+        return {s: c for s, c in plan.items() if c > 0}
+
+    def _chunk_dispatch_step(self, plan: Dict[int, int]):
+        """Dispatch phase of one chunked-prefill step: claim the pages
+        the chunk rows land in, build the token/width arrays from the
+        LIVE cursors (this is the dispatch side), advance the dispatch
+        cursors, and enqueue the step. The cursor state the commit
+        phase needs rides the step record (`InflightStep.chunks`) —
+        fxlint FX105 holds the reconcile side to that snapshot. The
+        step width pads up to a chunk_size multiple so the engine's
+        jitted-program LRU sees a bounded population of widths."""
+        if not plan:
+            return None
+        self._secure_pages(dict(plan))
+        live: Dict[int, int] = {}
+        for slot, c in plan.items():
+            req = self.running.get(slot)
+            if req is None:  # optimistic preemption evicted it
+                continue
+            c = min(c, len(req.prefill_seq) - req.prefill_dispatched)
+            if c > 0:
+                live[slot] = c
+        if not live:
+            return None
+        spec = self.cache.spec
+        unit = max(1, self.chunk_size)
+        w = max(live.values())
+        w = -(-w // unit) * unit
+        tokens = np.zeros((spec.max_seqs, w), dtype=np.int32)
+        chunk_lens = np.zeros(spec.max_seqs, dtype=np.int32)
+        chunks: Dict[int, tuple] = {}
+        for slot, c in sorted(live.items()):
+            req = self.running[slot]
+            start = req.prefill_dispatched
+            tokens[slot, :c] = req.prefill_seq[start : start + c]
+            chunk_lens[slot] = c
+            chunks[slot] = (start, c, start + c >= len(req.prefill_seq))
+        t0 = time.perf_counter()
+        try:
+            step = self.engine.prefill_chunk_dispatch(
+                self.params, tokens, chunk_lens
+            )
+        except Exception as e:
+            self._fail_all_running(f"chunk step failed: {e!r}")
+            return None
+        for slot, (start, c, _final) in chunks.items():
+            self.running[slot].prefill_dispatched = start + c
+        if self._tele is not None:
+            self._tele.tracer.complete(
+                "prefill:chunk",
+                "host",
+                t0,
+                time.perf_counter(),
+                args={
+                    "iter": self._iter,
+                    "slots": len(chunks),
+                    "tokens": int(chunk_lens.sum()),
+                },
+            )
+            self._tele.registry.counter(
+                "serve_chunks_total",
+                help="prompt chunks dispatched (chunked prefill)",
+            ).inc(len(chunks))
+        step.iteration = self._iter
+        step.participants = {s: self.running[s] for s in chunks}
+        step.chunks = chunks
+        self._note_dispatch(step)
+        self.stats.chunk_steps += 1
+        self.stats.chunk_tokens += int(chunk_lens.sum())
+        self.stats.slot_steps += spec.max_seqs
+        self.stats.busy_slot_steps += len(chunks)
+        self._budget_used_iter += int(chunk_lens.sum())
+        return step
+
+    def _commit_chunk(self, step, nxt, logits) -> None:
+        """Commit a reconciled chunk step: advance each participant's
+        committed cursor from the step's OWN cursor record
+        (`step.chunks` — never the live prefill_* attrs, fxlint FX105)
+        and, on a slot's FINAL chunk, emit the sampled token — exactly
+        the monolithic prefill's tail, so the downstream stream is
+        token-identical. The usual identity check discards results for
+        slots that retired or turned over while the step was in
+        flight."""
+        if self.injector is not None:
+            logits = np.array(logits)  # writable copy for the injector
+            self.injector.corrupt_logits(
+                logits, sorted(step.chunks), iteration=step.iteration
+            )
+        for slot in sorted(step.chunks):
+            req = step.participants.get(slot)
+            if req is None or self.running.get(slot) is not req:
+                continue
+            start, size, final = step.chunks[slot]
+            if not np.isfinite(logits[slot]).all():
+                self._fail(
+                    req,
+                    f"non-finite chunk logits at iteration "
+                    f"{step.iteration}",
+                )
+                continue
+            req.prefill_pos = start + size
+            if final:
+                self._chunk_unlocked.add(slot)
+                self._emit(req, int(nxt[slot]))
+
+    def _chunk_once(self) -> None:
+        """Synchronous chunk iteration: plan within the budget left
+        after the decode/verify reservation, dispatch, reconcile
+        immediately."""
+        step = self._chunk_dispatch_step(
+            self._plan_chunks(self._reserved_step_tokens())
+        )
+        if step is not None:
+            self._reconcile_step(step)
+
     def _generate_once(self) -> None:
         if self.proposer is not None:
             self._verify_once()
@@ -1117,6 +1458,8 @@ class _SchedulerBase:
     def _begin_iteration(self) -> None:
         self._iter += 1
         self.stats.iterations += 1
+        self._budget_used_iter = 0
+        self._chunk_unlocked.clear()
         if self._tele is not None:
             self._iter_t0 = time.perf_counter()
         if self.injector is not None:
@@ -1124,6 +1467,9 @@ class _SchedulerBase:
         self._reap_deadlines()
 
     def _end_iteration(self) -> None:
+        # per-iteration gauge: tokens this iteration's dispatches
+        # charged against the budget (chunk + decode/verify widths)
+        self.stats.budget_used = self._budget_used_iter
         self.stats.verify_cache_entries = getattr(
             self.engine, "verify_cache_entries", 0
         )
@@ -1201,11 +1547,16 @@ class ContinuousBatchingScheduler(_SchedulerBase):
     """Orca-style: every iteration joins new prefills with in-flight
     decodes; slots recycle the moment a request retires. With a
     `proposer` + `spec_k`, each iteration runs the speculative
-    draft/verify step instead of single-token decode."""
+    draft/verify step instead of single-token decode. With a
+    `token_budget`, each iteration additionally runs one chunked-
+    prefill step for the slots still streaming their prompts in,
+    planned so chunks + decode/verify work stay inside the budget."""
 
     def step(self) -> None:
         self._begin_iteration()
         self._admit()
+        if self.token_budget and self.running:
+            self._chunk_once()
         if self.running:
             self._generate_once()
         self._end_iteration()
@@ -1325,17 +1676,35 @@ class AsyncContinuousBatchingScheduler(ContinuousBatchingScheduler):
 
     def _decode_iteration_async(self) -> None:
         """Dispatch decode N+1 (token-chained on the in-flight step N's
-        device outputs), THEN reconcile N — the double buffer."""
-        dispatched = False
+        device outputs), THEN reconcile N — the double buffer. Under a
+        token budget the iteration also dispatches one chunk step ahead
+        of the decode: chunk progress has no host data dependency (the
+        prompt tokens are accepted by construction, the engine advances
+        lengths at dispatch), so chunks pipeline exactly like chained
+        decodes and both steps of iteration N ride the device while the
+        host reconciles N-1."""
+        keep = 0
+        if self.token_budget and self.running:
+            step = self._chunk_dispatch_step(
+                self._plan_chunks(self._reserved_step_tokens())
+            )
+            if step is not None:
+                self._inflight.append(step)
+                keep += 1
         if self.running:
-            chain = self._inflight[-1] if self._inflight else None
+            # chain on the newest in-flight DECODE step — an interleaved
+            # chunk step never carries the decoding slots' next tokens
+            chain = next(
+                (s for s in reversed(self._inflight) if s.kind == "decode"),
+                None,
+            )
             step = self._decode_dispatch_step(chain=chain)
             if step is not None:
                 self._inflight.append(step)
-                dispatched = True
-        while len(self._inflight) > 1:
+                keep += 1
+        while len(self._inflight) > keep:
             self._reconcile_front()
-        if not dispatched:
+        if not keep:
             # nothing enqueued this iteration (drained queue tail,
             # every slot budget-gated behind the in-flight step, or a
             # whole-step fault) — flush the pipeline so its pinned
@@ -1345,9 +1714,21 @@ class AsyncContinuousBatchingScheduler(ContinuousBatchingScheduler):
     def _verify_iteration_async(self) -> None:
         """Speculative iteration: while verify N is in flight, draft
         for N+1 against its predicted outcome; reconcile N; dispatch
-        N+1 with the surviving pre-proposals."""
+        N+1 with the surviving pre-proposals. Under a token budget a
+        chunk step dispatches BEFORE the drain — it overlaps the
+        in-flight verify on the device — and stays in flight through
+        this iteration's verify dispatch."""
         pre = self._pre_propose()
-        self._drain_inflight()
+        keep = 0
+        if self.token_budget and self.running:
+            step = self._chunk_dispatch_step(
+                self._plan_chunks(self._reserved_step_tokens())
+            )
+            if step is not None:
+                self._inflight.append(step)
+                keep += 1
+        while len(self._inflight) > keep:
+            self._reconcile_front()
         if self.running:
             step = self._verify_dispatch_step(self._merge_proposals(pre))
             if step is not None:
@@ -1433,7 +1814,18 @@ class AsyncContinuousBatchingScheduler(ContinuousBatchingScheduler):
 
 class StaticBatchingScheduler(_SchedulerBase):
     """Request-level batching baseline: a batch runs until every member
-    finishes; freed slots stay idle until the batch drains."""
+    finishes; freed slots stay idle until the batch drains. Chunked
+    prefill is an iteration-level technique — the baseline rejects a
+    token_budget rather than silently admitting requests whose prompts
+    would then never stream in."""
+
+    def __init__(self, *args, **kwargs):
+        if kwargs.get("token_budget"):
+            raise ValueError(
+                "token_budget (chunked prefill) requires the continuous "
+                "scheduler"
+            )
+        super().__init__(*args, **kwargs)
 
     def step(self) -> None:
         self._begin_iteration()

@@ -1,0 +1,26 @@
+"""fxlint fixture: FX105 — reconcile-phase code reading live
+chunked-prefill cursor state (positive cases).
+
+Linted by tests/test_fxlint.py — NOT imported. A chunk step's cursor
+travels WITH the step (`step.chunks[slot] = (start, size, final)`);
+the dispatcher advances the live request attrs the moment the next
+chunk leaves, so at reconcile time they describe a later dispatch.
+Expected findings: three FX105 in `commit_chunk`.
+"""
+
+
+class RacyChunkCommit:
+    def __init__(self):
+        self.running = {}
+
+    def commit_chunk(self, step, nxt):
+        for slot in step.chunks:
+            req = self.running[slot]
+            # FX105: live dispatch cursor — under the async pipeline it
+            # already points past the NEXT in-flight chunk
+            start = req.prefill_dispatched - 4
+            # FX105 x2: final-chunk decision against the live view —
+            # double-emits (or drops) the prompt's sampled token
+            if req.prefill_pos >= len(req.prefill_seq):
+                req.done = True
+            req.used = start

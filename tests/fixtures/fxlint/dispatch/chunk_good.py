@@ -1,0 +1,34 @@
+"""fxlint fixture: FX105 negative cases — chunk reconcile reading ONLY
+the step's cursor record, the sanctioned Store write-back, plus the
+two phases where live chunk-progress reads are the point: planning
+helpers (no step parameter) and dispatch-side code (where the record
+is built).
+
+Linted by tests/test_fxlint.py — NOT imported. Expected findings: none.
+"""
+
+
+class SnapshottedChunkCommit:
+    def __init__(self):
+        self.running = {}
+
+    def plan(self, req):
+        # not reconcile-phase (no step parameter): the planner reads
+        # the live cursor by definition
+        return len(req.prefill_seq) - req.prefill_dispatched
+
+    def chunk_dispatch_step(self, step):
+        # dispatch-side ('dispatch' in the name): the cursor record is
+        # BUILT here from the live attrs
+        req = self.running[0]
+        step.chunks = {0: (req.prefill_dispatched, 4, False)}
+        req.prefill_dispatched += 4
+        return step
+
+    def commit_chunk(self, step, nxt):
+        for slot in step.chunks:
+            req = self.running[slot]
+            start, size, final = step.chunks[slot]  # the step's record
+            req.prefill_pos = start + size  # Store: the commit itself
+            if final:
+                req.done = int(nxt[slot])

@@ -160,6 +160,74 @@ def test_async_no_wasted_slot_steps_on_budget_streams(lm):
     )
 
 
+# -- chunked prefill under the double-buffered loop ---------------------------
+
+
+_CHUNK_PROMPTS = [
+    [(i * 7 + j) % (VOCAB - 1) + 1 for j in range(n)]
+    for i, n in enumerate([13, 22, 2, 18, 9])
+]
+
+
+def _chunked_requests(max_new=6, **kw):
+    return [
+        Request(rid=i, prompt=list(p), max_new_tokens=max_new, **kw)
+        for i, p in enumerate(_CHUNK_PROMPTS)
+    ]
+
+
+@pytest.mark.parametrize("layout", ["slot", "paged"])
+@pytest.mark.parametrize(
+    "spec_kw", [{}, dict(spec_draft="ngram", spec_k=3)],
+    ids=["plain", "spec"],
+)
+def test_async_chunked_matches_sync_and_unchunked(lm, layout, spec_kw):
+    """Chunked prefill commits only at reconcile under --serve-async:
+    the async chunked run is token-identical to the sync chunked run
+    AND to the unchunked sync reference, on both layouts, with
+    speculation on and off — while actually chunking (chunk_steps > 0)
+    and keeping chunk steps in flight alongside decode/verify."""
+    chunk_kw = dict(token_budget=8, chunk_size=4, decode_kernel="dense",
+                    **spec_kw)
+    _, _, _, plain = _run(lm, False, layout, reqs=_chunked_requests(),
+                          **spec_kw)
+    sync_sched, _, _, sync = _run(lm, False, layout,
+                                  reqs=_chunked_requests(), **chunk_kw)
+    asy_sched, _, _, asy = _run(lm, True, layout,
+                                reqs=_chunked_requests(), **chunk_kw)
+    assert set(plain) == set(sync) == set(asy)
+    for rid in plain:
+        assert plain[rid].ok and sync[rid].ok and asy[rid].ok, rid
+        assert plain[rid].generated == sync[rid].generated, rid
+        assert plain[rid].generated == asy[rid].generated, rid
+    for sched in (sync_sched, asy_sched):
+        assert sched.stats.chunk_steps > 0
+        assert sched.stats.chunk_tokens == sum(
+            len(p) for p in _CHUNK_PROMPTS
+        )
+
+
+def test_async_chunked_with_eos_mid_stream(lm):
+    """EOS retirement interacting with partial prefill: streams still
+    match the sync chunked loop when requests retire mid-window."""
+    kw = dict(token_budget=8, chunk_size=4, decode_kernel="dense")
+    _, _, _, plain = _run(lm, False, reqs=_chunked_requests(10), **kw)
+    eos = int(plain[0].generated[len(plain[0].generated) // 2])
+    _, _, _, sync = _run(
+        lm, False, reqs=_chunked_requests(10, eos_token=eos), **kw
+    )
+    _, _, _, asy = _run(
+        lm, True, reqs=_chunked_requests(10, eos_token=eos), **kw
+    )
+    # the retirement is real: at least one stream truncated at the eos
+    assert any(
+        len(r.generated) < 10 and r.generated[-1] == eos
+        for r in sync.values()
+    )
+    for rid in sync:
+        assert sync[rid].generated == asy[rid].generated, rid
+
+
 # -- dispatch/commit stats ----------------------------------------------------
 
 

@@ -88,6 +88,8 @@ def test_seeded_block_table_race_is_caught(tmp_path):
     src_path = os.path.join(PACKAGE, "serving", "engine.py")
     with open(src_path) as f:
         src = f.read()
+    n_sites = src.count("snapshot(self.cache.block_tables)")
+    assert n_sites >= 2
     seeded = src.replace(
         "snapshot(self.cache.block_tables)",
         "jnp.asarray(self.cache.block_tables)",
@@ -103,7 +105,7 @@ def test_seeded_block_table_race_is_caught(tmp_path):
     diags = run_rules([str(tmp_path)], ["dispatch-race"])
     assert sum(
         d.rule_id == "FX101" and "block_tables" in d.message for d in diags
-    ) == 2
+    ) == n_sites
 
 
 def test_reconcile_snapshot_fixtures():
@@ -117,6 +119,57 @@ def test_reconcile_snapshot_fixtures():
     # snapshot reads (step.lengths), non-cache state (self.running), and
     # dispatch-side functions stay silent
     assert "reconcile_good.py" not in diags
+
+
+def test_chunk_progress_fixtures():
+    """FX105: reconcile-phase code reading live chunked-prefill cursor
+    state (prefill_seq/prefill_pos/prefill_dispatched) instead of the
+    step's own chunk record — the partial-prefill variant of FX103."""
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "dispatch")], ["dispatch-race"])
+    )
+    assert diags.get("chunk_bad.py", []).count("FX105") == 3
+    # step.chunks reads, the Store write-back, planning helpers and
+    # dispatch-side builders stay silent
+    assert "chunk_good.py" not in diags
+
+
+def test_seeded_chunk_progress_bypass_is_caught(tmp_path):
+    """Re-introduce the bug FX105 exists for: make the chunk commit
+    decide 'final chunk?' from the LIVE prefill cursor — which the
+    dispatcher already advanced for the next in-flight chunk — instead
+    of the step's own (start, size, final) record."""
+    src_path = os.path.join(PACKAGE, "serving", "scheduler.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "            req.prefill_pos = start + size\n"
+        "            if final:\n",
+        "            req.prefill_pos = start + size\n"
+        "            if req.prefill_pos >= len(req.prefill_seq):\n",
+        1,
+    )
+    assert seeded != src, (
+        "scheduler.py's chunk commit no longer advances prefill_pos "
+        "before the final-chunk emit — update this test alongside the "
+        "refactor"
+    )
+    (tmp_path / "scheduler.py").write_text(seeded)
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    assert any(
+        d.rule_id == "FX105" and "prefill_" in d.message for d in diags
+    ), [d.format() for d in diags]
+    # the unmodified scheduler stays clean
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "scheduler.py")
+    shutil.copy(
+        os.path.join(PACKAGE, "serving", "kv_cache.py"),
+        clean / "kv_cache.py",
+    )
+    assert run_rules([str(clean)], ["dispatch-race"]) == [], [
+        d.format() for d in run_rules([str(clean)], ["dispatch-race"])
+    ]
 
 
 def test_search_trace_hook_fixtures():

@@ -1,8 +1,12 @@
 """Serving subsystem (flexflow_tpu.serving): cache-equivalence of KV-cache
 decode against full-prefill recompute, scheduler invariants under a
 mixed-length request stream (no slot leak, FIFO starvation-freedom, EOS
-frees slots, determinism), the continuous-vs-static batching win, and the
-decode-regime strategy search. All CPU-fast (tier 1)."""
+frees slots, determinism), the continuous-vs-static batching win, chunked
+prefill under a per-iteration token budget (chunk==monolithic parity,
+budget enforcement, SLO-driven budget selection), and the decode-regime
+strategy search. All CPU-fast (tier 1)."""
+
+import time
 
 import numpy as np
 import pytest
@@ -22,6 +26,7 @@ from flexflow_tpu.serving import (
     GenerationEngine,
     KVCache,
     Request,
+    RequestStatus,
     ServeConfig,
     StaticBatchingScheduler,
     build_scheduler,
@@ -310,6 +315,237 @@ def test_continuous_batching_beats_static(lm):
         f"static {best_tps['static']:.1f} tok/s "
         f"(steps {cont.decode_steps} vs {stat.decode_steps})"
     )
+
+
+# -- chunked prefill ---------------------------------------------------------
+
+
+def _chunked_requests(max_new=6):
+    """Prompt lengths 22/3/13/2/18: long enough that a token_budget=8 /
+    chunk_size=4 run splits the long ones across many iterations, with
+    short ones riding along (the round-robin fairness case)."""
+    lens = [22, 3, 13, 2, 18]
+    return [
+        Request(
+            rid=i,
+            prompt=[(i * 7 + j) % (VOCAB - 1) + 1 for j in range(n)],
+            max_new_tokens=max_new,
+        )
+        for i, n in enumerate(lens)
+    ]
+
+
+@pytest.mark.parametrize("layout", ["slot", "paged"])
+def test_chunk_steps_reproduce_monolithic_prefill(lm, layout):
+    """Engine level: streaming a prompt in as staircase-masked chunk
+    steps leaves the SAME cache state and produces BIT-IDENTICAL final
+    logits and sampled token as one monolithic prefill — equality, not
+    allclose, on both kv layouts."""
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+    serve = ServeConfig(max_seqs=2, max_seq_len=32, kv_layout=layout)
+    _, eng_m, cache_m = build_scheduler(lm, serve)
+    slot = cache_m.alloc(len(prompt), len(prompt) + 6)
+    nxt_m, last_m = eng_m.prefill(lm.params, [prompt], [slot])
+    _, eng_c, cache_c = build_scheduler(lm, serve)
+    slot_c = cache_c.alloc(0, len(prompt) + 6)  # chunked: claim nothing yet
+    assert slot_c == slot
+    nxt = logits = None
+    for start in range(0, len(prompt), 4):
+        chunk = prompt[start : start + 4]
+        tokens = np.zeros((2, len(chunk)), dtype=np.int32)
+        tokens[slot_c, : len(chunk)] = chunk
+        chunk_lens = np.zeros(2, dtype=np.int32)
+        chunk_lens[slot_c] = len(chunk)
+        nxt, logits = eng_c.prefill_chunk(lm.params, tokens, chunk_lens)
+    assert int(cache_c.lengths[slot_c]) == len(prompt)
+    np.testing.assert_array_equal(logits[slot_c], last_m[0])
+    assert int(nxt[slot_c]) == int(nxt_m[0])
+
+
+@pytest.mark.parametrize("layout", ["slot", "paged"])
+@pytest.mark.parametrize(
+    "spec_kw", [{}, dict(spec_draft="ngram", spec_k=3)],
+    ids=["plain", "spec"],
+)
+def test_chunked_streams_token_identical(lm, layout, spec_kw):
+    """Scheduler level: a token-budgeted chunked run emits exactly the
+    unchunked run's token streams — chunking changes WHEN prompt work
+    happens, never WHAT is generated — on both layouts, with
+    speculation on and off."""
+    base = dict(
+        max_seqs=4, max_seq_len=32, kv_layout=layout,
+        debug_invariants=True, **spec_kw,
+    )
+    sched_u, _, _ = build_scheduler(lm, ServeConfig(**base))
+    plain = {r.rid: r for r in sched_u.run(_chunked_requests())}
+    sched_c, _, _ = build_scheduler(
+        lm,
+        ServeConfig(token_budget=8, chunk_size=4, decode_kernel="dense",
+                    **base),
+    )
+    chunked = {r.rid: r for r in sched_c.run(_chunked_requests())}
+    assert set(plain) == set(chunked)
+    for rid in plain:
+        assert plain[rid].ok and chunked[rid].ok, rid
+        assert plain[rid].generated == chunked[rid].generated, rid
+    assert sched_u.stats.chunk_steps == 0
+    assert sched_c.stats.chunk_steps > 0
+    # every prompt token streamed in through a chunk
+    assert sched_c.stats.chunk_tokens == sum(
+        len(r.prompt) for r in _chunked_requests()
+    )
+
+
+def test_token_budget_caps_every_iteration(lm):
+    """The budget is a hard per-iteration cap: chunk grants + decode
+    tokens never exceed it, on any iteration of a run that mixes
+    admissions, chunked prefill, and decode."""
+    serve = ServeConfig(
+        max_seqs=4, max_seq_len=32, token_budget=8, chunk_size=4,
+        decode_kernel="dense",
+    )
+    sched, _, _ = build_scheduler(lm, serve)
+    used = []
+    orig = sched._end_iteration
+
+    def spy():
+        used.append(sched._budget_used_iter)
+        orig()
+
+    sched._end_iteration = spy
+    done = sched.run(_chunked_requests())
+    assert all(r.ok for r in done)
+    assert used and max(used) <= serve.token_budget
+    assert any(u > 0 for u in used)
+    assert sched.stats.budget_used == used[-1]
+
+
+def test_chunked_config_validation():
+    base = dict(max_seqs=2, max_seq_len=32)
+    with pytest.raises(ValueError, match="token_budget must be >= 0"):
+        ServeConfig(token_budget=-1, **base)
+    with pytest.raises(ValueError, match="chunk_size >= 1"):
+        ServeConfig(token_budget=8, chunk_size=0, **base)
+    with pytest.raises(ValueError, match="continuous"):
+        ServeConfig(token_budget=8, chunk_size=8, scheduler="static", **base)
+    with pytest.raises(ValueError, match="could never fit"):
+        ServeConfig(token_budget=4, chunk_size=8, **base)
+    # a kernel-eligible config rejects sublane-misaligned chunk widths
+    # (they would silently route every chunk to the dense fallback)...
+    with pytest.raises(ValueError, match="multiple of"):
+        ServeConfig(token_budget=8, chunk_size=4, **base)
+    # ...while the dense path takes any width
+    ServeConfig(token_budget=8, chunk_size=4, decode_kernel="dense", **base)
+    cfg = FFConfig.parse_args(["--token-budget", "32", "--chunk-size", "8"])
+    sc = ServeConfig.from_config(cfg)
+    assert (sc.token_budget, sc.chunk_size) == (32, 8)
+
+
+def test_bad_chunk_config_fails_requests_not_process(lm):
+    """A rejected chunked-prefill config parked at scheduler
+    construction surfaces per-request: ValueError under strict submit,
+    FAILED (not a crash) under the serving-surface contract."""
+    cache = KVCache.from_model(lm, max_seqs=2, max_len=32)
+    engine = GenerationEngine(lm, cache)
+    sched = ContinuousBatchingScheduler(engine, token_budget=4, chunk_size=8)
+    with pytest.raises(ValueError, match="could never fit"):
+        sched.submit(Request(rid=0, prompt=[1, 2, 3], max_new_tokens=2))
+    req = Request(rid=1, prompt=[1, 2, 3], max_new_tokens=2)
+    assert sched.submit(req, strict=False) is False
+    assert req.status == RequestStatus.FAILED
+    assert "chunk" in (req.error or "")
+
+
+def test_chunked_telemetry_counters_and_spans(lm):
+    """The observability satellite: chunk dispatches count into
+    `serve_chunks_total`, zero-grant iterations into
+    `serve_budget_deferrals_total`, the per-iteration ledger lands on
+    the `serve_stats_budget_used` gauge, and each chunk step records a
+    `prefill:chunk` trace span."""
+    serve = ServeConfig(
+        max_seqs=4, max_seq_len=32, token_budget=4, chunk_size=4,
+        decode_kernel="dense", telemetry=True,
+    )
+    sched, _, _ = build_scheduler(lm, serve)
+    done = sched.run(_chunked_requests())
+    assert all(r.ok for r in done)
+    reg = sched.telemetry.registry
+    chunks = reg.get("serve_chunks_total")
+    assert chunks is not None and chunks.value >= sched.stats.chunk_steps > 0
+    # budget 4 fits ONE chunk while four prompts wait: deferrals are
+    # structurally guaranteed, and the stat mirrors the counter
+    deferrals = reg.get("serve_budget_deferrals_total")
+    assert deferrals is not None and deferrals.value > 0
+    assert sched.stats.budget_deferrals == deferrals.value
+    assert reg.get("serve_stats_budget_used") is not None
+    assert reg.get("serve_stats_chunk_steps").value == (
+        sched.stats.chunk_steps
+    )
+    assert any(
+        e.get("name") == "prefill:chunk"
+        for e in sched.telemetry.tracer.events
+    )
+
+
+def test_optimize_token_budget_prediction_tracks_measured_ttft(lm):
+    """Close the loop: with the analytic decode step calibrated against
+    one measured decode iteration, `optimize_token_budget`'s predicted
+    TTFT for the chosen budget lands within 2x of the rolling-window
+    p95 TTFT measured on the same bench shape (a long prompt chunking
+    in while a batch of short requests decodes)."""
+    from flexflow_tpu.core.machine import MachineSpec
+    from flexflow_tpu.search.auto import optimize_token_budget
+    from flexflow_tpu.serving.api import build_telemetry
+
+    cache = KVCache.from_model(lm, max_seqs=4, max_len=32)
+    engine = GenerationEngine(lm, cache)
+    long_prompt = [(7 * j) % (VOCAB - 1) + 1 for j in range(24)]
+
+    def shorts(base):
+        return [
+            Request(rid=base + i, prompt=[2 + i, 3, 5], max_new_tokens=16)
+            for i in range(3)
+        ]
+
+    # warm every jit signature on a throwaway scheduler (same engine)
+    warm = ContinuousBatchingScheduler(engine, token_budget=11, chunk_size=8)
+    warm.run(shorts(100) + [Request(rid=199, prompt=list(long_prompt),
+                                    max_new_tokens=4)])
+    tele = build_telemetry(
+        ServeConfig(max_seqs=4, max_seq_len=32, token_budget=11,
+                    chunk_size=8, decode_kernel="dense", telemetry=True)
+    )
+    sched = ContinuousBatchingScheduler(
+        engine, token_budget=11, chunk_size=8, telemetry=tele
+    )
+    for r in shorts(0):
+        sched.submit(r)
+    for _ in range(2):
+        sched.step()  # admit the shorts, settle into steady decode
+    t0 = time.perf_counter()
+    for _ in range(4):
+        sched.step()  # pure decode iterations: the calibration sample
+    t_dec_meas = (time.perf_counter() - t0) / 4
+    sched.submit(Request(rid=9, prompt=list(long_prompt), max_new_tokens=4))
+    sched.run([])
+    lr = next(r for r in sched.finished if r.rid == 9)
+    assert lr.ok and sched.stats.chunk_steps >= 3
+    measured_p95_s = (
+        sched.telemetry.slo.ttft_window.percentiles((95,))[95] / 1e3
+    )
+    assert measured_p95_s > 0
+    res = optimize_token_budget(
+        lm.graph,
+        MachineSpec(num_nodes=1, chips_per_node=1, chip="v5e"),
+        prompt_len=len(long_prompt), batch=3, kv_len=32, chunk_size=8,
+        measured_decode_step_s=t_dec_meas,
+    )
+    # no SLO set: the smallest budget (one chunk row per iteration on
+    # top of the decode batch) is already feasible
+    assert res.token_budget == 3 + 8
+    assert res.n_chunks == 3
+    ratio = res.predicted_ttft_s / measured_p95_s
+    assert 0.5 <= ratio <= 2.0, (res.predicted_ttft_s, measured_p95_s)
 
 
 # -- decode-regime strategy search -------------------------------------------
