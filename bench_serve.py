@@ -339,6 +339,150 @@ def run_paged(
     }
 
 
+def run_prefix(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    reps: int = 2,
+):
+    """Multi-tenant capacity at a FIXED cache byte budget: hashed
+    prefix sharing first, int8 token pools on top.
+
+    Capacity: every request carries the same long prompt prefix (the
+    system-prompt regime) plus a short unique tail. Admission is
+    optimistic, so a request whose prefix pages are already published
+    is charged only its fresh pages; `peak_in_flight` is how many the
+    page pool let run concurrently. Three engines at the SAME HBM byte
+    budget (slots sized to the pool so only pages bind anywhere):
+
+      fp32          — paged, no sharing (baseline)
+      fp32 + prefix — full prefix pages refcounted across tenants
+      int8 + prefix — 1-byte rows buy ~4x the pages at equal bytes,
+                      minus the fp32 dequant-scale side pools
+
+    Throughput parity: int8 + prefix against the plain paged fp32
+    engine on the decode-dominated stream (short prompts, near-max_len
+    generations) at EQUAL batch — the gate is DECODE throughput, so the
+    stream must be decode-bound: dequant fused into the decode gather
+    must stay within 5% on CPU. (Prefill pays a one-time quantize
+    round trip per prompt, but in the shared-prefix regime the prefix
+    pages skip prefill entirely — that cost is the capacity section's
+    subject, not this gate's.)"""
+    from flexflow_tpu.serving import (
+        ContinuousBatchingScheduler,
+        Request,
+        ServeConfig,
+        build_scheduler,
+        default_page_size,
+    )
+
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+    page_size = default_page_size(max_len)
+    head_dim = hidden // heads
+    budget_pages = max_seqs * max_len // page_size
+
+    # equal-HBM int8 pool: 1-byte rows shrink a page 4x; the fp32
+    # dequant scales (one per page per head, K and V) claw a sliver back
+    fp32_page_bytes = 2 * 4 * page_size * heads * head_dim
+    int8_page_bytes = 2 * 1 * page_size * heads * head_dim + 2 * 4 * heads
+    int8_pages = budget_pages * fp32_page_bytes // int8_page_bytes
+
+    # shared-prefix profile: a common prompt of whole pages (half the
+    # context) + a 1-3 token unique tail + a short generation
+    pref_pages = max(1, (max_len // 2) // page_size)
+    pref = [(j * 11 + 3) % vocab for j in range(pref_pages * page_size)]
+    gen = _gen_lengths(max_len)[0]
+
+    def shared_requests(n):
+        return [
+            Request(
+                rid=i,
+                prompt=pref
+                + [(i * 13 + j + 1) % vocab for j in range(1 + i % 3)],
+                # the first request anchors the prefix live while the
+                # rest churn, so later admissions land on pages the
+                # earlier batch already published
+                max_new_tokens=2 * gen if i == 0 else max(2, gen - i % 3),
+            )
+            for i in range(n)
+        ]
+
+    peak, hits = {}, {}
+    for name, pages, dtype, prefix in (
+        ("fp32", budget_pages, "fp32", False),
+        ("fp32_prefix", budget_pages, "fp32", True),
+        ("int8_prefix", int8_pages, "int8", True),
+    ):
+        # a live request always holds >= 1 page, so `pages` slots make
+        # the pool — never the slot count — the binding constraint
+        slots = max(max_seqs, pages)
+        serve = ServeConfig(
+            max_seqs=slots, max_seq_len=max_len, kv_layout="paged",
+            kv_page_size=page_size, kv_pages=pages, kv_dtype=dtype,
+            prefix_cache=prefix, admission="optimistic",
+        )
+        sched, _, _ = build_scheduler(model, serve)
+        sched.run(shared_requests(2 * slots))
+        peak[name] = sched.stats.peak_in_flight
+        hits[name] = sched.stats.prefix_hits
+    prefix_ratio = peak["fp32_prefix"] / peak["fp32"]
+    int8_ratio = peak["int8_prefix"] / peak["fp32_prefix"]
+
+    # -- decode throughput parity at equal batch ----------------------------
+    def decode_requests():
+        return _long_requests(vocab, max_len, num_requests)
+
+    tps = {}
+    for name, dtype, prefix in (
+        ("fp32", "fp32", False),
+        ("int8_prefix", "int8", True),
+    ):
+        serve = ServeConfig(
+            max_seqs=max_seqs, max_seq_len=max_len, kv_layout="paged",
+            kv_dtype=dtype, prefix_cache=prefix,
+        )
+        _, engine, _ = build_scheduler(model, serve)
+        ContinuousBatchingScheduler(engine).run(
+            decode_requests()[: max_seqs + 1]
+        )  # warm jit signatures
+        best = 0.0
+        for _ in range(reps):
+            sched = ContinuousBatchingScheduler(engine)
+            sched.run(decode_requests())
+            best = max(best, sched.stats.tokens_per_s)
+        tps[name] = best
+
+    return {
+        "metric": f"serve_prefix_capacity_{layers}L_{hidden}h",
+        "value": round(prefix_ratio, 3),
+        "unit": "x_concurrent_shared_prefix_requests",
+        # concurrency over plain paged fp32 at the same byte budget
+        # (acceptance floor: 2x)
+        "vs_baseline": round(prefix_ratio, 3),
+        "page_size": page_size,
+        "prefix_tokens": pref_pages * page_size,
+        "fp32_pages": budget_pages,
+        "int8_pages": int8_pages,
+        "fp32_peak_in_flight": peak["fp32"],
+        "prefix_peak_in_flight": peak["fp32_prefix"],
+        "int8_peak_in_flight": peak["int8_prefix"],
+        "prefix_hits": hits["fp32_prefix"],
+        "int8_prefix_hits": hits["int8_prefix"],
+        # additional capacity from int8 pools at equal bytes
+        # (acceptance floor: 1.8x)
+        "int8_capacity_ratio": round(int8_ratio, 3),
+        "fp32_tokens_per_s": round(tps["fp32"], 2),
+        "int8_tokens_per_s": round(tps["int8_prefix"], 2),
+        # int8+prefix / fp32 CPU decode throughput at equal batch
+        # (parity floor: 0.95)
+        "throughput_ratio": round(tps["int8_prefix"] / tps["fp32"], 3),
+    }
+
+
 def run_spec(
     layers: int,
     hidden: int,
@@ -1148,6 +1292,8 @@ def main():
             mode = "chaos"
         elif a == "--chunked":
             mode = "chunked"
+        elif a == "--prefix":
+            mode = "prefix"
         elif a == "--telemetry":
             mode = "telemetry"
         elif a == "--serve-async":
@@ -1213,6 +1359,28 @@ def main():
             raise SystemExit(
                 f"chunked prefill regressed decode throughput: "
                 f"{result['throughput_ratio']}x unchunked (floor 0.95x)"
+            )
+    elif mode == "prefix":
+        result = run_prefix(**args)
+        with open(os.path.join(here, "BENCH_PREFIX.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        if result["vs_baseline"] < 2.0:
+            raise SystemExit(
+                f"prefix sharing missed the capacity gate: "
+                f"{result['vs_baseline']}x concurrent requests at equal "
+                f"bytes (floor 2.0x)"
+            )
+        if result["int8_capacity_ratio"] < 1.8:
+            raise SystemExit(
+                f"int8 KV missed the capacity gate: "
+                f"{result['int8_capacity_ratio']}x over fp32+prefix at "
+                f"equal bytes (floor 1.8x)"
+            )
+        if result["throughput_ratio"] < 0.95:
+            raise SystemExit(
+                f"int8+prefix regressed decode throughput: "
+                f"{result['throughput_ratio']}x fp32 paged (floor 0.95x)"
             )
     elif mode == "telemetry":
         result = run_telemetry(**args)

@@ -55,6 +55,18 @@ findings go to the baseline):
   against them double-emit or drop the prompt's sampled token. Stores
   are the commit itself (``req.prefill_pos = start + size``) and stay
   sanctioned; loads must come through the step parameter.
+* **FX106** — refcount-mutation discipline for the prefix-sharing
+  allocator. With hashed prefix pages, a page's refcount is re-derived
+  from every live block table (``check_invariants``), so ANY code that
+  writes a ``block_tables`` entry or pushes/pops the ``_free_pages``
+  heap outside the blessed allocator helpers desynchronizes refcounts
+  from ownership — a shared page freed behind its sharers' backs, or a
+  leaked page the conservation gauge flags forever. The blessed
+  helpers (``_install_page``/``_incref``/``_decref_page``/
+  ``_cow_page``/``alloc``/``alloc_shared``/``ensure_position``/
+  ``truncate``/``free``/... — see ``_REFCOUNT_BLESSED``) are the ONLY
+  functions allowed to touch either structure; everything else must
+  route through them.
 """
 
 from __future__ import annotations
@@ -77,6 +89,32 @@ RULES = {
     "copy",
     "FX105": "reconcile reads live chunk-progress attrs instead of the "
     "InflightStep chunk record",
+    "FX106": "block-table write or free-heap mutation outside the "
+    "blessed refcount helpers",
+}
+
+#: the only functions allowed to write `block_tables` entries or touch
+#: the `_free_pages` heap (FX106) — the allocator's refcount seams plus
+#: the fault injector's sanctioned steal/restore pair. `__init__` is
+#: construction, not mutation (same rationale as collect_mutated_attrs).
+_REFCOUNT_BLESSED = {
+    "__init__",
+    "alloc",
+    "alloc_shared",
+    "ensure_position",
+    "truncate",
+    "free",
+    "claim",
+    "end_inflight",
+    "_release_page",
+    "_decref_entry",
+    "_decref_page",
+    "_incref",
+    "_cow_page",
+    "_install_page",
+    "register_prefix",
+    "_page_faults",
+    "release_stolen_pages",
 }
 
 _STEP_PARAM_NAMES = {"step", "inflight"}
@@ -260,6 +298,64 @@ def _chunk_progress_violations(
     return found
 
 
+def _refcount_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
+    """(description, line, offender) for refcount-bearing mutations
+    outside the blessed allocator helpers: a subscript store into a
+    ``block_tables`` attribute, or a ``heapq.heappush``/``heappop``
+    whose argument reaches a ``_free_pages`` attribute. Module-level
+    code reports under the pseudo-name '<module>'."""
+    found: List[Tuple[str, int, str]] = []
+
+    def is_bt_store(node: ast.AST) -> bool:
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(t.elts)
+            elif isinstance(t, ast.Subscript) and isinstance(
+                t.value, ast.Attribute
+            ) and t.value.attr == "block_tables":
+                return True
+        return False
+
+    def is_heap_op(node: ast.AST) -> bool:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("heappush", "heappop")
+        ):
+            return False
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Attribute) and (
+                    sub.attr == "_free_pages"
+                ):
+                    return True
+        return False
+
+    def visit(node: ast.AST, owner: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            owner = node.name
+            if owner in _REFCOUNT_BLESSED:
+                return
+        if is_bt_store(node):
+            found.append(
+                ("writes a 'block_tables' entry", node.lineno, owner)
+            )
+        elif is_heap_op(node):
+            found.append(
+                ("mutates the '_free_pages' heap", node.lineno, owner)
+            )
+        for child in ast.iter_child_nodes(node):
+            visit(child, owner)
+
+    visit(tree, "<module>")
+    return found
+
+
 def _is_trace_hook(node: ast.Call) -> bool:
     """A SearchTrace recording call: `<...>.trace.candidate(...)`,
     `trace.result(...)`, `self._trace.event(...)` — the method is one
@@ -316,6 +412,22 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                         "cursor record (step.chunks) instead",
                     )
                 )
+    for path, tree in trees.items():
+        for what, line, owner in _refcount_violations(tree):
+            diags.append(
+                Diagnostic(
+                    "FX106",
+                    path,
+                    line,
+                    f"'{owner}' {what} outside the blessed refcount "
+                    "helpers — prefix-shared pages derive their "
+                    "refcounts from block tables, so raw mutation "
+                    "desynchronizes ownership (shared page freed under "
+                    "its sharers, or leaked forever); route through "
+                    "alloc/alloc_shared/ensure_position/truncate/free "
+                    "or the _incref/_decref seams",
+                )
+            )
     for path, tree in trees.items():
         jitted = collect_jitted_names(tree)
         for node in ast.walk(tree):

@@ -122,6 +122,13 @@ class FFConfig:
     serve_kv_layout: str = "paged"
     serve_kv_page_size: int = 0
     serve_kv_pages: int = 0
+    # --kv-dtype: K/V pool element type, "fp32" | "int8" (int8 stores
+    # fp32 scales per page per head in side pools; paged layout only)
+    serve_kv_dtype: str = "fp32"
+    # --prefix-cache: hashed prefix-page cache with copy-on-write
+    # forking — admissions map content-matching full pages instead of
+    # recomputing them (paged layout only)
+    serve_prefix_cache: bool = False
     # speculative decoding (SpecInfer; serving/spec.py): draft source
     # ("" = off, "ngram" = weight-free prompt lookup, "model" = second
     # decoder LM passed to build_scheduler) and draft length per verify
@@ -292,6 +299,10 @@ class FFConfig:
                 cfg.serve_kv_page_size = int(take())
             elif a == "--kv-pages":
                 cfg.serve_kv_pages = int(take())
+            elif a == "--kv-dtype":
+                cfg.serve_kv_dtype = take()
+            elif a == "--prefix-cache":
+                cfg.serve_prefix_cache = True
             elif a == "--eos-token":
                 cfg.serve_eos_token = int(take())
             elif a == "--spec-draft":

@@ -222,64 +222,99 @@ def verify_attention(q, k_cache, v_cache, lengths, kernel="auto"):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
 
 
+def _dequant_pages(pool, tbl, scale, b, heads, d):
+    """Gather pages from an int8 pool and dequantize with the per-page
+    per-head fp32 scales: pool[tbl] is [b, np_seq, page_size, h, d] and
+    scale[tbl] is [b, np_seq, h], broadcast over page positions and
+    head_dim. Unwritten pages carry scale 0 and dequantize to exact
+    zeros at positions the length mask drops anyway."""
+    pages = pool[tbl].astype(jnp.float32)  # [b, np_seq, ps, h, d]
+    s = scale[tbl][:, :, None, :, None]  # [b, np_seq, 1, h, 1]
+    return (pages * s).reshape(b, -1, heads, d)
+
+
 def _paged_verify_pallas_hook(q, k_pool, v_pool, block_tables, lengths,
-                              kernel="auto"):
+                              kernel="auto", k_scale=None, v_scale=None):
     """Seam for the hand-tiled TPU paged-verify kernel (w-query flash
     walking the block table page by page — the fourth member of the
     pallas/decode_kernel.py family, completing the seam symmetry:
     every cache-attention path now has one). None routes
     paged_verify_attention to the dense gather path; mode semantics as
-    in _decode_pallas_hook."""
+    in _decode_pallas_hook. int8 pools (scales given) route to the
+    quantized kernel variant, gated separately by supports()."""
     from flexflow_tpu.ops.pallas import decode_kernel as dk
 
+    quant = k_scale is not None
     if not dk.use_kernel(
-        kernel, q.shape[1], 0, q.shape[-1], page_size=k_pool.shape[1]
+        kernel, q.shape[1], 0, q.shape[-1], page_size=k_pool.shape[1],
+        kv_dtype="int8" if quant else "fp32",
     ):
         return None
+    if quant:
+        return dk.paged_flash_verify_quant(
+            q, k_pool, v_pool, k_scale, v_scale, block_tables, lengths
+        )
     return dk.paged_flash_verify(q, k_pool, v_pool, block_tables, lengths)
 
 
 def paged_verify_attention(q, k_pool, v_pool, block_tables, lengths,
-                           kernel="auto"):
+                           kernel="auto", k_scale=None, v_scale=None):
     """Verify attention against the block-paged cache. The dense path
     gathers each sequence's pages into a contiguous view (same
     dense-gather strategy as paged_decode_attention, same sentinel
     clamping) and runs the exact verify_attention math, so paged verify
     is token-identical to the slot layout; the kernel path walks the
-    table with no gather."""
+    table with no gather. With int8 pools, k_scale/v_scale
+    [num_pages, heads] fp32 dequantize the gathered pages in place —
+    the fused-dequant chunk loop of the ISSUE."""
     out = _paged_verify_pallas_hook(
-        q, k_pool, v_pool, block_tables, lengths, kernel
+        q, k_pool, v_pool, block_tables, lengths, kernel,
+        k_scale=k_scale, v_scale=v_scale,
     )
     if out is not None:
         return out
     b = q.shape[0]
     num_pages, page_size, heads, d = k_pool.shape
     tbl = jnp.minimum(block_tables, num_pages - 1)
-    k = k_pool[tbl].reshape(b, -1, heads, d)
-    v = v_pool[tbl].reshape(b, -1, heads, d)
+    if k_scale is not None:
+        k = _dequant_pages(k_pool, tbl, k_scale, b, heads, d)
+        v = _dequant_pages(v_pool, tbl, v_scale, b, heads, d)
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+    else:
+        k = k_pool[tbl].reshape(b, -1, heads, d)
+        v = v_pool[tbl].reshape(b, -1, heads, d)
     return verify_attention(q, k, v, lengths)
 
 
 def _paged_decode_pallas_hook(q, k_pool, v_pool, block_tables, lengths,
-                              kernel="auto"):
+                              kernel="auto", k_scale=None, v_scale=None):
     """Seam for the hand-tiled TPU paged-decode kernel (single-query
     flash that walks the block table page by page instead of gathering
     the pages into a contiguous [b, max_len] view first — the
     PagedAttention kernel shape, pallas/decode_kernel.py with its
     supports() gate and calibration-table tile sizes). None routes
     paged_decode_attention to the dense gather path below; mode
-    semantics as in _decode_pallas_hook."""
+    semantics as in _decode_pallas_hook. int8 pools (scales given)
+    route to the quantized kernel variant, gated separately by
+    supports()."""
     from flexflow_tpu.ops.pallas import decode_kernel as dk
 
+    quant = k_scale is not None
     if not dk.use_kernel(
-        kernel, q.shape[1], 0, q.shape[-1], page_size=k_pool.shape[1]
+        kernel, q.shape[1], 0, q.shape[-1], page_size=k_pool.shape[1],
+        kv_dtype="int8" if quant else "fp32",
     ):
         return None
+    if quant:
+        return dk.paged_flash_decode_quant(
+            q, k_pool, v_pool, k_scale, v_scale, block_tables, lengths
+        )
     return dk.paged_flash_decode(q, k_pool, v_pool, block_tables, lengths)
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
-                           kernel="auto"):
+                           kernel="auto", k_scale=None, v_scale=None):
     """Serving decode against a block-paged KV cache. q: [b, 1, h, d];
     k_pool/v_pool: [num_pages, page_size, h, d]; block_tables:
     [b, max_pages_per_seq] int32 page ids (sentinel num_pages for
@@ -292,9 +327,12 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
     slot layout: sentinel/unwritten pages land at positions > lengths
     and the same -1e30 mask drops them before softmax. (The gather is a
     per-step temp the size of ONE dense cache view; the capacity win is
-    in the persistent pool allocation, not this working set.)"""
+    in the persistent pool allocation, not this working set.) With int8
+    pools, k_scale/v_scale [num_pages, heads] fp32 dequantize the
+    gathered pages in place."""
     out = _paged_decode_pallas_hook(
-        q, k_pool, v_pool, block_tables, lengths, kernel
+        q, k_pool, v_pool, block_tables, lengths, kernel,
+        k_scale=k_scale, v_scale=v_scale,
     )
     if out is not None:
         return out
@@ -303,8 +341,14 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
     # sentinel entries are clamped to a real page; whatever that page
     # holds sits at masked positions, so the clamp is numerically inert
     tbl = jnp.minimum(block_tables, num_pages - 1)
-    k = k_pool[tbl].reshape(b, -1, heads, d)
-    v = v_pool[tbl].reshape(b, -1, heads, d)
+    if k_scale is not None:
+        k = _dequant_pages(k_pool, tbl, k_scale, b, heads, d)
+        v = _dequant_pages(v_pool, tbl, v_scale, b, heads, d)
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+    else:
+        k = k_pool[tbl].reshape(b, -1, heads, d)
+        v = v_pool[tbl].reshape(b, -1, heads, d)
     return decode_attention(q, k, v, lengths)
 
 

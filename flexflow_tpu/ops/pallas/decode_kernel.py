@@ -110,7 +110,16 @@ def _pick_chunk(kv_len: int, pref: Optional[int] = None) -> Optional[int]:
     return None
 
 
-def supports(w: int, kv_len: int, head_dim: int, page_size: int = 0) -> bool:
+# int8 native tiles are (32, 128) sublane x lane on TPU — a quantized
+# page must pack whole int8 sublanes, so the paged quant variant needs
+# 32-row page alignment where fp32 needs only 8
+_INT8_SUBLANES = 32
+
+
+def supports(
+    w: int, kv_len: int, head_dim: int, page_size: int = 0,
+    kv_dtype: str = "fp32",
+) -> bool:
     """Whether the kernel family takes this cache geometry. False routes
     the caller to the dense jnp paths (ops/attention.py) — the explicit
     fallback contract, like flash_kernel.supports for training shapes.
@@ -118,16 +127,24 @@ def supports(w: int, kv_len: int, head_dim: int, page_size: int = 0) -> bool:
     w: query positions per sequence (1 = decode, k+1 = verify);
     kv_len: max_len of the contiguous cache; page_size > 0 checks the
     paged variant instead (its chunk is one page, so the page must be
-    sublane-aligned; kv_len is ignored — the walk is table-driven)."""
+    sublane-aligned; kv_len is ignored — the walk is table-driven).
+    kv_dtype "int8" selects the quantized paged variant's gate: pages
+    must pack whole (32, 128) int8 tiles, and only the paged layout
+    carries the per-page scale side pools."""
     if not 1 <= w <= _MAX_W or head_dim % SUBLANES:
         return False
+    if kv_dtype == "int8":
+        # quantized pools exist only on the paged layout; the page must
+        # be int8-sublane-aligned or the dense dequant path takes over
+        return page_size > 0 and page_size % _INT8_SUBLANES == 0
     if page_size > 0:
         return page_size % SUBLANES == 0
     return kv_len >= 1 and _pick_chunk(kv_len) is not None
 
 
 def use_kernel(
-    mode: str, w: int, kv_len: int, head_dim: int, page_size: int = 0
+    mode: str, w: int, kv_len: int, head_dim: int, page_size: int = 0,
+    kv_dtype: str = "fp32",
 ) -> bool:
     """Resolve a ServeConfig.decode_kernel mode for one geometry:
     "dense" never takes the kernel, "pallas" takes it whenever
@@ -137,7 +154,9 @@ def use_kernel(
     kernel there is a correctness tool, not a serving config)."""
     if mode not in MODES:
         raise ValueError(f"decode_kernel must be one of {MODES}, got {mode!r}")
-    if mode == "dense" or not supports(w, kv_len, head_dim, page_size):
+    if mode == "dense" or not supports(
+        w, kv_len, head_dim, page_size, kv_dtype=kv_dtype
+    ):
         return False
     return mode == "pallas" or jax.default_backend() == "tpu"
 
@@ -423,3 +442,143 @@ def paged_flash_decode(q, k_pool, v_pool, block_tables, lengths, **kw):
     paged_flash_verify (ops/attention.paged_decode_attention's
     semantics)."""
     return paged_flash_verify(q, k_pool, v_pool, block_tables, lengths, **kw)
+
+
+# -- int8-quantized block-paged cache -----------------------------------------
+
+
+def _paged_kernel_quant(
+    len_ref, tbl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    m_scr, l_scr, acc_scr, *, cfg, num_pages, page_size, np_seq,
+):
+    """_paged_kernel with fused per-page dequant: the K/V tiles arrive
+    int8 and the (1, 1) scale tiles — one fp32 scalar per (page, head),
+    DMA'd through the same table-driven index map — multiply them back
+    to fp32 INSIDE the chunk loop, so no dequantized cache view ever
+    exists outside VMEM."""
+    ib = pl.program_id(0)
+    ip = pl.program_id(2)
+
+    @pl.when(ip == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _MASK)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[ib]
+
+    @pl.when(
+        (ip * page_size <= length + (cfg.w - 1))
+        & (tbl_ref[ib, ip] < num_pages)
+    )
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # (w, d)
+        k = k_ref[0, :, 0, :].astype(jnp.float32) * ks_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.sm_scale  # (w, page_size)
+        s = _stair_mask(s, cfg, length, ip * page_size)
+        v = v_ref[0, :, 0, :].astype(jnp.float32) * vs_ref[0, 0]
+        _online_softmax_step(s, v, m_scr, l_scr, acc_scr)
+
+    @pl.when(ip == np_seq - 1)
+    def _done():
+        _finish(o_ref, l_scr, acc_scr)
+
+
+def paged_flash_verify_quant(
+    q,
+    k_pool,
+    v_pool,
+    k_scale,
+    v_scale,
+    block_tables,
+    lengths,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+):
+    """paged_flash_verify over int8 pools with fp32 per-(page, head)
+    scale side pools [num_pages, h]: dequant fuses into the page walk
+    (each page's scale rides the same scalar-prefetched table lookup as
+    its K/V tile). Semantics match paged_verify_attention's dense
+    dequant path bit-for-bit on the visible positions."""
+    b, w, h, d = q.shape
+    num_pages, page_size = k_pool.shape[0], k_pool.shape[1]
+    np_seq = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if page_size % _INT8_SUBLANES:
+        raise ValueError(
+            f"paged flash decode (int8): page_size {page_size} is not "
+            f"int8-sublane-aligned ({_INT8_SUBLANES}); use supports() "
+            "and fall back to dense"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cfg = _Cfg(w, sm_scale, page_size, interpret)
+    qt = q.transpose(0, 2, 1, 3)  # [b, h, w, d]
+
+    def q_map(ib, ih, ip, lens, tbl):
+        return (ib, ih, 0, 0)
+
+    def kv_map(ib, ih, ip, lens, tbl):
+        ip = lax.select(ip * page_size <= lens[ib] + (w - 1), ip, 0)
+        page = jnp.minimum(tbl[ib, ip], num_pages - 1)
+        return (page, 0, ih, 0)
+
+    def scale_map(ib, ih, ip, lens, tbl):
+        ip = lax.select(ip * page_size <= lens[ib] + (w - 1), ip, 0)
+        page = jnp.minimum(tbl[ib, ip], num_pages - 1)
+        return (page, ih)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel_quant,
+            cfg=cfg,
+            num_pages=num_pages,
+            page_size=page_size,
+            np_seq=np_seq,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, np_seq),
+            in_specs=[
+                pl.BlockSpec((1, 1, w, d), q_map),
+                pl.BlockSpec((1, page_size, 1, d), kv_map),
+                pl.BlockSpec((1, page_size, 1, d), kv_map),
+                pl.BlockSpec((1, 1), scale_map),
+                pl.BlockSpec((1, 1), scale_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, w, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, d), q.dtype),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        qt,
+        k_pool,
+        v_pool,
+        k_scale.astype(jnp.float32),
+        v_scale.astype(jnp.float32),
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def paged_flash_decode_quant(
+    q, k_pool, v_pool, k_scale, v_scale, block_tables, lengths, **kw
+):
+    """Single-query int8 paged flash decode — the w == 1 case of
+    paged_flash_verify_quant."""
+    return paged_flash_verify_quant(
+        q, k_pool, v_pool, k_scale, v_scale, block_tables, lengths, **kw
+    )

@@ -833,6 +833,8 @@ def estimate_max_in_flight(
     itemsize: int = 4,
     admission: str = "optimistic",
     max_new_tokens: Optional[int] = None,
+    kv_dtype: str = "fp32",
+    prefix_hit_rate: float = 0.0,
 ) -> int:
     """How many concurrent sequences with the measured length profile
     (mean_prompt_len + mean_gen_len cached tokens each) fit in a
@@ -854,20 +856,42 @@ def estimate_max_in_flight(
     what it uses). The ratio of the two answers is the concurrency
     headroom `--admission optimistic` unlocks on budget-declaring-but-
     short-finishing traffic (requests that reserve 256 tokens and emit
-    20)."""
+    20).
+
+    `kv_dtype="int8"` prices the quantized paged pools: 1-byte K/V rows
+    plus the fp32 per-(page, head) dequant scales in the side pools —
+    just under 4x the sequences at the same budget. `prefix_hit_rate`
+    (0..1) discounts the prompt bytes a shared-prefix workload never
+    allocates: at hit rate h each admission charges (1-h)·prompt fresh
+    tokens; the shared remainder maps refcounted pages another live
+    request already paid for. The discount applies only to the
+    "optimistic" charge — the reserve gate admits on worst-case
+    divergence (every shared page may COW), so sharing buys it
+    nothing."""
     from flexflow_tpu.serving.kv_cache import KVCacheSpec
 
     if admission not in ("reserve", "optimistic"):
         raise ValueError(
             f"admission must be 'reserve' or 'optimistic', got {admission!r}"
         )
+    if kv_dtype not in ("fp32", "int8"):
+        raise ValueError(f"kv_dtype must be 'fp32' or 'int8', got {kv_dtype!r}")
+    if kv_dtype == "int8" and page_size <= 0:
+        raise ValueError("kv_dtype='int8' requires a paged layout")
+    if not 0.0 <= prefix_hit_rate <= 1.0:
+        raise ValueError(
+            f"prefix_hit_rate must be in [0, 1], got {prefix_hit_rate}"
+        )
+    if prefix_hit_rate and page_size <= 0:
+        raise ValueError("prefix_hit_rate > 0 requires a paged layout")
     guids, heads, head_dim = _serving_cache_geometry(graph)
     heads_chip = max(1, heads // max(1, tp))
     if admission == "reserve":
         budget = max_new_tokens if max_new_tokens is not None else mean_gen_len
         seq_len = min(max_len, int(mean_prompt_len) + int(budget))
     else:
-        seq_len = min(max_len, int(mean_prompt_len) + int(mean_gen_len))
+        fresh_prompt = int(round(mean_prompt_len * (1.0 - prefix_hit_rate)))
+        seq_len = min(max_len, fresh_prompt + int(mean_gen_len))
     if page_size > 0:
         one = KVCacheSpec(
             layer_guids=guids,
@@ -877,8 +901,9 @@ def estimate_max_in_flight(
             head_dim=head_dim,
             buckets=(max_len,),
             page_size=page_size,
-            num_pages=-(-seq_len // page_size),
-            itemsize=itemsize,
+            num_pages=-(-max(1, seq_len) // page_size),
+            itemsize=1 if kv_dtype == "int8" else itemsize,
+            kv_dtype=kv_dtype,
         )
     else:
         one = KVCacheSpec(
@@ -903,6 +928,7 @@ def estimate_decode_step(
     kv_len: int,
     page_size: int = 0,
     decode_kernel: str = "dense",
+    kv_dtype: str = "fp32",
 ) -> Optional[GraphCost]:
     """Cost one decode iteration of the whole PCG under a (dp, tp) mesh;
     None when infeasible (dp doesn't divide the batch, tp doesn't divide
@@ -933,7 +959,7 @@ def estimate_decode_step(
             node_tp = 1
         c = cm.decode_op_cost(
             node, b_chip, kv_len, tp=node_tp, page_size=page_size,
-            kernel=decode_kernel,
+            kernel=decode_kernel, kv_dtype=kv_dtype,
         )
         compute += c.forward_time
         mem += c.memory
@@ -960,6 +986,7 @@ def estimate_verify_step(
     k: int,
     page_size: int = 0,
     decode_kernel: str = "dense",
+    kv_dtype: str = "fp32",
 ) -> Optional[GraphCost]:
     """Cost one speculative-decoding VERIFY iteration (k+1 scored token
     positions per sequence, serving/engine.verify) of the whole PCG
@@ -984,7 +1011,7 @@ def estimate_verify_step(
             node_tp = 1
         c = cm.verify_op_cost(
             node, b_chip, kv_len, k, tp=node_tp, page_size=page_size,
-            kernel=decode_kernel,
+            kernel=decode_kernel, kv_dtype=kv_dtype,
         )
         compute += c.forward_time
         mem += c.memory
@@ -1396,6 +1423,8 @@ def optimize_serving(
     max_len: Optional[int] = None,
     decode_kernel: str = "dense",
     max_new_tokens: Optional[int] = None,
+    kv_dtype: str = "fp32",
+    prefix_hit_rate: float = 0.0,
 ) -> ServingSearchResult:
     """Pick the decode-latency-optimal (dp, tp) mesh for serving
     `batch_size` concurrent sequences at `kv_len` cache positions.
@@ -1418,7 +1447,13 @@ def optimize_serving(
     the mean actually generated) additionally fills
     `max_in_flight_reserve` — the same budget under the preemption-free
     reserve admission gate, so the result compares what
-    `--admission optimistic` buys over `reserve` on this workload."""
+    `--admission optimistic` buys over `reserve` on this workload.
+    `kv_dtype` and `prefix_hit_rate` reprice the capacity estimates for
+    the quantized pools (--kv-dtype int8) and a shared-prefix workload
+    (--prefix-cache at measured hit rate h): see
+    estimate_max_in_flight — the decode step-time cost itself also
+    shifts under int8 (thinner pool reads, extra scale reads), priced
+    through CostModel.decode_op_cost's kv_dtype term."""
     cm = CostModel(
         spec,
         measure=False,  # the measured table times training shapes
@@ -1432,7 +1467,7 @@ def optimize_serving(
         for dp, tp in _mesh_factorizations(used):
             cost = estimate_decode_step(
                 graph, cm, dp, tp, batch_size, kv_len, page_size=page_size,
-                decode_kernel=decode_kernel,
+                decode_kernel=decode_kernel, kv_dtype=kv_dtype,
             )
             if cost is None or not cost.feasible(spec):
                 continue
@@ -1465,6 +1500,8 @@ def optimize_serving(
             horizon,
             page_size=page_size,
             tp=best.tp,
+            kv_dtype=kv_dtype,
+            prefix_hit_rate=prefix_hit_rate,
         )
         if max_new_tokens is not None:
             best.max_in_flight_reserve = estimate_max_in_flight(
@@ -1477,6 +1514,7 @@ def optimize_serving(
                 tp=best.tp,
                 admission="reserve",
                 max_new_tokens=max_new_tokens,
+                kv_dtype=kv_dtype,
             )
     return best
 
@@ -1488,6 +1526,7 @@ def search_serving_strategy(
     mean_prompt_len: Optional[int] = None,
     mean_gen_len: Optional[int] = None,
     max_new_tokens: Optional[int] = None,
+    prefix_hit_rate: Optional[float] = None,
 ) -> ServingSearchResult:
     """Model-level entry: cost the compiled builder graph's decode regime
     on the config's machine (chip/nodes like the training search). kv_len
@@ -1496,7 +1535,9 @@ def search_serving_strategy(
     attention core's cost shape from --decode-kernel (resolved against
     the graph's cache geometry exactly like the engine resolves it), and
     a supplied length profile fills the winner's max_in_flight capacity
-    estimate."""
+    estimate. The capacity estimate prices the config's --kv-dtype, and
+    `prefix_hit_rate` (workload-measured; defaults to 0, and is only
+    honored when --prefix-cache is on) discounts shared prompt bytes."""
     from flexflow_tpu.serving.kv_cache import default_page_size
 
     cfg = model.config
@@ -1534,6 +1575,12 @@ def search_serving_strategy(
         max_len=cfg.serve_max_seq_len,
         decode_kernel=decode_kernel,
         max_new_tokens=max_new_tokens,
+        kv_dtype=getattr(cfg, "serve_kv_dtype", "fp32"),
+        prefix_hit_rate=(
+            prefix_hit_rate or 0.0
+            if getattr(cfg, "serve_prefix_cache", False)
+            else 0.0
+        ),
     )
 
 

@@ -474,6 +474,7 @@ class CostModel:
         tp: int = 1,
         page_size: int = 0,
         kernel: str = "dense",
+        kv_dtype: str = "fp32",
     ) -> OpCost:
         """Forward cost of ONE decode step of this op on one chip.
 
@@ -503,7 +504,13 @@ class CostModel:
         write plus one extra read of the gathered bytes on top of the
         pool read, so the dense paged KV term is 3x the kernel's. On
         the contiguous layout the two paths move the same bytes and the
-        term is unchanged."""
+        term is unchanged.
+
+        kv_dtype "int8" (paged-only, serving/kv_cache quantized pools)
+        prices cache rows at 1 byte each plus one fp32 dequant-scale
+        read per touched (page, head) for K and V — the bandwidth win
+        that pairs with the 4x capacity win estimate_max_in_flight
+        prices on the footprint side."""
         tp = max(1, tp)
         elem = lambda s: self.elem_bytes(s)  # noqa: E731
         weight_bytes = sum(
@@ -524,7 +531,13 @@ class CostModel:
             kv_rows = kv_len
             if page_size > 0:
                 kv_rows = -(-kv_len // page_size) * page_size
-            cache_bytes = 2.0 * batch * kv_rows * heads * head_dim * out_elem
+            cache_elem = 1 if kv_dtype == "int8" else out_elem
+            cache_bytes = 2.0 * batch * kv_rows * heads * head_dim * cache_elem
+            if kv_dtype == "int8" and page_size > 0:
+                # one fp32 scale per touched (page, head), K and V each
+                cache_bytes += (
+                    2.0 * batch * (kv_rows // page_size) * heads * 4.0
+                )
             mem += cache_bytes
             if page_size > 0 and kernel != "pallas":
                 # dense fallback on the paged layout: gather the pages
@@ -554,6 +567,7 @@ class CostModel:
         tp: int = 1,
         page_size: int = 0,
         kernel: str = "dense",
+        kv_dtype: str = "fp32",
     ) -> OpCost:
         """Forward cost of ONE speculative-decoding verify step of this
         op on one chip: k+1 token positions per sequence (the last
@@ -571,7 +585,9 @@ class CostModel:
 
         kernel as in decode_op_cost: "pallas" prices the flash-verify
         kernel's single page-granular cache read; "dense" adds the
-        paged gather's extra write + read of the contiguous view."""
+        paged gather's extra write + read of the contiguous view.
+        kv_dtype "int8" as in decode_op_cost: 1-byte cache rows plus
+        per-(page, head) fp32 scale reads."""
         tp = max(1, tp)
         w = int(k) + 1
         elem = lambda s: self.elem_bytes(s)  # noqa: E731
@@ -595,7 +611,12 @@ class CostModel:
             kv_rows = kv_len + w
             if page_size > 0:
                 kv_rows = -(-kv_rows // page_size) * page_size
-            cache_bytes = 2.0 * batch * kv_rows * heads * head_dim * out_elem
+            cache_elem = 1 if kv_dtype == "int8" else out_elem
+            cache_bytes = 2.0 * batch * kv_rows * heads * head_dim * cache_elem
+            if kv_dtype == "int8" and page_size > 0:
+                cache_bytes += (
+                    2.0 * batch * (kv_rows // page_size) * heads * 4.0
+                )
             mem += cache_bytes
             if page_size > 0 and kernel != "pallas":
                 # dense gather tax, as in decode_op_cost
@@ -621,6 +642,7 @@ class CostModel:
         tp: int = 1,
         page_size: int = 0,
         kernel: str = "dense",
+        kv_dtype: str = "fp32",
     ) -> OpCost:
         """Forward cost of ONE prefill of `seq_len` token positions of
         this op on one chip, against an empty cache — a verify step with
@@ -639,6 +661,7 @@ class CostModel:
             tp=tp,
             page_size=page_size,
             kernel=kernel,
+            kv_dtype=kv_dtype,
         )
 
     def prefill_chunk_cost(
@@ -650,6 +673,7 @@ class CostModel:
         tp: int = 1,
         page_size: int = 0,
         kernel: str = "dense",
+        kv_dtype: str = "fp32",
     ) -> OpCost:
         """Forward cost of ONE chunked-prefill step of this op on one
         chip: `chunk` prompt positions appended at cache cursor
@@ -671,6 +695,7 @@ class CostModel:
             tp=tp,
             page_size=page_size,
             kernel=kernel,
+            kv_dtype=kv_dtype,
         )
 
     # -- measured mode ------------------------------------------------------

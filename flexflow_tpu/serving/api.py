@@ -48,6 +48,17 @@ class ServeConfig:
     kv_layout: str = "paged"
     kv_page_size: int = 0  # 0 = auto (vLLM-style 16, halved to divide max_len)
     kv_pages: int = 0  # 0 = max_seqs * max_seq_len / page_size (same capacity)
+    # K/V pool element type (--kv-dtype): "int8" quantizes both pools
+    # (fp32 scale per page per head in side pools, dequant fused into
+    # the per-chunk attention loop) for ~4x cache bytes; paged layout
+    # only — the slot layout has no per-page scale granularity.
+    kv_dtype: str = "fp32"
+    # hashed prefix-page cache (--prefix-cache): admissions map full
+    # pages whose chained content hash matches an already-resident
+    # prefix (refcounted, copy-on-write on first divergent write)
+    # instead of recomputing them; paged layout only — sharing is
+    # page-aligned by construction.
+    prefix_cache: bool = False
     # speculative decoding (SpecInfer, ASPLOS'24; serving/spec.py):
     # "" = off, "ngram" = weight-free prompt-lookup draft, "model" = a
     # second compiled decoder LM (pass it as build_scheduler/generate's
@@ -142,6 +153,20 @@ class ServeConfig:
                 f"max_seq_len {self.max_seq_len} is not divisible by "
                 f"kv_page_size {self.kv_page_size}"
             )
+        if self.kv_dtype not in ("fp32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'fp32' or 'int8', got {self.kv_dtype!r}"
+            )
+        if self.kv_dtype == "int8" and self.kv_layout != "paged":
+            raise ValueError(
+                "kv_dtype='int8' requires kv_layout='paged' (the scale "
+                "side pools are per page per head)"
+            )
+        if self.prefix_cache and self.kv_layout != "paged":
+            raise ValueError(
+                "prefix_cache requires kv_layout='paged' (sharing is "
+                "page-aligned: whole pages map through block tables)"
+            )
         if self.spec_draft not in _SPEC_DRAFTS:
             raise ValueError(
                 f"spec_draft must be one of {_SPEC_DRAFTS}, "
@@ -221,6 +246,8 @@ class ServeConfig:
             kv_layout=cfg.serve_kv_layout,
             kv_page_size=cfg.serve_kv_page_size,
             kv_pages=cfg.serve_kv_pages,
+            kv_dtype=cfg.serve_kv_dtype,
+            prefix_cache=cfg.serve_prefix_cache,
             spec_draft=cfg.serve_spec_draft,
             spec_k=cfg.serve_spec_k,
             token_budget=cfg.serve_token_budget,
@@ -302,6 +329,8 @@ def build_scheduler(
             buckets=serve.prefill_buckets or None,
             page_size=serve.kv_page_size,
             num_pages=serve.kv_pages,
+            kv_dtype=serve.kv_dtype,
+            prefix_cache=serve.prefix_cache,
         )
     else:
         cache = KVCache.from_model(

@@ -136,6 +136,11 @@ class InflightStep:
     # snapshot the commit phase reads INSTEAD of live Request attrs
     # (fxlint FX105 holds reconcile code to this record)
     chunks: Optional[Dict[int, tuple]] = None
+    # chunked prefill: slot -> prompt tokens at dispatch — what the
+    # commit phase hands register_prefix (same FX105 discipline: the
+    # prompt is immutable per request, but the SLOT can turn over while
+    # the step is in flight, so even this read rides the snapshot)
+    chunk_seqs: Optional[Dict[int, list]] = None
     # device futures (JAX arrays still computing behind the queue)
     device_next: object = None  # decode: sampled tokens [max_seqs]
     device_logits: object = None  # [max_seqs, V] or [max_seqs, w, V]
@@ -380,6 +385,57 @@ class GenerationEngine:
 
         return jax.vmap(one)(slots, positions, logits).astype(jnp.int32)
 
+    # -- int8 pool writes ----------------------------------------------------
+
+    def _quant_scatter(self, pool, scale, rows, dest):
+        """Quantize `rows` [N, heads, head_dim] into the int8 `pool` at
+        flat row indices `dest` [N] (out-of-bounds rows drop, exactly
+        like the fp32 scatter). A page's fp32 scale is claimed exactly
+        once, from the abs-max of its FIRST row (position page_size·p):
+        sequential streaming guarantees a fresh page's first write
+        contains that row, and the first row's content is a pure
+        function of the token history — so the scale (and therefore the
+        page's bytes) comes out identical no matter how the writes were
+        batched into chunks, which request recomputed them, or whether
+        the page arrived via COW (the copied scale equals what a fresh
+        recompute would derive). Pages whose scale is already set (> 0)
+        keep it; rows beyond ±127·scale clip — the documented int8
+        tolerance. Returns (pool', scale', dequantized_rows): the round
+        trip through int8, for callers (prefill) whose attention must
+        read exactly what a later pool reader will see."""
+        import jax.numpy as jnp
+
+        spec = self.cache.spec
+        page = dest // spec.page_size  # OOB dest -> OOB page, dropped
+        f32 = rows.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(f32), axis=-1)  # [N, heads]
+        first = (dest % spec.page_size == 0)[:, None]  # page-initial rows
+        cand = jnp.zeros_like(scale).at[page].max(
+            jnp.where(first, amax / 127.0, 0.0), mode="drop"
+        )
+        # a batch that writes a page's first row (RE)DERIVES its scale —
+        # never trust a stored value then: freed pages keep stale scales
+        # on device, and a reallocated page must quantize from its new
+        # content, not its previous tenant's
+        claimed = jnp.zeros_like(scale).at[page].max(
+            jnp.where(first, 1.0, 0.0), mode="drop"
+        )
+        new_scale = jnp.where(claimed > 0.0, cand, scale)
+        s = new_scale[jnp.clip(page, 0, spec.num_pages - 1)]  # [N, heads]
+        safe = jnp.where(s > 0.0, s, 1.0)
+        q = jnp.clip(jnp.round(f32 / safe[:, :, None]), -127, 127).astype(
+            pool.dtype
+        )
+        flat = pool.reshape(-1, spec.num_heads, spec.head_dim)
+        deq = q.astype(jnp.float32) * jnp.where(
+            s > 0.0, s, 0.0
+        )[:, :, None]
+        return (
+            flat.at[dest].set(q, mode="drop").reshape(pool.shape),
+            new_scale,
+            deq,
+        )
+
     # -- prefill -------------------------------------------------------------
 
     def _prefill_impl(self, params, tokens, slot_ids, prompt_lens, ck, cv):
@@ -425,7 +481,8 @@ class GenerationEngine:
         return new_k, new_v, self._pick(last, slot_ids, prompt_lens), last
 
     def _prefill_impl_paged(
-        self, params, tokens, slot_ids, row_tables, prompt_lens, ck, cv
+        self, params, tokens, slot_ids, row_tables, prompt_lens, ck, cv,
+        cks, cvs,
     ):
         """Paged twin of _prefill_impl. row_tables [max_seqs,
         ceil(bucket/page_size)] int32: the admitted slots' block-table
@@ -444,42 +501,64 @@ class GenerationEngine:
             scaled_dot_product_attention,
         )
 
-        captured_k: Dict[int, object] = {}
-        captured_v: Dict[int, object] = {}
-
-        def hook(node, ins, ws, ctx):
-            use_bias = node.params.get("bias", True)
-            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
-            captured_k[node.guid] = k
-            captured_v[node.guid] = v
-            attn = scaled_dot_product_attention(q, k, v, causal=True)
-            return [
-                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
-            ]
-
-        logits = self._forward_logits(params, tokens, hook)
         spec = self.cache.spec
         ps = spec.page_size
         bucket = tokens.shape[1]
         pos = jnp.arange(bucket)
         # [max_seqs, bucket] flat pool destinations through the table
         dest = (row_tables[:, pos // ps] * ps + pos % ps).reshape(-1)
+        quant = getattr(self.cache, "quantized", False)
         new_k, new_v = {}, {}
-        for g in spec.layer_guids:
-            kp = ck[g].reshape(-1, spec.num_heads, spec.head_dim)
-            vp = cv[g].reshape(-1, spec.num_heads, spec.head_dim)
-            kr = captured_k[g].astype(ck[g].dtype).reshape(
-                -1, spec.num_heads, spec.head_dim
-            )
-            vr = captured_v[g].astype(cv[g].dtype).reshape(
-                -1, spec.num_heads, spec.head_dim
-            )
-            new_k[g] = kp.at[dest].set(kr).reshape(ck[g].shape)
-            new_v[g] = vp.at[dest].set(vr).reshape(cv[g].shape)
+        new_ks, new_vs = dict(cks), dict(cvs)
+
+        def hook(node, ins, ws, ctx):
+            g = node.guid
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            if quant:
+                # scatter inside the hook and attend over the int8
+                # ROUND TRIP: a prefix-shared admission later reads
+                # these rows dequantized from the pool, so the logits
+                # computed here must come from the same lossy values or
+                # shared and unshared streams would diverge
+                kr = k.reshape(-1, spec.num_heads, spec.head_dim)
+                vr = v.reshape(-1, spec.num_heads, spec.head_dim)
+                new_k[g], new_ks[g], k_deq = self._quant_scatter(
+                    ck[g], cks[g], kr, dest
+                )
+                new_v[g], new_vs[g], v_deq = self._quant_scatter(
+                    cv[g], cvs[g], vr, dest
+                )
+                k = k_deq.reshape(k.shape).astype(k.dtype)
+                v = v_deq.reshape(v.shape).astype(v.dtype)
+            else:
+                kp = ck[g].reshape(-1, spec.num_heads, spec.head_dim)
+                vp = cv[g].reshape(-1, spec.num_heads, spec.head_dim)
+                kr = k.reshape(-1, spec.num_heads, spec.head_dim)
+                vr = v.reshape(-1, spec.num_heads, spec.head_dim)
+                new_k[g] = kp.at[dest].set(kr.astype(ck[g].dtype)).reshape(
+                    ck[g].shape
+                )
+                new_v[g] = vp.at[dest].set(vr.astype(cv[g].dtype)).reshape(
+                    cv[g].shape
+                )
+            attn = scaled_dot_product_attention(q, k, v, causal=True)
+            return [
+                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
+            ]
+
+        logits = self._forward_logits(params, tokens, hook)
         last = jnp.take_along_axis(
             logits, (prompt_lens - 1)[:, None, None], axis=1
         )[:, 0]
-        return new_k, new_v, self._pick(last, slot_ids, prompt_lens), last
+        return (
+            new_k,
+            new_v,
+            new_ks,
+            new_vs,
+            self._pick(last, slot_ids, prompt_lens),
+            last,
+        )
 
     def prefill(
         self,
@@ -528,15 +607,27 @@ class GenerationEngine:
             for i, s in enumerate(slots):
                 row_tables[i] = self.cache.block_tables[s, :width]
             route.append(jnp.asarray(row_tables))
-        new_k, new_v, nxt, last = fn(
-            params,
-            jnp.asarray(tokens),
-            *route,
-            jnp.asarray(plens),
-            self.cache.k,
-            self.cache.v,
-        )
-        self.cache.commit(new_k, new_v)
+            new_k, new_v, new_ks, new_vs, nxt, last = fn(
+                params,
+                jnp.asarray(tokens),
+                *route,
+                jnp.asarray(plens),
+                self.cache.k,
+                self.cache.v,
+                self.cache.k_scale,
+                self.cache.v_scale,
+            )
+            self.cache.commit(new_k, new_v, new_ks, new_vs)
+        else:
+            new_k, new_v, nxt, last = fn(
+                params,
+                jnp.asarray(tokens),
+                *route,
+                jnp.asarray(plens),
+                self.cache.k,
+                self.cache.v,
+            )
+            self.cache.commit(new_k, new_v)
         for p, s in zip(prompts, slots):
             self.cache.lengths[s] = len(p)
         out_nxt, out_last = np.asarray(nxt[:n]), np.asarray(last[:n])
@@ -551,6 +642,57 @@ class GenerationEngine:
                 args={"prompts": n, "bucket": bucket},
             )
         return out_nxt, out_last
+
+    def prefill_suffix(
+        self,
+        params,
+        prompts: Sequence[Sequence[int]],
+        slots: Sequence[int],
+        cursors: Sequence[int],
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Prefill only tokens[cursor:] of each prompt — the admission
+        path for prefix-shared requests. The shared pages already hold
+        positions [0, cursor) (alloc_shared mapped them and parked
+        cache.lengths at the cursor), so this runs ONE chunked-prefill
+        step over the unshared suffixes: the chunk core's staircase
+        mask with query_offset = cursor reads the shared pages through
+        the block table and is logit-identical to the monolithic
+        prefill (PR 10's bit-identity argument), and the sampled token
+        lands at each request's FULL prompt length — the same _pick key
+        the monolithic path uses. Returns (next_tokens [n],
+        last_logits [n, V]) in request order."""
+        t0 = time.perf_counter()
+        spec = self.cache.spec
+        if not prompts:
+            raise ValueError("prefill_suffix needs at least one prompt")
+        suffixes = []
+        for p, c in zip(prompts, cursors):
+            c = int(c)
+            if not 0 <= c < len(p):
+                raise ValueError(
+                    f"cursor {c} outside [0, {len(p)}) — at least one "
+                    "prompt token must be recomputed for sampling logits"
+                )
+            suffixes.append(list(p[c:]))
+        w = max(len(sfx) for sfx in suffixes)
+        tokens = np.zeros((spec.max_seqs, w), dtype=np.int32)
+        chunk_lens = np.zeros(spec.max_seqs, dtype=np.int32)
+        for sfx, s in zip(suffixes, slots):
+            tokens[s, : len(sfx)] = np.asarray(sfx, dtype=np.int32)
+            chunk_lens[s] = len(sfx)
+        nxt, logits = self.prefill_chunk(params, tokens, chunk_lens)
+        if self.telemetry is not None:
+            self.telemetry.tracer.complete(
+                "prefill_suffix",
+                "engine",
+                t0,
+                time.perf_counter(),
+                args={"prompts": len(prompts), "width": w},
+            )
+        return (
+            np.asarray([nxt[s] for s in slots]),
+            np.stack([logits[s] for s in slots]),
+        )
 
     # -- decode --------------------------------------------------------------
 
@@ -599,7 +741,7 @@ class GenerationEngine:
         return new_k, new_v, self._pick(logits, slots, lengths + 1), logits
 
     def _decode_impl_paged(
-        self, params, tokens, lengths, active, tables, ck, cv
+        self, params, tokens, lengths, active, tables, ck, cv, cks, cvs
     ):
         """Paged twin of _decode_impl. tables [max_seqs,
         max_pages_per_seq] int32 block tables. The new K/V row scatters
@@ -618,8 +760,10 @@ class GenerationEngine:
         spec = self.cache.spec
         ps = spec.page_size
         oob = spec.num_pages * ps
+        quant = getattr(self.cache, "quantized", False)
         new_k = dict(ck)
         new_v = dict(cv)
+        new_ks, new_vs = dict(cks), dict(cvs)
         page = jnp.take_along_axis(tables, (lengths // ps)[:, None], axis=1)[
             :, 0
         ]
@@ -635,20 +779,39 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
-            kc = row_update(ck[g], k)
-            vc = row_update(cv[g], v)
+            if quant:
+                kc, new_ks[g], _ = self._quant_scatter(
+                    ck[g], cks[g], k[:, 0], dest
+                )
+                vc, new_vs[g], _ = self._quant_scatter(
+                    cv[g], cvs[g], v[:, 0], dest
+                )
+                attn = paged_decode_attention(
+                    q, kc, vc, tables, lengths, kernel=self.decode_kernel,
+                    k_scale=new_ks[g], v_scale=new_vs[g],
+                )
+            else:
+                kc = row_update(ck[g], k)
+                vc = row_update(cv[g], v)
+                attn = paged_decode_attention(
+                    q, kc, vc, tables, lengths, kernel=self.decode_kernel
+                )
             new_k[g] = kc
             new_v[g] = vc
-            attn = paged_decode_attention(
-                q, kc, vc, tables, lengths, kernel=self.decode_kernel
-            )
             return [
                 mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
             ]
 
         logits = self._forward_logits(params, tokens, hook)[:, -1, :]
         slots = jnp.arange(lengths.shape[0])
-        return new_k, new_v, self._pick(logits, slots, lengths + 1), logits
+        return (
+            new_k,
+            new_v,
+            new_ks,
+            new_vs,
+            self._pick(logits, slots, lengths + 1),
+            logits,
+        )
 
     def decode_dispatch(
         self,
@@ -710,6 +873,9 @@ class GenerationEngine:
         # allocator table edits between iterations mutate behind the
         # async dispatch queue); the locals built above are fresh per
         # call and safe to hand over directly
+        scale_args = (
+            [self.cache.k_scale, self.cache.v_scale] if self.paged else []
+        )
         step_args = (
             params,
             dev_tokens[:, None],
@@ -718,11 +884,18 @@ class GenerationEngine:
             *args,
             self.cache.k,
             self.cache.v,
+            *scale_args,
         )
-        new_k, new_v, nxt, logits = self._dispatch(
-            "decode", lambda: self._decode_jit(*step_args)
-        )
-        self.cache.commit(new_k, new_v)
+        if self.paged:
+            new_k, new_v, new_ks, new_vs, nxt, logits = self._dispatch(
+                "decode", lambda: self._decode_jit(*step_args)
+            )
+            self.cache.commit(new_k, new_v, new_ks, new_vs)
+        else:
+            new_k, new_v, nxt, logits = self._dispatch(
+                "decode", lambda: self._decode_jit(*step_args)
+            )
+            self.cache.commit(new_k, new_v)
         self.cache.lengths[np.asarray(active_mask)] += 1
         # the in-flight window pins pages this step's snapshot tables
         # reference; decode_reconcile closes it
@@ -853,11 +1026,13 @@ class GenerationEngine:
         return new_k, new_v, logits
 
     def _verify_impl_paged(
-        self, params, tokens, lengths, draft_lens, tables, ck, cv
+        self, params, tokens, lengths, draft_lens, tables, ck, cv, cks, cvs
     ):
         """Paged twin of _verify_impl: rows route through the block
         tables into the flattened pools, attention gathers pages via
-        ops.attention.paged_verify_attention."""
+        ops.attention.paged_verify_attention. Under int8 pools the w
+        fresh rows quantize through `_quant_scatter` and the per-page
+        scales ride along to the attention gather."""
         import jax.numpy as jnp
 
         from flexflow_tpu.ops.attention import (
@@ -867,11 +1042,14 @@ class GenerationEngine:
         )
 
         spec = self.cache.spec
+        quant = getattr(self.cache, "quantized", False)
         dest = self._verify_scatter_dest(
             tokens.shape[1], lengths, draft_lens, tables, jnp
         )
         new_k = dict(ck)
         new_v = dict(cv)
+        new_ks = dict(cks)
+        new_vs = dict(cvs)
 
         def row_update(pool, new):
             flat = pool.reshape(-1, spec.num_heads, spec.head_dim)
@@ -884,19 +1062,45 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
-            kc = row_update(ck[g], k)
-            vc = row_update(cv[g], v)
-            new_k[g] = kc
-            new_v[g] = vc
-            attn = paged_verify_attention(
-                q, kc, vc, tables, lengths, kernel=self.decode_kernel
-            )
+            if quant:
+                kc, new_ks[g], _ = self._quant_scatter(
+                    ck[g],
+                    cks[g],
+                    k.reshape(-1, spec.num_heads, spec.head_dim),
+                    dest,
+                )
+                vc, new_vs[g], _ = self._quant_scatter(
+                    cv[g],
+                    cvs[g],
+                    v.reshape(-1, spec.num_heads, spec.head_dim),
+                    dest,
+                )
+                new_k[g] = kc
+                new_v[g] = vc
+                attn = paged_verify_attention(
+                    q,
+                    kc,
+                    vc,
+                    tables,
+                    lengths,
+                    kernel=self.decode_kernel,
+                    k_scale=new_ks[g],
+                    v_scale=new_vs[g],
+                )
+            else:
+                kc = row_update(ck[g], k)
+                vc = row_update(cv[g], v)
+                new_k[g] = kc
+                new_v[g] = vc
+                attn = paged_verify_attention(
+                    q, kc, vc, tables, lengths, kernel=self.decode_kernel
+                )
             return [
                 mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
             ]
 
         logits = self._forward_logits(params, tokens, hook)
-        return new_k, new_v, logits
+        return new_k, new_v, new_ks, new_vs, logits
 
     def verify_dispatch(
         self,
@@ -952,6 +1156,9 @@ class GenerationEngine:
         # snapshot() lengths/tables: the caller truncates the cache
         # right after the reconcile, and jnp.asarray's host read is
         # deferred behind the dispatch queue — see decode_dispatch()
+        scale_args = (
+            [self.cache.k_scale, self.cache.v_scale] if self.paged else []
+        )
         step_args = (
             params,
             jnp.asarray(tokens),
@@ -960,6 +1167,7 @@ class GenerationEngine:
             *args,
             self.cache.k,
             self.cache.v,
+            *scale_args,
         )
 
         def call():
@@ -967,8 +1175,14 @@ class GenerationEngine:
             # cleared cache re-traces with the dense attention core
             return self._verify_fn(w)(*step_args)
 
-        new_k, new_v, logits = self._dispatch("verify", call)
-        self.cache.commit(new_k, new_v)
+        if self.paged:
+            new_k, new_v, new_ks, new_vs, logits = self._dispatch(
+                "verify", call
+            )
+            self.cache.commit(new_k, new_v, new_ks, new_vs)
+        else:
+            new_k, new_v, logits = self._dispatch("verify", call)
+            self.cache.commit(new_k, new_v)
         self.cache.begin_inflight()
         return InflightStep(
             kind="verify",
@@ -1082,7 +1296,7 @@ class GenerationEngine:
 
     def _chunk_impl_paged(
         self, params, tokens, slot_ids, all_lengths, chunk_lens, tables,
-        ck, cv,
+        ck, cv, cks, cvs,
     ):
         """Paged twin of _chunk_impl: rows route through the block
         tables into the flattened pools, attention gathers pages via
@@ -1105,8 +1319,11 @@ class GenerationEngine:
         dest = self._verify_scatter_dest(
             w, lengths, chunk_lens, tables_g, jnp
         )
+        quant = getattr(self.cache, "quantized", False)
         new_k = dict(ck)
         new_v = dict(cv)
+        new_ks = dict(cks)
+        new_vs = dict(cvs)
 
         def row_update(pool, new):
             flat = pool.reshape(-1, spec.num_heads, spec.head_dim)
@@ -1119,13 +1336,39 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
-            kc = row_update(ck[g], k)
-            vc = row_update(cv[g], v)
-            new_k[g] = kc
-            new_v[g] = vc
-            attn = paged_verify_attention(
-                q, kc, vc, tables_g, lengths, kernel=self.decode_kernel
-            )
+            if quant:
+                kc, new_ks[g], _ = self._quant_scatter(
+                    ck[g],
+                    cks[g],
+                    k.reshape(-1, spec.num_heads, spec.head_dim),
+                    dest,
+                )
+                vc, new_vs[g], _ = self._quant_scatter(
+                    cv[g],
+                    cvs[g],
+                    v.reshape(-1, spec.num_heads, spec.head_dim),
+                    dest,
+                )
+                new_k[g] = kc
+                new_v[g] = vc
+                attn = paged_verify_attention(
+                    q,
+                    kc,
+                    vc,
+                    tables_g,
+                    lengths,
+                    kernel=self.decode_kernel,
+                    k_scale=new_ks[g],
+                    v_scale=new_vs[g],
+                )
+            else:
+                kc = row_update(ck[g], k)
+                vc = row_update(cv[g], v)
+                new_k[g] = kc
+                new_v[g] = vc
+                attn = paged_verify_attention(
+                    q, kc, vc, tables_g, lengths, kernel=self.decode_kernel
+                )
             return [
                 mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
             ]
@@ -1137,6 +1380,8 @@ class GenerationEngine:
         return (
             new_k,
             new_v,
+            new_ks,
+            new_vs,
             self._pick(last, slot_ids, lengths + chunk_lens),
             last,
         )
@@ -1200,6 +1445,9 @@ class GenerationEngine:
         # The batch compacts to the chunking slots (tokens/chunk_lens
         # rows); the jitted impl gathers its lengths/tables rows from
         # the full snapshots by slot_ids.
+        scale_args = (
+            [self.cache.k_scale, self.cache.v_scale] if self.paged else []
+        )
         step_args = (
             params,
             jnp.asarray(tokens[slot_ids]),
@@ -1209,6 +1457,7 @@ class GenerationEngine:
             *args,
             self.cache.k,
             self.cache.v,
+            *scale_args,
         )
 
         def call():
@@ -1216,8 +1465,14 @@ class GenerationEngine:
             # cleared cache re-traces with the dense attention core
             return self._chunk_fn((slot_ids.size, w))(*step_args)
 
-        new_k, new_v, nxt, last = self._dispatch("chunk", call)
-        self.cache.commit(new_k, new_v)
+        if self.paged:
+            new_k, new_v, new_ks, new_vs, nxt, last = self._dispatch(
+                "chunk", call
+            )
+            self.cache.commit(new_k, new_v, new_ks, new_vs)
+        else:
+            new_k, new_v, nxt, last = self._dispatch("chunk", call)
+            self.cache.commit(new_k, new_v)
         # prompt rows are committed by construction — advance the
         # cursors now so the NEXT chunk step dispatches against them
         active = chunk_lens > 0

@@ -47,6 +47,7 @@ chip's cache slice local.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import heapq
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -104,6 +105,7 @@ class KVCacheSpec:
     page_size: int = 0
     num_pages: int = 0
     itemsize: int = 4
+    kv_dtype: str = "fp32"  # "fp32" | "int8" (int8 is paged-only)
 
     def bucket(self, length: int) -> int:
         """Smallest bucket >= length (prefill pad target)."""
@@ -133,7 +135,15 @@ class KVCacheSpec:
 
     @property
     def bytes_per_layer(self) -> int:
-        return 2 * self.itemsize * self.total_rows * self.num_heads * self.head_dim
+        base = (
+            2 * self.itemsize * self.total_rows * self.num_heads * self.head_dim
+        )
+        if self.kv_dtype == "int8":
+            # fp32 dequant scales ride in a side pool, one per page per
+            # head for K and V each — they are part of the cache's HBM
+            # bill even though the token pools shrink 4x
+            base += 2 * 4 * self.num_pages * self.num_heads
+        return base
 
     @property
     def total_bytes(self) -> int:
@@ -379,7 +389,13 @@ class KVCache:
             "kv_free_heap_depth": 0,
             "kv_pages_reserved": 0,
             "kv_inflight_depth": self._inflight_depth,
+            "kv_prefix_pages_shared": 0,
         }
+
+    def telemetry_counters(self) -> Dict[str, int]:
+        """Series parity with PagedKVCache (the slot layout never
+        shares pages)."""
+        return {"kv_prefix_hits_total": 0, "kv_cow_copies_total": 0}
 
     def check_invariants(self, extra_free: int = 0) -> None:
         """Assert the slot bookkeeping is consistent — the chaos-harness
@@ -434,11 +450,30 @@ class PagedKVCache:
     gathers are masked by lengths, so sentinel entries are inert on
     device), per-slot lengths, and the reserve ledger that keeps
     admission preemption-free.
+
+    Prefix sharing (`prefix_cache=True`): full pages whose token content
+    (a chained blake2b over per-page tokens) matches a page a previous
+    request registered are MAPPED into a new request's block table
+    instead of recomputed — per-page refcounts track the aliasing, the
+    sharer's table entries are flagged shared, and the first divergent
+    write copies the page (copy-on-write inside `ensure_position`).
+    Pages leave the pool only when their refcount hits zero, at which
+    point their hash-index entry is invalidated too.
+
+    int8 quantization (`spec.kv_dtype == "int8"`): the token pools hold
+    int8 with one fp32 dequant scale per page per head in side pools
+    (`k_scale`/`v_scale`, `[num_pages, num_heads]`). The FIRST write
+    into a page fixes its scale (engine-side scatter-max); later rows
+    reuse it (values beyond ±127·scale clip — the documented
+    tolerance), so a page's bytes depend only on its token content and
+    prefix-shared pages stay bit-identical across requests.
     """
 
     paged = True
 
-    def __init__(self, spec: KVCacheSpec, dtype, shardings=None):
+    def __init__(
+        self, spec: KVCacheSpec, dtype, shardings=None, prefix_cache=False
+    ):
         import jax
         import jax.numpy as jnp
 
@@ -447,14 +482,33 @@ class PagedKVCache:
         _validate_page_geometry(
             spec.max_seqs, spec.max_len, spec.page_size, spec.num_pages
         )
+        self.quantized = spec.kv_dtype == "int8"
+        if self.quantized:
+            dtype = jnp.int8
         self.spec = dataclasses.replace(
             spec, itemsize=jnp.dtype(dtype).itemsize
         )
         spec = self.spec
         self.dtype = dtype
+        self.prefix_cache = bool(prefix_cache)
         shape = (spec.num_pages, spec.page_size, spec.num_heads, spec.head_dim)
         self.k: Dict[int, object] = {}
         self.v: Dict[int, object] = {}
+        # int8 side pools: fp32 scale per (page, head); scale == 0 marks
+        # a page whose first write has not landed yet (engine scatter-max
+        # claims it). Empty dicts under fp32 so the engine threads one
+        # pytree shape through the jitted steps either way.
+        self.k_scale: Dict[int, object] = {}
+        self.v_scale: Dict[int, object] = {}
+        scale_shardings = None
+        if shardings is not None and self.quantized:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # pools shard heads on dim 2; the [num_pages, heads] scale
+            # pools carry the same axis on dim 1
+            scale_shardings = NamedSharding(
+                shardings.mesh, PartitionSpec(None, shardings.spec[2])
+            )
         for g in spec.layer_guids:
             k = jnp.zeros(shape, dtype)
             v = jnp.zeros(shape, dtype)
@@ -463,6 +517,14 @@ class PagedKVCache:
                 v = jax.device_put(v, shardings)
             self.k[g] = k
             self.v[g] = v
+            if self.quantized:
+                ks = jnp.zeros((spec.num_pages, spec.num_heads), jnp.float32)
+                vs = jnp.zeros((spec.num_pages, spec.num_heads), jnp.float32)
+                if scale_shardings is not None:
+                    ks = jax.device_put(ks, scale_shardings)
+                    vs = jax.device_put(vs, scale_shardings)
+                self.k_scale[g] = ks
+                self.v_scale[g] = vs
         self.lengths = np.zeros(spec.max_seqs, dtype=np.int32)
         self.block_tables = np.full(
             (spec.max_seqs, spec.max_pages_per_seq),
@@ -486,6 +548,23 @@ class PagedKVCache:
         self._max_pages = np.zeros(spec.max_seqs, dtype=np.int64)
         self._reserved = 0
         self._optimistic: set = set()
+        # prefix sharing: per-page reference counts (re-derivable from
+        # the block tables — check_invariants does exactly that), the
+        # per-entry shared flag (True = this mapping aliases a page some
+        # other request wrote; first write through it must COW), the
+        # per-slot shared-mapping count, and the content-hash index
+        # (chained page key -> page id, with its exact inverse).
+        # "Owned" pages (_held - _shared) are what the reserve ledger
+        # prices: a shared mapping costs the pool nothing until it COWs.
+        self._refcounts = np.zeros(spec.num_pages, dtype=np.int32)
+        self._entry_shared = np.zeros(
+            (spec.max_seqs, spec.max_pages_per_seq), dtype=bool
+        )
+        self._shared = np.zeros(spec.max_seqs, dtype=np.int64)
+        self._prefix_index: Dict[bytes, int] = {}
+        self._page_keys: Dict[int, bytes] = {}
+        self.prefix_hits = 0  # admissions that mapped >= 1 shared page
+        self.cow_copies = 0  # divergent writes that copied a page
         # in-flight window (async dispatch): while a dispatched step's
         # deferred device reads may still reference the block tables it
         # was handed, pages released by free/truncate go to _limbo
@@ -617,7 +696,7 @@ class PagedKVCache:
         slot = heapq.heappop(self._free_slots)
         self._active.add(slot)
         for i in range(need_now):
-            self.block_tables[slot, i] = heapq.heappop(self._free_pages)
+            self._install_page(slot, i, heapq.heappop(self._free_pages))
         self._held[slot] = need_now
         if optimistic:
             # no growth reserve: _max_pages tracks _held so this slot
@@ -630,6 +709,226 @@ class PagedKVCache:
         self.lengths[slot] = 0
         return slot
 
+    # -- prefix sharing (hashed page cache + copy-on-write) ------------------
+
+    def _owned(self, slot: int) -> int:
+        """Pages this slot holds that came from the free pool (its
+        shared mappings alias pages other requests own)."""
+        return int(self._held[slot]) - int(self._shared[slot])
+
+    def _install_page(self, slot: int, pi: int, page: int) -> None:
+        """Map a freshly popped page into a table entry (refcount 1)."""
+        self.block_tables[slot, pi] = page
+        self._refcounts[page] = 1
+
+    def _incref(self, slot: int, pi: int, page: int) -> None:
+        """Map an already-live page as a SHARED entry of `slot`."""
+        self.block_tables[slot, pi] = page
+        self._refcounts[page] += 1
+        self._entry_shared[slot, pi] = True
+        self._shared[slot] += 1
+
+    def _decref_page(self, page: int) -> None:
+        """Drop one reference; the last owner unpublishes the page from
+        the hash index and releases it (through the in-flight limbo when
+        a dispatched step may still read it)."""
+        self._refcounts[page] -= 1
+        assert self._refcounts[page] >= 0
+        if self._refcounts[page] == 0:
+            key = self._page_keys.pop(page, None)
+            if key is not None and self._prefix_index.get(key) == page:
+                del self._prefix_index[key]
+            self._release_page(page)
+
+    def _decref_entry(self, slot: int, pi: int) -> None:
+        """Clear one block-table entry: sentinel the mapping, settle the
+        shared flag and held count, and decref the page."""
+        page = int(self.block_tables[slot, pi])
+        if page == self.spec.num_pages:
+            return
+        self.block_tables[slot, pi] = self.spec.num_pages
+        self._held[slot] -= 1
+        if self._entry_shared[slot, pi]:
+            self._entry_shared[slot, pi] = False
+            self._shared[slot] -= 1
+        self._decref_page(page)
+
+    @staticmethod
+    def _chain_key(prev: bytes, tokens) -> bytes:
+        """Key of a full page holding `tokens`, chained on the previous
+        page's key — equal keys mean equal page content AND equal prefix
+        up to this page, which is exactly what makes the page's KV rows
+        (a pure function of the tokens at and before it) reusable."""
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(np.asarray(tokens, dtype=np.int64).tobytes())
+        return h.digest()
+
+    def match_prefix(self, tokens: Sequence[int]) -> List[int]:
+        """Longest run of registered pages covering a prefix of `tokens`
+        (full pages only — partial pages are never shared). Read-only."""
+        pages: List[int] = []
+        if not self.prefix_cache:
+            return pages
+        ps = self.spec.page_size
+        key = b""
+        for i in range(len(tokens) // ps):
+            key = self._chain_key(key, tokens[i * ps : (i + 1) * ps])
+            page = self._prefix_index.get(key)
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
+    def register_prefix(self, slot: int, tokens: Sequence[int], upto) -> None:
+        """Publish `slot`'s full pages covering tokens[:upto] in the
+        hash index so later admissions can map them. Idempotent; only
+        pages whose content is fully written (upto capped at the slot's
+        visible length) are published, and a content collision dedupes
+        to the page already in the index."""
+        if not self.prefix_cache:
+            return
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        ps = self.spec.page_size
+        upto = min(int(upto), len(tokens), int(self.lengths[slot]))
+        key = b""
+        for i in range(upto // ps):
+            key = self._chain_key(key, tokens[i * ps : (i + 1) * ps])
+            page = int(self.block_tables[slot, i])
+            if page == self.spec.num_pages:
+                break
+            if key in self._prefix_index or page in self._page_keys:
+                continue
+            self._prefix_index[key] = page
+            self._page_keys[page] = key
+
+    def alloc_shared(
+        self,
+        tokens: Sequence[int],
+        prompt_len: Optional[int] = None,
+        total_len: Optional[int] = None,
+        optimistic: bool = False,
+    ) -> Optional[Tuple[int, int]]:
+        """Admit a sequence with prefix sharing: registered pages whose
+        chained content hash matches a prefix of `tokens` are MAPPED
+        (refcounted) instead of allocated, and the caller receives
+        `(slot, cursor)` — the cache cursor past the shared content, so
+        prefill recomputes only tokens[cursor:]. At least one token is
+        always left to recompute (the request needs sampling logits), so
+        a whole-prompt match gets cursor len(tokens)-1 and its first
+        write copy-on-writes the final shared page. `prompt_len` is the
+        prompt span allocated eagerly (0 under token-budget chunking —
+        chunks claim lazily); shared pages are mapped eagerly either
+        way. Falls back to plain `alloc` semantics when the prefix cache
+        is off (returns cursor 0). None when admission is refused."""
+        spec = self.spec
+        ntok = len(tokens)
+        if prompt_len is None:
+            prompt_len = ntok
+        total = max(ntok, prompt_len, total_len if total_len is not None else 0)
+        if total > spec.max_len:
+            raise ValueError(
+                f"sequence of {total} tokens exceeds max_len {spec.max_len}"
+            )
+        if not self.prefix_cache:
+            slot = self.alloc(prompt_len, total, optimistic=optimistic)
+            return None if slot is None else (slot, 0)
+        ps = spec.page_size
+        matched = self.match_prefix(tokens)
+        m = len(matched)
+        cursor = min(m * ps, max(0, ntok - 1))
+        # fresh pages popped now: the unshared remainder of the eager
+        # prompt span; worst-case pool draws over the slot's lifetime:
+        # every page from the cursor's page up to the total-length page
+        # (the cursor page itself COWs when it is still shared — the
+        # whole-prompt-match case)
+        fresh_now = max(0, self._pages_for(prompt_len) - m)
+        max_p = self._pages_for(total) - (cursor // ps)
+        need = fresh_now if optimistic else max_p
+        if (
+            not self._free_slots
+            or len(self._free_pages) - self._reserved < need
+        ):
+            return None
+        slot = heapq.heappop(self._free_slots)
+        self._active.add(slot)
+        for i, page in enumerate(matched):
+            self._incref(slot, i, page)
+        for i in range(m, m + fresh_now):
+            self._install_page(slot, i, heapq.heappop(self._free_pages))
+        self._held[slot] = m + fresh_now
+        if optimistic:
+            self._optimistic.add(slot)
+            self._max_pages[slot] = fresh_now  # == owned
+        else:
+            self._max_pages[slot] = max_p
+            self._reserved += max_p - fresh_now
+        self.lengths[slot] = cursor
+        if m:
+            self.prefix_hits += 1
+        return slot, cursor
+
+    def _cow_page(self, slot: int, pi: int) -> None:
+        """First divergent write into a shared mapping: take the page
+        over in place when this slot became its sole owner (unpublishing
+        the now-divergent content), otherwise pop a fresh page, copy the
+        shared page's rows (and int8 scales) across every layer pool,
+        and swap the mapping — readers holding the old page see it
+        untouched, and the functional pool threading orders the copy
+        before any later step's reads."""
+        page = int(self.block_tables[slot, pi])
+        if self._refcounts[page] > 1:
+            if slot in self._optimistic:
+                if len(self._free_pages) - self._reserved < 1:
+                    raise PagePoolExhausted(
+                        f"free-page pool exhausted: optimistic slot {slot} "
+                        f"needs a copy-on-write page but "
+                        f"{len(self._free_pages)} free - {self._reserved} "
+                        "reserved leaves none"
+                    )
+            elif not self._free_pages:
+                if self._limbo:
+                    raise PagePoolExhausted(
+                        f"free-page pool exhausted: {len(self._limbo)} pages "
+                        "pinned by an in-flight step — reconcile the "
+                        "pipeline to release them"
+                    )
+                raise PagePoolExhausted(
+                    "free-page pool exhausted despite the admission reserve "
+                    "— allocator invariant violated"
+                )
+            new = heapq.heappop(self._free_pages)
+            # functional rebind (fresh dicts, whole-attribute swap), not
+            # in-place entry mutation: any already-queued step read the
+            # OLD array objects, which the .at[].set() copies leave
+            # untouched — same discipline as commit()
+            nk, nv = dict(self.k), dict(self.v)
+            nks, nvs = dict(self.k_scale), dict(self.v_scale)
+            for g in self.spec.layer_guids:
+                nk[g] = nk[g].at[new].set(nk[g][page])
+                nv[g] = nv[g].at[new].set(nv[g][page])
+                if self.quantized:
+                    nks[g] = nks[g].at[new].set(nks[g][page])
+                    nvs[g] = nvs[g].at[new].set(nvs[g][page])
+            self.k, self.v = nk, nv
+            self.k_scale, self.v_scale = nks, nvs
+            self.block_tables[slot, pi] = new
+            self._refcounts[new] = 1
+            self._refcounts[page] -= 1
+            self.cow_copies += 1
+        else:
+            # sole owner now — the content is about to diverge, so the
+            # index must stop advertising it
+            key = self._page_keys.pop(page, None)
+            if key is not None and self._prefix_index.get(key) == page:
+                del self._prefix_index[key]
+        self._entry_shared[slot, pi] = False
+        self._shared[slot] -= 1
+        if slot in self._optimistic:
+            self._max_pages[slot] = self._owned(slot)
+        elif self._owned(slot) <= self._max_pages[slot]:
+            self._reserved -= 1
+
     def ensure_position(self, slot: int, pos: int) -> None:
         """Make position `pos` of `slot` writable, claiming the next page
         from the free list when the sequence crosses a page boundary.
@@ -637,11 +936,16 @@ class PagedKVCache:
         claim succeeds for any position inside the declared worst case;
         an optimistic slot's claim must additionally leave the reserve
         intact, and raises PagePoolExhausted when it cannot — the signal
-        the scheduler answers with preemption-by-recompute."""
+        the scheduler answers with preemption-by-recompute. A position
+        whose page is mapped but SHARED triggers the copy-on-write fork
+        here — every dispatch path claims its write positions through
+        this method, which is what makes it the single COW seam."""
         if slot not in self._active:
             raise ValueError(f"slot {slot} is not active")
         pi = pos // self.spec.page_size
         if self.block_tables[slot, pi] != self.spec.num_pages:
+            if self._entry_shared[slot, pi]:
+                self._cow_page(slot, pi)
             return
         if slot in self._optimistic:
             if len(self._free_pages) - self._reserved < 1:
@@ -650,9 +954,9 @@ class PagedKVCache:
                     f"needs a page but {len(self._free_pages)} free - "
                     f"{self._reserved} reserved leaves none"
                 )
-            self.block_tables[slot, pi] = heapq.heappop(self._free_pages)
+            self._install_page(slot, pi, heapq.heappop(self._free_pages))
             self._held[slot] += 1
-            self._max_pages[slot] = self._held[slot]
+            self._max_pages[slot] = self._owned(slot)
             return
         if not self._free_pages:
             if self._limbo:
@@ -665,9 +969,9 @@ class PagedKVCache:
                 "free-page pool exhausted despite the admission reserve — "
                 "allocator invariant violated"
             )
-        self.block_tables[slot, pi] = heapq.heappop(self._free_pages)
+        self._install_page(slot, pi, heapq.heappop(self._free_pages))
         self._held[slot] += 1
-        if self._held[slot] <= self._max_pages[slot]:
+        if self._owned(slot) <= self._max_pages[slot]:
             self._reserved -= 1
 
     def truncate(self, slot: int, new_len: int) -> None:
@@ -694,20 +998,15 @@ class PagedKVCache:
                 f"new_len {new_len} needs {keep} pages but slot {slot} "
                 f"holds {int(self._held[slot])}"
             )
-        sentinel = self.spec.num_pages
-        old_resv = max(0, int(self._max_pages[slot] - self._held[slot]))
+        old_resv = max(0, int(self._max_pages[slot]) - self._owned(slot))
         for pi in range(keep, self.spec.max_pages_per_seq):
-            p = int(self.block_tables[slot, pi])
-            if p != sentinel:
-                self._release_page(p)
-                self.block_tables[slot, pi] = sentinel
-                self._held[slot] -= 1
+            self._decref_entry(slot, pi)
         if slot in self._optimistic:
             # released pages return to the COMMON pool, not a reserve
-            self._max_pages[slot] = self._held[slot]
+            self._max_pages[slot] = self._owned(slot)
         else:
             self._reserved += (
-                max(0, int(self._max_pages[slot] - self._held[slot]))
+                max(0, int(self._max_pages[slot]) - self._owned(slot))
                 - old_resv
             )
         self.lengths[slot] = new_len
@@ -716,38 +1015,48 @@ class PagedKVCache:
         if slot not in self._active:
             raise ValueError(f"slot {slot} is not active")
         self._active.remove(slot)
-        sentinel = self.spec.num_pages
+        owned_before = self._owned(slot)
         for pi in range(self.spec.max_pages_per_seq):
-            p = int(self.block_tables[slot, pi])
-            if p != sentinel:
-                self._release_page(p)
-        self.block_tables[slot, :] = sentinel
+            self._decref_entry(slot, pi)
         if slot in self._optimistic:
             self._optimistic.discard(slot)
         else:
             self._reserved -= max(
-                0, int(self._max_pages[slot] - self._held[slot])
+                0, int(self._max_pages[slot]) - owned_before
             )
         self._held[slot] = 0
         self._max_pages[slot] = 0
         self.lengths[slot] = 0
         heapq.heappush(self._free_slots, slot)
 
-    def commit(self, new_k: Dict[int, object], new_v: Dict[int, object]):
-        """Swap in the pools a jitted step returned."""
+    def commit(
+        self,
+        new_k: Dict[int, object],
+        new_v: Dict[int, object],
+        new_k_scale: Optional[Dict[int, object]] = None,
+        new_v_scale: Optional[Dict[int, object]] = None,
+    ):
+        """Swap in the pools a jitted step returned (and, under int8,
+        the scale side pools the step's scatter-max may have claimed)."""
         self.k = dict(new_k)
         self.v = dict(new_v)
+        if new_k_scale is not None:
+            self.k_scale = dict(new_k_scale)
+        if new_v_scale is not None:
+            self.v_scale = dict(new_v_scale)
 
     def telemetry_gauges(self) -> Dict[str, float]:
         """Point-in-time allocator gauges for the telemetry sampler:
-        pages live in block tables (`Σ _held`), pages pinned in the
+        UNIQUE pages live in block tables (refcount >= 1 — a shared
+        mapping rides an already-live page, so it adds to
+        `kv_prefix_pages_shared`, not to live), pages pinned in the
         in-flight limbo list, free-heap depth, the reserve ledger, and
         pool occupancy. These are the SAME ledgers `check_invariants`
         audits, so live + pinned + free (+ injector-stolen) always
         covers the pool — the conservation law the KV-gauge tests
         re-derive from the block tables themselves."""
         spec = self.spec
-        live = int(self._held.sum())
+        live = int((self._refcounts > 0).sum())
         return {
             "kv_slots_active": len(self._active),
             "kv_slots_free": len(self._free_slots),
@@ -758,6 +1067,14 @@ class PagedKVCache:
             "kv_free_heap_depth": len(self._free_pages),
             "kv_pages_reserved": int(self._reserved),
             "kv_inflight_depth": self._inflight_depth,
+            "kv_prefix_pages_shared": int(self._shared.sum()),
+        }
+
+    def telemetry_counters(self) -> Dict[str, int]:
+        """Monotonic allocator counters for the telemetry sampler."""
+        return {
+            "kv_prefix_hits_total": self.prefix_hits,
+            "kv_cow_copies_total": self.cow_copies,
         }
 
     def check_invariants(self, extra_free: int = 0) -> None:
@@ -769,40 +1086,63 @@ class PagedKVCache:
         conservation check must count."""
         spec = self.spec
         sentinel = spec.num_pages
-        live: List[int] = []
+        refs = np.zeros(spec.num_pages, dtype=np.int64)
+        owners = np.zeros(spec.num_pages, dtype=np.int64)
         for s in range(spec.max_seqs):
             row = [int(p) for p in self.block_tables[s] if p != sentinel]
-            live.extend(row)
-            # per-slot ledger matches the table; free slots hold nothing
+            for pi in range(spec.max_pages_per_seq):
+                p = int(self.block_tables[s, pi])
+                if p == sentinel:
+                    # shared flags only mark real mappings
+                    assert not self._entry_shared[s, pi]
+                    continue
+                refs[p] += 1
+                if not self._entry_shared[s, pi]:
+                    owners[p] += 1
+            # per-slot ledgers match the table; free slots hold nothing
             assert len(row) == int(self._held[s])
+            assert int(self._entry_shared[s].sum()) == int(self._shared[s])
             if s not in self._active:
                 assert not row and self.lengths[s] == 0
             else:
                 # visible length fits in the held pages
                 assert int(self.lengths[s]) <= len(row) * spec.page_size
-        # no double allocation anywhere in the table
-        assert len(live) == len(set(live))
-        # conservation: live + free + in-flight limbo (+ injector-held)
-        # is the whole pool
+        # the refcount ledger re-derives exactly from the live block
+        # tables, and a multiply-referenced page has at most one OWNING
+        # (unshared) mapping — everyone else must COW before writing
+        assert np.array_equal(refs, self._refcounts.astype(np.int64))
+        assert (owners <= 1).all()
+        live = {p for p in range(spec.num_pages) if refs[p] > 0}
+        # conservation over UNIQUE pages: live + free + in-flight limbo
+        # (+ injector-held) is the whole pool; free/limbo pages carry no
+        # references
         limbo = [p for p, _ in self._limbo]
         assert len(limbo) == len(set(limbo))
-        assert set(live).isdisjoint(self._free_pages)
-        assert set(live).isdisjoint(limbo)
+        assert live.isdisjoint(self._free_pages)
+        assert live.isdisjoint(limbo)
         assert set(limbo).isdisjoint(self._free_pages)
         assert len(live) + len(self._free_pages) + len(limbo) + (
             extra_free
         ) == spec.num_pages
+        # the hash index only advertises live pages, bijectively with
+        # its reverse map
+        assert len(self._prefix_index) == len(self._page_keys)
+        for key, p in self._prefix_index.items():
+            assert self._page_keys.get(p) == key
+            assert refs[p] > 0
         # limbo pages only exist while an in-flight window is open
         assert self._inflight_depth >= 0
         if self._limbo:
             assert self._inflight_depth > 0
-        # the reserve ledger re-derives from the per-slot worst cases,
+        # the reserve ledger re-derives from the per-slot worst cases
+        # over OWNED pages (shared mappings cost the pool nothing until
+        # they COW — and their COW page is part of the worst case),
         # counting only reserve-admitted slots, and never promises pages
         # the pool doesn't have (limbo pages still honor the promise —
         # they return to the heap before any claim that needs them, the
         # async scheduler's drain-before-preempt rule)
         resv = sum(
-            max(0, int(self._max_pages[s] - self._held[s]))
+            max(0, int(self._max_pages[s]) - self._owned(s))
             for s in self._active
             if s not in self._optimistic
         )
@@ -813,7 +1153,7 @@ class PagedKVCache:
         # optimistic slots never carry a growth reserve
         for s in self._optimistic:
             assert s in self._active
-            assert int(self._max_pages[s]) == int(self._held[s])
+            assert int(self._max_pages[s]) == self._owned(s)
         # slot bookkeeping
         assert self._active.isdisjoint(self._free_slots)
         assert len(self._active) + len(self._free_slots) == spec.max_seqs
@@ -829,14 +1169,22 @@ class PagedKVCache:
         buckets: Optional[Sequence[int]] = None,
         page_size: int = 0,
         num_pages: int = 0,
+        kv_dtype: str = "fp32",
+        prefix_cache: bool = False,
     ) -> "PagedKVCache":
         """Derive geometry + shardings from a compiled FFModel. Defaults
         (page_size 0 / num_pages 0) pick the vLLM-style block size and a
         pool with EXACTLY the slot layout's capacity
         (max_seqs * max_len rows), so existing callers see identical
-        byte footprint and admission behavior."""
+        byte footprint and admission behavior. kv_dtype "int8" selects
+        the quantized pool variant (the dtype argument is ignored);
+        prefix_cache=True turns the hashed prefix-page index on."""
         import jax.numpy as jnp
 
+        if kv_dtype not in ("fp32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'fp32' or 'int8', got {kv_dtype!r}"
+            )
         guids, heads, head_dim, head_axis, executor = _derive_geometry(model)
         if page_size <= 0:
             page_size = default_page_size(max_len)
@@ -855,9 +1203,13 @@ class PagedKVCache:
             buckets=tuple(buckets) if buckets else default_buckets(max_len),
             page_size=page_size,
             num_pages=num_pages,
+            kv_dtype=kv_dtype,
         )
         if dtype is None:
             dtype = jnp.float32
         return PagedKVCache(
-            spec, dtype, shardings=_heads_sharding(executor, head_axis)
+            spec,
+            dtype,
+            shardings=_heads_sharding(executor, head_axis),
+            prefix_cache=prefix_cache,
         )

@@ -274,6 +274,11 @@ _STAT_FIELDS: Dict[str, object] = dict(
     # kernel-failure dense fallbacks (mirrored from the engine's ledger
     # at each iteration end)
     kernel_fallbacks=0,
+    # prefix-sharing page cache (paged layout with --prefix-cache;
+    # mirrored from the allocator's ledgers at each iteration end)
+    prefix_hits=0,  # admissions that mapped at least one shared page
+    prefix_pages_shared=0,  # live shared table entries (gauge-like)
+    cow_copies=0,  # copy-on-write page forks
     # per-request audit-log ring-buffer drops, summed at finalize
     events_dropped=0,
 )
@@ -801,8 +806,10 @@ class _SchedulerBase:
         (prompt + tokens already generated): the prefill rebuilds the
         KV it lost and its next token comes out of that same call."""
         optimistic = self.admission == "optimistic"
+        prefix = bool(getattr(self.cache, "prefix_cache", False))
         admitted: List[Request] = []
         seqs: List[List[int]] = []
+        cursors: List[int] = []
         while self.queue:
             if limit is not None and len(admitted) >= limit:
                 break
@@ -811,21 +818,38 @@ class _SchedulerBase:
             # chunked admission claims pages chunk by chunk (the step's
             # page claims), so nothing is needed NOW — the reserve
             # policy still gates on the same worst case either way
-            slot = self.cache.alloc(
-                0 if self.token_budget else len(seq),
-                len(req.prompt) + req.max_new_tokens,
-                optimistic=optimistic,
-            )
+            if prefix:
+                # prefix-sharing admission: registered pages matching a
+                # prefix of the sequence map into the slot's table and
+                # the cursor skips them (prefill recomputes the rest)
+                res = self.cache.alloc_shared(
+                    seq,
+                    prompt_len=0 if self.token_budget else len(seq),
+                    total_len=len(req.prompt) + req.max_new_tokens,
+                    optimistic=optimistic,
+                )
+                slot, cursor = (None, 0) if res is None else res
+            else:
+                slot = self.cache.alloc(
+                    0 if self.token_budget else len(seq),
+                    len(req.prompt) + req.max_new_tokens,
+                    optimistic=optimistic,
+                )
+                cursor = 0
             if slot is None:
                 break
             self.queue.popleft()
             req.slot = slot
             req.admit_iter = self._iter
             req.status = RequestStatus.RUNNING
-            req.log("admit", f"slot {slot}")
+            req.log(
+                "admit",
+                f"slot {slot}" + (f" shared {cursor}" if cursor else ""),
+            )
             self.running[req.slot] = req
             admitted.append(req)
             seqs.append(seq)
+            cursors.append(cursor)
         self.stats.peak_in_flight = max(
             self.stats.peak_in_flight, len(self.running)
         )
@@ -838,14 +862,40 @@ class _SchedulerBase:
                 # stream the sequence in. A preempted request re-admits
                 # here too: its recompute sequence (prompt + generated)
                 # replaces the old prefill_seq and the cursors restart.
-                for req, seq in zip(admitted, seqs):
+                # Shared admissions start their cursors AT the shared
+                # extent: alloc_shared left cache.lengths there, so the
+                # planner streams only the unshared suffix.
+                for req, seq, cur in zip(admitted, seqs, cursors):
                     req.prefill_seq = [int(t) for t in seq]
-                    req.prefill_pos = 0
-                    req.prefill_dispatched = 0
+                    req.prefill_pos = cur
+                    req.prefill_dispatched = cur
                 return admitted
             try:
-                nxt, last = self.engine.prefill(
-                    self.params, seqs, [r.slot for r in admitted]
+                plain = [i for i, c in enumerate(cursors) if c == 0]
+                shared = [i for i, c in enumerate(cursors) if c > 0]
+                rows: Dict[int, Tuple[int, np.ndarray]] = {}
+                if plain:
+                    nxt_p, last_p = self.engine.prefill(
+                        self.params,
+                        [seqs[i] for i in plain],
+                        [admitted[i].slot for i in plain],
+                    )
+                    for j, i in enumerate(plain):
+                        rows[i] = (int(nxt_p[j]), np.asarray(last_p[j]))
+                if shared:
+                    # shared slots recompute only tokens[cursor:] — the
+                    # mapped pages already hold the prefix KV rows
+                    nxt_s, last_s = self.engine.prefill_suffix(
+                        self.params,
+                        [seqs[i] for i in shared],
+                        [admitted[i].slot for i in shared],
+                        [cursors[i] for i in shared],
+                    )
+                    for j, i in enumerate(shared):
+                        rows[i] = (int(nxt_s[j]), np.asarray(last_s[j]))
+                nxt = np.array([rows[i][0] for i in range(len(admitted))])
+                last = np.stack(
+                    [rows[i][1] for i in range(len(admitted))]
                 )
             except Exception as e:  # fault isolation: the batch fails,
                 # in-flight slots are untouched and keep decoding
@@ -854,6 +904,12 @@ class _SchedulerBase:
                     self._fail(req, f"prefill failed: {e!r}")
                 return admitted
             self.stats.prefill_batches += 1
+            if prefix:
+                # publish AFTER the prefill returned: a failed dispatch
+                # must never leave hash keys pointing at pages whose
+                # writes never executed
+                for req, seq in zip(admitted, seqs):
+                    self.cache.register_prefix(req.slot, seq, len(seq))
             if self.injector is not None:
                 # np.array (copy): the step's output buffer is read-only
                 last = np.array(last)
@@ -1400,6 +1456,7 @@ class _SchedulerBase:
         step.iteration = self._iter
         step.participants = {s: self.running[s] for s in chunks}
         step.chunks = chunks
+        step.chunk_seqs = {s: self.running[s].prefill_seq for s in chunks}
         self._note_dispatch(step)
         self.stats.chunk_steps += 1
         self.stats.chunk_tokens += int(chunk_lens.sum())
@@ -1435,6 +1492,15 @@ class _SchedulerBase:
                 )
                 continue
             req.prefill_pos = start + size
+            if getattr(self.cache, "prefix_cache", False):
+                # progressive publication: every COMMITTED full page of
+                # the streaming prompt becomes matchable immediately —
+                # and only committed ones (a faulted chunk never
+                # publishes pages with unexecuted writes). Tokens and
+                # extent both come from the step record (FX105).
+                self.cache.register_prefix(
+                    slot, step.chunk_seqs[slot], start + size
+                )
             if final:
                 self._chunk_unlocked.add(slot)
                 self._emit(req, int(nxt[slot]))
@@ -1476,6 +1542,11 @@ class _SchedulerBase:
         self.stats.kernel_fallbacks = getattr(
             self.engine, "kernel_fallbacks", 0
         )
+        self.stats.prefix_hits = getattr(self.cache, "prefix_hits", 0)
+        self.stats.prefix_pages_shared = int(
+            getattr(self.cache, "_shared", np.zeros(1)).sum()
+        )
+        self.stats.cow_copies = getattr(self.cache, "cow_copies", 0)
         if self.debug_invariants:
             self.cache.check_invariants()
         if self._tele is not None:
@@ -1512,6 +1583,10 @@ class _SchedulerBase:
             self.injector.publish_metrics(tele.registry)
         if self.proposer is not None:
             for name, value in self.proposer.telemetry_counters().items():
+                tele.registry.counter(name).set_monotonic(value)
+        cache_counters = getattr(self.cache, "telemetry_counters", None)
+        if cache_counters is not None:
+            for name, value in cache_counters().items():
                 tele.registry.counter(name).set_monotonic(value)
         self.stats.publish_derived()
         tele.sample(self._iter)

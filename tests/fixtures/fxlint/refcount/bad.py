@@ -1,0 +1,24 @@
+"""Seeded FX106 violations: refcount-bearing structures mutated
+outside the blessed allocator helpers. With prefix sharing, a page's
+refcount is re-derived from every live block table, so a raw table
+write or free-heap mutation desynchronizes ownership."""
+
+import heapq
+
+
+class RogueScheduler:
+    def steal_page(self, cache, slot, pi):
+        # raw table write outside the allocator: the old page's
+        # refcount still counts this slot as an owner
+        cache.block_tables[slot, pi] = 7  # FX106
+
+    def drop_pages(self, cache, slot, upto):
+        for pi in range(upto):
+            page = int(cache.block_tables[slot, pi])
+            cache.block_tables[slot, pi] = cache.spec.num_pages  # FX106
+            # returning a possibly-shared page to the heap frees it
+            # under its sharers
+            heapq.heappush(cache._free_pages, page)  # FX106
+
+    def grab_free(self, cache):
+        return heapq.heappop(cache._free_pages)  # FX106

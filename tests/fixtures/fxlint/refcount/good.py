@@ -1,0 +1,48 @@
+"""FX106 negative space: the blessed allocator helpers own these
+mutations, reads are always sanctioned, and unrelated heaps don't
+match."""
+
+import heapq
+
+
+class WellBehavedAllocator:
+    def __init__(self):
+        # construction precedes sharing — init-time population is fine
+        self.block_tables = {}
+        self.block_tables[0, 0] = 3
+        self._free_pages = [1, 2]
+
+    def alloc(self, slot, pages):
+        # a blessed helper IS the mutation seam
+        for pi, _ in enumerate(pages):
+            self._install_page(slot, pi, heapq.heappop(self._free_pages))
+
+    def _install_page(self, slot, pi, page):
+        self.block_tables[slot, pi] = page
+
+    def _cow_page(self, slot, pi):
+        new = heapq.heappop(self._free_pages)
+        self.block_tables[slot, pi] = new
+
+    def ensure_position(self, slot, pos):
+        self.block_tables[slot, pos] = heapq.heappop(self._free_pages)
+
+    def free(self, slot):
+        heapq.heappush(self._free_pages, int(self.block_tables[slot, 0]))
+
+
+class InnocentBystander:
+    def read_table(self, cache, slot, pi):
+        # loads never match — only stores and heap mutations do
+        return int(cache.block_tables[slot, pi])
+
+    def own_heap(self):
+        # heap ops on plain locals / other attrs are out of scope
+        pq = []
+        heapq.heappush(pq, 3)
+        heapq.heappush(self_queue_like(), 1)
+        return heapq.heappop(pq)
+
+
+def self_queue_like():
+    return []

@@ -143,15 +143,15 @@ def test_seeded_chunk_progress_bypass_is_caught(tmp_path):
     with open(src_path) as f:
         src = f.read()
     seeded = src.replace(
-        "            req.prefill_pos = start + size\n"
-        "            if final:\n",
-        "            req.prefill_pos = start + size\n"
-        "            if req.prefill_pos >= len(req.prefill_seq):\n",
+        "            if final:\n"
+        "                self._chunk_unlocked.add(slot)\n",
+        "            if req.prefill_pos >= len(req.prefill_seq):\n"
+        "                self._chunk_unlocked.add(slot)\n",
         1,
     )
     assert seeded != src, (
-        "scheduler.py's chunk commit no longer advances prefill_pos "
-        "before the final-chunk emit — update this test alongside the "
+        "scheduler.py's chunk commit no longer gates the final-chunk "
+        "emit on the step record — update this test alongside the "
         "refactor"
     )
     (tmp_path / "scheduler.py").write_text(seeded)
@@ -167,6 +167,50 @@ def test_seeded_chunk_progress_bypass_is_caught(tmp_path):
         os.path.join(PACKAGE, "serving", "kv_cache.py"),
         clean / "kv_cache.py",
     )
+    assert run_rules([str(clean)], ["dispatch-race"]) == [], [
+        d.format() for d in run_rules([str(clean)], ["dispatch-race"])
+    ]
+
+
+def test_refcount_discipline_fixtures():
+    """FX106: block-table writes and free-heap mutations outside the
+    blessed allocator helpers — the discipline that keeps prefix-page
+    refcounts derivable from the live tables."""
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "refcount")], ["dispatch-race"])
+    )
+    # steal_page (table write), drop_pages (table write + heap push),
+    # grab_free (heap pop)
+    assert diags.get("bad.py", []).count("FX106") == 4, diags
+    # blessed helpers, __init__ population, reads, unrelated heaps silent
+    assert "good.py" not in diags
+
+
+def test_seeded_refcount_bypass_is_caught(tmp_path):
+    """Re-introduce the bug FX106 exists for: demote the COW helper to
+    an unblessed name so its table write and free-heap pop become raw
+    mutations — fxlint must flag both; the unmodified allocator stays
+    clean."""
+    src_path = os.path.join(PACKAGE, "serving", "kv_cache.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace("def _cow_page(", "def unblessed_cow_page(", 1)
+    assert seeded != src, (
+        "kv_cache.py no longer defines _cow_page — update this test "
+        "AND the CI fxlint self-test recipe together"
+    )
+    (tmp_path / "kv_cache.py").write_text(seeded)
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    hits = [d for d in diags if d.rule_id == "FX106"]
+    assert any("block_tables" in d.message for d in hits), [
+        d.format() for d in diags
+    ]
+    assert any("_free_pages" in d.message for d in hits), [
+        d.format() for d in diags
+    ]
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "kv_cache.py")
     assert run_rules([str(clean)], ["dispatch-race"]) == [], [
         d.format() for d in run_rules([str(clean)], ["dispatch-race"])
     ]

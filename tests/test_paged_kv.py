@@ -174,12 +174,18 @@ def _check_allocator_invariants(cache, injector=None):
         for p in row
         if p != spec.num_pages
     ]
-    # no double allocation: a page appears in at most one table entry
-    assert len(live) == len(set(live))
-    # free-list conservation: free + held (+ injector-stolen) = pool
-    assert set(live).isdisjoint(cache._free_pages)
-    assert len(live) + cache.num_free_pages + extra == spec.num_pages
-    assert cache.pages_in_use == len(live) + extra
+    # no double allocation: a page's table multiplicity is exactly its
+    # refcount — 1 everywhere unless the prefix cache shared it
+    refs = getattr(cache, "_refcounts", None)
+    for p in set(live):
+        expect = int(refs[p]) if refs is not None else 1
+        assert live.count(p) == expect, (p, live.count(p), expect)
+    # free-list conservation over UNIQUE pages: free + held
+    # (+ injector-stolen) = pool
+    uniq = set(live)
+    assert uniq.isdisjoint(cache._free_pages)
+    assert len(uniq) + cache.num_free_pages + extra == spec.num_pages
+    assert cache.pages_in_use == len(uniq) + extra
     # the reserve never promises pages the pool doesn't have
     assert 0 <= cache._reserved <= cache.num_free_pages + extra
 
