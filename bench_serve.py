@@ -75,6 +75,13 @@ against the checked-in schemas, require the trace to SHOW dispatch N+1
 overlapping step N's in-flight window, and hold the rolling-window p95
 TTFT to exact agreement with post-hoc latency_percentiles.
 
+--pod mode (writes BENCH_POD.json): pod-scale capacity — peak
+concurrent requests on a 4-way host-partitioned page pool
+(--serve-hosts, serving/distributed.py) vs the single-host engine at
+an EQUAL PER-HOST page budget. Hosts are simulated (one process,
+per-host admission views); capacity must scale >= 3x at 4 hosts —
+EXIT NONZERO on miss.
+
 The default workload is the flagship Transformer geometry (12 layers,
 hidden 1024, 16 heads — transformer.cc:79-85) recast as a decoder LM;
 `--smoke` shrinks it for CPU CI.
@@ -480,6 +487,87 @@ def run_prefix(
         # int8+prefix / fp32 CPU decode throughput at equal batch
         # (parity floor: 0.95)
         "throughput_ratio": round(tps["int8_prefix"] / tps["fp32"], 3),
+    }
+
+
+def run_pod(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    hosts: int = 4,
+):
+    """Pod capacity scaling (writes BENCH_POD.json): peak concurrent
+    requests on a `hosts`-way host-partitioned page pool vs the
+    single-host engine at an EQUAL PER-HOST page budget.
+
+    The single-host baseline runs today's engine (no placement) over B
+    pages; the pod run sets --serve-hosts so build_scheduler applies a
+    serving placement and partitions hosts*B pages into per-host free
+    views (serving/distributed.py). Requests hold a fixed worst case of
+    two pages each (one-page prompt + a tail that may cross the page
+    boundary), so admission capacity is pages-bound on every host and
+    peak_in_flight should scale ~linearly with the simulated host count
+    (acceptance floor: 3x at hosts=4). Hosts are SIMULATED: one process,
+    per-host admission views — the CPU CI posture; a real pod replaces
+    the simulation with jax.process_count() partitions."""
+    from flexflow_tpu.serving import (
+        Request,
+        ServeConfig,
+        build_scheduler,
+        default_page_size,
+    )
+
+    page_size = default_page_size(max_len)
+    # per-host budget: the pages the single-host smoke geometry carries
+    pages_per_host = max_seqs * max_len // page_size
+
+    def requests(n):
+        # one-page prompts + 2 generated tokens: worst case 2 pages per
+        # request under the reserve admission policy, on every host
+        return [
+            Request(
+                rid=i,
+                prompt=[(i * 7 + j + 1) % vocab for j in range(page_size)],
+                max_new_tokens=2,
+            )
+            for i in range(n)
+        ]
+
+    peak, tps = {}, {}
+    for nh in (1, hosts):
+        model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+        pages = pages_per_host * nh
+        slots = pages  # slots never bind; the page pool is the constraint
+        serve = ServeConfig(
+            max_seqs=slots, max_seq_len=max_len, kv_layout="paged",
+            kv_page_size=page_size, kv_pages=pages,
+            serve_hosts=nh if nh > 1 else 0,
+        )
+        sched, _, cache = build_scheduler(model, serve)
+        assert cache.num_hosts == nh
+        sched.run(requests(2 * slots))
+        peak[nh] = sched.stats.peak_in_flight
+        tps[nh] = sched.stats.tokens_per_s
+
+    ratio = peak[hosts] / max(1, peak[1])
+    return {
+        "metric": f"serve_pod_capacity_{layers}L_{hidden}h_{hosts}hosts",
+        "value": round(ratio, 3),
+        "unit": "x_peak_concurrent_requests",
+        # concurrency over the single-host engine at equal per-host
+        # pages (acceptance floor: 3x at hosts=4)
+        "vs_baseline": round(ratio, 3),
+        "hosts": hosts,
+        "page_size": page_size,
+        "pages_per_host": pages_per_host,
+        "single_host_peak_in_flight": peak[1],
+        "pod_peak_in_flight": peak[hosts],
+        "single_host_tokens_per_s": round(tps[1], 2),
+        "pod_tokens_per_s": round(tps[hosts], 2),
     }
 
 
@@ -1294,6 +1382,8 @@ def main():
             mode = "chunked"
         elif a == "--prefix":
             mode = "prefix"
+        elif a == "--pod":
+            mode = "pod"
         elif a == "--telemetry":
             mode = "telemetry"
         elif a == "--serve-async":
@@ -1381,6 +1471,18 @@ def main():
             raise SystemExit(
                 f"int8+prefix regressed decode throughput: "
                 f"{result['throughput_ratio']}x fp32 paged (floor 0.95x)"
+            )
+    elif mode == "pod":
+        result = run_pod(**args)
+        with open(os.path.join(here, "BENCH_POD.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        if result["vs_baseline"] < 3.0:
+            raise SystemExit(
+                f"pod serving missed the capacity gate: "
+                f"{result['vs_baseline']}x peak concurrent requests at "
+                f"equal per-host pages over {result['hosts']} simulated "
+                f"hosts (floor 3.0x)"
             )
     elif mode == "telemetry":
         result = run_telemetry(**args)

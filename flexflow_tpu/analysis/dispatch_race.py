@@ -72,7 +72,7 @@ findings go to the baseline):
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from flexflow_tpu.analysis.diagnostics import (
     Diagnostic,
@@ -302,8 +302,10 @@ def _refcount_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
     """(description, line, offender) for refcount-bearing mutations
     outside the blessed allocator helpers: a subscript store into a
     ``block_tables`` attribute, or a ``heapq.heappush``/``heappop``
-    whose argument reaches a ``_free_pages`` attribute. Module-level
-    code reports under the pseudo-name '<module>'."""
+    whose argument reaches a ``_free_pages`` attribute (or a
+    ``_free_pages_h`` per-host heap — the pod-serving partition of the
+    same pool). Module-level code reports under the pseudo-name
+    '<module>'."""
     found: List[Tuple[str, int, str]] = []
 
     def is_bt_store(node: ast.AST) -> bool:
@@ -321,20 +323,20 @@ def _refcount_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
                 return True
         return False
 
-    def is_heap_op(node: ast.AST) -> bool:
+    def heap_op_attr(node: ast.AST) -> Optional[str]:
         if not (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
             and node.func.attr in ("heappush", "heappop")
         ):
-            return False
+            return None
         for arg in node.args:
             for sub in ast.walk(arg):
                 if isinstance(sub, ast.Attribute) and (
-                    sub.attr == "_free_pages"
+                    sub.attr in ("_free_pages", "_free_pages_h")
                 ):
-                    return True
-        return False
+                    return sub.attr
+        return None
 
     def visit(node: ast.AST, owner: str) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -345,10 +347,12 @@ def _refcount_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
             found.append(
                 ("writes a 'block_tables' entry", node.lineno, owner)
             )
-        elif is_heap_op(node):
-            found.append(
-                ("mutates the '_free_pages' heap", node.lineno, owner)
-            )
+        else:
+            heap = heap_op_attr(node)
+            if heap is not None:
+                found.append(
+                    (f"mutates the '{heap}' heap", node.lineno, owner)
+                )
         for child in ast.iter_child_nodes(node):
             visit(child, owner)
 

@@ -29,6 +29,20 @@ lowering will rely on, producing typed diagnostics instead:
 * **FX308** unknown-op — a strategy file references an op name the
   current graph does not contain.
 
+Serving placement docs (``kind: "serving"``, the files
+``FFModel.compile_for_serving`` exports via ``--serve-export-strategy``)
+replay through `validate_serving_placement_doc` instead:
+
+* **FX310** bad-serving-mesh — the (data, model) mesh is malformed:
+  axes are not exactly ``["data", "model"]``, sizes disagree with
+  dp/tp, or a size/host count is below 1.
+* **FX311** tp-heads-mismatch — tp does not divide ``num_heads``
+  (head-sharded attention weights and K/V pools split the heads dim).
+* **FX312** host-shard-mismatch — the page-pool or slot partition does
+  not tile across the host count (``num_pages % num_hosts``,
+  ``max_seqs % num_hosts``, or a recorded per-host count that does not
+  multiply back).
+
 ``FFModel.compile()`` runs the graph validator after the final shape
 propagation and raises `StrategyValidationError` (a ``ValueError``
 carrying ``.diagnostics``) on errors — before any XLA lowering. The
@@ -57,6 +71,9 @@ RULES = {
     "FX306": "unknown strategy or site kind",
     "FX307": "degree or mesh axis size below 1",
     "FX308": "strategy file references an unknown op",
+    "FX310": "serving placement mesh is malformed",
+    "FX311": "serving tp degree does not divide the attention head count",
+    "FX312": "serving page-pool/slot shards do not match the host count",
 }
 
 _DOC_KINDS = ("tp", "seq", "spatial", "pipeline", "mixed")
@@ -220,6 +237,112 @@ def validate_graph_strategy(
     return diags
 
 
+def validate_serving_placement_doc(
+    doc: Dict,
+    num_devices: Optional[int] = None,
+) -> List[StrategyDiagnostic]:
+    """Replay the validator over a serving placement document
+    (``kind: "serving"``, exported by ``FFModel.compile_for_serving``
+    via ``--serve-export-strategy``; serving/distributed.py
+    ``ServingPlacement.to_doc``). Checks the (data, model) mesh shape
+    (FX310), tp | num_heads (FX311), and that the page-pool and slot
+    partitions tile across the host count (FX312)."""
+    diags: List[StrategyDiagnostic] = []
+
+    def _int(value, default=0):
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            return default
+
+    dp = _int(doc.get("dp"), 0)
+    tp = _int(doc.get("tp"), 0)
+    num_hosts = _int(doc.get("num_hosts"), 0)
+    num_heads = _int(doc.get("num_heads"), 0)
+
+    axes = list(doc.get("mesh_axes") or [])
+    sizes = [_int(s) for s in (doc.get("mesh_sizes") or [])]
+    if axes != ["data", "model"]:
+        diags.append(
+            StrategyDiagnostic(
+                "FX310",
+                "error",
+                "mesh_axes",
+                f"serving mesh axes must be ['data', 'model'], got {axes}",
+            )
+        )
+    if sizes != [dp, tp]:
+        diags.append(
+            StrategyDiagnostic(
+                "FX310",
+                "error",
+                "mesh_sizes",
+                f"mesh_sizes {sizes} disagree with dp={dp}, tp={tp}",
+            )
+        )
+    for name, value in (("dp", dp), ("tp", tp), ("num_hosts", num_hosts)):
+        if value < 1:
+            diags.append(
+                StrategyDiagnostic(
+                    "FX310",
+                    "error",
+                    name,
+                    f"{name}={value} (must be >= 1)",
+                )
+            )
+    if num_devices is not None and dp >= 1 and tp >= 1:
+        if dp * tp > num_devices:
+            diags.append(
+                StrategyDiagnostic(
+                    "FX305",
+                    "error",
+                    "",
+                    f"serving mesh (data={dp}, model={tp}) needs "
+                    f"{dp * tp} devices, machine has {num_devices}",
+                )
+            )
+    if tp >= 1 and num_heads >= 1 and num_heads % tp:
+        diags.append(
+            StrategyDiagnostic(
+                "FX311",
+                "error",
+                "tp",
+                f"tp={tp} does not divide num_heads={num_heads}",
+            )
+        )
+
+    def _check_partition(section, total_key, per_host_key):
+        block = doc.get(section)
+        if not block or num_hosts < 1:
+            return
+        total = _int(block.get(total_key), 0)
+        per_host = _int(block.get(per_host_key), -1)
+        if total % num_hosts:
+            diags.append(
+                StrategyDiagnostic(
+                    "FX312",
+                    "error",
+                    section,
+                    f"{total_key}={total} is not divisible by "
+                    f"num_hosts={num_hosts}",
+                )
+            )
+        elif per_host >= 0 and per_host * num_hosts != total:
+            diags.append(
+                StrategyDiagnostic(
+                    "FX312",
+                    "error",
+                    section,
+                    f"{per_host_key}={per_host} x num_hosts={num_hosts} "
+                    f"!= {total_key}={total}",
+                )
+            )
+
+    _check_partition("page_pool", "num_pages", "pages_per_host")
+    _check_partition("slots", "max_seqs", "slots_per_host")
+    return diags
+
+
 def validate_strategy_doc(
     doc: Dict,
     graph=None,
@@ -227,9 +350,13 @@ def validate_strategy_doc(
 ) -> List[StrategyDiagnostic]:
     """Replay the validator over an exported strategy JSON document
     (search/strategy_io format) — the ``fxlint --strategy`` mode. With
-    a graph, additionally checks site op names and dp divisibility."""
-    diags: List[StrategyDiagnostic] = []
+    a graph, additionally checks site op names and dp divisibility.
+    Serving placement docs (``kind: "serving"``) route to
+    `validate_serving_placement_doc`."""
     kind = doc.get("kind", "tp")
+    if kind == "serving":
+        return validate_serving_placement_doc(doc, num_devices=num_devices)
+    diags: List[StrategyDiagnostic] = []
     if kind not in _DOC_KINDS:
         diags.append(
             StrategyDiagnostic(

@@ -172,6 +172,17 @@ class FFConfig:
     serve_slo_ttft_ms: float = 0.0
     serve_slo_itl_ms: float = 0.0
     serve_telemetry: bool = False
+    # pod-scale serving (serving/distributed.py): --serve-mesh "dp,tp"
+    # applies that (data, model) serving mesh at compile_for_serving
+    # ("" = search one when compile_for_serving runs; serving without
+    # compile_for_serving keeps inheriting the training sharding),
+    # --serve-hosts partitions slots/pages across N host views (0 =
+    # process count on pods, else the data-axis degree; >1 on the slot
+    # KV layout is rejected), --serve-export-strategy writes the
+    # applied placement doc (fxlint strategy-validate input)
+    serve_mesh: str = ""
+    serve_hosts: int = 0
+    serve_export_strategy: str = ""
 
     @property
     def num_devices(self) -> int:
@@ -335,6 +346,12 @@ class FFConfig:
                 cfg.serve_slo_itl_ms = float(take())
             elif a == "--serve-telemetry":
                 cfg.serve_telemetry = True
+            elif a == "--serve-mesh":
+                cfg.serve_mesh = take()
+            elif a == "--serve-hosts":
+                cfg.serve_hosts = int(take())
+            elif a == "--serve-export-strategy":
+                cfg.serve_export_strategy = take()
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

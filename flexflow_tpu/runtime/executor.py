@@ -206,6 +206,32 @@ class Executor:
             params[guid] = ws
         return params
 
+    def reshard_params(self, params, sharding_fn):
+        """Re-place a live param tree under NEW shardings — the
+        compile-for-serving path, where the serving (data, model) mesh
+        differs from the training mesh the weights were initialized on.
+        `sharding_fn(node, weight_index, wshape)` returns the target
+        `jax.sharding.Sharding` for each weight, or None to leave that
+        array untouched. Arrays round-trip through host memory (they
+        must be addressable: single-process, or restored host-replicated
+        checkpoints on pods) and re-place through
+        `multihost.place_array` so multi-process runs materialize only
+        locally-owned shards."""
+        from flexflow_tpu.runtime import multihost
+
+        out: Dict[int, List[jnp.ndarray]] = {}
+        for guid, ws in params.items():
+            node = self.graph.nodes[guid]
+            new_ws = []
+            for i, arr in enumerate(ws):
+                sh = sharding_fn(node, i, node.weight_shapes[i])
+                if sh is None:
+                    new_ws.append(arr)
+                else:
+                    new_ws.append(multihost.place_array(np.asarray(arr), sh))
+            out[guid] = new_ws
+        return out
+
     def export_host_params(self, params):
         """Params in the on-disk checkpoint layout (per-guid). The base
         executor's storage IS that layout (copied, so callers can edit

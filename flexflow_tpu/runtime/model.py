@@ -1365,6 +1365,113 @@ class FFModel:
             draft_model=draft_model,
         )
 
+    def compile_for_serving(
+        self,
+        serve_config=None,
+        dp: Optional[int] = None,
+        tp: Optional[int] = None,
+        num_hosts: Optional[int] = None,
+        verbose: bool = False,
+    ):
+        """Apply a (data, model) SERVING mesh to the compiled model —
+        head-sharded attention weights and (via kv_cache.from_model) K/V
+        pools as NamedShardings on the mesh `serving/distributed.py`
+        builds through `runtime/multihost` (outer axis on DCN, inner on
+        ICI) — instead of inheriting the training strategy's sharding.
+
+        Mesh selection: explicit `dp`/`tp` args, else the config's
+        ``--serve-mesh dp,tp`` flag, else `search_serving_strategy`'s
+        winner (which is then recorded as *applied* rather than
+        inherited — the explain/export path reports the mesh the engine
+        actually executes). ``--serve-hosts`` (or `num_hosts`) sets the
+        scheduler's host-partition count; it defaults to the process
+        count on real pods and to dp for simulated-host CPU runs.
+
+        Returns the `ServingPlacement`; also stored as
+        `self.serving_placement`, where `serving.api.build_scheduler`
+        and `KVCache/PagedKVCache.from_model` pick it up."""
+        from flexflow_tpu.core.types import OperatorType
+        from flexflow_tpu.serving import distributed as dserve
+
+        if self.executor is None:
+            raise RuntimeError("call compile() before compile_for_serving()")
+        cfg = self.config
+        sc = serve_config  # a ServeConfig overrides the FFConfig mirror
+
+        def knob(sc_name, cfg_name, default):
+            if sc is not None:
+                return getattr(sc, sc_name)
+            return getattr(cfg, cfg_name, default)
+
+        source = "flag"
+        sr = None
+        if dp is None or tp is None:
+            spec = dserve.parse_serve_mesh(knob("serve_mesh", "serve_mesh", ""))
+            if spec is not None:
+                dp, tp = spec
+            else:
+                from flexflow_tpu.search.auto import search_serving_strategy
+
+                sr = search_serving_strategy(
+                    self,
+                    batch_size=max(1, knob("max_seqs", "serve_max_seqs", 8)),
+                )
+                dp, tp = sr.dp, sr.tp
+                source = "searched"
+        if num_hosts is None:
+            num_hosts = knob("serve_hosts", "serve_hosts", 0) or None
+        placement = dserve.build_placement(
+            self, dp, tp, num_hosts=num_hosts, mesh_source=source
+        )
+
+        # cache geometry (the from_model defaults) — validated here so a
+        # bad --serve-mesh fails before any device work, and exported in
+        # the placement doc for fxlint strategy-validate
+        max_seqs = knob("max_seqs", "serve_max_seqs", 8)
+        max_seq_len = knob("max_seq_len", "serve_max_seq_len", 256)
+        num_pages = None
+        if knob("kv_layout", "serve_kv_layout", "paged") == "paged":
+            from flexflow_tpu.serving.kv_cache import default_page_size
+
+            page_size = knob(
+                "kv_page_size", "serve_kv_page_size", 0
+            ) or default_page_size(max_seq_len)
+            num_pages = knob("kv_pages", "serve_kv_pages", 0) or (
+                max_seqs * max_seq_len // page_size
+            )
+            placement.validate_geometry(max_seqs, num_pages)
+
+        def _serving_sharding(node, i, wshape):
+            if node.op_type == OperatorType.MULTIHEAD_ATTENTION:
+                ndim = sum(1 for d in wshape.dims if not d.is_replica_dim)
+                if i in (0, 1, 2):  # wq/wk/wv: (embed, heads, head_dim)
+                    return placement.head_sharding(1, ndim)
+                if i in (3, 4, 5, 6):  # wo / bq/bk/bv: heads-major
+                    return placement.head_sharding(0, ndim)
+            return placement.replicated()  # bo + every non-attention op
+
+        self.params = self.executor.reshard_params(
+            self.params, _serving_sharding
+        )
+        self.serving_placement = placement
+        if sr is not None:
+            sr.mesh_execution = "applied"
+            self.serve_search_result = sr
+            if verbose or cfg.search_explain:
+                print(f"[serve-search] {sr.describe()}")
+        if verbose or cfg.search_explain:
+            print(f"[serve-mesh] {placement.describe()}")
+        export = getattr(cfg, "serve_export_strategy", "")
+        if export:
+            import json
+
+            doc = placement.to_doc(max_seqs=max_seqs, num_pages=num_pages)
+            if sr is not None:
+                doc["search"] = sr.to_doc()
+            with open(export, "w") as f:
+                json.dump(doc, f, indent=2)
+        return placement
+
     def zero_gradients(self):
         pass  # gradients are functional; nothing to zero
 

@@ -78,22 +78,51 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
-def global_mesh(axis_names: Sequence[str], axis_sizes: Sequence[int]):
+def global_mesh(
+    axis_names: Sequence[str],
+    axis_sizes: Sequence[int],
+    devices=None,
+):
     """Build a Mesh over ALL processes' devices with DCN-friendly
     placement: `mesh_utils.create_device_mesh` keeps ICI neighbors
     adjacent on the inner axes, so the OUTERMOST axis (by convention the
     "data" axis — gradient all-reduce tolerates DCN latency, activations
     do not) is the one crossing hosts. The scaling-mesh recipe the
     reference approximates with its node-major MachineViews
-    (machine_view.h:62-96)."""
+    (machine_view.h:62-96). `devices` restricts the mesh to an explicit
+    device list (serving meshes may use a subset of the machine);
+    `create_device_mesh` requires len(devices) == prod(axis_sizes)."""
     import jax
     from jax.experimental import mesh_utils
     from jax.sharding import Mesh
 
-    devices = mesh_utils.create_device_mesh(
-        tuple(axis_sizes), devices=jax.devices()
+    if devices is None:
+        devices = jax.devices()
+    grid = mesh_utils.create_device_mesh(
+        tuple(axis_sizes), devices=devices
     )
-    return Mesh(devices, tuple(axis_names))
+    return Mesh(grid, tuple(axis_names))
+
+
+def place_array(value, sharding=None, multi: Optional[bool] = None):
+    """Place ONE host array onto devices — the single-array core of
+    `place_batch`, exposed so the serving placement layer
+    (serving/distributed.py) routes KV pools and scheduler-assembled
+    global batches through the same path. multi defaults to "is this a
+    multi-process run"; when true every process passes the SAME global
+    value and only the locally-owned shards materialize."""
+    import jax
+
+    if multi is None:
+        multi = jax.process_count() > 1
+    if sharding is None:
+        return jax.device_put(value)
+    if multi:
+        g = np.asarray(value)
+        return jax.make_array_from_callback(
+            g.shape, sharding, lambda idx: g[idx]
+        )
+    return jax.device_put(value, sharding)
 
 
 def place_batch(
@@ -117,15 +146,9 @@ def place_batch(
     for name, arr in batch.items():
         if name in shapes:
             sharding = executor.sharding_for(shapes[name])
-            if multi:
-                g = np.asarray(arr)
-                out[name] = jax.make_array_from_callback(
-                    g.shape, sharding, lambda idx, g=g: g[idx]
-                )
-            else:
-                out[name] = jax.device_put(arr, sharding)
+            out[name] = place_array(arr, sharding, multi=multi)
         else:
-            out[name] = jax.device_put(arr)
+            out[name] = place_array(arr)
     return out
 
 

@@ -765,10 +765,33 @@ class ServingSearchResult:
         self.page_size = page_size
         self.max_in_flight = max_in_flight
         self.max_in_flight_reserve = max_in_flight_reserve
+        # Which mesh the engine will ACTUALLY execute. The search alone
+        # does not apply anything — serving inherits the training
+        # strategy's sharding unless `FFModel.compile_for_serving` flips
+        # this to "applied" after placing weights and pools on the
+        # searched mesh. Exported docs and --explain carry it so the
+        # explain path cannot report a mesh the runtime ignored.
+        self.mesh_execution = "inherited"
 
     @property
     def tokens_per_s(self) -> float:
         return self.batch / self.cost.step_time if self.cost.step_time else 0.0
+
+    def to_doc(self) -> dict:
+        """Exportable summary of the search winner (embedded in the
+        serving placement doc by compile_for_serving)."""
+        return {
+            "kind": "serving-search",
+            "dp": self.dp,
+            "tp": self.tp,
+            "batch": self.batch,
+            "kv_len": self.kv_len,
+            "page_size": self.page_size,
+            "step_time_us": self.cost.step_time * 1e6,
+            "max_in_flight": self.max_in_flight,
+            "max_in_flight_reserve": self.max_in_flight_reserve,
+            "mesh_execution": self.mesh_execution,
+        }
 
     def describe(self) -> str:
         layout = f", pages of {self.page_size}" if self.page_size else ""
@@ -780,7 +803,8 @@ class ServingSearchResult:
         if self.max_in_flight_reserve is not None:
             fit += f" ({self.max_in_flight_reserve} under reserve admission)"
         return (
-            f"serving mesh(data={self.dp}, model={self.tp}), batch "
+            f"serving mesh(data={self.dp}, model={self.tp}) "
+            f"[{self.mesh_execution}], batch "
             f"{self.batch}, kv {self.kv_len}{layout}: decode step "
             f"{self.cost.step_time * 1e6:.1f} us, "
             f"{self.tokens_per_s:.0f} tokens/s{fit}"

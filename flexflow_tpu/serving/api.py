@@ -117,6 +117,14 @@ class ServeConfig:
     slo_itl_ms: float = 0.0
     slo_window: int = 1024
     telemetry: bool = False
+    # pod serving (serving/distributed.py): serve_mesh = "dp,tp" applies
+    # a (data, model) serving mesh via FFModel.compile_for_serving;
+    # serve_hosts > 0 partitions slots and the page pool across that
+    # many host shards (0 = auto: jax.process_count(), else dp). The
+    # multihost KV partition is paged-layout only — the slot layout has
+    # no page pool to shard.
+    serve_mesh: str = ""
+    serve_hosts: int = 0
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -219,6 +227,21 @@ class ServeConfig:
             raise ValueError(
                 f"slo_window must be >= 1, got {self.slo_window}"
             )
+        if self.serve_hosts < 0:
+            raise ValueError(
+                f"serve_hosts must be >= 0 (0 = auto), got "
+                f"{self.serve_hosts}"
+            )
+        if self.serve_hosts > 1 and self.kv_layout != "paged":
+            raise ValueError(
+                "multihost serving requires kv_layout='paged' (the host "
+                "partition shards the page pool; the slot layout has no "
+                "pool to shard)"
+            )
+        if self.serve_mesh:
+            from flexflow_tpu.serving.distributed import parse_serve_mesh
+
+            parse_serve_mesh(self.serve_mesh)  # raises on malformed text
 
     @property
     def telemetry_requested(self) -> bool:
@@ -263,6 +286,8 @@ class ServeConfig:
             slo_ttft_ms=cfg.serve_slo_ttft_ms,
             slo_itl_ms=cfg.serve_slo_itl_ms,
             telemetry=cfg.serve_telemetry,
+            serve_mesh=cfg.serve_mesh,
+            serve_hosts=cfg.serve_hosts,
         )
 
 
@@ -321,6 +346,27 @@ def build_scheduler(
     flexflow_tpu.telemetry.Telemetry bundle through the same seams
     (built from the serve config's telemetry knobs when omitted); the
     attached bundle is reachable as `scheduler.telemetry`."""
+    if (
+        (serve.serve_mesh or serve.serve_hosts)
+        and getattr(model, "serving_placement", None) is None
+        and hasattr(model, "compile_for_serving")
+    ):
+        # --serve-mesh / --serve-hosts end-to-end path: apply the serving
+        # mesh before the cache is built so from_model picks the
+        # placement up (idempotent — an explicit compile_for_serving()
+        # call beforehand wins)
+        model.compile_for_serving(serve_config=serve)
+    placement = getattr(model, "serving_placement", None)
+    if (
+        placement is not None
+        and placement.num_hosts > 1
+        and serve.kv_layout != "paged"
+    ):
+        raise ValueError(
+            "multihost serving requires kv_layout='paged' (the host "
+            "partition shards the page pool; the slot layout has no "
+            "pool to shard)"
+        )
     if serve.kv_layout == "paged":
         cache = PagedKVCache.from_model(
             model,

@@ -259,6 +259,15 @@ class KVCache:
         self._free: List[int] = list(range(spec.max_seqs))
         self._active: set = set()
         self._inflight_depth = 0
+        # host-partition parity with PagedKVCache: the slot layout is
+        # single-host only (serving.api rejects --serve-hosts > 1 on it)
+        self.num_hosts = 1
+
+    def host_of_slot(self, slot: int) -> int:
+        return 0
+
+    def free_pages_by_host(self) -> List[int]:
+        return [0]
 
     # -- in-flight window (async dispatch) -----------------------------------
 
@@ -421,7 +430,10 @@ class KVCache:
         dtype=None,
         buckets: Optional[Sequence[int]] = None,
     ) -> "KVCache":
-        """Derive geometry + shardings from a compiled FFModel."""
+        """Derive geometry + shardings from a compiled FFModel. When the
+        model carries a `serving_placement` (compile_for_serving), the
+        cache rides the SERVING mesh — slots on the data axis, heads on
+        the model axis — instead of the training strategy's sharding."""
         import jax.numpy as jnp
 
         guids, heads, head_dim, head_axis, executor = _derive_geometry(model)
@@ -435,9 +447,12 @@ class KVCache:
         )
         if dtype is None:
             dtype = jnp.float32
-        return KVCache(
-            spec, dtype, shardings=_heads_sharding(executor, head_axis)
-        )
+        placement = getattr(model, "serving_placement", None)
+        if placement is not None:
+            shardings = placement.kv_sharding()
+        else:
+            shardings = _heads_sharding(executor, head_axis)
+        return KVCache(spec, dtype, shardings=shardings)
 
 
 class PagedKVCache:
@@ -472,7 +487,12 @@ class PagedKVCache:
     paged = True
 
     def __init__(
-        self, spec: KVCacheSpec, dtype, shardings=None, prefix_cache=False
+        self,
+        spec: KVCacheSpec,
+        dtype,
+        shardings=None,
+        prefix_cache=False,
+        placement=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -504,25 +524,28 @@ class PagedKVCache:
         if shardings is not None and self.quantized:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            # pools shard heads on dim 2; the [num_pages, heads] scale
-            # pools carry the same axis on dim 1
+            # pools shard pages on dim 0 and heads on dim 2; the
+            # [num_pages, heads] scale pools carry the same axes
             scale_shardings = NamedSharding(
-                shardings.mesh, PartitionSpec(None, shardings.spec[2])
+                shardings.mesh,
+                PartitionSpec(shardings.spec[0], shardings.spec[2]),
             )
+        from flexflow_tpu.runtime import multihost
+
         for g in spec.layer_guids:
             k = jnp.zeros(shape, dtype)
             v = jnp.zeros(shape, dtype)
             if shardings is not None:
-                k = jax.device_put(k, shardings)
-                v = jax.device_put(v, shardings)
+                k = multihost.place_array(k, shardings)
+                v = multihost.place_array(v, shardings)
             self.k[g] = k
             self.v[g] = v
             if self.quantized:
                 ks = jnp.zeros((spec.num_pages, spec.num_heads), jnp.float32)
                 vs = jnp.zeros((spec.num_pages, spec.num_heads), jnp.float32)
                 if scale_shardings is not None:
-                    ks = jax.device_put(ks, scale_shardings)
-                    vs = jax.device_put(vs, scale_shardings)
+                    ks = multihost.place_array(ks, scale_shardings)
+                    vs = multihost.place_array(vs, scale_shardings)
                 self.k_scale[g] = ks
                 self.v_scale[g] = vs
         self.lengths = np.zeros(spec.max_seqs, dtype=np.int32)
@@ -531,12 +554,45 @@ class PagedKVCache:
             spec.num_pages,
             dtype=np.int32,
         )
+        # HOST partition (serving/distributed.py): host h owns the
+        # contiguous slot block [h*spn, (h+1)*spn) and page block
+        # [h*ppn, (h+1)*ppn) — coinciding with the device shard
+        # boundaries of pool dim 0 on the serving mesh's data axis, so a
+        # slot's pages live with its host's devices. Admission and page
+        # claims run against PER-HOST free views; num_hosts == 1
+        # degenerates to the single global heap (byte-identical pop
+        # order to the pre-placement allocator).
+        self.placement = placement
+        self.num_hosts = placement.num_hosts if placement is not None else 1
+        if spec.max_seqs % self.num_hosts or spec.num_pages % self.num_hosts:
+            raise ValueError(
+                f"host partition: max_seqs {spec.max_seqs} and num_pages "
+                f"{spec.num_pages} must both divide by num_hosts "
+                f"{self.num_hosts}"
+            )
+        self._slots_per_host = spec.max_seqs // self.num_hosts
+        self._pages_per_host = spec.num_pages // self.num_hosts
         # min-heaps: alloc pops the lowest free slot/page id (deterministic
         # reuse order), release is O(log n) heappush instead of the old
-        # append + full sort
-        self._free_slots: List[int] = list(range(spec.max_seqs))
+        # append + full sort. One heap pair PER HOST; `_free_slots` /
+        # `_free_pages` stay bound to host 0's heaps (the SAME list
+        # objects, mutated in place, never rebound) so the single-host
+        # fault injector and tests keep their direct handle on the pool.
+        self._free_slots_h: List[List[int]] = [
+            list(
+                range(h * self._slots_per_host, (h + 1) * self._slots_per_host)
+            )
+            for h in range(self.num_hosts)
+        ]
+        self._free_pages_h: List[List[int]] = [
+            list(
+                range(h * self._pages_per_host, (h + 1) * self._pages_per_host)
+            )
+            for h in range(self.num_hosts)
+        ]
+        self._free_slots: List[int] = self._free_slots_h[0]
         self._active: set = set()
-        self._free_pages: List[int] = list(range(spec.num_pages))
+        self._free_pages: List[int] = self._free_pages_h[0]
         # preemption-free reserve: _max_pages[s] is slot s's worst-case
         # page need (fixed at admission), _held[s] what it holds now;
         # _reserved = Σ (max - held) over active RESERVE-admitted slots —
@@ -546,7 +602,7 @@ class PagedKVCache:
         # touch _reserved.
         self._held = np.zeros(spec.max_seqs, dtype=np.int64)
         self._max_pages = np.zeros(spec.max_seqs, dtype=np.int64)
-        self._reserved = 0
+        self._reserved_h: List[int] = [0] * self.num_hosts
         self._optimistic: set = set()
         # prefix sharing: per-page reference counts (re-derivable from
         # the block tables — check_invariants does exactly that), the
@@ -607,7 +663,7 @@ class PagedKVCache:
             kept: List[Tuple[int, int]] = []
             for p, wid in self._limbo:
                 if wid <= self._window_closed:
-                    heapq.heappush(self._free_pages, p)
+                    heapq.heappush(self._free_pages_h[self._page_home(p)], p)
                 else:
                     kept.append((p, wid))
             self._limbo = kept
@@ -623,7 +679,50 @@ class PagedKVCache:
         if self._window_seq > self._window_closed:
             self._limbo.append((p, self._window_seq))
         else:
-            heapq.heappush(self._free_pages, p)
+            heapq.heappush(self._free_pages_h[self._page_home(p)], p)
+
+    # -- host partition ------------------------------------------------------
+
+    @property
+    def _reserved(self) -> int:
+        """Total growth reserve across host partitions (read-only view;
+        writes go to the owning host's `_reserved_h` entry)."""
+        return sum(self._reserved_h)
+
+    def host_of_slot(self, slot: int) -> int:
+        """Which host partition owns `slot` (contiguous blocks)."""
+        return int(slot) // self._slots_per_host
+
+    def _page_home(self, p: int) -> int:
+        """Which host partition owns page `p` (contiguous blocks,
+        aligned with the data-axis device shards of pool dim 0)."""
+        return int(p) // self._pages_per_host
+
+    def _host_avail(self, h: int) -> int:
+        """Free pages minus the growth reserve on host `h` — the
+        admission headroom. A ONE-STEP-STALE view is safe by design:
+        pages released during an open in-flight window sit in limbo (not
+        the free heap), so this count only under-promises; it never
+        hands out a page an in-flight step could still read."""
+        return len(self._free_pages_h[h]) - self._reserved_h[h]
+
+    def _pick_host(self, need: int) -> Optional[int]:
+        """Choose the admission host: any with a free slot whose free
+        view covers `need` pages; most headroom wins, ties to the lowest
+        host id (deterministic). None when no host can admit."""
+        best = None
+        best_avail = -1
+        for h in range(self.num_hosts):
+            if not self._free_slots_h[h]:
+                continue
+            avail = self._host_avail(h)
+            if avail >= need and avail > best_avail:
+                best, best_avail = h, avail
+        return best
+
+    def free_pages_by_host(self) -> List[int]:
+        """Per-host free-heap depths (telemetry / scheduler views)."""
+        return [len(hp) for hp in self._free_pages_h]
 
     # -- page/slot management (host side) ------------------------------------
 
@@ -633,15 +732,15 @@ class PagedKVCache:
 
     @property
     def num_free(self) -> int:
-        return len(self._free_slots)
+        return sum(len(hs) for hs in self._free_slots_h)
 
     @property
     def num_free_pages(self) -> int:
-        return len(self._free_pages)
+        return sum(len(hp) for hp in self._free_pages_h)
 
     @property
     def pages_in_use(self) -> int:
-        return self.spec.num_pages - len(self._free_pages)
+        return self.spec.num_pages - self.num_free_pages
 
     def active_slots(self) -> List[int]:
         return sorted(self._active)
@@ -655,18 +754,18 @@ class PagedKVCache:
         total_len: int = 0,
         optimistic: bool = False,
     ) -> bool:
-        """True when a slot is free AND the free pool covers this
-        request's page need on top of every in-flight reservation: the
-        worst case (prompt + max_new_tokens) under the reserve policy,
-        only the pages the prompt fills NOW under the optimistic one."""
+        """True when SOME host partition has a free slot AND its free
+        view covers this request's page need on top of every in-flight
+        reservation: the worst case (prompt + max_new_tokens) under the
+        reserve policy, only the pages the prompt fills NOW under the
+        optimistic one. Admission is per-host (a request's pages never
+        straddle hosts), so a fragmented pod can refuse a request the
+        global count would accept."""
         if optimistic:
             need = self._pages_for(prompt_len)
         else:
             need = self._pages_for(max(prompt_len, total_len))
-        return (
-            bool(self._free_slots)
-            and len(self._free_pages) - self._reserved >= need
-        )
+        return self._pick_host(need) is not None
 
     def alloc(
         self,
@@ -691,12 +790,13 @@ class PagedKVCache:
             )
         need_now = self._pages_for(prompt_len)
         max_p = self._pages_for(total)
-        if not self.can_admit(prompt_len, total, optimistic=optimistic):
+        h = self._pick_host(need_now if optimistic else max_p)
+        if h is None:
             return None
-        slot = heapq.heappop(self._free_slots)
+        slot = heapq.heappop(self._free_slots_h[h])
         self._active.add(slot)
         for i in range(need_now):
-            self._install_page(slot, i, heapq.heappop(self._free_pages))
+            self._install_page(slot, i, heapq.heappop(self._free_pages_h[h]))
         self._held[slot] = need_now
         if optimistic:
             # no growth reserve: _max_pages tracks _held so this slot
@@ -705,7 +805,7 @@ class PagedKVCache:
             self._max_pages[slot] = need_now
         else:
             self._max_pages[slot] = max_p
-            self._reserved += max_p - need_now
+            self._reserved_h[h] += max_p - need_now
         self.lengths[slot] = 0
         return slot
 
@@ -834,8 +934,35 @@ class PagedKVCache:
             slot = self.alloc(prompt_len, total, optimistic=optimistic)
             return None if slot is None else (slot, 0)
         ps = spec.page_size
-        matched = self.match_prefix(tokens)
-        m = len(matched)
+        matched_all = self.match_prefix(tokens)
+        # Host choice with page locality: a slot only maps shared pages
+        # its OWN host's pool shard holds (the match truncates at the
+        # first foreign page — cross-host prefix sharing would alias
+        # pages onto another host's devices). Longest usable match wins,
+        # then admission headroom, then lowest host id. Single-host runs
+        # reduce to the full match and the old admission check exactly.
+        best = None  # (m, avail, -h) ordering via explicit compare
+        for h in range(self.num_hosts):
+            if not self._free_slots_h[h]:
+                continue
+            m_h = 0
+            for page in matched_all:
+                if self._page_home(page) != h:
+                    break
+                m_h += 1
+            cursor_h = min(m_h * ps, max(0, ntok - 1))
+            fresh_h = max(0, self._pages_for(prompt_len) - m_h)
+            max_p_h = self._pages_for(total) - (cursor_h // ps)
+            need_h = fresh_h if optimistic else max_p_h
+            avail = self._host_avail(h)
+            if avail < need_h:
+                continue
+            if best is None or (m_h, avail) > (best[0], best[1]):
+                best = (m_h, avail, h)
+        if best is None:
+            return None
+        m, _, h = best
+        matched = matched_all[:m]
         cursor = min(m * ps, max(0, ntok - 1))
         # fresh pages popped now: the unshared remainder of the eager
         # prompt span; worst-case pool draws over the slot's lifetime:
@@ -844,25 +971,19 @@ class PagedKVCache:
         # whole-prompt-match case)
         fresh_now = max(0, self._pages_for(prompt_len) - m)
         max_p = self._pages_for(total) - (cursor // ps)
-        need = fresh_now if optimistic else max_p
-        if (
-            not self._free_slots
-            or len(self._free_pages) - self._reserved < need
-        ):
-            return None
-        slot = heapq.heappop(self._free_slots)
+        slot = heapq.heappop(self._free_slots_h[h])
         self._active.add(slot)
         for i, page in enumerate(matched):
             self._incref(slot, i, page)
         for i in range(m, m + fresh_now):
-            self._install_page(slot, i, heapq.heappop(self._free_pages))
+            self._install_page(slot, i, heapq.heappop(self._free_pages_h[h]))
         self._held[slot] = m + fresh_now
         if optimistic:
             self._optimistic.add(slot)
             self._max_pages[slot] = fresh_now  # == owned
         else:
             self._max_pages[slot] = max_p
-            self._reserved += max_p - fresh_now
+            self._reserved_h[h] += max_p - fresh_now
         self.lengths[slot] = cursor
         if m:
             self.prefix_hits += 1
@@ -877,16 +998,18 @@ class PagedKVCache:
         untouched, and the functional pool threading orders the copy
         before any later step's reads."""
         page = int(self.block_tables[slot, pi])
+        h = self.host_of_slot(slot)
         if self._refcounts[page] > 1:
             if slot in self._optimistic:
-                if len(self._free_pages) - self._reserved < 1:
+                if self._host_avail(h) < 1:
                     raise PagePoolExhausted(
                         f"free-page pool exhausted: optimistic slot {slot} "
                         f"needs a copy-on-write page but "
-                        f"{len(self._free_pages)} free - {self._reserved} "
+                        f"{len(self._free_pages_h[h])} free - "
+                        f"{self._reserved_h[h]} "
                         "reserved leaves none"
                     )
-            elif not self._free_pages:
+            elif not self._free_pages_h[h]:
                 if self._limbo:
                     raise PagePoolExhausted(
                         f"free-page pool exhausted: {len(self._limbo)} pages "
@@ -897,7 +1020,7 @@ class PagedKVCache:
                     "free-page pool exhausted despite the admission reserve "
                     "— allocator invariant violated"
                 )
-            new = heapq.heappop(self._free_pages)
+            new = heapq.heappop(self._free_pages_h[h])
             # functional rebind (fresh dicts, whole-attribute swap), not
             # in-place entry mutation: any already-queued step read the
             # OLD array objects, which the .at[].set() copies leave
@@ -927,7 +1050,7 @@ class PagedKVCache:
         if slot in self._optimistic:
             self._max_pages[slot] = self._owned(slot)
         elif self._owned(slot) <= self._max_pages[slot]:
-            self._reserved -= 1
+            self._reserved_h[h] -= 1
 
     def ensure_position(self, slot: int, pos: int) -> None:
         """Make position `pos` of `slot` writable, claiming the next page
@@ -947,18 +1070,19 @@ class PagedKVCache:
             if self._entry_shared[slot, pi]:
                 self._cow_page(slot, pi)
             return
+        h = self.host_of_slot(slot)
         if slot in self._optimistic:
-            if len(self._free_pages) - self._reserved < 1:
+            if self._host_avail(h) < 1:
                 raise PagePoolExhausted(
                     f"free-page pool exhausted: optimistic slot {slot} "
-                    f"needs a page but {len(self._free_pages)} free - "
-                    f"{self._reserved} reserved leaves none"
+                    f"needs a page but {len(self._free_pages_h[h])} free - "
+                    f"{self._reserved_h[h]} reserved leaves none"
                 )
-            self._install_page(slot, pi, heapq.heappop(self._free_pages))
+            self._install_page(slot, pi, heapq.heappop(self._free_pages_h[h]))
             self._held[slot] += 1
             self._max_pages[slot] = self._owned(slot)
             return
-        if not self._free_pages:
+        if not self._free_pages_h[h]:
             if self._limbo:
                 raise PagePoolExhausted(
                     f"free-page pool exhausted: {len(self._limbo)} pages "
@@ -969,10 +1093,10 @@ class PagedKVCache:
                 "free-page pool exhausted despite the admission reserve — "
                 "allocator invariant violated"
             )
-        self._install_page(slot, pi, heapq.heappop(self._free_pages))
+        self._install_page(slot, pi, heapq.heappop(self._free_pages_h[h]))
         self._held[slot] += 1
         if self._owned(slot) <= self._max_pages[slot]:
-            self._reserved -= 1
+            self._reserved_h[h] -= 1
 
     def truncate(self, slot: int, new_len: int) -> None:
         """Roll the slot's visible length to `new_len` and return every
@@ -1005,7 +1129,7 @@ class PagedKVCache:
             # released pages return to the COMMON pool, not a reserve
             self._max_pages[slot] = self._owned(slot)
         else:
-            self._reserved += (
+            self._reserved_h[self.host_of_slot(slot)] += (
                 max(0, int(self._max_pages[slot]) - self._owned(slot))
                 - old_resv
             )
@@ -1021,13 +1145,13 @@ class PagedKVCache:
         if slot in self._optimistic:
             self._optimistic.discard(slot)
         else:
-            self._reserved -= max(
+            self._reserved_h[self.host_of_slot(slot)] -= max(
                 0, int(self._max_pages[slot]) - owned_before
             )
         self._held[slot] = 0
         self._max_pages[slot] = 0
         self.lengths[slot] = 0
-        heapq.heappush(self._free_slots, slot)
+        heapq.heappush(self._free_slots_h[self.host_of_slot(slot)], slot)
 
     def commit(
         self,
@@ -1059,15 +1183,34 @@ class PagedKVCache:
         live = int((self._refcounts > 0).sum())
         return {
             "kv_slots_active": len(self._active),
-            "kv_slots_free": len(self._free_slots),
+            "kv_slots_free": self.num_free,
             "kv_rows_used": int(self.lengths.sum()),
             "kv_occupancy": live / spec.num_pages if spec.num_pages else 0.0,
             "kv_pages_live": live,
             "kv_pages_pinned": len(self._limbo),
-            "kv_free_heap_depth": len(self._free_pages),
+            "kv_free_heap_depth": self.num_free_pages,
             "kv_pages_reserved": int(self._reserved),
             "kv_inflight_depth": self._inflight_depth,
             "kv_prefix_pages_shared": int(self._shared.sum()),
+        }
+
+    def telemetry_gauges_host(self, h: int) -> Dict[str, float]:
+        """The per-host slice of the allocator gauges — sampled under a
+        `host` label when the placement runs more than one host
+        partition. Sums across hosts equal the unlabelled series."""
+        lo, hi = h * self._pages_per_host, (h + 1) * self._pages_per_host
+        live = int((self._refcounts[lo:hi] > 0).sum())
+        return {
+            "kv_slots_active": sum(
+                1 for s in self._active if self.host_of_slot(s) == h
+            ),
+            "kv_slots_free": len(self._free_slots_h[h]),
+            "kv_pages_live": live,
+            "kv_pages_pinned": sum(
+                1 for p, _ in self._limbo if self._page_home(p) == h
+            ),
+            "kv_free_heap_depth": len(self._free_pages_h[h]),
+            "kv_pages_reserved": int(self._reserved_h[h]),
         }
 
     def telemetry_counters(self) -> Dict[str, int]:
@@ -1117,13 +1260,36 @@ class PagedKVCache:
         # (+ injector-held) is the whole pool; free/limbo pages carry no
         # references
         limbo = [p for p, _ in self._limbo]
+        free_all = [p for hp in self._free_pages_h for p in hp]
         assert len(limbo) == len(set(limbo))
-        assert live.isdisjoint(self._free_pages)
+        assert live.isdisjoint(free_all)
         assert live.isdisjoint(limbo)
-        assert set(limbo).isdisjoint(self._free_pages)
-        assert len(live) + len(self._free_pages) + len(limbo) + (
+        assert set(limbo).isdisjoint(free_all)
+        assert len(live) + len(free_all) + len(limbo) + (
             extra_free
         ) == spec.num_pages
+        # host-partition purity: every free heap holds only its own
+        # host's pages, every slot heap its own host's slots, and every
+        # mapped page lives on its slot's home host (alloc_shared
+        # truncates prefix matches at the first foreign page to keep
+        # this true) — the property that makes per-host free views a
+        # sound admission signal. Per-host conservation pins the
+        # injector's stolen pages (single-host harness) to host 0.
+        for h in range(self.num_hosts):
+            assert all(self._page_home(p) == h for p in self._free_pages_h[h])
+            assert all(
+                self.host_of_slot(s) == h for s in self._free_slots_h[h]
+            )
+            live_h = sum(1 for p in live if self._page_home(p) == h)
+            limbo_h = sum(1 for p in limbo if self._page_home(p) == h)
+            assert live_h + len(self._free_pages_h[h]) + limbo_h + (
+                extra_free if h == 0 else 0
+            ) == self._pages_per_host
+        for s in self._active:
+            hs = self.host_of_slot(s)
+            for p in self.block_tables[s]:
+                if int(p) != sentinel:
+                    assert self._page_home(int(p)) == hs
         # the hash index only advertises live pages, bijectively with
         # its reverse map
         assert len(self._prefix_index) == len(self._page_keys)
@@ -1141,22 +1307,27 @@ class PagedKVCache:
         # the pool doesn't have (limbo pages still honor the promise —
         # they return to the heap before any claim that needs them, the
         # async scheduler's drain-before-preempt rule)
-        resv = sum(
-            max(0, int(self._max_pages[s]) - self._owned(s))
-            for s in self._active
-            if s not in self._optimistic
-        )
-        assert resv == self._reserved
-        assert 0 <= self._reserved <= (
-            len(self._free_pages) + len(self._limbo) + extra_free
-        )
+        for h in range(self.num_hosts):
+            resv_h = sum(
+                max(0, int(self._max_pages[s]) - self._owned(s))
+                for s in self._active
+                if s not in self._optimistic and self.host_of_slot(s) == h
+            )
+            assert resv_h == self._reserved_h[h]
+            limbo_h = sum(1 for p in limbo if self._page_home(p) == h)
+            assert 0 <= self._reserved_h[h] <= (
+                len(self._free_pages_h[h])
+                + limbo_h
+                + (extra_free if h == 0 else 0)
+            )
         # optimistic slots never carry a growth reserve
         for s in self._optimistic:
             assert s in self._active
             assert int(self._max_pages[s]) == self._owned(s)
         # slot bookkeeping
-        assert self._active.isdisjoint(self._free_slots)
-        assert len(self._active) + len(self._free_slots) == spec.max_seqs
+        free_slots_all = [s for hs in self._free_slots_h for s in hs]
+        assert self._active.isdisjoint(free_slots_all)
+        assert len(self._active) + len(free_slots_all) == spec.max_seqs
 
     # -- construction from a compiled model ---------------------------------
 
@@ -1207,9 +1378,16 @@ class PagedKVCache:
         )
         if dtype is None:
             dtype = jnp.float32
+        placement = getattr(model, "serving_placement", None)
+        if placement is not None:
+            placement.validate_geometry(max_seqs, num_pages)
+            shardings = placement.kv_sharding()
+        else:
+            shardings = _heads_sharding(executor, head_axis)
         return PagedKVCache(
             spec,
             dtype,
-            shardings=_heads_sharding(executor, head_axis),
+            shardings=shardings,
             prefix_cache=prefix_cache,
+            placement=placement,
         )

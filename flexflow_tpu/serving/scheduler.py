@@ -636,6 +636,11 @@ class _SchedulerBase:
         req.finish_iter = self._iter
         req.finish_time = time.perf_counter()
         req.log(status, error or "")
+        slot_host = (
+            self.cache.host_of_slot(req.slot)
+            if req.slot is not None
+            else None
+        )
         if req.slot is not None and self.running.get(req.slot) is req:
             if self.proposer is not None:
                 self.proposer.retire(req)
@@ -675,6 +680,15 @@ class _SchedulerBase:
                 help="terminal request transitions by status",
                 labels={"status": status},
             ).inc()
+            if (
+                getattr(self.cache, "num_hosts", 1) > 1
+                and slot_host is not None
+            ):
+                reg.counter(
+                    "serve_requests_total",
+                    help="terminal request transitions by status",
+                    labels={"status": status, "host": str(slot_host)},
+                ).inc()
             if req.events_dropped:
                 reg.counter(
                     "serve_request_events_dropped_total",
@@ -1314,19 +1328,22 @@ class _SchedulerBase:
             req.prefill_seq
         )
 
-    def _reserved_step_tokens(self) -> int:
+    def _reserved_step_tokens(self, host: Optional[int] = None) -> int:
         """Tokens this iteration's decode/verify step may consume for
         the slots already past prefill — 1 per slot, plus up to spec_k
         drafts each under speculation. The chunk planner budgets around
         this reservation so chunks + decode work stay inside
         token_budget together, which is the whole point: decodes keep
-        their cadence WHILE a prompt streams in."""
+        their cadence WHILE a prompt streams in. `host` narrows the
+        count to one host partition's slots (the per-host budget of a
+        pod placement)."""
         per = 1 + (self.spec_k if self.proposer is not None else 0)
         return per * sum(
             1
             for r in self.running.values()
             if not self._prefill_pending(r)
             and len(r.generated) < r.max_new_tokens
+            and (host is None or self.cache.host_of_slot(r.slot) == host)
         )
 
     def _plan_chunks(self, reserved: int) -> Dict[int, int]:
@@ -1343,9 +1360,13 @@ class _SchedulerBase:
         token work — charging the unlocked decode here instead would
         wedge the planner when token_budget == chunk_size (a full
         final chunk could never fit). Pending slots granted nothing
-        count as budget deferrals (`serve_budget_deferrals_total`)."""
-        budget = self.token_budget - int(reserved)
-        pending = sorted(
+        count as budget deferrals (`serve_budget_deferrals_total`).
+
+        Under a multi-host placement the token budget applies PER HOST
+        (each host prefills into its own pool shard at its own cadence),
+        so the round-robin runs once per host partition over that host's
+        pending slots against `token_budget - reserved_on_that_host`."""
+        pending_all = sorted(
             (
                 r
                 for r in self.running.values()
@@ -1353,7 +1374,7 @@ class _SchedulerBase:
             ),
             key=lambda r: (r.admit_iter, r.rid),
         )
-        if not pending:
+        if not pending_all:
             return {}
         # keep the chunk step's width inside the Pallas kernel's query
         # tile when a kernel mode is on — a wider grant would silently
@@ -1363,24 +1384,38 @@ class _SchedulerBase:
             from flexflow_tpu.ops.pallas.decode_kernel import _MAX_W
 
             max_grant = _MAX_W
-        plan: Dict[int, int] = {r.slot: 0 for r in pending}
-        progress = True
-        while progress and budget > 0:
-            progress = False
-            for req in pending:
-                rem = (
-                    len(req.prefill_seq)
-                    - req.prefill_dispatched
-                    - plan[req.slot]
-                )
-                if rem <= 0 or plan[req.slot] >= max_grant:
-                    continue
-                unit = min(self.chunk_size, rem, max_grant - plan[req.slot])
-                if unit > budget:
-                    continue
-                plan[req.slot] += unit
-                budget -= unit
-                progress = True
+        plan: Dict[int, int] = {r.slot: 0 for r in pending_all}
+        hosts = range(self.cache.num_hosts)
+        for h in hosts:
+            if self.cache.num_hosts > 1:
+                pending = [
+                    r
+                    for r in pending_all
+                    if self.cache.host_of_slot(r.slot) == h
+                ]
+                budget = self.token_budget - self._reserved_step_tokens(h)
+            else:
+                pending = pending_all
+                budget = self.token_budget - int(reserved)
+            progress = True
+            while progress and budget > 0:
+                progress = False
+                for req in pending:
+                    rem = (
+                        len(req.prefill_seq)
+                        - req.prefill_dispatched
+                        - plan[req.slot]
+                    )
+                    if rem <= 0 or plan[req.slot] >= max_grant:
+                        continue
+                    unit = min(
+                        self.chunk_size, rem, max_grant - plan[req.slot]
+                    )
+                    if unit > budget:
+                        continue
+                    plan[req.slot] += unit
+                    budget -= unit
+                    progress = True
         deferred = sum(1 for c in plan.values() if c == 0)
         if deferred:
             self.stats.budget_deferrals += deferred
@@ -1579,6 +1614,27 @@ class _SchedulerBase:
             handles[name].value = value
         handles["serve_queue_depth"].value = len(self.queue)
         handles["serve_running_requests"].value = len(self.running)
+        if getattr(self.cache, "num_hosts", 1) > 1:
+            # per-host pool/scheduler slices under a `host` label (the
+            # process index on a real pod; simulated-host partitions on
+            # one process). The unlabelled series above stay the
+            # pod-wide totals, so single-host dashboards see identical
+            # streams; labelled series ride the same JSONL sample rows
+            # as extra name{host="h"} columns.
+            reg = tele.registry
+            for h in range(self.cache.num_hosts):
+                labels = {"host": str(h)}
+                for name, value in self.cache.telemetry_gauges_host(
+                    h
+                ).items():
+                    reg.gauge(name, labels=labels).value = value
+                reg.gauge(
+                    "serve_running_requests", labels=labels
+                ).value = sum(
+                    1
+                    for r in self.running.values()
+                    if self.cache.host_of_slot(r.slot) == h
+                )
         if self.injector is not None:
             self.injector.publish_metrics(tele.registry)
         if self.proposer is not None:
@@ -1590,13 +1646,36 @@ class _SchedulerBase:
                 tele.registry.counter(name).set_monotonic(value)
         self.stats.publish_derived()
         tele.sample(self._iter)
+        now = time.perf_counter()
         tele.tracer.complete(
             "iteration",
             "host",
             self._iter_t0,
-            time.perf_counter(),
+            now,
             args={"iter": self._iter},
         )
+        if getattr(self.cache, "num_hosts", 1) > 1:
+            # one lane per host partition: the iteration span again, but
+            # annotated with that host's running/free-page view so the
+            # Perfetto timeline shows per-host load side by side
+            free_by_host = self.cache.free_pages_by_host()
+            for h in range(self.cache.num_hosts):
+                tele.tracer.complete(
+                    "iteration",
+                    f"host{h}",
+                    self._iter_t0,
+                    now,
+                    tid=tele.tracer.host_lane(h),
+                    args={
+                        "iter": self._iter,
+                        "running": sum(
+                            1
+                            for r in self.running.values()
+                            if self.cache.host_of_slot(r.slot) == h
+                        ),
+                        "free_pages": free_by_host[h],
+                    },
+                )
 
     def _work_pending(self) -> bool:
         return bool(self.queue or self.running)

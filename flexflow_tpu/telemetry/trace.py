@@ -42,6 +42,7 @@ PID_REQUESTS = 2
 TID_HOST = 1  # scheduler host work: iterations, dispatch, reconcile
 TID_DEVICE0 = 10  # in-flight device windows, even steps
 TID_DEVICE1 = 11  # in-flight device windows, odd steps (overlap lane)
+TID_HOST_BASE = 20  # per-host-partition lanes (pod serving), 20 + host
 
 
 class Tracer:
@@ -52,6 +53,7 @@ class Tracer:
         self.events: List[dict] = []
         self.dropped_events = 0
         self.max_events = int(max_events)
+        self._host_lanes: set = set()
         self._meta(PID_ENGINE, None, "process_name", "flexflow_tpu.serve")
         self._meta(PID_ENGINE, TID_HOST, "thread_name", "host scheduler")
         self._meta(PID_ENGINE, TID_DEVICE0, "thread_name", "device in-flight (even)")
@@ -84,6 +86,19 @@ class Tracer:
             self.dropped_events += 1
             return
         self.events.append(ev)
+
+    def host_lane(self, host: int) -> int:
+        """The engine-process lane for one host partition of a pod
+        placement (serving/distributed.py). Lanes register their
+        thread_name metadata on first use so the Perfetto UI labels
+        them; events land via complete(..., tid=host_lane(h))."""
+        tid = TID_HOST_BASE + int(host)
+        if tid not in self._host_lanes:
+            self._host_lanes.add(tid)
+            self._meta(
+                PID_ENGINE, tid, "thread_name", f"host {int(host)} partition"
+            )
+        return tid
 
     # -- recording -----------------------------------------------------------
 
