@@ -1218,8 +1218,11 @@ def run_chaos(
 
     model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
     page_size = max_len // 8
-    # the minimum legal pool (one max_len sequence): optimistic
-    # admission overcommits it immediately, forcing preemption
+    # two simulated hosts, each holding HALF a max_len sequence of
+    # pages: any single mixed request fits on one host (so every stream
+    # can finish) but a host cannot hold two long continuations, so
+    # optimistic admission forces preemption churn — and the host_down
+    # site can reap a whole partition while the survivor progresses
     num_pages = max_len // page_size
     serve = ServeConfig(
         max_seqs=max_seqs,
@@ -1227,8 +1230,10 @@ def run_chaos(
         kv_layout="paged",
         kv_page_size=page_size,
         kv_pages=num_pages,
+        serve_hosts=2,
         admission="optimistic",
         max_preemptions=6,
+        kv_swap=True,
         serve_async=serve_async,
         # exercise EVERY injector site: the n-gram draft gives the
         # draft-fault seam a target, and starting on the Pallas kernel
@@ -1252,9 +1257,19 @@ def run_chaos(
         steal_hold=3,
         kernel_iters=(2,),
         draft_iters=(3,),
+        # graceful-degradation sites: half the swap attempts in the
+        # churn window fail (each must degrade to recompute, never a
+        # lost request), and host 1 drops out mid-run then rejoins
+        swap_fail_rate=0.5,
+        host_down_iters={6: 1},
+        host_down_hold=4,
     )
     injector = FaultInjector(plan, seed=seed)
     sched, engine, cache = build_scheduler(model, serve, injector=injector)
+    # the cost decider correctly prices recompute below PCIe traffic on
+    # a model this small; force always-swap so the swap_fail site and
+    # the swap-restore path are actually exercised
+    sched.swap_decider = None
     requests = _mixed_requests(vocab, max_len, num_requests)
     # a few requests carry deadlines the spikes may push past
     for r in requests[:: max(1, num_requests // 4)]:
@@ -1294,7 +1309,7 @@ def run_chaos(
     # draft, steal, cancel, spike — must appear in the exported metrics
     # with the same count, keyed by site
     injected = injector.summary()
-    for site in ("kernel", "draft", "page_steal"):
+    for site in ("kernel", "draft", "page_steal", "swap_fail", "host_down"):
         if site not in injected:
             raise SystemExit(
                 f"chaos plan scheduled a {site!r} fault that never fired "
@@ -1329,12 +1344,249 @@ def run_chaos(
         "by_status": by_status,
         "preemptions": s.preemptions,
         "peak_in_flight": s.peak_in_flight,
+        "swap_outs": s.swap_outs,
+        "swap_ins": s.swap_ins,
+        "host_downs": s.host_downs,
         "injected": injector.summary(),
         "injected_in_metrics": True,
         "kernel_fallbacks": engine.kernel_fallbacks,
         "lost_requests": 0,
         "invariant_violations": 0,
         "tokens_per_s": round(s.tokens_per_s, 2),
+    }
+
+
+def run_pressure(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    seed: int = 0,
+):
+    """Graceful-degradation gate: long-prompt streams on a page pool
+    too small for two of them, so every boundary crossing preempts a
+    victim. Recompute-only re-admission re-prefills the whole resumed
+    sequence; swap-to-host restores the committed pages from host
+    staging instead. The gates are (a) swap-enabled goodput >= 1.3x
+    recompute-only on BOTH loops, (b) every restored stream
+    token-identical to an unpressured reference, and (c) zero lost
+    requests under combined chaos (pool pressure + swap_fail +
+    host_down) — again on both loops."""
+    from flexflow_tpu.serving import (
+        FaultInjector,
+        FaultPlan,
+        Request,
+        ServeConfig,
+        build_scheduler,
+    )
+    import time as _time
+
+    page_size = max_len // 8
+    # long prompts ending two tokens shy of a page boundary with a
+    # short decode tail: every stream crosses into a fresh page at its
+    # ~3rd generated token, so a tight pool collides immediately, and
+    # re-prefill (O(len^2) attention over ~7/8 of max_len) dominates
+    # recompute-only re-admission while the decode work both policies
+    # share stays small
+    prompt_pages = 7
+    prompt_len = prompt_pages * page_size - 2
+    max_new = 8
+    footprint = -(-(prompt_len + max_new) // page_size)  # pages/request
+
+    def _requests():
+        return [
+            Request(
+                rid=i,
+                prompt=[(i * 11 + j) % vocab for j in range(prompt_len)],
+                max_new_tokens=max_new,
+            )
+            for i in range(num_requests)
+        ]
+
+    # ONE model for the reference and both timed legs: the jit caches
+    # (prefill buckets, decode step) stay shared, so the timed legs
+    # compare scheduling policy, not compilation luck. The chaos legs
+    # get a SEPARATE model: compile_for_serving(serve_hosts=2) pins a
+    # two-host placement on the model, and a later single-host
+    # build_scheduler would silently inherit it (explicit placement
+    # wins by design), splitting the tight pool in half
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+    chaos_model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+
+    def _run_leg(serve, plan=None, force_swap=False, check=False, lm=None):
+        injector = FaultInjector(plan, seed=seed) if plan is not None else None
+        sched, _, cache = build_scheduler(
+            lm if lm is not None else model, serve, injector=injector
+        )
+        if force_swap:
+            # the cost decider honestly prices recompute below PCIe
+            # traffic on a benchmark-sized model; the point here is to
+            # measure the swap path, so always-swap
+            sched.swap_decider = None
+        for r in _requests():
+            sched.submit(r)
+        t0 = _time.perf_counter()
+        while sched._work_pending():
+            sched.step()
+            if check:
+                cache.check_invariants(
+                    extra_free=injector.stolen_pages if injector else 0
+                )
+        sched.stats.elapsed_s += _time.perf_counter() - t0
+        cache.check_invariants()
+        return sched
+
+    # unpressured reference: ample pool, no swap — the token streams
+    # every pressured leg must reproduce exactly (greedy decoding)
+    ample = ServeConfig(
+        max_seqs=max_seqs,
+        max_seq_len=max_len,
+        kv_layout="paged",
+        kv_page_size=page_size,
+        kv_pages=max_seqs * (max_len // page_size),
+    )
+    ref_sched = _run_leg(ample)
+    ref = {r.rid: tuple(r.generated) for r in ref_sched.finished}
+    assert len(ref) == num_requests
+
+    def _check_streams(sched, leg):
+        got = {r.rid: tuple(r.generated) for r in sched.finished}
+        # the only faults in any pressure leg are recoverable ones
+        # (pool pressure, swap_fail, host_down), so "zero lost" here
+        # means stronger than terminal: every rid must FINISH
+        not_finished = [
+            r.rid for r in sched.finished if r.status != "finished"
+        ]
+        if len(got) != num_requests or not_finished:
+            raise SystemExit(
+                f"pressure {leg} LOST requests: {len(got)}/{num_requests} "
+                f"terminal, not finished: {not_finished}"
+            )
+        bad = [rid for rid, toks in got.items() if toks != ref.get(rid)]
+        if bad:
+            raise SystemExit(
+                f"pressure {leg} moved greedy streams for rids {bad}"
+            )
+        return len(got)
+
+    # a pool that admits TWO long prompts but cannot hold their decode
+    # growth: optimistic admission overcommits, and every page-boundary
+    # crossing preempts the younger stream
+    tight_pages = 2 * prompt_pages
+
+    # untimed warm-up of the swap path: the page-scatter restore
+    # kernels compile per page-count, and the timed legs compare
+    # steady-state policies, not first-call XLA compilation
+    _run_leg(
+        ServeConfig(
+            max_seqs=max_seqs,
+            max_seq_len=max_len,
+            kv_layout="paged",
+            kv_page_size=page_size,
+            kv_pages=tight_pages,
+            admission="optimistic",
+            max_preemptions=64,
+            kv_swap=True,
+        ),
+        force_swap=True,
+    )
+
+    loops = {}
+    for serve_async in (False, True):
+        tag = "async" if serve_async else "sync"
+        common = dict(
+            max_seqs=max_seqs,
+            max_seq_len=max_len,
+            kv_layout="paged",
+            kv_page_size=page_size,
+            kv_pages=tight_pages,
+            admission="optimistic",
+            max_preemptions=64,
+            serve_async=serve_async,
+        )
+        rec = _run_leg(ServeConfig(**common))
+        _check_streams(rec, f"{tag}/recompute")
+        swp = _run_leg(
+            ServeConfig(**common, kv_swap=True), force_swap=True
+        )
+        _check_streams(swp, f"{tag}/swap")
+        if swp.stats.swap_outs == 0:
+            raise SystemExit(
+                f"pressure {tag}/swap never swapped — the leg measured "
+                f"nothing (preemptions {swp.stats.preemptions})"
+            )
+        ratio = (
+            swp.stats.goodput_tokens_per_s / rec.stats.goodput_tokens_per_s
+        )
+
+        # combined chaos on two hosts: pool pressure + seeded swap
+        # failures + a host partition dropping mid-run and rejoining.
+        # Each host gets the same tight two-prompts-collide pool the
+        # timed legs use (pool pressure -> swap attempts for the
+        # swap_fail site to hit), and any single request still fits
+        chaos_pages = 2 * tight_pages
+        chaos = _run_leg(
+            ServeConfig(
+                max_seqs=max_seqs,
+                max_seq_len=max_len,
+                kv_layout="paged",
+                kv_page_size=page_size,
+                kv_pages=chaos_pages,
+                serve_hosts=2,
+                admission="optimistic",
+                max_preemptions=64,
+                kv_swap=True,
+                serve_async=serve_async,
+                telemetry=True,
+            ),
+            plan=FaultPlan(
+                swap_fail_rate=0.4,
+                host_down_iters={8: 1},
+                host_down_hold=6,
+            ),
+            force_swap=True,
+            check=True,
+            lm=chaos_model,
+        )
+        _check_streams(chaos, f"{tag}/chaos")
+        injected = chaos.injector.summary()
+        missing = [s for s in ("host_down", "swap_fail") if s not in injected]
+        if missing:
+            raise SystemExit(
+                f"pressure {tag}/chaos: {missing} never fired ({injected})"
+            )
+        loops[tag] = {
+            "goodput_recompute": round(rec.stats.goodput_tokens_per_s, 2),
+            "goodput_swap": round(swp.stats.goodput_tokens_per_s, 2),
+            "ratio": round(ratio, 3),
+            "preemptions_recompute": rec.stats.preemptions,
+            "preemptions_swap": swp.stats.preemptions,
+            "swap_outs": swp.stats.swap_outs,
+            "swap_ins": swp.stats.swap_ins,
+            "swap_bytes": swp.stats.swap_bytes,
+            "chaos_injected": injected,
+            "chaos_host_downs": chaos.stats.host_downs,
+            "chaos_finished": chaos.stats.finished_requests,
+            "streams_match": f"{num_requests}/{num_requests}",
+        }
+
+    return {
+        "metric": f"serve_pressure_{layers}L_{hidden}h",
+        "value": min(l["ratio"] for l in loops.values()),
+        "unit": "x_goodput_swap_vs_recompute",
+        "vs_baseline": min(l["ratio"] for l in loops.values()),
+        "page_size": page_size,
+        "prompt_len": prompt_len,
+        "max_new": max_new,
+        "tight_pages": tight_pages,
+        "num_requests": num_requests,
+        "seed": seed,
+        "lost_requests": 0,
+        "sync": loops["sync"],
+        "async": loops["async"],
     }
 
 
@@ -1378,6 +1630,8 @@ def main():
             mode = "spec"
         elif a == "--chaos":
             mode = "chaos"
+        elif a == "--pressure":
+            mode = "pressure"
         elif a == "--chunked":
             mode = "chunked"
         elif a == "--prefix":
@@ -1489,6 +1743,19 @@ def main():
         with open(os.path.join(here, "BENCH_TELEMETRY.json"), "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
+    elif mode == "pressure":
+        result = run_pressure(seed=seed, **args)
+        with open(os.path.join(here, "BENCH_PRESSURE.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        if result["value"] < 1.3:
+            raise SystemExit(
+                f"swap-to-host missed the goodput gate: "
+                f"{result['value']}x recompute-only under forced "
+                f"pressure (floor 1.3x; sync "
+                f"{result['sync']['ratio']}x, async "
+                f"{result['async']['ratio']}x)"
+            )
     elif mode == "chaos":
         result = run_chaos(seed=seed, serve_async=serve_async, **args)
         name = "BENCH_CHAOS_ASYNC.json" if serve_async else "BENCH_CHAOS.json"

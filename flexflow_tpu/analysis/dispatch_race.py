@@ -67,6 +67,20 @@ findings go to the baseline):
   ``truncate``/``free``/... — see ``_REFCOUNT_BLESSED``) are the ONLY
   functions allowed to touch either structure; everything else must
   route through them.
+* **FX107** — swap/eviction ledger discipline for the
+  pressure-degradation allocator. The host-swap table (``_swapped``:
+  handle -> staged pages + bytes), the publication-only LRU
+  (``_pub_only``: page -> (stamp, wait window)), and the downed host
+  set (``_hosts_down``) are each audited by ``check_invariants`` —
+  the swap-bytes budget, the page conservation sum, and admission
+  routing all re-derive from them. A raw mutation (subscript store,
+  ``del``, rebinding, or a mutating method call like ``.pop()``/
+  ``.clear()``/``.add()``) outside the blessed helpers
+  (``swap_out``/``swap_in``/``discard_swap``/``_incref``/
+  ``_decref_page``/``_evict_prefix_page``/``mark_host_down``/
+  ``mark_host_up`` — see ``_SWAP_BLESSED``) double-frees staged
+  bytes, resurrects evicted pages, or routes admissions to a dead
+  host. Same blessed-set machinery as FX106, different ledgers.
 """
 
 from __future__ import annotations
@@ -91,6 +105,8 @@ RULES = {
     "InflightStep chunk record",
     "FX106": "block-table write or free-heap mutation outside the "
     "blessed refcount helpers",
+    "FX107": "swap/eviction ledger mutation outside the blessed "
+    "allocator helpers",
 }
 
 #: the only functions allowed to write `block_tables` entries or touch
@@ -115,6 +131,42 @@ _REFCOUNT_BLESSED = {
     "register_prefix",
     "_page_faults",
     "release_stolen_pages",
+    # PR 14 pressure-degradation seams: eviction reroutes a retained
+    # page back to the heap, _pop_free_page is the evict-or-pop gate
+    # every allocation path drains, swap_in reinstalls staged pages
+    "_evict_prefix_page",
+    "_pop_free_page",
+    "swap_in",
+}
+
+#: the only functions allowed to mutate the swap/eviction ledgers
+#: (FX107): the host-swap table `_swapped`, the publication-only LRU
+#: `_pub_only`, and the downed-host set `_hosts_down`. `__init__` is
+#: construction, not mutation (same rationale as FX106).
+_SWAP_BLESSED = {
+    "__init__",
+    "swap_out",
+    "swap_in",
+    "discard_swap",
+    "_incref",
+    "_decref_page",
+    "_evict_prefix_page",
+    "mark_host_down",
+    "mark_host_up",
+}
+
+_SWAP_LEDGER_ATTRS = {"_swapped", "_pub_only", "_hosts_down"}
+
+#: method calls that mutate a dict/set ledger in place
+_SWAP_MUTATING_METHODS = {
+    "pop",
+    "popitem",
+    "update",
+    "clear",
+    "setdefault",
+    "add",
+    "discard",
+    "remove",
 }
 
 _STEP_PARAM_NAMES = {"step", "inflight"}
@@ -360,6 +412,71 @@ def _refcount_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
     return found
 
 
+def _swap_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
+    """(description, line, offender) for swap/eviction ledger mutations
+    outside the blessed allocator helpers (FX107): subscript stores,
+    ``del`` statements, attribute rebinding, or in-place mutating
+    method calls reaching ``_swapped`` / ``_pub_only`` /
+    ``_hosts_down``. Reads never match — resurrection checks, budget
+    math, and the invariant audit all read freely."""
+    found: List[Tuple[str, int, str]] = []
+
+    def ledger_attr_of(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and (
+            node.attr in _SWAP_LEDGER_ATTRS
+        ):
+            return node.attr
+        return None
+
+    def store_target_attr(t: ast.AST) -> Optional[str]:
+        # `x._swapped[h] = ...` / `x._swapped = {}` / `del x._pub_only[p]`
+        if isinstance(t, ast.Subscript):
+            return ledger_attr_of(t.value)
+        return ledger_attr_of(t)
+
+    def visit(node: ast.AST, owner: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            owner = node.name
+            if owner in _SWAP_BLESSED:
+                return
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign,)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(t.elts)
+                continue
+            attr = store_target_attr(t)
+            if attr is not None:
+                found.append(
+                    (f"writes the '{attr}' ledger", node.lineno, owner)
+                )
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SWAP_MUTATING_METHODS
+        ):
+            attr = ledger_attr_of(node.func.value)
+            if attr is not None:
+                found.append(
+                    (
+                        f"mutates the '{attr}' ledger via "
+                        f".{node.func.attr}()",
+                        node.lineno,
+                        owner,
+                    )
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, owner)
+
+    visit(tree, "<module>")
+    return found
+
+
 def _is_trace_hook(node: ast.Call) -> bool:
     """A SearchTrace recording call: `<...>.trace.candidate(...)`,
     `trace.result(...)`, `self._trace.event(...)` — the method is one
@@ -430,6 +547,23 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                     "its sharers, or leaked forever); route through "
                     "alloc/alloc_shared/ensure_position/truncate/free "
                     "or the _incref/_decref seams",
+                )
+            )
+    for path, tree in trees.items():
+        for what, line, owner in _swap_violations(tree):
+            diags.append(
+                Diagnostic(
+                    "FX107",
+                    path,
+                    line,
+                    f"'{owner}' {what} outside the blessed swap/"
+                    "eviction helpers — check_invariants re-derives "
+                    "the swap-bytes budget, page conservation, and "
+                    "host routing from these ledgers, so raw mutation "
+                    "double-frees staged bytes or resurrects evicted "
+                    "pages; route through swap_out/swap_in/"
+                    "discard_swap, the _incref/_decref_page seams, or "
+                    "mark_host_down/mark_host_up",
                 )
             )
     for path, tree in trees.items():

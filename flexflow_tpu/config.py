@@ -183,6 +183,16 @@ class FFConfig:
     serve_mesh: str = ""
     serve_hosts: int = 0
     serve_export_strategy: str = ""
+    # graceful degradation under pressure (serving/kv_cache.py +
+    # scheduler.py): --kv-swap stages preemption victims' pages to host
+    # buffers and restores them at re-admission (no re-prefill),
+    # --kv-swap-bytes caps the host bytes held at once (0 = unbounded),
+    # --prefix-evict "lru" lets publication-only prefix pages be
+    # reclaimed under pool pressure before any live request is
+    # preempted ("none" retains them forever)
+    serve_kv_swap: bool = False
+    serve_kv_swap_bytes: int = 0
+    serve_prefix_evict: str = "none"
 
     @property
     def num_devices(self) -> int:
@@ -352,6 +362,12 @@ class FFConfig:
                 cfg.serve_hosts = int(take())
             elif a == "--serve-export-strategy":
                 cfg.serve_export_strategy = take()
+            elif a == "--kv-swap":
+                cfg.serve_kv_swap = True
+            elif a == "--kv-swap-bytes":
+                cfg.serve_kv_swap_bytes = int(take())
+            elif a == "--prefix-evict":
+                cfg.serve_prefix_evict = take()
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

@@ -340,6 +340,22 @@ class CostModel:
         wire = (group_size - 1) / group_size * bytes_per_chip
         return self._ici_time(wire, hops=group_size - 1)
 
+    def swap_cost(self, bytes_moved: float) -> float:
+        """Seconds to stage `bytes_moved` across the chip<->host link —
+        the price of KV swap-to-host (serving/scheduler.py weighs it
+        against estimate_recompute_step when picking swap vs recompute
+        for a preemption victim). Uses the machine model's PCIe comm
+        device when one is attached (NetworkedMachineModel models the
+        host link explicitly); otherwise the same defaults that device
+        is built from: 32 GB/s x efficiency, 2 us setup latency."""
+        if bytes_moved <= 0:
+            return 0.0
+        pcie = getattr(self.machine_model, "_pcie", None)
+        if pcie is not None:
+            return pcie.latency_s + bytes_moved / pcie.bandwidth_Bps
+        bw = 32.0 * 1e9 * self.efficiency
+        return 2e-6 + bytes_moved / bw
+
     # -- compute ------------------------------------------------------------
 
     def _roofline(

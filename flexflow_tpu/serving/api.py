@@ -125,6 +125,19 @@ class ServeConfig:
     # no page pool to shard.
     serve_mesh: str = ""
     serve_hosts: int = 0
+    # graceful degradation under pressure (serving/kv_cache.py +
+    # scheduler.py). kv_swap (--kv-swap): a preemption victim's
+    # committed pages are staged to host buffers and restored
+    # page-for-page at re-admission — no re-prefill — whenever the cost
+    # model prices the copy under the recompute; kv_swap_bytes
+    # (--kv-swap-bytes) caps the host bytes held at once (0 =
+    # unbounded). prefix_evict (--prefix-evict): "lru" lets published
+    # prefix pages whose refcount is publication-only be reclaimed
+    # (last-use LRU order) before any live request is preempted;
+    # "none" retains them forever (the pre-PR-14 behavior).
+    kv_swap: bool = False
+    kv_swap_bytes: int = 0
+    prefix_evict: str = "none"
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -242,6 +255,26 @@ class ServeConfig:
             from flexflow_tpu.serving.distributed import parse_serve_mesh
 
             parse_serve_mesh(self.serve_mesh)  # raises on malformed text
+        if self.kv_swap and self.kv_layout != "paged":
+            raise ValueError(
+                "kv_swap requires kv_layout='paged' (swap stages whole "
+                "pages; the slot layout has none)"
+            )
+        if self.kv_swap_bytes < 0:
+            raise ValueError(
+                f"kv_swap_bytes must be >= 0 (0 = unbounded), got "
+                f"{self.kv_swap_bytes}"
+            )
+        if self.prefix_evict not in ("none", "lru"):
+            raise ValueError(
+                f"prefix_evict must be 'none' or 'lru', got "
+                f"{self.prefix_evict!r}"
+            )
+        if self.prefix_evict != "none" and not self.prefix_cache:
+            raise ValueError(
+                "prefix_evict needs prefix_cache=True (only published "
+                "prefix pages are ever evictable)"
+            )
 
     @property
     def telemetry_requested(self) -> bool:
@@ -288,6 +321,9 @@ class ServeConfig:
             telemetry=cfg.serve_telemetry,
             serve_mesh=cfg.serve_mesh,
             serve_hosts=cfg.serve_hosts,
+            kv_swap=cfg.serve_kv_swap,
+            kv_swap_bytes=cfg.serve_kv_swap_bytes,
+            prefix_evict=cfg.serve_prefix_evict,
         )
 
 
@@ -377,6 +413,8 @@ def build_scheduler(
             num_pages=serve.kv_pages,
             kv_dtype=serve.kv_dtype,
             prefix_cache=serve.prefix_cache,
+            prefix_evict=serve.prefix_evict,
+            swap_bytes_budget=serve.kv_swap_bytes,
         )
     else:
         cache = KVCache.from_model(
@@ -412,8 +450,63 @@ def build_scheduler(
         telemetry=telemetry,
         token_budget=serve.token_budget,
         chunk_size=serve.chunk_size,
+        kv_swap=serve.kv_swap,
+        swap_decider=(
+            build_swap_decider(model) if serve.kv_swap else None
+        ),
     )
     return sched, engine, cache
+
+
+def build_swap_decider(model):
+    """A `(cache, request) -> bool` callable pricing swap vs recompute
+    for one preemption victim: True when staging the victim's pages out
+    AND back in (2x swap_bytes_for over the host link,
+    CostModel.swap_cost) beats recomputing its committed history at
+    re-admission (estimate_recompute_step's modeled step time). Falls
+    back to None — always-swap — when the model carries no compiled
+    graph/cost-model context to price against; a pricing failure at
+    preempt time must never lose the victim, so the scheduler also
+    treats a raising decider as a refusal."""
+    try:
+        from flexflow_tpu.core.machine import MachineSpec
+        from flexflow_tpu.search.auto import estimate_recompute_step
+        from flexflow_tpu.search.cost_model import CostModel
+        from flexflow_tpu.search.machine_model import build_machine_model
+
+        graph = getattr(model, "graph", None)
+        cfg = getattr(model, "config", None)
+        if graph is None or cfg is None or not graph.nodes:
+            return None
+        spec = MachineSpec(
+            num_nodes=max(1, cfg.num_nodes),
+            chips_per_node=1,
+            chip=cfg.chip,
+        )
+        cm = CostModel(spec, machine_model=build_machine_model(cfg, spec))
+        placement = getattr(model, "serving_placement", None)
+        dp = max(1, int(getattr(placement, "dp", 1)))
+        tp = max(1, int(getattr(placement, "tp", 1)))
+    except Exception:
+        return None
+
+    def decide(cache, req) -> bool:
+        resume_len = len(req.prompt) + len(req.generated)
+        cost = estimate_recompute_step(
+            graph,
+            cm,
+            dp,
+            tp,
+            resume_len,
+            page_size=getattr(cache.spec, "page_size", 0),
+            decode_kernel="dense",
+        )
+        if cost is None:
+            return True  # nothing to price against: prefer the copy
+        swap_s = cm.swap_cost(2 * cache.swap_bytes_for(req.slot))
+        return swap_s < cost.step_time
+
+    return decide
 
 
 def generate(

@@ -23,7 +23,13 @@ threaded through the engine/scheduler seams that injects
 * **step latency spikes** — a host-side sleep before an iteration
   (deadlines must fire, goodput accounting must stay honest);
 * **mid-flight cancellation** — `scheduler.cancel(rid)` on a running
-  request (its slot and pages must free; the stream must stop).
+  request (its slot and pages must free; the stream must stop);
+* **swap failure** — a KV swap_out/swap_in attempt refuses (the
+  scheduler must degrade to recompute-preemption / recompute
+  re-admission — never a lost request);
+* **host-partition failure** — a pod host partition goes down for a
+  bounded window (the scheduler must drain its requests to survivors
+  and re-join it on recovery).
 
 Determinism discipline: every decision draws from a fresh
 `np.random.default_rng([seed, iteration, site, key])` stream, so the
@@ -68,7 +74,15 @@ class DraftFault(FaultError):
 
 
 # deterministic sub-stream ids per injection site
-_SITE = {"spike": 1, "cancel": 2, "nan": 3, "kernel": 4, "draft": 5}
+_SITE = {
+    "spike": 1,
+    "cancel": 2,
+    "nan": 3,
+    "kernel": 4,
+    "draft": 5,
+    "swap_fail": 6,
+    "host_down": 7,
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,15 +122,38 @@ class FaultPlan:
     steal_iters: Sequence[int] = ()
     steal_pages: int = 0
     steal_hold: int = 2
+    # KV swap failure: per-attempt probability that a swap_out (stage to
+    # host) or swap_in (restore) refuses — the scheduler must degrade to
+    # recompute-preemption / recompute re-admission, never lose the
+    # request
+    swap_fail_rate: float = 0.0
+    swap_fail_iters: Sequence[int] = ()
+    # host-partition failure: {iteration: host} marks that host's
+    # partition lost at that iteration; it recovers (scheduler.host_up)
+    # `host_down_hold` iterations later
+    host_down_iters: Mapping[int, int] = dataclasses.field(
+        default_factory=dict
+    )
+    host_down_hold: int = 3
 
     def __post_init__(self):
         for name in ("nan_rate", "kernel_rate", "draft_rate", "spike_rate",
-                     "cancel_rate"):
+                     "cancel_rate", "swap_fail_rate"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
         if self.spike_s < 0.0 or self.steal_pages < 0 or self.steal_hold < 0:
             raise ValueError("spike_s / steal_pages / steal_hold must be >= 0")
+        if self.host_down_hold < 1:
+            raise ValueError(
+                f"host_down_hold must be >= 1, got {self.host_down_hold}"
+            )
+        for it, host in self.host_down_iters.items():
+            if int(it) < 0 or int(host) < 0:
+                raise ValueError(
+                    "host_down_iters maps iterations >= 0 to hosts >= 0, "
+                    f"got {{{it}: {host}}}"
+                )
 
 
 class FaultInjector:
@@ -135,6 +172,8 @@ class FaultInjector:
         self._iter = 0
         # pages stolen from a paged cache's free pool: [(page, release_iter)]
         self._stolen: List[Tuple[int, int]] = []
+        # host partitions currently marked down: [(host, recover_iter)]
+        self._downed: List[Tuple[int, int]] = []
 
     def _rng(
         self, site: str, key: int = 0, iteration: Optional[int] = None
@@ -177,6 +216,40 @@ class FaultInjector:
         cache = scheduler.cache
         if getattr(cache, "paged", False):
             self._page_faults(cache)
+        self._host_faults(scheduler)
+
+    def _host_faults(self, scheduler) -> None:
+        """Recover held-down hosts whose hold window closed, then fire
+        this iteration's scheduled host_down. Never downs the last
+        alive host — a pod with zero partitions is an outage, not a
+        degradation, and the drain contract (every request completes on
+        survivors) would be unsatisfiable."""
+        plan = self.plan
+        cache = scheduler.cache
+        if not plan.host_down_iters and not self._downed:
+            return
+        kept: List[Tuple[int, int]] = []
+        for host, recover_iter in self._downed:
+            if self._iter >= recover_iter:
+                scheduler.host_up(host)
+            else:
+                kept.append((host, recover_iter))
+        self._downed = kept
+        host = plan.host_down_iters.get(self._iter)
+        if host is None:
+            return
+        host = int(host)
+        num_hosts = getattr(cache, "num_hosts", 1)
+        if not getattr(cache, "paged", False) or num_hosts <= 1:
+            return
+        down = {h for h, _ in self._downed}
+        if host in down or host >= num_hosts:
+            return
+        if len(down) + 1 >= num_hosts:
+            return  # never down the last alive host
+        scheduler.host_down(host)
+        self._downed.append((host, self._iter + plan.host_down_hold))
+        self.injected["host_down"] += 1
 
     def _page_faults(self, cache) -> None:
         """Steal pages at scheduled iterations; return them after the
@@ -241,6 +314,25 @@ class FaultInjector:
                 hit.append(slot)
                 self.injected["nan"] += 1
         return hit
+
+    def maybe_swap_fail(self, op: str = "swap_out") -> bool:
+        """Whether this swap attempt fails. `op` is "swap_out" (staging
+        a victim's pages to host) or "swap_in" (restoring them) — the
+        two draw from distinct sub-streams so a plan can be replayed
+        regardless of how many of each the scheduler attempts. The
+        scheduler degrades a failed swap to recompute; this method only
+        decides and counts."""
+        plan = self.plan
+        if plan.swap_fail_rate <= 0.0 and not plan.swap_fail_iters:
+            return False
+        key = 0 if op == "swap_out" else 1
+        if self._iter in set(plan.swap_fail_iters) or (
+            plan.swap_fail_rate > 0.0
+            and self._rng("swap_fail", key).random() < plan.swap_fail_rate
+        ):
+            self.injected["swap_fail"] += 1
+            return True
+        return False
 
     def maybe_draft_fault(self) -> None:
         plan = self.plan

@@ -399,12 +399,21 @@ class KVCache:
             "kv_pages_reserved": 0,
             "kv_inflight_depth": self._inflight_depth,
             "kv_prefix_pages_shared": 0,
+            "kv_swapped_pages": 0,
+            "kv_pages_pub_only": 0,
         }
 
     def telemetry_counters(self) -> Dict[str, int]:
         """Series parity with PagedKVCache (the slot layout never
-        shares pages)."""
-        return {"kv_prefix_hits_total": 0, "kv_cow_copies_total": 0}
+        shares, swaps, or evicts pages)."""
+        return {
+            "kv_prefix_hits_total": 0,
+            "kv_cow_copies_total": 0,
+            "kv_swap_out_total": 0,
+            "kv_swap_in_total": 0,
+            "kv_swap_bytes_total": 0,
+            "kv_prefix_evictions_total": 0,
+        }
 
     def check_invariants(self, extra_free: int = 0) -> None:
         """Assert the slot bookkeeping is consistent — the chaos-harness
@@ -493,12 +502,18 @@ class PagedKVCache:
         shardings=None,
         prefix_cache=False,
         placement=None,
+        prefix_evict: str = "none",
+        swap_bytes_budget: int = 0,
     ):
         import jax
         import jax.numpy as jnp
 
         if not spec.paged:
             raise ValueError("PagedKVCache needs a spec with page_size > 0")
+        if prefix_evict not in ("none", "lru"):
+            raise ValueError(
+                f"prefix_evict must be 'none' or 'lru', got {prefix_evict!r}"
+            )
         _validate_page_geometry(
             spec.max_seqs, spec.max_len, spec.page_size, spec.num_pages
         )
@@ -621,6 +636,39 @@ class PagedKVCache:
         self._page_keys: Dict[int, bytes] = {}
         self.prefix_hits = 0  # admissions that mapped >= 1 shared page
         self.cow_copies = 0  # divergent writes that copied a page
+        # published-prefix eviction (prefix_evict="lru"): a published
+        # page whose LAST table reference drops is RETAINED — refcount 0,
+        # off the free heap, still advertised by the hash index — in
+        # `_pub_only` (page -> (LRU stamp, wait-for window id)) instead
+        # of released. Under pool pressure the least-recently-published
+        # page is unpublished and returned to the free heap BEFORE any
+        # live request is swapped or preempted; a new admission matching
+        # it resurrects the mapping (refcount 0 -> 1) at zero pool cost.
+        # The wait-window tag mirrors limbo's discipline: an in-flight
+        # step dispatched before the release may still WRITE the page's
+        # pool rows, so eviction (which hands the page to a new writer)
+        # waits for that window to close; read-only resurrection is
+        # always safe and is not gated.
+        self.prefix_evict = prefix_evict
+        self._pub_only: Dict[int, Tuple[int, int]] = {}
+        self._evict_tick = 0
+        self.prefix_evictions = 0
+        # KV swap-to-host (vLLM's swap alternative to recompute): a
+        # victim's committed pages are device-gathered into host numpy
+        # buffers keyed by a monotonic handle; re-admission scatters
+        # them into freshly claimed pages — no re-prefill. The bytes
+        # ledger enforces `swap_bytes_budget` (0 = unlimited) across
+        # every outstanding handle.
+        self.swap_bytes_budget = int(swap_bytes_budget)
+        self._swapped: Dict[int, Dict[str, object]] = {}
+        self._swap_seq = 0
+        self._swap_bytes_held = 0
+        self.swap_outs = 0
+        self.swap_ins = 0
+        self.swap_bytes_total = 0
+        # host-failure drain: partitions marked lost refuse admission
+        # (_pick_host / alloc_shared skip them) until marked up again
+        self._hosts_down: set = set()
         # in-flight window (async dispatch): while a dispatched step's
         # deferred device reads may still reference the block tables it
         # was handed, pages released by free/truncate go to _limbo
@@ -699,21 +747,49 @@ class PagedKVCache:
         return int(p) // self._pages_per_host
 
     def _host_avail(self, h: int) -> int:
-        """Free pages minus the growth reserve on host `h` — the
-        admission headroom. A ONE-STEP-STALE view is safe by design:
-        pages released during an open in-flight window sit in limbo (not
-        the free heap), so this count only under-promises; it never
-        hands out a page an in-flight step could still read."""
-        return len(self._free_pages_h[h]) - self._reserved_h[h]
+        """Free pages plus evictable publication-only pages minus the
+        growth reserve on host `h` — the admission headroom. A
+        ONE-STEP-STALE view is safe by design: pages released during an
+        open in-flight window sit in limbo (not the free heap), so this
+        count only under-promises; it never hands out a page an
+        in-flight step could still read. Counting evictable pages here
+        is what makes prefix eviction happen BEFORE any live request is
+        swapped or preempted: admission and page claims see the
+        headroom, and `_pop_free_page` evicts lazily when the heap runs
+        dry."""
+        return (
+            len(self._free_pages_h[h])
+            + self._evictable_count(h)
+            - self._reserved_h[h]
+        )
+
+    def mark_host_down(self, h: int) -> None:
+        """Mark host partition `h` lost: `_pick_host` and `alloc_shared`
+        refuse it until `mark_host_up`. The partition's ledgers stay
+        intact (its pool content is gone with its devices, but the
+        accounting still re-derives) — the scheduler drains its RUNNING
+        requests to surviving hosts."""
+        if not 0 <= h < self.num_hosts:
+            raise ValueError(f"host {h} outside [0, {self.num_hosts})")
+        self._hosts_down.add(h)
+
+    def mark_host_up(self, h: int) -> None:
+        """Re-join a recovered host partition into admission."""
+        self._hosts_down.discard(h)
+
+    @property
+    def hosts_down(self) -> frozenset:
+        return frozenset(self._hosts_down)
 
     def _pick_host(self, need: int) -> Optional[int]:
-        """Choose the admission host: any with a free slot whose free
-        view covers `need` pages; most headroom wins, ties to the lowest
-        host id (deterministic). None when no host can admit."""
+        """Choose the admission host: any alive host with a free slot
+        whose free view covers `need` pages; most headroom wins, ties to
+        the lowest host id (deterministic). None when no host can
+        admit."""
         best = None
         best_avail = -1
         for h in range(self.num_hosts):
-            if not self._free_slots_h[h]:
+            if h in self._hosts_down or not self._free_slots_h[h]:
                 continue
             avail = self._host_avail(h)
             if avail >= need and avail > best_avail:
@@ -796,7 +872,7 @@ class PagedKVCache:
         slot = heapq.heappop(self._free_slots_h[h])
         self._active.add(slot)
         for i in range(need_now):
-            self._install_page(slot, i, heapq.heappop(self._free_pages_h[h]))
+            self._install_page(slot, i, self._pop_free_page(h))
         self._held[slot] = need_now
         if optimistic:
             # no growth reserve: _max_pages tracks _held so this slot
@@ -822,23 +898,82 @@ class PagedKVCache:
         self._refcounts[page] = 1
 
     def _incref(self, slot: int, pi: int, page: int) -> None:
-        """Map an already-live page as a SHARED entry of `slot`."""
+        """Map an already-live (or publication-only retained) page as a
+        SHARED entry of `slot`. Resurrecting a retained page (refcount
+        0 -> 1) removes it from the eviction candidates — it is live
+        again and its sharers protect it."""
         self.block_tables[slot, pi] = page
         self._refcounts[page] += 1
         self._entry_shared[slot, pi] = True
         self._shared[slot] += 1
+        if page in self._pub_only:
+            del self._pub_only[page]
 
     def _decref_page(self, page: int) -> None:
-        """Drop one reference; the last owner unpublishes the page from
-        the hash index and releases it (through the in-flight limbo when
-        a dispatched step may still read it)."""
+        """Drop one reference. Under prefix_evict="lru" a PUBLISHED
+        page whose last reference drops is retained as an eviction
+        candidate (still matchable, resurrectable at zero pool cost)
+        instead of released — closing the "last owner unpublishes" gap:
+        publication alone now keeps a page warm until pool pressure
+        actually needs it back. Otherwise the last owner unpublishes
+        the page and releases it (through the in-flight limbo when a
+        dispatched step may still read it)."""
         self._refcounts[page] -= 1
         assert self._refcounts[page] >= 0
         if self._refcounts[page] == 0:
+            if self.prefix_evict != "none" and page in self._page_keys:
+                self._evict_tick += 1
+                self._pub_only[page] = (self._evict_tick, self._window_seq)
+                return
             key = self._page_keys.pop(page, None)
             if key is not None and self._prefix_index.get(key) == page:
                 del self._prefix_index[key]
             self._release_page(page)
+
+    def _evictable_count(self, h: int) -> int:
+        """Publication-only pages homed on host `h` whose wait window
+        has closed — claimable via `_evict_prefix_page`. Pages retained
+        while an in-flight window was open stay uncounted until that
+        window reconciles (same discipline as limbo: an in-flight step
+        may still write their rows)."""
+        if not self._pub_only:
+            return 0
+        return sum(
+            1
+            for p, (_, wid) in self._pub_only.items()
+            if wid <= self._window_closed and self._page_home(p) == h
+        )
+
+    def _evict_prefix_page(self, h: int) -> None:
+        """Evict the least-recently-published publication-only page
+        homed on host `h`: unpublish it from the hash index and push it
+        straight onto the free heap (its wait window closed, so no
+        in-flight step can touch it)."""
+        cands = [
+            (stamp, p)
+            for p, (stamp, wid) in self._pub_only.items()
+            if wid <= self._window_closed and self._page_home(p) == h
+        ]
+        if not cands:
+            raise PagePoolExhausted(
+                f"host {h}: no evictable publication-only page"
+            )
+        _, page = min(cands)
+        del self._pub_only[page]
+        key = self._page_keys.pop(page, None)
+        if key is not None and self._prefix_index.get(key) == page:
+            del self._prefix_index[key]
+        heapq.heappush(self._free_pages_h[h], page)
+        self.prefix_evictions += 1
+
+    def _pop_free_page(self, h: int) -> int:
+        """The one pop path for host `h`'s free-page heap: when the
+        heap is dry, evict a publication-only prefix page to refill it
+        — live requests are ALWAYS served from published-but-idle
+        capacity before anyone is swapped or preempted."""
+        if not self._free_pages_h[h]:
+            self._evict_prefix_page(h)
+        return heapq.heappop(self._free_pages_h[h])
 
     def _decref_entry(self, slot: int, pi: int) -> None:
         """Clear one block-table entry: sentinel the mapping, settle the
@@ -943,7 +1078,7 @@ class PagedKVCache:
         # reduce to the full match and the old admission check exactly.
         best = None  # (m, avail, -h) ordering via explicit compare
         for h in range(self.num_hosts):
-            if not self._free_slots_h[h]:
+            if h in self._hosts_down or not self._free_slots_h[h]:
                 continue
             m_h = 0
             for page in matched_all:
@@ -954,7 +1089,12 @@ class PagedKVCache:
             fresh_h = max(0, self._pages_for(prompt_len) - m_h)
             max_p_h = self._pages_for(total) - (cursor_h // ps)
             need_h = fresh_h if optimistic else max_p_h
-            avail = self._host_avail(h)
+            # matched publication-only pages are about to be RESURRECTED
+            # (mapped, not evicted), so the headroom they contribute as
+            # eviction candidates is not really there for this admission
+            avail = self._host_avail(h) - sum(
+                1 for page in matched_all[:m_h] if page in self._pub_only
+            )
             if avail < need_h:
                 continue
             if best is None or (m_h, avail) > (best[0], best[1]):
@@ -976,7 +1116,7 @@ class PagedKVCache:
         for i, page in enumerate(matched):
             self._incref(slot, i, page)
         for i in range(m, m + fresh_now):
-            self._install_page(slot, i, heapq.heappop(self._free_pages_h[h]))
+            self._install_page(slot, i, self._pop_free_page(h))
         self._held[slot] = m + fresh_now
         if optimistic:
             self._optimistic.add(slot)
@@ -1009,7 +1149,7 @@ class PagedKVCache:
                         f"{self._reserved_h[h]} "
                         "reserved leaves none"
                     )
-            elif not self._free_pages_h[h]:
+            elif not self._free_pages_h[h] and not self._evictable_count(h):
                 if self._limbo:
                     raise PagePoolExhausted(
                         f"free-page pool exhausted: {len(self._limbo)} pages "
@@ -1020,7 +1160,11 @@ class PagedKVCache:
                     "free-page pool exhausted despite the admission reserve "
                     "— allocator invariant violated"
                 )
-            new = heapq.heappop(self._free_pages_h[h])
+            new = (
+                heapq.heappop(self._free_pages_h[h])
+                if self._free_pages_h[h]
+                else self._pop_free_page(h)  # LRU-evict a retained page
+            )
             # functional rebind (fresh dicts, whole-attribute swap), not
             # in-place entry mutation: any already-queued step read the
             # OLD array objects, which the .at[].set() copies leave
@@ -1078,11 +1222,11 @@ class PagedKVCache:
                     f"needs a page but {len(self._free_pages_h[h])} free - "
                     f"{self._reserved_h[h]} reserved leaves none"
                 )
-            self._install_page(slot, pi, heapq.heappop(self._free_pages_h[h]))
+            self._install_page(slot, pi, self._pop_free_page(h))
             self._held[slot] += 1
             self._max_pages[slot] = self._owned(slot)
             return
-        if not self._free_pages_h[h]:
+        if not self._free_pages_h[h] and not self._evictable_count(h):
             if self._limbo:
                 raise PagePoolExhausted(
                     f"free-page pool exhausted: {len(self._limbo)} pages "
@@ -1093,7 +1237,7 @@ class PagedKVCache:
                 "free-page pool exhausted despite the admission reserve — "
                 "allocator invariant violated"
             )
-        self._install_page(slot, pi, heapq.heappop(self._free_pages_h[h]))
+        self._install_page(slot, pi, self._pop_free_page(h))
         self._held[slot] += 1
         if self._owned(slot) <= self._max_pages[slot]:
             self._reserved_h[h] -= 1
@@ -1153,6 +1297,149 @@ class PagedKVCache:
         self.lengths[slot] = 0
         heapq.heappush(self._free_slots_h[self.host_of_slot(slot)], slot)
 
+    # -- KV swap-to-host (swap vs recompute preemption) ----------------------
+
+    def swap_bytes_for(self, slot: int) -> int:
+        """Host bytes one swap-out of `slot` would stage: its held
+        pages' K/V rows across every layer, plus the int8 fp32 scale
+        slivers — the bytes_moved the cost model prices against one
+        recompute prefill."""
+        spec = self.spec
+        per_page = (
+            2 * spec.itemsize * spec.page_size * spec.num_heads * spec.head_dim
+        )
+        if self.quantized:
+            per_page += 2 * 4 * spec.num_heads
+        return int(self._held[slot]) * per_page * len(spec.layer_guids)
+
+    @property
+    def swapped_pages(self) -> int:
+        """Pages' worth of KV currently staged in host swap buffers."""
+        return sum(int(rec["pages"]) for rec in self._swapped.values())
+
+    def swap_out(self, slot: int) -> Optional[int]:
+        """Stage `slot`'s committed pages (K/V pools AND int8 scale
+        slivers, in block-table order) into host buffers, free the slot,
+        and return a swap handle `swap_in` restores from. Returns None —
+        the caller degrades to recompute-preemption — when an in-flight
+        step could still write the slot's pages (the scheduler drains
+        the pipeline first, so this is a belt-and-braces refusal) or
+        when `swap_bytes_budget` would be exceeded. The staged copy is
+        the COMMITTED pool content, so a restore resumes decoding with
+        value-identical KV rows — no re-prefill."""
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        if self._inflight_depth > 0:
+            return None
+        bytes_staged = self.swap_bytes_for(slot)
+        if (
+            self.swap_bytes_budget
+            and self._swap_bytes_held + bytes_staged > self.swap_bytes_budget
+        ):
+            return None
+        sentinel = self.spec.num_pages
+        pages = [int(p) for p in self.block_tables[slot] if p != sentinel]
+        idx = np.asarray(pages, dtype=np.int32)
+        hk: Dict[int, np.ndarray] = {}
+        hv: Dict[int, np.ndarray] = {}
+        hks: Dict[int, np.ndarray] = {}
+        hvs: Dict[int, np.ndarray] = {}
+        for g in self.spec.layer_guids:
+            kp, vp = self.k[g], self.v[g]
+            hk[g] = np.asarray(kp[idx])
+            hv[g] = np.asarray(vp[idx])
+            if self.quantized:
+                ksp, vsp = self.k_scale[g], self.v_scale[g]
+                hks[g] = np.asarray(ksp[idx])
+                hvs[g] = np.asarray(vsp[idx])
+        handle = self._swap_seq
+        self._swap_seq += 1
+        self._swapped[handle] = {
+            "k": hk,
+            "v": hv,
+            "k_scale": hks,
+            "v_scale": hvs,
+            "length": int(self.lengths[slot]),
+            "pages": len(pages),
+            "bytes": bytes_staged,
+        }
+        self._swap_bytes_held += bytes_staged
+        self.swap_outs += 1
+        self.swap_bytes_total += bytes_staged
+        self.free(slot)
+        return handle
+
+    def swap_in(
+        self,
+        handle: int,
+        total_len: Optional[int] = None,
+        optimistic: bool = False,
+    ) -> Optional[int]:
+        """Restore a swapped-out sequence: claim a fresh slot and pages
+        on any alive host, scatter the staged rows back into the pools
+        (functional rebind, same discipline as `_cow_page`), and set the
+        slot's length to the staged length — the stream resumes with a
+        plain decode, token- and logit-identical to never-swapped.
+        `total_len` sizes the growth reserve exactly like `alloc`'s;
+        None means no host can admit (the handle stays valid for a
+        later retry or `discard_swap`)."""
+        rec = self._swapped.get(handle)
+        if rec is None:
+            raise KeyError(f"unknown swap handle {handle}")
+        spec = self.spec
+        n = int(rec["pages"])
+        total = max(int(rec["length"]), total_len if total_len else 0)
+        if total > spec.max_len:
+            raise ValueError(
+                f"sequence of {total} tokens exceeds max_len {spec.max_len}"
+            )
+        max_p = max(n, self._pages_for(total))
+        h = self._pick_host(n if optimistic else max_p)
+        if h is None:
+            return None
+        rec = self._swapped.pop(handle)
+        self._swap_bytes_held -= int(rec["bytes"])
+        slot = heapq.heappop(self._free_slots_h[h])
+        self._active.add(slot)
+        pages = [self._pop_free_page(h) for _ in range(n)]
+        for i, page in enumerate(pages):
+            self._install_page(slot, i, page)
+        self._held[slot] = n
+        if optimistic:
+            self._optimistic.add(slot)
+            self._max_pages[slot] = n
+        else:
+            self._max_pages[slot] = max_p
+            self._reserved_h[h] += max_p - n
+        self.lengths[slot] = int(rec["length"])
+        if n:
+            import jax.numpy as jnp
+
+            idx = np.asarray(pages, dtype=np.int32)
+            hk, hv = rec["k"], rec["v"]
+            hks, hvs = rec["k_scale"], rec["v_scale"]
+            nk, nv = dict(self.k), dict(self.v)
+            nks, nvs = dict(self.k_scale), dict(self.v_scale)
+            for g in spec.layer_guids:
+                nk[g] = nk[g].at[idx].set(jnp.asarray(hk[g]))
+                nv[g] = nv[g].at[idx].set(jnp.asarray(hv[g]))
+                if self.quantized:
+                    nks[g] = nks[g].at[idx].set(jnp.asarray(hks[g]))
+                    nvs[g] = nvs[g].at[idx].set(jnp.asarray(hvs[g]))
+            self.k, self.v = nk, nv
+            self.k_scale, self.v_scale = nks, nvs
+        self.swap_ins += 1
+        self.swap_bytes_total += int(rec["bytes"])
+        return slot
+
+    def discard_swap(self, handle: int) -> None:
+        """Drop a staged swap record (terminal request, or a swap-in
+        degraded to recompute): its host bytes return to the budget.
+        Unknown handles are ignored — discard races are expected."""
+        rec = self._swapped.pop(handle, None)
+        if rec is not None:
+            self._swap_bytes_held -= int(rec["bytes"])
+
     def commit(
         self,
         new_k: Dict[int, object],
@@ -1192,6 +1479,8 @@ class PagedKVCache:
             "kv_pages_reserved": int(self._reserved),
             "kv_inflight_depth": self._inflight_depth,
             "kv_prefix_pages_shared": int(self._shared.sum()),
+            "kv_swapped_pages": self.swapped_pages,
+            "kv_pages_pub_only": len(self._pub_only),
         }
 
     def telemetry_gauges_host(self, h: int) -> Dict[str, float]:
@@ -1211,6 +1500,9 @@ class PagedKVCache:
             ),
             "kv_free_heap_depth": len(self._free_pages_h[h]),
             "kv_pages_reserved": int(self._reserved_h[h]),
+            "kv_pages_pub_only": sum(
+                1 for p in self._pub_only if self._page_home(p) == h
+            ),
         }
 
     def telemetry_counters(self) -> Dict[str, int]:
@@ -1218,6 +1510,10 @@ class PagedKVCache:
         return {
             "kv_prefix_hits_total": self.prefix_hits,
             "kv_cow_copies_total": self.cow_copies,
+            "kv_swap_out_total": self.swap_outs,
+            "kv_swap_in_total": self.swap_ins,
+            "kv_swap_bytes_total": self.swap_bytes_total,
+            "kv_prefix_evictions_total": self.prefix_evictions,
         }
 
     def check_invariants(self, extra_free: int = 0) -> None:
@@ -1256,16 +1552,27 @@ class PagedKVCache:
         assert np.array_equal(refs, self._refcounts.astype(np.int64))
         assert (owners <= 1).all()
         live = {p for p in range(spec.num_pages) if refs[p] > 0}
+        # publication-only retained pages: refcount 0 (no table maps
+        # them), still published (matchable), off the free heap — a
+        # fourth disjoint population the conservation law must count.
+        # They exist only under an eviction policy.
+        pub_only = set(self._pub_only)
+        assert not pub_only or self.prefix_evict != "none"
+        for p in pub_only:
+            assert refs[p] == 0
+            assert p in self._page_keys
         # conservation over UNIQUE pages: live + free + in-flight limbo
-        # (+ injector-held) is the whole pool; free/limbo pages carry no
-        # references
+        # + publication-only retained (+ injector-held) is the whole
+        # pool; free/limbo/retained pages carry no references
         limbo = [p for p, _ in self._limbo]
         free_all = [p for hp in self._free_pages_h for p in hp]
         assert len(limbo) == len(set(limbo))
         assert live.isdisjoint(free_all)
         assert live.isdisjoint(limbo)
         assert set(limbo).isdisjoint(free_all)
-        assert len(live) + len(free_all) + len(limbo) + (
+        assert pub_only.isdisjoint(free_all)
+        assert pub_only.isdisjoint(limbo)
+        assert len(live) + len(free_all) + len(limbo) + len(pub_only) + (
             extra_free
         ) == spec.num_pages
         # host-partition purity: every free heap holds only its own
@@ -1282,7 +1589,8 @@ class PagedKVCache:
             )
             live_h = sum(1 for p in live if self._page_home(p) == h)
             limbo_h = sum(1 for p in limbo if self._page_home(p) == h)
-            assert live_h + len(self._free_pages_h[h]) + limbo_h + (
+            pub_h = sum(1 for p in pub_only if self._page_home(p) == h)
+            assert live_h + len(self._free_pages_h[h]) + limbo_h + pub_h + (
                 extra_free if h == 0 else 0
             ) == self._pages_per_host
         for s in self._active:
@@ -1290,12 +1598,12 @@ class PagedKVCache:
             for p in self.block_tables[s]:
                 if int(p) != sentinel:
                     assert self._page_home(int(p)) == hs
-        # the hash index only advertises live pages, bijectively with
-        # its reverse map
+        # the hash index only advertises live or publication-only
+        # retained pages, bijectively with its reverse map
         assert len(self._prefix_index) == len(self._page_keys)
         for key, p in self._prefix_index.items():
             assert self._page_keys.get(p) == key
-            assert refs[p] > 0
+            assert refs[p] > 0 or p in pub_only
         # limbo pages only exist while an in-flight window is open
         assert self._inflight_depth >= 0
         if self._limbo:
@@ -1315,9 +1623,11 @@ class PagedKVCache:
             )
             assert resv_h == self._reserved_h[h]
             limbo_h = sum(1 for p in limbo if self._page_home(p) == h)
+            pub_h = sum(1 for p in pub_only if self._page_home(p) == h)
             assert 0 <= self._reserved_h[h] <= (
                 len(self._free_pages_h[h])
                 + limbo_h
+                + pub_h
                 + (extra_free if h == 0 else 0)
             )
         # optimistic slots never carry a growth reserve
@@ -1328,6 +1638,17 @@ class PagedKVCache:
         free_slots_all = [s for hs in self._free_slots_h for s in hs]
         assert self._active.isdisjoint(free_slots_all)
         assert len(self._active) + len(free_slots_all) == spec.max_seqs
+        # swap ledger: the host-bytes counter re-derives from the
+        # outstanding records and never exceeds the budget
+        assert self._swap_bytes_held == sum(
+            int(rec["bytes"]) for rec in self._swapped.values()
+        )
+        if self.swap_bytes_budget:
+            assert self._swap_bytes_held <= self.swap_bytes_budget
+        for rec in self._swapped.values():
+            assert 0 <= int(rec["length"]) <= int(rec["pages"]) * spec.page_size
+        # downed hosts are a subset of the partition
+        assert all(0 <= h < self.num_hosts for h in self._hosts_down)
 
     # -- construction from a compiled model ---------------------------------
 
@@ -1342,6 +1663,8 @@ class PagedKVCache:
         num_pages: int = 0,
         kv_dtype: str = "fp32",
         prefix_cache: bool = False,
+        prefix_evict: str = "none",
+        swap_bytes_budget: int = 0,
     ) -> "PagedKVCache":
         """Derive geometry + shardings from a compiled FFModel. Defaults
         (page_size 0 / num_pages 0) pick the vLLM-style block size and a
@@ -1390,4 +1713,6 @@ class PagedKVCache:
             shardings=shardings,
             prefix_cache=prefix_cache,
             placement=placement,
+            prefix_evict=prefix_evict,
+            swap_bytes_budget=swap_bytes_budget,
         )

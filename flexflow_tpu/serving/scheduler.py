@@ -163,6 +163,10 @@ class Request:
     prefill_seq: List[int] = dataclasses.field(default_factory=list)
     prefill_pos: int = 0
     prefill_dispatched: int = 0
+    # KV swap-to-host (kv_swap=True): handle of this request's staged
+    # pages while it waits PREEMPTED->QUEUED for re-admission — swap_in
+    # restores them (no re-prefill); None everywhere else
+    swap_handle: Optional[int] = None
 
     def log(self, event: str, detail: str = "") -> None:
         if len(self.events) >= max(1, self.events_max):
@@ -279,6 +283,14 @@ _STAT_FIELDS: Dict[str, object] = dict(
     prefix_hits=0,  # admissions that mapped at least one shared page
     prefix_pages_shared=0,  # live shared table entries (gauge-like)
     cow_copies=0,  # copy-on-write page forks
+    # graceful degradation under pressure (kv_swap / prefix_evict;
+    # mirrored from the allocator's ledgers at each iteration end)
+    swap_outs=0,  # victims whose pages rode the host link out
+    swap_ins=0,  # swap-restored re-admissions (no re-prefill)
+    swap_bytes=0,  # Σ bytes staged across the host link, both ways
+    swapped_pages=0,  # pages currently parked in host buffers (gauge)
+    prefix_evictions=0,  # publication-only prefix pages reclaimed
+    host_downs=0,  # host partitions drained after a failure
     # per-request audit-log ring-buffer drops, summed at finalize
     events_dropped=0,
 )
@@ -473,6 +485,8 @@ class _SchedulerBase:
         telemetry=None,
         token_budget: int = 0,
         chunk_size: int = 16,
+        kv_swap: bool = False,
+        swap_decider=None,
     ):
         self.engine = engine
         self.cache = engine.cache
@@ -525,6 +539,16 @@ class _SchedulerBase:
                     f"{engine.decode_kernel!r}"
                 )
         self.injector = injector
+        # KV swap-to-host: when on (paged layout only), a preemption
+        # victim's committed pages ride the host link instead of being
+        # recomputed — unless `swap_decider(cache, request)` (built from
+        # CostModel.swap_cost vs estimate_recompute_step; None means
+        # always-swap) says the recompute is cheaper, or the allocator
+        # refuses (budget / in-flight step), or the injector fails it.
+        self.kv_swap = bool(kv_swap)
+        if self.kv_swap and not getattr(engine.cache, "paged", False):
+            raise ValueError("kv_swap requires the paged KV layout")
+        self.swap_decider = swap_decider
         # ServeConfig.debug_invariants / --check-invariants: re-derive
         # the cache/allocator accounting after EVERY iteration (what the
         # chaos harness does), so an invariant violation surfaces at the
@@ -654,6 +678,12 @@ class _SchedulerBase:
                 if queued is req:
                     del self.queue[i]
                     break
+        if req.swap_handle is not None:
+            # a terminal request still holding host-swapped pages (e.g.
+            # cancelled or timed out while QUEUED) returns its staged
+            # bytes to the swap budget
+            self.cache.discard_swap(req.swap_handle)
+            req.swap_handle = None
         self.finished.append(req)
         stats = self.stats
         stats.events_dropped += req.events_dropped
@@ -729,35 +759,83 @@ class _SchedulerBase:
             self.running.values(), key=lambda r: (r.admit_iter, r.rid)
         )
 
-    def _preempt(self, req: Request) -> None:
+    def _preempt(
+        self, req: Request, cause: str = "pool", allow_swap: bool = True
+    ) -> None:
         """Reclaim the victim's slot and pages and requeue it at the
-        queue HEAD for prefill-from-recompute (prompt + generated so
-        far). A request preempted more than `max_preemptions` times
-        hard-fails instead — the bound that turns a livelock into a
-        diagnosable error."""
+        queue HEAD. With kv_swap the victim's committed pages ride the
+        host link out (`swap_out`) and restore page-for-page at
+        re-admission — no re-prefill; every refusal along that path
+        (ineligible victim, cost decider, swap budget, injected
+        swap_fail, in-flight step) degrades to prefill-from-recompute
+        (prompt + generated so far). A request preempted more than
+        `max_preemptions` times hard-fails instead — the bound that
+        turns a livelock into a diagnosable error — and the failure
+        carries the triggering cause (forensics contract)."""
         req.preemptions += 1
         self.stats.preemptions += 1
         if req.preemptions > self.max_preemptions:
             self._fail(
                 req,
                 f"preempted {req.preemptions} times "
-                f"(max_preemptions {self.max_preemptions})",
+                f"(max_preemptions {self.max_preemptions}; "
+                f"last cause={cause})",
             )
             return
         req.status = RequestStatus.PREEMPTED
-        req.log("preempt", f"iteration {self._iter}")
-        if self._tele is not None:
-            self._tele.registry.counter(
-                "serve_preemptions_total",
-                help="preempt-and-requeue events (optimistic admission)",
-            ).inc()
         if self.proposer is not None:
             self.proposer.retire(req)
         del self.running[req.slot]
-        self.cache.free(req.slot)
+        action = "recompute"
+        if allow_swap and self._swap_eligible(req):
+            handle = self.cache.swap_out(req.slot)
+            if handle is not None:  # None: budget/in-flight refusal
+                req.swap_handle = handle
+                action = "swap"
+        if action == "recompute":
+            self.cache.free(req.slot)
         req.slot = None
+        req.log(
+            "preempt", f"cause={cause} action={action} iteration {self._iter}"
+        )
+        if self._tele is not None:
+            reg = self._tele.registry
+            reg.counter(
+                "serve_preemptions_total",
+                help="preempt-and-requeue events (optimistic admission)",
+            ).inc()
+            reg.counter(
+                "serve_preemptions_total",
+                help="preempt-and-requeue events (optimistic admission)",
+                labels={"cause": cause, "action": action},
+            ).inc()
         req.status = RequestStatus.QUEUED
         self.queue.appendleft(req)
+
+    def _swap_eligible(self, req: Request) -> bool:
+        """Whether this victim's KV should ride the host link instead of
+        being recomputed: swap must be ON and the layout paged, the
+        slot's committed history worth saving (generated tokens exist
+        and no prefill is mid-stream — a half-prefilled slot recomputes
+        its chunks anyway), the injector must not fail the swap-out,
+        and the cost decider must prefer the copy over the recompute."""
+        if not self.kv_swap or not getattr(self.cache, "paged", False):
+            return False
+        if req.slot is None or not req.generated:
+            return False
+        if self._prefill_pending(req):
+            return False
+        if self.injector is not None and self.injector.maybe_swap_fail(
+            "swap_out"
+        ):
+            return False
+        if self.swap_decider is not None:
+            try:
+                if not self.swap_decider(self.cache, req):
+                    return False
+            except Exception:
+                return False  # a broken decider must not lose requests
+        return True
 
     def _secure_pages(self, widths: Dict[int, int]) -> None:
         """Claim every page this iteration's step will touch BEFORE the
@@ -806,6 +884,112 @@ class _SchedulerBase:
         schedulers never have a step in flight — nothing to reclaim."""
         return False
 
+    def _admit_swapped(self, req: Request) -> bool:
+        """Re-admit a host-swapped queue head: restore its staged pages
+        into a fresh slot (no re-prefill — the stream resumes at the
+        next decode from generated[-1], and cache.lengths resumes at
+        len(prompt) + len(generated) - 1, exactly where free() left it).
+        An injected swap_in failure discards the staged copy and sends
+        the head back through the normal recompute path — degraded,
+        never lost. Returns False when no host can take it right now
+        (FIFO: the queue holds behind the head)."""
+        if self.injector is not None and self.injector.maybe_swap_fail(
+            "swap_in"
+        ):
+            self.cache.discard_swap(req.swap_handle)
+            req.swap_handle = None
+            req.log(
+                "swap_in_fail",
+                f"iteration {self._iter} -> recompute re-admission",
+            )
+            return True  # head re-enters the loop on the normal path
+        # restores are always conservative (reserve the full remaining
+        # footprint), even under optimistic admission: a restore that
+        # gets re-evicted at the next boundary crossing made no
+        # progress but paid the host round-trip twice — bring the
+        # stream back only when it can run to completion
+        slot = self.cache.swap_in(
+            req.swap_handle,
+            total_len=len(req.prompt) + req.max_new_tokens,
+            optimistic=False,
+        )
+        if slot is None:
+            return False  # handle stays valid for a later iteration
+        self.queue.popleft()
+        req.swap_handle = None
+        req.slot = slot
+        req.admit_iter = self._iter
+        req.status = RequestStatus.RUNNING
+        # any stale chunk cursors die with the swap restore: the full
+        # committed history is already resident, nothing left to stream
+        req.prefill_seq = []
+        req.prefill_pos = 0
+        req.prefill_dispatched = 0
+        req.log("admit", f"slot {slot} swap_in")
+        self.running[slot] = req
+        if self.proposer is not None:
+            # the draft cache holds no swapped copy — the proposer
+            # re-prefills its side from the committed history (a cold
+            # draft degrades acceptance, never correctness)
+            self.proposer.admit([req])
+        self.stats.peak_in_flight = max(
+            self.stats.peak_in_flight, len(self.running)
+        )
+        return True
+
+    # -- host-failure drain --------------------------------------------------
+
+    def host_down(self, host: int) -> None:
+        """Drain a lost host partition: reap its RUNNING requests to
+        PREEMPTED (recompute — the dead host's pool content is gone
+        with it; queued requests already swapped to host RAM still
+        restore on survivors), refuse it new admissions, and stamp the
+        event in telemetry. The per-host invariants keep re-deriving
+        every iteration: the dead partition's ledgers stay consistent,
+        just unused, so recovery is mark_host_up and nothing else."""
+        cache = self.cache
+        if not getattr(cache, "paged", False) or cache.num_hosts <= 1:
+            raise ValueError(
+                "host_down needs a multi-host paged partition"
+            )
+        t0 = time.perf_counter()
+        # in-flight steps may still reference the dying host's slots —
+        # drain the pipeline first, same discipline as _secure_pages
+        self._reclaim_inflight_pages()
+        cache.mark_host_down(host)
+        self.stats.host_downs += 1
+        victims = sorted(
+            (
+                r
+                for r in self.running.values()
+                if cache.host_of_slot(r.slot) == host
+            ),
+            key=lambda r: (r.admit_iter, r.rid),
+        )
+        for req in victims:
+            # the partition is lost: its device pages cannot be staged
+            # out, so the drain always recomputes
+            self._preempt(req, cause="host_down", allow_swap=False)
+        if self._tele is not None:
+            tele = self._tele
+            tele.registry.counter(
+                "serve_host_down_total",
+                help="host partitions drained after an injected failure",
+                labels={"host": str(host)},
+            ).inc()
+            tele.tracer.complete(
+                "host_down drain",
+                f"host{host}",
+                t0,
+                time.perf_counter(),
+                tid=tele.tracer.host_lane(host),
+                args={"host": host, "reaped": len(victims)},
+            )
+
+    def host_up(self, host: int) -> None:
+        """Re-join a recovered host partition into admission."""
+        self.cache.mark_host_up(host)
+
     # -- shared pieces -------------------------------------------------------
 
     def _admit(self, limit: Optional[int] = None) -> List[Request]:
@@ -828,6 +1012,14 @@ class _SchedulerBase:
             if limit is not None and len(admitted) >= limit:
                 break
             req = self.queue[0]
+            if req.swap_handle is not None:
+                # host-swapped victim: restore its pages instead of
+                # recomputing them — it joins running directly (its
+                # stream resumes at the next decode), never the prefill
+                # batch below
+                if not self._admit_swapped(req):
+                    break  # no host can take it NOW — FIFO holds
+                continue
             seq = list(req.prompt) + list(req.generated)
             # chunked admission claims pages chunk by chunk (the step's
             # page claims), so nothing is needed NOW — the reserve
@@ -1582,8 +1774,23 @@ class _SchedulerBase:
             getattr(self.cache, "_shared", np.zeros(1)).sum()
         )
         self.stats.cow_copies = getattr(self.cache, "cow_copies", 0)
+        self.stats.swap_outs = getattr(self.cache, "swap_outs", 0)
+        self.stats.swap_ins = getattr(self.cache, "swap_ins", 0)
+        self.stats.swap_bytes = getattr(self.cache, "swap_bytes_total", 0)
+        self.stats.swapped_pages = getattr(self.cache, "swapped_pages", 0)
+        self.stats.prefix_evictions = getattr(
+            self.cache, "prefix_evictions", 0
+        )
         if self.debug_invariants:
-            self.cache.check_invariants()
+            # pages the injector stole this iteration are accounted as
+            # extra frees — conservation must hold even mid-chaos
+            self.cache.check_invariants(
+                extra_free=(
+                    self.injector.stolen_pages
+                    if self.injector is not None
+                    else 0
+                )
+            )
         if self._tele is not None:
             self._sample_telemetry()
 

@@ -1,0 +1,46 @@
+"""Seeded FX107 violations: swap/eviction ledgers mutated outside the
+blessed allocator helpers. check_invariants re-derives the swap-bytes
+budget, page conservation, and host admission routing from these
+structures, so every raw mutation here desynchronizes an audit."""
+
+
+class RogueSwapper:
+    def forge_handle(self, cache, handle):
+        # raw store into the host-swap table: staged bytes appear from
+        # nowhere — the budget ledger never saw them
+        cache._swapped[handle] = {"pages": 0, "bytes": 0}  # FX107
+
+    def drop_handle(self, cache, handle):
+        # bypasses discard_swap: _swap_bytes_held keeps counting the
+        # staged bytes forever
+        del cache._swapped[handle]  # FX107
+
+    def leak_handle(self, cache, handle):
+        return cache._swapped.pop(handle)  # FX107
+
+    def wipe_ledger(self, cache):
+        cache._swapped = {}  # FX107
+
+
+class RogueEvictor:
+    def pin_page(self, cache, page):
+        # hand-rolled retention: the page never entered through
+        # _decref_page, so its refcount is NOT publication-only
+        cache._pub_only[page] = (0, 0)  # FX107
+
+    def resurrect(self, cache, page):
+        # bypasses _incref: the page stays in the prefix index while
+        # eviction still believes it is reclaimable
+        del cache._pub_only[page]  # FX107
+
+    def flush_lru(self, cache):
+        cache._pub_only.clear()  # FX107
+
+
+class RogueOperator:
+    def kill_host(self, cache, host):
+        # bypasses mark_host_down's range validation
+        cache._hosts_down.add(host)  # FX107
+
+    def revive_host(self, cache, host):
+        cache._hosts_down.discard(host)  # FX107

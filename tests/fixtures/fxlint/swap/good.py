@@ -1,0 +1,65 @@
+"""FX107 negative space: the blessed swap/eviction helpers own these
+mutations, reads are always sanctioned, and similarly named state on
+unrelated objects stays out of scope only when the attribute names
+differ (the rule is attribute-name granular, like FX101/FX106)."""
+
+
+class WellBehavedAllocator:
+    def __init__(self):
+        # construction precedes sharing — init-time population is fine
+        self._swapped = {}
+        self._pub_only = {}
+        self._hosts_down = set()
+        self._swap_bytes_held = 0
+
+    def swap_out(self, slot):
+        # a blessed helper IS the mutation seam
+        handle = len(self._swapped)
+        self._swapped[handle] = {"pages": 1, "bytes": 64}
+        self._swap_bytes_held += 64
+        return handle
+
+    def swap_in(self, handle):
+        rec = self._swapped.pop(handle)
+        self._swap_bytes_held -= rec["bytes"]
+        return rec
+
+    def discard_swap(self, handle):
+        rec = self._swapped.pop(handle, None)
+        if rec is not None:
+            self._swap_bytes_held -= rec["bytes"]
+
+    def _decref_page(self, page):
+        self._pub_only[page] = (0, 0)
+
+    def _incref(self, page):
+        if page in self._pub_only:
+            del self._pub_only[page]
+
+    def _evict_prefix_page(self, host):
+        self._pub_only.clear()
+
+    def mark_host_down(self, host):
+        self._hosts_down.add(host)
+
+    def mark_host_up(self, host):
+        self._hosts_down.discard(host)
+
+
+class InnocentAuditor:
+    def check_invariants(self, cache):
+        # reads never match — the audit exists to read these ledgers
+        held = sum(r["bytes"] for r in cache._swapped.values())
+        evictable = len(cache._pub_only)
+        alive = 2 - len(cache._hosts_down)
+        return held, evictable, alive
+
+    def swapped_pages(self, cache):
+        return sum(r["pages"] for r in cache._swapped.values())
+
+    def own_state(self):
+        # mutating differently named attrs is out of scope
+        swapped = {}
+        swapped[0] = {"bytes": 1}
+        swapped.pop(0)
+        return swapped

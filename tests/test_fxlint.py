@@ -216,6 +216,43 @@ def test_seeded_refcount_bypass_is_caught(tmp_path):
     ]
 
 
+def test_swap_ledger_discipline_fixtures():
+    """FX107: swap/eviction ledger mutations (_swapped host-swap table,
+    _pub_only publication LRU, _hosts_down routing set) outside the
+    blessed allocator helpers — the discipline that keeps the
+    swap-bytes budget and eviction audit derivable."""
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "swap")], ["dispatch-race"])
+    )
+    # forge/drop/leak/wipe the swap table (4), pin/resurrect/flush the
+    # publication LRU (3), kill/revive a host (2)
+    assert diags.get("bad.py", []).count("FX107") == 9, diags
+    # blessed helpers, __init__ population, audit reads, same-named
+    # locals all silent
+    assert "good.py" not in diags
+
+
+def test_seeded_swap_bypass_is_caught(tmp_path):
+    """Re-introduce the bug FX107 exists for: demote discard_swap to an
+    unblessed name so its ledger pop becomes a raw mutation — fxlint
+    must flag it; the unmodified allocator stays clean (covered again
+    by test_dispatch_race_clean_on_head over the real package)."""
+    src_path = os.path.join(PACKAGE, "serving", "kv_cache.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace("def discard_swap(", "def rogue_discard(", 1)
+    assert seeded != src, (
+        "kv_cache.py no longer defines discard_swap — update this test "
+        "AND the FX107 blessed set together"
+    )
+    (tmp_path / "kv_cache.py").write_text(seeded)
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    hits = [d for d in diags if d.rule_id == "FX107"]
+    assert any("_swapped" in d.message for d in hits), [
+        d.format() for d in diags
+    ]
+
+
 def test_search_trace_hook_fixtures():
     """FX104: search-trace recording calls capturing live mutable
     state — a captured reference lets exported rows rewrite themselves
