@@ -81,6 +81,24 @@ findings go to the baseline):
   ``mark_host_up`` — see ``_SWAP_BLESSED``) double-frees staged
   bytes, resurrects evicted pages, or routes admissions to a dead
   host. Same blessed-set machinery as FX106, different ledgers.
+* **FX108** — cross-engine swap-handle lifetime (the prefill→decode
+  handoff). A handle/record produced by a staging call (``swap_out``/
+  ``export_swap``/``stage_out``) is a MOVE token: ``export_swap`` pops
+  the source ledger entry and ``import_swap`` installs it under a
+  fresh handle, so the original is dead the moment it is consumed.
+  Two findings: (1) one function consumes the same staged
+  handle/record variable twice (``swap_in``/``import_swap``/
+  ``export_swap``/``discard_swap``) — the second consumption restores
+  pages the first already owns (a KeyError at best, two engines
+  decoding one stream's KV at worst); (2) handoff-phase code (a
+  function with a ``src``/``source``/``src_cache``/``source_cache``/
+  ``src_engine``/``source_engine`` parameter) loads live pool/table
+  state (``k``/``v``/``k_scale``/``v_scale``/``block_tables``/
+  ``lengths``/``_swapped``) through that parameter without a staging
+  copy — the source engine keeps serving while the handoff reads, so
+  a live reference ships rows the next decode step is rewriting; the
+  staged record (``export_swap``'s host-side numpy copies) is the
+  only sanctioned carrier across the engine boundary.
 """
 
 from __future__ import annotations
@@ -107,6 +125,8 @@ RULES = {
     "blessed refcount helpers",
     "FX107": "swap/eviction ledger mutation outside the blessed "
     "allocator helpers",
+    "FX108": "cross-engine swap handle consumed twice, or handoff code "
+    "reading live source-engine pool state",
 }
 
 #: the only functions allowed to write `block_tables` entries or touch
@@ -153,6 +173,10 @@ _SWAP_BLESSED = {
     "_evict_prefix_page",
     "mark_host_down",
     "mark_host_up",
+    # cross-engine handoff seams (FX108's domain): export pops the
+    # local ledger entry, import installs under a fresh local handle
+    "export_swap",
+    "import_swap",
 }
 
 _SWAP_LEDGER_ATTRS = {"_swapped", "_pub_only", "_hosts_down"}
@@ -170,6 +194,43 @@ _SWAP_MUTATING_METHODS = {
 }
 
 _STEP_PARAM_NAMES = {"step", "inflight"}
+
+#: calls that PRODUCE a staged cross-engine token (handle or record):
+#: the variable they bind is a move token, live until first consumption
+_HANDOFF_STAGING_CALLS = {"swap_out", "export_swap", "stage_out"}
+
+#: calls that CONSUME a staged token — each kills its argument
+#: (export pops the ledger entry; import/swap_in install it; discard
+#: returns the budget). A second consumption is the FX108 bug class.
+_HANDOFF_CONSUMING_CALLS = {
+    "swap_in",
+    "import_swap",
+    "export_swap",
+    "discard_swap",
+}
+
+#: parameter names marking a function as handoff-phase code holding a
+#: reference to the SOURCE engine/cache of a KV movement
+_HANDOFF_SRC_PARAMS = {
+    "src",
+    "source",
+    "src_cache",
+    "source_cache",
+    "src_engine",
+    "source_engine",
+}
+
+#: live pool/table state on an engine's cache that must never cross
+#: the engine boundary by reference — the staged record is the carrier
+_HANDOFF_POOL_ATTRS = {
+    "k",
+    "v",
+    "k_scale",
+    "v_scale",
+    "block_tables",
+    "lengths",
+    "_swapped",
+}
 
 #: chunked-prefill cursor state on Request — the live view a chunk
 #: reconcile must never read (FX105); the snapshot is `step.chunks`
@@ -477,6 +538,124 @@ def _swap_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
     return found
 
 
+def _handle_reuse_violations(fn) -> List[Tuple[str, str, int]]:
+    """(variable, consumer, line) for every consumption of a staged
+    handle/record variable AFTER its first — the double-restore shape
+    of FX108. Name-granular within one function: a variable bound from
+    a staging call (``h = cache.swap_out(slot)``, ``rec =
+    cache.export_swap(h)``) is a move token; each consuming call
+    taking it as an argument kills it, and a later consumption (or one
+    inside a loop body, which re-runs) is reported. Rebinding from a
+    fresh staging call revives the name (a loop-carried
+    ``handle = stage(...)`` per iteration is the sanctioned idiom)."""
+    found: List[Tuple[str, str, int]] = []
+    consumed: Dict[str, int] = {}  # var -> line of first consumption
+    staged: Dict[str, int] = {}  # var -> loop depth at staging
+
+    def call_method(node: ast.Call) -> Optional[str]:
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr
+        if isinstance(node.func, ast.Name):
+            return node.func.id
+        return None
+
+    loop_depth = 0
+
+    def visit(node: ast.AST) -> None:
+        nonlocal loop_depth
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            method = call_method(node.value)
+            if method in _HANDOFF_STAGING_CALLS:
+                visit(node.value)  # args may consume earlier tokens
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        staged[t.id] = loop_depth
+                        consumed.pop(t.id, None)
+                return
+        if isinstance(node, ast.Call):
+            method = call_method(node)
+            if method in _HANDOFF_CONSUMING_CALLS:
+                for arg in node.args:
+                    if not (
+                        isinstance(arg, ast.Name) and arg.id in staged
+                    ):
+                        continue
+                    # a token staged OUTSIDE a loop but consumed inside
+                    # one is consumed on every iteration — same bug as
+                    # two sequential consumptions
+                    if arg.id in consumed or loop_depth > staged[arg.id]:
+                        found.append((arg.id, method, node.lineno))
+                    consumed.setdefault(arg.id, node.lineno)
+        in_loop = isinstance(node, (ast.For, ast.While, ast.AsyncFor))
+        if in_loop:
+            loop_depth += 1
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if in_loop:
+            loop_depth -= 1
+
+    for stmt in fn.body:
+        visit(stmt)
+    return found
+
+
+def _src_params(fn) -> Set[str]:
+    """Parameter names of `fn` that carry the SOURCE engine/cache of a
+    handoff — by convention (src/source/src_cache/...), the same
+    name-granular marking _step_params uses for reconcile code."""
+    params: Set[str] = set()
+    args = list(fn.args.posonlyargs) + list(fn.args.args) + list(
+        fn.args.kwonlyargs
+    )
+    for a in args:
+        if a.arg in _HANDOFF_SRC_PARAMS:
+            params.add(a.arg)
+    return params
+
+
+def _live_source_violations(
+    fn, src_params: Set[str]
+) -> List[Tuple[str, int]]:
+    """(attr, line) for loads of live pool/table state reached through
+    a source-engine parameter without a staging copy. The copy wrappers
+    _is_snapshot_call blesses (``np.array``/``.copy()``/``snapshot``)
+    sanction the load — they ARE the staging — as do the staging calls
+    themselves (``source.export_swap(...)`` reads `_swapped` by
+    design, through a blessed method)."""
+    found: List[Tuple[str, int]] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            if _is_snapshot_call(node):
+                return  # copied below here: that IS the staging
+            method = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else None
+            )
+            if method in _HANDOFF_STAGING_CALLS or (
+                method in _HANDOFF_CONSUMING_CALLS
+            ):
+                return  # the blessed movement seams
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and node.attr in _HANDOFF_POOL_ATTRS
+        ):
+            chain = name_chain(node)
+            if chain is not None and chain[0] in src_params:
+                found.append((node.attr, node.lineno))
+                return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+    return found
+
+
 def _is_trace_hook(node: ast.Call) -> bool:
     """A SearchTrace recording call: `<...>.trace.candidate(...)`,
     `trace.result(...)`, `self._trace.event(...)` — the method is one
@@ -566,6 +745,43 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                     "mark_host_down/mark_host_up",
                 )
             )
+    for path, tree in trees.items():
+        for node in ast.walk(tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            for var, consumer, line in _handle_reuse_violations(node):
+                diags.append(
+                    Diagnostic(
+                        "FX108",
+                        path,
+                        line,
+                        f"'{node.name}' consumes staged swap token "
+                        f"'{var}' again via '{consumer}' — a staged "
+                        "handle/record is a move token (export pops "
+                        "the source ledger, import installs it under "
+                        "a fresh handle); the second consumption "
+                        "restores pages another engine already owns",
+                    )
+                )
+            srcs = _src_params(node)
+            if not srcs:
+                continue
+            for attr, line in _live_source_violations(node, srcs):
+                diags.append(
+                    Diagnostic(
+                        "FX108",
+                        path,
+                        line,
+                        f"handoff-phase function '{node.name}' reads "
+                        f"live source-engine state '{attr}' by "
+                        "reference — the source keeps serving while "
+                        "the handoff reads; stage a copy "
+                        "(export_swap's host buffers, .copy(), "
+                        "np.array) across the engine boundary instead",
+                    )
+                )
     for path, tree in trees.items():
         jitted = collect_jitted_names(tree)
         for node in ast.walk(tree):

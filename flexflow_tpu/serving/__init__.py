@@ -65,6 +65,15 @@ from flexflow_tpu.serving.spec import (
     NGramDraftProposer,
     accept_drafts,
 )
+from flexflow_tpu.serving.frontend import (
+    DisaggregatedPipeline,
+    EngineReplica,
+    FrontDoor,
+    PrefillOnlyScheduler,
+    ReplicaRouter,
+    StreamEvent,
+    serve_tcp,
+)
 
 __all__ = [
     "ServeConfig",
@@ -99,4 +108,11 @@ __all__ = [
     "ModelDraftProposer",
     "NGramDraftProposer",
     "accept_drafts",
+    "DisaggregatedPipeline",
+    "EngineReplica",
+    "FrontDoor",
+    "PrefillOnlyScheduler",
+    "ReplicaRouter",
+    "StreamEvent",
+    "serve_tcp",
 ]

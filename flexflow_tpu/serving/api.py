@@ -134,7 +134,10 @@ class ServeConfig:
     # unbounded). prefix_evict (--prefix-evict): "lru" lets published
     # prefix pages whose refcount is publication-only be reclaimed
     # (last-use LRU order) before any live request is preempted;
-    # "none" retains them forever (the pre-PR-14 behavior).
+    # "cost" reclaims the page CHEAPEST to recompute instead (priced
+    # by CostModel.prefill_chunk_cost over the page's token span —
+    # deep chain tails stay warm); "none" retains them forever (the
+    # pre-PR-14 behavior).
     kv_swap: bool = False
     kv_swap_bytes: int = 0
     prefix_evict: str = "none"
@@ -265,9 +268,9 @@ class ServeConfig:
                 f"kv_swap_bytes must be >= 0 (0 = unbounded), got "
                 f"{self.kv_swap_bytes}"
             )
-        if self.prefix_evict not in ("none", "lru"):
+        if self.prefix_evict not in ("none", "lru", "cost"):
             raise ValueError(
-                f"prefix_evict must be 'none' or 'lru', got "
+                f"prefix_evict must be 'none', 'lru', or 'cost', got "
                 f"{self.prefix_evict!r}"
             )
         if self.prefix_evict != "none" and not self.prefix_cache:
@@ -372,6 +375,7 @@ def build_scheduler(
     draft_model=None,
     injector=None,
     telemetry=None,
+    scheduler_cls=None,
 ):
     """(scheduler, engine, cache) wired to a compiled model — the pieces
     generate() uses, exposed for callers that drive iterations themselves
@@ -381,7 +385,10 @@ def build_scheduler(
     seams — the chaos harness's entry point. `telemetry` threads a
     flexflow_tpu.telemetry.Telemetry bundle through the same seams
     (built from the serve config's telemetry knobs when omitted); the
-    attached bundle is reachable as `scheduler.telemetry`."""
+    attached bundle is reachable as `scheduler.telemetry`.
+    `scheduler_cls` overrides the scheduler class the config would pick
+    (the disaggregated front door's prefill tier swaps in its
+    chunk-only loop this way); it must subclass a serving scheduler."""
     if (
         (serve.serve_mesh or serve.serve_hosts)
         and getattr(model, "serving_placement", None) is None
@@ -415,6 +422,11 @@ def build_scheduler(
             prefix_cache=serve.prefix_cache,
             prefix_evict=serve.prefix_evict,
             swap_bytes_budget=serve.kv_swap_bytes,
+            evict_pricer=(
+                build_evict_pricer(model)
+                if serve.prefix_evict == "cost"
+                else None
+            ),
         )
     else:
         cache = KVCache.from_model(
@@ -439,6 +451,8 @@ def build_scheduler(
         # __post_init__ already pinned serve_async to the continuous
         # scheduler; the async loop is its double-buffered subclass
         cls = AsyncContinuousBatchingScheduler
+    if scheduler_cls is not None:
+        cls = scheduler_cls
     sched = cls(
         engine,
         proposer=build_proposer(serve, draft_model),
@@ -507,6 +521,52 @@ def build_swap_decider(model):
         return swap_s < cost.step_time
 
     return decide
+
+
+def build_evict_pricer(model):
+    """A `(cursor, chunk) -> seconds` callable pricing the recompute of
+    one published prefix page for the cost-aware eviction policy
+    (`prefix_evict="cost"`): the page's tokens re-enter as one chunked-
+    prefill step of `chunk` positions appended at cache cursor `cursor`
+    (CostModel.prefill_chunk_cost summed over the graph, the same shape
+    auto.optimize_token_budget prices), so the allocator can reclaim
+    the cheapest-to-recompute page first. Falls back to None — the
+    cache then orders by cursor, the same monotone order unpriced —
+    when the model carries no compiled graph/cost-model context, same
+    posture as build_swap_decider."""
+    try:
+        from flexflow_tpu.core.machine import MachineSpec
+        from flexflow_tpu.core.types import OperatorType
+        from flexflow_tpu.search.cost_model import CostModel
+        from flexflow_tpu.search.machine_model import build_machine_model
+
+        graph = getattr(model, "graph", None)
+        cfg = getattr(model, "config", None)
+        if graph is None or cfg is None or not graph.nodes:
+            return None
+        spec = MachineSpec(
+            num_nodes=max(1, cfg.num_nodes),
+            chips_per_node=1,
+            chip=cfg.chip,
+        )
+        cm = CostModel(spec, machine_model=build_machine_model(cfg, spec))
+        nodes = [
+            n
+            for n in graph.nodes.values()
+            if n.op_type != OperatorType.INPUT and not n.is_parallel_op
+        ]
+        if not nodes:
+            return None
+    except Exception:
+        return None
+
+    def price(cursor: int, chunk: int) -> float:
+        return sum(
+            cm.prefill_chunk_cost(n, 1, int(cursor), int(chunk)).forward_time
+            for n in nodes
+        )
+
+    return price
 
 
 def generate(

@@ -29,7 +29,10 @@ threaded through the engine/scheduler seams that injects
   re-admission — never a lost request);
 * **host-partition failure** — a pod host partition goes down for a
   bounded window (the scheduler must drain its requests to survivors
-  and re-join it on recovery).
+  and re-join it on recovery);
+* **engine-replica failure** — a front-door engine replica dies
+  mid-stream (the router must evacuate its requests to surviving
+  replicas with zero lost streams).
 
 Determinism discipline: every decision draws from a fresh
 `np.random.default_rng([seed, iteration, site, key])` stream, so the
@@ -82,6 +85,7 @@ _SITE = {
     "draft": 5,
     "swap_fail": 6,
     "host_down": 7,
+    "replica_down": 8,
 }
 
 
@@ -135,6 +139,14 @@ class FaultPlan:
         default_factory=dict
     )
     host_down_hold: int = 3
+    # engine-replica failure (front-door router): {iteration: replica}
+    # marks that replica killed at that router iteration — the router
+    # must evacuate its streams to survivors with zero lost requests.
+    # Unlike host_down there is no recovery window: a killed replica's
+    # process is gone; the chaos leg proves the drain, not the re-join.
+    replica_down_iters: Mapping[int, int] = dataclasses.field(
+        default_factory=dict
+    )
 
     def __post_init__(self):
         for name in ("nan_rate", "kernel_rate", "draft_rate", "spike_rate",
@@ -153,6 +165,12 @@ class FaultPlan:
                 raise ValueError(
                     "host_down_iters maps iterations >= 0 to hosts >= 0, "
                     f"got {{{it}: {host}}}"
+                )
+        for it, rep in self.replica_down_iters.items():
+            if int(it) < 0 or int(rep) < 0:
+                raise ValueError(
+                    "replica_down_iters maps iterations >= 0 to replicas "
+                    f">= 0, got {{{it}: {rep}}}"
                 )
 
 
@@ -333,6 +351,19 @@ class FaultInjector:
             self.injected["swap_fail"] += 1
             return True
         return False
+
+    def maybe_replica_down(self, iteration: int) -> Optional[int]:
+        """The replica scheduled to die at this router iteration, or
+        None. Consulted by the front-door router at each step boundary;
+        the router — not this method — performs the evacuation (it
+        alone knows the survivor set), this method only schedules and
+        counts. The router is expected to refuse killing the last alive
+        replica, same contract as `_host_faults`."""
+        rep = self.plan.replica_down_iters.get(int(iteration))
+        if rep is None:
+            return None
+        self.injected["replica_down"] += 1
+        return int(rep)
 
     def maybe_draft_fault(self) -> None:
         plan = self.plan

@@ -1,0 +1,36 @@
+"""Disaggregated serving front door.
+
+Three composable layers over the single-process engine:
+
+* `server` — asyncio submit/stream/cancel front end (`FrontDoor`,
+  `serve_tcp`) over any scheduler-shaped backend;
+* `router` — prefix-affinity placement across N in-process engine
+  replicas (`ReplicaRouter`), with the replica-kill drain;
+* `handoff` — prefill-tier → decode-tier KV movement over the swap
+  staging path (`DisaggregatedPipeline`, `PrefillOnlyScheduler`).
+
+They stack: a `FrontDoor` can front a bare scheduler, a router, or a
+router whose replicas are disaggregated pipelines — each layer only
+assumes the `submit`/`cancel`/`step`/`work_pending` duck type.
+"""
+
+from flexflow_tpu.serving.frontend.handoff import (
+    DisaggregatedPipeline,
+    PrefillOnlyScheduler,
+)
+from flexflow_tpu.serving.frontend.router import EngineReplica, ReplicaRouter
+from flexflow_tpu.serving.frontend.server import (
+    FrontDoor,
+    StreamEvent,
+    serve_tcp,
+)
+
+__all__ = [
+    "DisaggregatedPipeline",
+    "PrefillOnlyScheduler",
+    "EngineReplica",
+    "ReplicaRouter",
+    "FrontDoor",
+    "StreamEvent",
+    "serve_tcp",
+]

@@ -1,0 +1,304 @@
+"""Streaming front-door server (asyncio submit/stream/cancel).
+
+The serving stack below this file is a synchronous iteration loop; a
+front door is the piece that turns it into a service: clients submit a
+prompt, stream tokens back AS THEY COMMIT, and disconnect (or cancel)
+at any moment without disturbing other streams. `FrontDoor` is that
+adapter over any backend exposing the scheduler driving surface —
+a single scheduler, a `ReplicaRouter`, or a `DisaggregatedPipeline`
+(`submit` / `cancel` / `step` / `work_pending` duck type) — so every
+lifecycle guarantee the lower layers prove (deadlines, deferred
+cancel, terminal statuses, fault isolation) is what the wire sees.
+
+Design rules:
+
+* **One pump, many streams.** A single background task steps the
+  backend and fans committed tokens out to per-request queues; client
+  coroutines only await their own queue. The engine never runs
+  per-client — exactly the continuous-batching posture.
+* **Disconnect is cancel.** A client that stops consuming its stream
+  (GeneratorExit / connection reset) cancels its request; the
+  scheduler's deferred-cancel semantics retire it at the next safe
+  boundary and its slot/pages free. No orphaned streams.
+* **Terminal truth from the Request.** The stream's `done` event
+  carries `Request.status` verbatim (finished / cancelled / timed_out
+  / failed) — the audit trail clients see is the one the scheduler
+  wrote.
+
+The wire transport (`serve_tcp`) is deliberately minimal: newline-
+delimited JSON over asyncio streams — an HTTP-ish request/streaming-
+response shape without an HTTP dependency (the container rule: no new
+deps). `{"op": "submit", "prompt": [...], ...}` answers
+`{"event": "submitted", "rid": n}` then token events; `{"op":
+"cancel", "rid": n}` cancels; closing the connection cancels every
+stream it opened.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+from typing import AsyncIterator, Dict, List, Optional
+
+from flexflow_tpu.serving.scheduler import (
+    Request,
+    TERMINAL_STATUSES,
+)
+
+__all__ = ["StreamEvent", "FrontDoor", "serve_tcp"]
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamEvent:
+    """One stream element: a committed token (`kind="token"`) or the
+    terminal record (`kind="done"`, carrying the request's final
+    status and error)."""
+
+    rid: int
+    kind: str  # "token" | "done"
+    token: Optional[int] = None
+    status: Optional[str] = None
+    error: Optional[str] = None
+
+    def to_wire(self) -> Dict[str, object]:
+        out: Dict[str, object] = {"event": self.kind, "rid": self.rid}
+        if self.kind == "token":
+            out["token"] = self.token
+        else:
+            out["status"] = self.status
+            if self.error:
+                out["error"] = self.error
+        return out
+
+
+class FrontDoor:
+    """Async submit/stream/cancel over a scheduler-shaped backend."""
+
+    def __init__(self, backend, next_rid: int = 0):
+        self.backend = backend
+        self._next_rid = int(next_rid)
+        self._requests: Dict[int, Request] = {}
+        self._queues: Dict[int, asyncio.Queue] = {}
+        self._published: Dict[int, int] = {}
+        self._done: set = set()  # rids whose terminal event is queued
+        self._pump_task: Optional[asyncio.Task] = None
+
+    # -- client surface ------------------------------------------------------
+
+    async def submit(
+        self,
+        prompt: List[int],
+        max_new_tokens: int = 16,
+        eos_token: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> int:
+        """Submit one request; returns its rid (stream with
+        `stream(rid)`). A validation rejection surfaces on the stream
+        as an immediate failed `done` event, not an exception here —
+        the wire protocol has one error path, not two."""
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(
+            rid=rid,
+            prompt=list(prompt),
+            max_new_tokens=max_new_tokens,
+            eos_token=eos_token,
+            deadline_s=deadline_s,
+        )
+        self._requests[rid] = req
+        self._queues[rid] = asyncio.Queue()
+        self._published[rid] = 0
+        self.backend.submit(req)
+        self._ensure_pump()
+        self._publish()  # a rejected submit is terminal already
+        return rid
+
+    async def stream(self, rid: int) -> AsyncIterator[StreamEvent]:
+        """Yield this request's events until its terminal record. A
+        consumer that stops early — client disconnect, GeneratorExit,
+        task cancellation — CANCELS the request (deferred-cancel
+        semantics below apply); a fully-consumed stream just cleans
+        up."""
+        queue = self._queues.get(rid)
+        if queue is None:
+            raise KeyError(f"unknown rid {rid}")
+        try:
+            while True:
+                event = await queue.get()
+                yield event
+                if event.kind == "done":
+                    return
+        finally:
+            self._detach(rid)
+
+    async def cancel(self, rid: int) -> bool:
+        return self.backend.cancel(rid)
+
+    def request(self, rid: int) -> Optional[Request]:
+        return self._requests.get(rid)
+
+    async def drain(self) -> None:
+        """Run the backend until every submitted stream is terminal
+        (test/bench convenience — a live server just lets the pump
+        idle)."""
+        while self.backend.work_pending():
+            self.backend.step()
+            self._publish()
+            await asyncio.sleep(0)
+        self._publish()
+
+    # -- engine pump ---------------------------------------------------------
+
+    def _ensure_pump(self) -> None:
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.ensure_future(self._pump())
+
+    async def _pump(self) -> None:
+        """THE engine driver: step, publish fresh commits, yield to the
+        event loop (so client coroutines drain their queues between
+        iterations), repeat until idle. Submissions restart it. A
+        backend exception must not strand consumers on silent queues —
+        every live stream gets a failed terminal event before the
+        exception propagates into the task."""
+        try:
+            while self.backend.work_pending():
+                self.backend.step()
+                self._publish()
+                await asyncio.sleep(0)
+            self._publish()
+        except Exception as exc:
+            for rid, queue in list(self._queues.items()):
+                if rid not in self._done:
+                    queue.put_nowait(
+                        StreamEvent(
+                            rid=rid,
+                            kind="done",
+                            status="failed",
+                            error=f"engine pump died: {exc!r}",
+                        )
+                    )
+                    self._done.add(rid)
+            raise
+
+    def _publish(self) -> None:
+        """Fan out every token committed since the last publish, then
+        the terminal record. The scheduler appends to
+        `Request.generated` as tokens commit; the cursor diff is the
+        stream — no scheduler hook needed, and a burst (speculative
+        accepts, chunk-final + decode) publishes as individual
+        events."""
+        for rid, queue in list(self._queues.items()):
+            if rid in self._done:
+                continue
+            req = self._requests[rid]
+            cursor = self._published[rid]
+            fresh = req.generated[cursor:]
+            for token in fresh:
+                queue.put_nowait(
+                    StreamEvent(rid=rid, kind="token", token=int(token))
+                )
+            self._published[rid] = cursor + len(fresh)
+            if req.status in TERMINAL_STATUSES:
+                # the queue stays registered (buffered events included)
+                # until the consumer detaches — a client may open its
+                # stream after a short request already finished
+                queue.put_nowait(
+                    StreamEvent(
+                        rid=rid,
+                        kind="done",
+                        status=req.status,
+                        error=req.error,
+                    )
+                )
+                self._done.add(rid)
+
+    def _detach(self, rid: int) -> None:
+        """A consumer left. If the request is still live this is a
+        disconnect: cancel it (the backend's deferred-cancel rules
+        decide when it actually retires) and stop publishing to the
+        dead queue."""
+        req = self._requests.get(rid)
+        if req is not None and req.status not in TERMINAL_STATUSES:
+            self.backend.cancel(rid)
+        self._queues.pop(rid, None)
+        self._published.pop(rid, None)
+        self._done.discard(rid)
+
+
+# -- wire transport ----------------------------------------------------------
+
+
+async def _handle_connection(
+    door: FrontDoor, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+) -> None:
+    """One client connection: newline-delimited JSON ops in, streamed
+    events out. Submitted streams are served by concurrent writer
+    tasks so several streams interleave on one connection; dropping
+    the connection cancels every stream it still owns."""
+    owned: List[int] = []
+    stream_tasks: List[asyncio.Task] = []
+    lock = asyncio.Lock()  # one writer at a time on the shared socket
+
+    async def send(payload: Dict[str, object]) -> None:
+        async with lock:
+            writer.write(json.dumps(payload).encode() + b"\n")
+            await writer.drain()
+
+    async def run_stream(rid: int) -> None:
+        async for event in door.stream(rid):
+            await send(event.to_wire())
+
+    try:
+        while True:
+            line = await reader.readline()
+            if not line:
+                break
+            try:
+                msg = json.loads(line)
+                op = msg.get("op")
+            except Exception:
+                await send({"event": "error", "error": "bad json"})
+                continue
+            if op == "submit":
+                rid = await door.submit(
+                    prompt=list(msg.get("prompt", ())),
+                    max_new_tokens=int(msg.get("max_new_tokens", 16)),
+                    eos_token=msg.get("eos_token"),
+                    deadline_s=msg.get("deadline_s"),
+                )
+                owned.append(rid)
+                await send({"event": "submitted", "rid": rid})
+                stream_tasks.append(asyncio.ensure_future(run_stream(rid)))
+            elif op == "cancel":
+                ok = await door.cancel(int(msg.get("rid", -1)))
+                await send(
+                    {"event": "cancelled", "rid": msg.get("rid"), "ok": ok}
+                )
+            else:
+                await send({"event": "error", "error": f"unknown op {op!r}"})
+    except (ConnectionResetError, asyncio.IncompleteReadError):
+        pass
+    finally:
+        # connection gone: every stream it owns is a disconnect-cancel
+        for task in stream_tasks:
+            task.cancel()
+        for rid in owned:
+            req = door.request(rid)
+            if req is not None and req.status not in TERMINAL_STATUSES:
+                door.backend.cancel(rid)
+        writer.close()
+
+
+async def serve_tcp(
+    backend, host: str = "127.0.0.1", port: int = 0
+) -> asyncio.AbstractServer:
+    """Bind the front door to a TCP port (port 0 picks a free one —
+    read it back from `server.sockets[0].getsockname()`). The caller
+    owns the returned server's lifetime."""
+    door = FrontDoor(backend)
+
+    async def handler(reader, writer):
+        await _handle_connection(door, reader, writer)
+
+    return await asyncio.start_server(handler, host, port)

@@ -504,15 +504,17 @@ class PagedKVCache:
         placement=None,
         prefix_evict: str = "none",
         swap_bytes_budget: int = 0,
+        evict_pricer=None,
     ):
         import jax
         import jax.numpy as jnp
 
         if not spec.paged:
             raise ValueError("PagedKVCache needs a spec with page_size > 0")
-        if prefix_evict not in ("none", "lru"):
+        if prefix_evict not in ("none", "lru", "cost"):
             raise ValueError(
-                f"prefix_evict must be 'none' or 'lru', got {prefix_evict!r}"
+                f"prefix_evict must be 'none', 'lru', or 'cost', "
+                f"got {prefix_evict!r}"
             )
         _validate_page_geometry(
             spec.max_seqs, spec.max_len, spec.page_size, spec.num_pages
@@ -649,7 +651,20 @@ class PagedKVCache:
         # pool rows, so eviction (which hands the page to a new writer)
         # waits for that window to close; read-only resurrection is
         # always safe and is not gated.
+        # prefix_evict="cost" replaces the LRU victim choice with the
+        # page CHEAPEST to recompute: a published page covering tokens
+        # [c, c+page_size) of its chain re-prefills as one chunk at
+        # cursor c (CostModel.prefill_chunk_cost), and that cost grows
+        # with c — so the cost policy reclaims shallow chain pages first
+        # and keeps the deep (expensive) tails warm. `evict_pricer`
+        # is the (cursor, chunk) -> seconds callable api.build_scheduler
+        # wires from the compiled model's cost model; None degrades to
+        # the cursor itself (the same monotone order, unpriced).
+        # `_page_spans` records each published page's chain-start cursor
+        # at registration time — pages only store hash keys otherwise.
         self.prefix_evict = prefix_evict
+        self.evict_pricer = evict_pricer
+        self._page_spans: Dict[int, int] = {}
         self._pub_only: Dict[int, Tuple[int, int]] = {}
         self._evict_tick = 0
         self.prefix_evictions = 0
@@ -928,6 +943,7 @@ class PagedKVCache:
             key = self._page_keys.pop(page, None)
             if key is not None and self._prefix_index.get(key) == page:
                 del self._prefix_index[key]
+            self._page_spans.pop(page, None)
             self._release_page(page)
 
     def _evictable_count(self, h: int) -> int:
@@ -944,11 +960,30 @@ class PagedKVCache:
             if wid <= self._window_closed and self._page_home(p) == h
         )
 
+    def _evict_cost(self, page: int) -> float:
+        """Seconds to recompute `page` if its prefix is wanted again:
+        one chunk of page_size tokens appended at the page's chain-start
+        cursor. Priced through `evict_pricer` when the compiled model
+        wired one; otherwise the cursor itself — the same monotone
+        order (attention cost grows with cursor), just unscaled. A
+        raising pricer degrades to the proxy: eviction must never fail
+        because pricing did."""
+        cursor = self._page_spans.get(page, 0)
+        if self.evict_pricer is not None:
+            try:
+                return float(self.evict_pricer(cursor, self.spec.page_size))
+            except Exception:
+                pass
+        return float(cursor)
+
     def _evict_prefix_page(self, h: int) -> None:
-        """Evict the least-recently-published publication-only page
-        homed on host `h`: unpublish it from the hash index and push it
-        straight onto the free heap (its wait window closed, so no
-        in-flight step can touch it)."""
+        """Evict one publication-only page homed on host `h`: unpublish
+        it from the hash index and push it straight onto the free heap
+        (its wait window closed, so no in-flight step can touch it).
+        Victim order is the policy: "lru" takes the least-recently-
+        published page; "cost" takes the page cheapest to recompute
+        (`_evict_cost`), stamp-then-page-id as the deterministic
+        tiebreak."""
         cands = [
             (stamp, p)
             for p, (stamp, wid) in self._pub_only.items()
@@ -958,8 +993,14 @@ class PagedKVCache:
             raise PagePoolExhausted(
                 f"host {h}: no evictable publication-only page"
             )
-        _, page = min(cands)
+        if self.prefix_evict == "cost":
+            _, _, page = min(
+                (self._evict_cost(p), stamp, p) for stamp, p in cands
+            )
+        else:
+            _, page = min(cands)
         del self._pub_only[page]
+        self._page_spans.pop(page, None)
         key = self._page_keys.pop(page, None)
         if key is not None and self._prefix_index.get(key) == page:
             del self._prefix_index[key]
@@ -1036,6 +1077,9 @@ class PagedKVCache:
                 continue
             self._prefix_index[key] = page
             self._page_keys[page] = key
+            # chain-start cursor: page i of the chain covers tokens
+            # [i*ps, (i+1)*ps) — what the cost eviction policy prices
+            self._page_spans[page] = i * ps
 
     def alloc_shared(
         self,
@@ -1189,6 +1233,7 @@ class PagedKVCache:
             key = self._page_keys.pop(page, None)
             if key is not None and self._prefix_index.get(key) == page:
                 del self._prefix_index[key]
+            self._page_spans.pop(page, None)
         self._entry_shared[slot, pi] = False
         self._shared[slot] -= 1
         if slot in self._optimistic:
@@ -1440,6 +1485,65 @@ class PagedKVCache:
         if rec is not None:
             self._swap_bytes_held -= int(rec["bytes"])
 
+    # -- cross-engine handoff (prefill tier -> decode tier) ------------------
+
+    def _swap_fingerprint(self) -> Tuple:
+        """The geometry a staged record's rows are shaped by — two
+        caches exchange swap records only when these agree (heads/dim/
+        page_size fix the row shape, layer_guids the per-layer keys,
+        kv_dtype the int8 scale slivers)."""
+        spec = self.spec
+        return (
+            tuple(spec.layer_guids),
+            spec.page_size,
+            spec.num_heads,
+            spec.head_dim,
+            spec.kv_dtype,
+        )
+
+    def export_swap(self, handle: int) -> Dict[str, object]:
+        """Surrender a staged swap record for restoration in ANOTHER
+        engine's cache (the prefill->decode handoff): pops the record —
+        the handle dies here, so a staged copy can be consumed exactly
+        once (fxlint FX108's contract) — returns the staged bytes to
+        this cache's budget, and stamps a geometry fingerprint
+        `import_swap` validates. Raises KeyError on an unknown or
+        already-consumed handle: double export IS the bug class."""
+        rec = self._swapped.pop(handle)
+        self._swap_bytes_held -= int(rec["bytes"])
+        out = dict(rec)
+        out["fingerprint"] = self._swap_fingerprint()
+        return out
+
+    def import_swap(self, record: Dict[str, object]) -> Optional[int]:
+        """Adopt a record `export_swap` produced on a geometry-
+        compatible cache: install it under a fresh LOCAL handle (the
+        source handle died at export) against this cache's swap budget.
+        Returns the new handle — `swap_in` then restores it exactly
+        like a locally staged victim, bit-exact rows and int8 scales
+        included — or None when the budget refuses (the record stays
+        the caller's, to retry or degrade to recompute). Raises
+        ValueError on a geometry mismatch: restoring rows shaped by a
+        different page/head layout would scatter garbage."""
+        rec = dict(record)
+        fp = rec.pop("fingerprint", None)
+        if fp is not None and tuple(fp) != self._swap_fingerprint():
+            raise ValueError(
+                f"import_swap: incompatible cache geometry {fp} vs "
+                f"{self._swap_fingerprint()}"
+            )
+        bytes_staged = int(rec["bytes"])
+        if (
+            self.swap_bytes_budget
+            and self._swap_bytes_held + bytes_staged > self.swap_bytes_budget
+        ):
+            return None
+        handle = self._swap_seq
+        self._swap_seq += 1
+        self._swapped[handle] = rec
+        self._swap_bytes_held += bytes_staged
+        return handle
+
     def commit(
         self,
         new_k: Dict[int, object],
@@ -1665,6 +1769,7 @@ class PagedKVCache:
         prefix_cache: bool = False,
         prefix_evict: str = "none",
         swap_bytes_budget: int = 0,
+        evict_pricer=None,
     ) -> "PagedKVCache":
         """Derive geometry + shardings from a compiled FFModel. Defaults
         (page_size 0 / num_pages 0) pick the vLLM-style block size and a
@@ -1715,4 +1820,5 @@ class PagedKVCache:
             placement=placement,
             prefix_evict=prefix_evict,
             swap_bytes_budget=swap_bytes_budget,
+            evict_pricer=evict_pricer,
         )

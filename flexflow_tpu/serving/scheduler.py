@@ -990,6 +990,84 @@ class _SchedulerBase:
         """Re-join a recovered host partition into admission."""
         self.cache.mark_host_up(host)
 
+    # -- cross-engine seams (disaggregated front door) -----------------------
+
+    def stage_out(self, rid: int) -> Optional[int]:
+        """Stage a RUNNING request's committed KV out of this engine and
+        detach the request, WITHOUT a terminal transition: the caller
+        owns the returned swap handle (export it with
+        ``cache.export_swap`` to move the pages into another engine) and
+        the Request object itself, which re-submits elsewhere with its
+        stream intact. This is the prefill-tier half of the
+        prefill→decode handoff. Returns None when the request is
+        unknown, terminal, not resident, or the cache refuses the copy
+        (budget / in-flight step) — the caller retries a later
+        iteration; nothing is lost or half-moved."""
+        req = self._by_rid.get(rid)
+        if req is None or req.status in TERMINAL_STATUSES:
+            return None
+        if req.slot is None or self.running.get(req.slot) is not req:
+            return None
+        # pages pinned by an in-flight step would tear mid-copy — drain
+        # the pipeline first, same discipline as host_down
+        self._reclaim_inflight_pages()
+        if req.status in TERMINAL_STATUSES or req.slot is None:
+            return None  # reconcile finished/cancelled it
+        handle = self.cache.swap_out(req.slot)
+        if handle is None:
+            return None
+        if self.proposer is not None:
+            self.proposer.retire(req)
+        del self.running[req.slot]
+        del self._by_rid[rid]
+        req.slot = None
+        req.status = RequestStatus.QUEUED
+        req.swap_handle = handle
+        # chunk cursors die with the move: the staged copy IS the
+        # committed history, nothing left to stream on this engine
+        req.prefill_seq = []
+        req.prefill_pos = 0
+        req.prefill_dispatched = 0
+        req.log("stage_out", f"handle {handle} iteration {self._iter}")
+        return handle
+
+    def evacuate(self) -> List[Request]:
+        """Detach every live request from this engine — the replica-kill
+        drain. RUNNING requests drop their device state (the dead
+        replica's pool dies with it: no stage-out) and return to QUEUED
+        with recompute cursors; queued requests holding swap handles
+        discard them (staged copies live in the dead replica's ledger).
+        Returns the detached requests in FIFO order (running by
+        admission order, then the queue) for the router to re-submit on
+        survivors. Not a preemption — the requests never failed, the
+        hardware did — so `preemptions` budgets don't tick."""
+        self._reclaim_inflight_pages()
+        moved: List[Request] = []
+        for req in sorted(
+            self.running.values(), key=lambda r: (r.admit_iter, r.rid)
+        ):
+            if self.proposer is not None:
+                self.proposer.retire(req)
+            self.cache.free(req.slot)
+            req.slot = None
+            req.status = RequestStatus.QUEUED
+            req.prefill_seq = []
+            req.prefill_pos = 0
+            req.prefill_dispatched = 0
+            req.log("evacuate", f"replica_down iteration {self._iter}")
+            moved.append(req)
+        self.running.clear()
+        for req in self.queue:
+            if req.swap_handle is not None:
+                self.cache.discard_swap(req.swap_handle)
+                req.swap_handle = None
+            req.log("evacuate", f"replica_down iteration {self._iter}")
+            moved.append(req)
+        self.queue.clear()
+        for req in moved:
+            self._by_rid.pop(req.rid, None)
+        return moved
+
     # -- shared pieces -------------------------------------------------------
 
     def _admit(self, limit: Optional[int] = None) -> List[Request]:
@@ -1886,6 +1964,13 @@ class _SchedulerBase:
 
     def _work_pending(self) -> bool:
         return bool(self.queue or self.running)
+
+    def work_pending(self) -> bool:
+        """Public driving surface (shared with `ReplicaRouter` and
+        `DisaggregatedPipeline`): anything submitted but not yet
+        terminal. The front door and the benches drive every backend
+        through this same duck type."""
+        return self._work_pending()
 
     def run(self, requests: Optional[Sequence[Request]] = None) -> List[Request]:
         """Drain the queue (plus `requests`, submitted first) to

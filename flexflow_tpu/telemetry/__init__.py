@@ -123,6 +123,12 @@ class NullTracer:
     def request_lifecycle(self, req) -> None:
         pass
 
+    def host_lane(self, host: int) -> int:
+        return 0
+
+    def replica_lane(self, replica: int) -> int:
+        return 0
+
     def span(self, *a, **k):
         return _NULL_CM
 

@@ -43,6 +43,7 @@ TID_HOST = 1  # scheduler host work: iterations, dispatch, reconcile
 TID_DEVICE0 = 10  # in-flight device windows, even steps
 TID_DEVICE1 = 11  # in-flight device windows, odd steps (overlap lane)
 TID_HOST_BASE = 20  # per-host-partition lanes (pod serving), 20 + host
+TID_REPLICA_BASE = 200  # per-engine-replica lanes (front door), 200 + idx
 
 
 class Tracer:
@@ -97,6 +98,19 @@ class Tracer:
             self._host_lanes.add(tid)
             self._meta(
                 PID_ENGINE, tid, "thread_name", f"host {int(host)} partition"
+            )
+        return tid
+
+    def replica_lane(self, replica: int) -> int:
+        """The engine-process lane for one front-door engine replica
+        (serving/frontend/router.py) — same registration discipline as
+        host_lane, offset past the host range so a routed pod placement
+        keeps both label families distinct."""
+        tid = TID_REPLICA_BASE + int(replica)
+        if tid not in self._host_lanes:
+            self._host_lanes.add(tid)
+            self._meta(
+                PID_ENGINE, tid, "thread_name", f"replica {int(replica)}"
             )
         return tid
 

@@ -1,0 +1,56 @@
+"""Seeded FX108 violations: cross-engine swap tokens consumed twice,
+and handoff code reading live source-engine pool state by reference.
+A staged handle/record is a MOVE token — export pops the source
+ledger, import installs under a fresh handle — so a second consumption
+restores pages another engine already owns; and the source engine
+keeps serving while a handoff runs, so live pool references ship rows
+mid-rewrite."""
+
+import numpy as np
+
+
+class DoubleRestorer:
+    def restore_twice(self, src_cache, dst_cache, slot):
+        handle = src_cache.swap_out(slot)
+        rec = src_cache.export_swap(handle)
+        dst_cache.import_swap(rec)
+        dst_cache.import_swap(rec)  # FX108: token already consumed
+
+    def export_then_discard(self, cache, slot):
+        handle = cache.swap_out(slot)
+        rec = cache.export_swap(handle)
+        cache.discard_swap(handle)  # FX108: export already killed it
+        return rec
+
+    def replay_restore(self, cache, slot, replicas):
+        handle = cache.swap_out(slot)
+        for replica in replicas:
+            # FX108: one token, N restores — every replica after the
+            # first installs pages the first already owns
+            replica.swap_in(handle, total_len=8)
+
+    def fresh_token_per_restore(self, cache, slot):
+        # rebinding from a fresh staging call revives the name — this
+        # half is CLEAN; the bug is the tail consumption below
+        handle = cache.swap_out(slot)
+        rec = cache.export_swap(handle)
+        handle = cache.swap_out(slot)
+        rec = cache.export_swap(handle)
+        cache.import_swap(rec)
+        cache.import_swap(rec)  # FX108
+
+
+class LiveReader:
+    def steal_pool_rows(self, src, dst, slot):
+        # FX108 x2: live K/V pool references cross the engine boundary
+        k_rows = src.k[0]
+        v_rows = src.v[0]
+        return k_rows, v_rows
+
+    def read_tables(self, source_cache, slot):
+        table = source_cache.block_tables[slot]  # FX108: live table
+        length = source_cache.lengths[slot]  # FX108: live cursor
+        return table, length
+
+    def peek_ledger(self, src_engine, handle):
+        return src_engine._swapped[handle]  # FX108: live swap ledger

@@ -1,0 +1,50 @@
+"""FX108 negative space: single-consumption moves, loop-carried fresh
+tokens, staged copies across the boundary, and source reads through
+the blessed movement seams or copy wrappers."""
+
+import numpy as np
+
+
+class WellBehavedHandoff:
+    def move_once(self, src_cache, dst_cache, slot):
+        # the sanctioned shape: stage, export, import — each token
+        # consumed exactly once
+        handle = src_cache.swap_out(slot)
+        rec = src_cache.export_swap(handle)
+        return dst_cache.import_swap(rec)
+
+    def move_many(self, src_cache, dst_cache, slots):
+        # loop-carried fresh tokens: every iteration stages its own
+        handles = []
+        for slot in slots:
+            handle = src_cache.swap_out(slot)
+            rec = src_cache.export_swap(handle)
+            handles.append(dst_cache.import_swap(rec))
+        return handles
+
+    def refusal_retry(self, src_cache, dst_cache, slot):
+        # consuming a FRESH token after a refusal rebinds — not reuse
+        handle = src_cache.swap_out(slot)
+        if handle is None:
+            return None
+        rec = src_cache.export_swap(handle)
+        return dst_cache.import_swap(rec)
+
+
+class StagedReader:
+    def staged_copy(self, src, slot):
+        # copies ARE the staging — the boundary never sees a live ref
+        k_rows = np.array(src.k[0])
+        v_rows = src.v[0].copy()
+        table = list(src.block_tables[slot])
+        return k_rows, v_rows, table
+
+    def blessed_seams(self, src, dst, slot):
+        # export_swap/import_swap read the ledgers by design
+        rec = src.export_swap(src.swap_out(slot))
+        return dst.import_swap(rec)
+
+    def non_source_reads(self, cache, slot):
+        # no src/source param: ordinary engine code reading its OWN
+        # pool is the normal serving path, out of FX108's scope
+        return cache.lengths[slot], cache.block_tables[slot]

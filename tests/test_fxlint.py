@@ -253,6 +253,59 @@ def test_seeded_swap_bypass_is_caught(tmp_path):
     ]
 
 
+def test_handoff_lifetime_fixtures():
+    """FX108: cross-engine swap handles/records consumed more than once
+    (the staged copy is a MOVE token — export pops the source ledger,
+    so a replay restores pages another engine already owns), and
+    handoff code reading live source-engine pool state by reference
+    while that engine keeps serving."""
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "handoff")], ["dispatch-race"])
+    )
+    # double import, discard-after-export, loop replay, tail double (4
+    # reuse) + live k/v refs, live table + cursor, live ledger (5
+    # live-source)
+    assert diags.get("bad.py", []).count("FX108") == 9, diags
+    # single-consumption moves, loop-carried fresh tokens, staged
+    # copies, blessed seams, own-pool reads all silent
+    assert "good.py" not in diags
+
+
+def test_seeded_handoff_replay_is_caught(tmp_path):
+    """Re-introduce the bug FX108 exists for: make the pipeline's
+    install step restore the SAME exported record twice (the retry
+    shape that forgets export already moved the pages) — fxlint must
+    flag it; the unmodified frontend stays clean (re-proven over the
+    whole package by test_dispatch_race_clean_on_head)."""
+    src_path = os.path.join(
+        PACKAGE, "serving", "frontend", "handoff.py"
+    )
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "            record = self.prefill_cache.export_swap(handle)\n",
+        "            record = self.prefill_cache.export_swap(handle)\n"
+        "            self.prefill_cache.discard_swap(handle)\n",
+        1,
+    )
+    assert seeded != src, (
+        "handoff.py's _drain_ready no longer calls export_swap(handle) "
+        "— update this seeding recipe alongside the refactor"
+    )
+    (tmp_path / "handoff.py").write_text(seeded)
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    assert any(
+        d.rule_id == "FX108" and "handle" in d.message for d in diags
+    ), [d.format() for d in diags]
+    # the unmodified pipeline stays clean
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "handoff.py")
+    assert run_rules([str(clean)], ["dispatch-race"]) == [], [
+        d.format() for d in run_rules([str(clean)], ["dispatch-race"])
+    ]
+
+
 def test_search_trace_hook_fixtures():
     """FX104: search-trace recording calls capturing live mutable
     state — a captured reference lets exported rows rewrite themselves
