@@ -99,6 +99,25 @@ findings go to the baseline):
   a live reference ships rows the next decode step is rewriting; the
   staged record (``export_swap``'s host-side numpy copies) is the
   only sanctioned carrier across the engine boundary.
+* **FX109** — device-resident multi-step decode discipline (the fused
+  K-step ``lax.scan`` window). Two findings: (a) a multi-step dispatch
+  function (``multi`` + ``dispatch`` in the name) captures live
+  mutated host allocator state (``lengths`` / ``block_tables`` /
+  ``_free_pages``) without a snapshot — the scan executes K decode
+  steps behind the async dispatch queue, so a live reference is up to
+  K iterations stale when the device finally reads it, K times the
+  exposure of the single-step FX101 race. Scalars materialized at
+  call time (``int()``/``len()``/``min()``...) are synchronous host
+  reads and stay sanctioned, as do Assign/AugAssign store TARGETS
+  (the dispatch-side pre-advance ``cache.lengths[act] += limits`` is
+  the commit itself, not a capture). (b) reconcile-phase code reads
+  multi-step window state (``k_steps`` / ``step_limits`` /
+  ``device_tokens`` / ``device_mask`` / ``device_lengths``) from
+  anywhere but the step record — the window's geometry travels WITH
+  its ``InflightStep``; any scheduler-side mirror is a whole window
+  stale under async double-buffering, so commit/rollback decisions
+  made against it truncate to the wrong length or emit phantom
+  steps.
 """
 
 from __future__ import annotations
@@ -127,6 +146,8 @@ RULES = {
     "allocator helpers",
     "FX108": "cross-engine swap handle consumed twice, or handoff code "
     "reading live source-engine pool state",
+    "FX109": "multi-step dispatch captures live host state into the "
+    "fused window, or reconcile reads window state off the step record",
 }
 
 #: the only functions allowed to write `block_tables` entries or touch
@@ -235,6 +256,32 @@ _HANDOFF_POOL_ATTRS = {
 #: chunked-prefill cursor state on Request — the live view a chunk
 #: reconcile must never read (FX105); the snapshot is `step.chunks`
 _CHUNK_PROGRESS_ATTRS = {"prefill_seq", "prefill_pos", "prefill_dispatched"}
+
+#: host allocator state a multi-step dispatch must snapshot before the
+#: fused scan captures it (FX109a). Deliberately NOT the full mutated
+#: set: the device pools (`cache.k`/`cache.v`) are donated device
+#: arrays that legitimately ride into the jit raw.
+_MULTISTEP_HOST_ATTRS = {
+    "lengths",
+    "block_tables",
+    "_free_pages",
+    "_free_pages_h",
+}
+
+#: single-name builtins whose call materializes a host SCALAR at call
+#: time — a synchronous read, immune to the deferred-read race, so a
+#: multi-step dispatch may apply them to live state (`int(lengths[s])`)
+_MULTI_DISPATCH_SCALARS = {"int", "float", "bool", "len", "min", "max"}
+
+#: fused-window state on InflightStep — reconcile-phase code must read
+#: these through the step record, never a scheduler-side mirror (FX109b)
+_WINDOW_STATE_ATTRS = {
+    "k_steps",
+    "step_limits",
+    "device_tokens",
+    "device_mask",
+    "device_lengths",
+}
 
 _ASARRAY_CHAINS = {("jnp", "asarray"), ("jax", "numpy", "asarray")}
 _SNAPSHOT_NAMES = {"snapshot"}
@@ -402,6 +449,84 @@ def _chunk_progress_violations(
             isinstance(node, ast.Attribute)
             and isinstance(node.ctx, ast.Load)
             and node.attr in _CHUNK_PROGRESS_ATTRS
+        ):
+            continue
+        chain = name_chain(node)
+        if chain is not None and chain[0] in step_params:
+            continue
+        found.append((node.attr, node.lineno))
+    return found
+
+
+def _is_multistep_dispatch(fn) -> bool:
+    """Multi-step dispatch code by the same name convention _step_params
+    uses to EXEMPT dispatch functions from FX103/FX105: it takes the
+    window's snapshots, so it reads live state by definition — but what
+    it hands the fused scan must be snapshotted (FX109a)."""
+    return "multi" in fn.name and "dispatch" in fn.name
+
+
+def _multistep_capture_violations(
+    fn, mutated: Set[str]
+) -> List[Tuple[str, int]]:
+    """(attr, line) for loads of live host allocator state inside a
+    multi-step dispatch function with no snapshot wrapper and no
+    scalar materialization. The fused scan reads its captures behind
+    the async dispatch queue — K steps after this function returns —
+    so every mutable host array must cross as a copy. Store targets
+    (the pre-advance ``cache.lengths[act] += limits``) are the
+    dispatch-side commit and never match."""
+    attrs = _MULTISTEP_HOST_ATTRS & mutated
+    found: List[Tuple[str, int]] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            if _is_snapshot_call(node):
+                return  # copied below here — that IS the snapshot
+            chain = name_chain(node.func)
+            if (
+                chain is not None
+                and len(chain) == 1
+                and chain[0] in _MULTI_DISPATCH_SCALARS
+            ):
+                return  # scalar materialized at call time: synchronous
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            # store targets are the dispatch-side commit (pre-advance);
+            # only the VALUE can leak a live reference
+            visit(node.value)
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and node.attr in attrs
+        ):
+            chain = name_chain(node)
+            if chain is not None and "cache" in chain[:-1]:
+                found.append((node.attr, node.lineno))
+                return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+    return found
+
+
+def _window_state_violations(
+    fn, step_params: Set[str]
+) -> List[Tuple[str, int]]:
+    """(attr, line) for loads of fused-window state inside a
+    reconcile-phase function that do not come through the step
+    parameter. The window's geometry (k_steps, per-slot limits) and
+    per-step device stacks travel WITH the InflightStep; a
+    scheduler-side mirror is one whole window stale under async
+    double-buffering."""
+    found: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and node.attr in _WINDOW_STATE_ATTRS
         ):
             continue
         chain = name_chain(node)
@@ -684,9 +809,41 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                 node, (ast.FunctionDef, ast.AsyncFunctionDef)
             ):
                 continue
+            if _is_multistep_dispatch(node):
+                for attr, line in _multistep_capture_violations(
+                    node, mutated
+                ):
+                    diags.append(
+                        Diagnostic(
+                            "FX109",
+                            path,
+                            line,
+                            f"multi-step dispatch '{node.name}' captures "
+                            f"live host attribute '{attr}' into the "
+                            "fused K-step window without a snapshot — "
+                            "the scan reads it behind the dispatch "
+                            "queue, up to K iterations after this call "
+                            "returns; wrap it in snapshot()/np.array or "
+                            "materialize a scalar (int())",
+                        )
+                    )
             steps = _step_params(node)
             if not steps:
                 continue
+            for attr, line in _window_state_violations(node, steps):
+                diags.append(
+                    Diagnostic(
+                        "FX109",
+                        path,
+                        line,
+                        f"reconcile-phase function '{node.name}' reads "
+                        f"multi-step window state '{attr}' off the "
+                        "step record — the window's geometry travels "
+                        "WITH its InflightStep; a scheduler-side "
+                        "mirror is a whole window stale under async "
+                        "double-buffering",
+                    )
+                )
             for attr, line in _reconcile_violations(node, mutated):
                 diags.append(
                     Diagnostic(

@@ -193,6 +193,12 @@ class FFConfig:
     serve_kv_swap: bool = False
     serve_kv_swap_bytes: int = 0
     serve_prefix_evict: str = "none"
+    # device-resident multi-step decode (serving/engine.py +
+    # scheduler.py): --decode-multistep fuses scheduler-invariant runs
+    # of decode iterations into one jitted lax.scan window of up to
+    # --max-fused-steps steps, reconciled in a single host sync
+    serve_decode_multistep: bool = False
+    serve_max_fused_steps: int = 8
 
     @property
     def num_devices(self) -> int:
@@ -368,6 +374,10 @@ class FFConfig:
                 cfg.serve_kv_swap_bytes = int(take())
             elif a == "--prefix-evict":
                 cfg.serve_prefix_evict = take()
+            elif a == "--decode-multistep":
+                cfg.serve_decode_multistep = True
+            elif a == "--max-fused-steps":
+                cfg.serve_max_fused_steps = int(take())
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

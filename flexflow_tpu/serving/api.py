@@ -141,6 +141,14 @@ class ServeConfig:
     kv_swap: bool = False
     kv_swap_bytes: int = 0
     prefix_evict: str = "none"
+    # device-resident multi-step decode (--decode-multistep):
+    # scheduler-invariant runs of decode iterations fuse into ONE
+    # jitted lax.scan window of up to max_fused_steps
+    # (--max-fused-steps) steps, reconciled in a single host sync —
+    # token/logit-identical to step-at-a-time, ~K fewer host
+    # round-trips per committed token on quiet stretches.
+    decode_multistep: bool = False
+    max_fused_steps: int = 8
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -278,6 +286,17 @@ class ServeConfig:
                 "prefix_evict needs prefix_cache=True (only published "
                 "prefix pages are ever evictable)"
             )
+        if self.max_fused_steps < 1:
+            raise ValueError(
+                f"max_fused_steps must be >= 1, got "
+                f"{self.max_fused_steps}"
+            )
+        if self.decode_multistep and self.scheduler == "static":
+            raise ValueError(
+                "decode_multistep requires the continuous scheduler "
+                "(the static baseline is the reference the fused loop "
+                "is proved identical against)"
+            )
 
     @property
     def telemetry_requested(self) -> bool:
@@ -327,6 +346,8 @@ class ServeConfig:
             kv_swap=cfg.serve_kv_swap,
             kv_swap_bytes=cfg.serve_kv_swap_bytes,
             prefix_evict=cfg.serve_prefix_evict,
+            decode_multistep=cfg.serve_decode_multistep,
+            max_fused_steps=cfg.serve_max_fused_steps,
         )
 
 
@@ -468,6 +489,8 @@ def build_scheduler(
         swap_decider=(
             build_swap_decider(model) if serve.kv_swap else None
         ),
+        decode_multistep=serve.decode_multistep,
+        max_fused_steps=serve.max_fused_steps,
     )
     return sched, engine, cache
 

@@ -83,6 +83,7 @@ rejection-sampling verify needs.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from collections import OrderedDict
 from typing import Dict, Optional, Sequence, Tuple
@@ -107,6 +108,49 @@ def snapshot(host_state: np.ndarray):
     import jax.numpy as jnp
 
     return jnp.asarray(np.array(host_state))
+
+
+class _JitCache:
+    """Bounded keyed LRU over jitted step programs.
+
+    The verify, chunked-prefill, and multi-step decode families each
+    jit one program per shape key (draft width, compact batch, K
+    bucket); widths churn with re-tuning and per-request budget caps,
+    and an unbounded dict would keep every key's device executable
+    alive for the engine's whole life. One helper owns the discipline
+    all three caches previously hand-rolled: a hit refreshes recency, a
+    miss calls `trace(key)` and evicts the least-recently-used entry
+    past `max_entries`. Iteration/containment/len mirror the dict so
+    the compile population stays inspectable (the
+    `verify_cache_entries`-style gauges)."""
+
+    def __init__(self, trace, max_entries: int = 8):
+        self._trace = trace
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[object, object]" = OrderedDict()
+
+    def get(self, key):
+        fn = self._entries.get(key)
+        if fn is None:
+            fn = self._trace(key)
+            self._entries[key] = fn
+            while len(self._entries) > max(1, int(self.max_entries)):
+                self._entries.popitem(last=False)
+        else:
+            self._entries.move_to_end(key)
+        return fn
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __iter__(self):
+        return iter(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
 
 
 @dataclasses.dataclass
@@ -144,6 +188,18 @@ class InflightStep:
     # device futures (JAX arrays still computing behind the queue)
     device_next: object = None  # decode: sampled tokens [max_seqs]
     device_logits: object = None  # [max_seqs, V] or [max_seqs, w, V]
+    # device-resident multi-step decode (kind "multistep"): the fused
+    # window's per-step device outputs — sampled tokens / logits /
+    # executed-step masks are [K, max_seqs] stacks, device_lengths the
+    # end-of-window cache lengths, k_steps the window depth actually
+    # dispatched, step_limits the per-slot fused-step caps the commit
+    # rolls truncation against. Reconcile code consumes THESE, never a
+    # live scheduler copy of the window bookkeeping (fxlint FX109).
+    device_tokens: object = None  # [K, max_seqs] sampled token per step
+    device_mask: object = None  # [K, max_seqs] bool — step ran for slot
+    device_lengths: object = None  # [max_seqs] end-of-window lengths
+    k_steps: int = 1  # fused steps dispatched in this window
+    step_limits: Optional[np.ndarray] = None  # int32 [max_seqs] per-slot cap
     # scheduler-side snapshot: slot -> Request identity at dispatch,
     # verify draft plan, and the dispatching iteration (fault keying)
     participants: Dict[int, object] = dataclasses.field(default_factory=dict)
@@ -239,20 +295,41 @@ class GenerationEngine:
             self._decode_impl_paged if self.paged else self._decode_impl
         )
         # one jitted prefill per length bucket / one jitted verify per
-        # draft width (jit caches by shape anyway; the explicit dicts make
-        # the compile-count contract inspectable). The verify cache is a
-        # bounded LRU: draft widths vary with optimize_spec_k re-tuning
-        # and per-request budget caps, and an unbounded dict kept every
-        # width's jitted program (and its device executable) alive for
-        # the engine's whole life.
+        # draft width (jit caches by shape anyway; the explicit caches
+        # make the compile-count contract inspectable). The verify,
+        # chunk, and multi-step caches are bounded LRUs (_JitCache):
+        # draft widths vary with optimize_spec_k re-tuning and
+        # per-request budget caps, chunk widths with the token budget,
+        # K buckets with the scheduler's fusing horizon — unbounded
+        # dicts kept every key's jitted program (and its device
+        # executable) alive for the engine's whole life.
         self._prefill_cache: Dict[int, object] = {}
-        self._verify_cache: "OrderedDict[int, object]" = OrderedDict()
-        self.verify_cache_max = 8
-        # chunked-prefill programs, one per chunk width — the scheduler
-        # pads widths to multiples of chunk_size, so the population is
-        # budget/chunk_size distinct widths at most
-        self._chunk_cache: "OrderedDict[int, object]" = OrderedDict()
-        self.chunk_cache_max = 8
+        self._verify_cache = _JitCache(
+            lambda w: jax.jit(
+                self._verify_impl_paged if self.paged else self._verify_impl
+            )
+        )
+        # chunked-prefill programs, one per compact batch shape (B, w) —
+        # the scheduler pads widths to multiples of chunk_size, so the
+        # population is budget/chunk_size distinct widths at most
+        self._chunk_cache = _JitCache(
+            lambda key: jax.jit(
+                self._chunk_impl_paged if self.paged else self._chunk_impl
+            )
+        )
+        # multi-step decode scan programs, one per (B, K-bucket, layout)
+        # key — K buckets are powers of two, so the population is
+        # log2(max_fused_steps) at most
+        self._multistep_cache = _JitCache(
+            lambda key: jax.jit(
+                functools.partial(
+                    self._decode_multi_impl_paged
+                    if self.paged
+                    else self._decode_multi_impl,
+                    key[1],
+                )
+            )
+        )
 
     @property
     def verify_cache_entries(self) -> int:
@@ -261,41 +338,37 @@ class GenerationEngine:
         width-churning workload's compile footprint is observable."""
         return len(self._verify_cache)
 
-    def _verify_fn(self, w: int):
-        """The jitted verify program for draft width `w`, LRU-managed:
-        a hit refreshes recency, a miss traces a new program and evicts
-        the least-recently-used width past `verify_cache_max`."""
-        import jax
+    @property
+    def multistep_cache_entries(self) -> int:
+        """Live jitted multi-step scan programs (LRU-bounded), the
+        `verify_cache_entries` twin for the fused-decode family."""
+        return len(self._multistep_cache)
 
-        fn = self._verify_cache.get(w)
-        if fn is None:
-            fn = jax.jit(
-                self._verify_impl_paged if self.paged else self._verify_impl
-            )
-            self._verify_cache[w] = fn
-            while len(self._verify_cache) > max(1, self.verify_cache_max):
-                self._verify_cache.popitem(last=False)
-        else:
-            self._verify_cache.move_to_end(w)
-        return fn
+    @property
+    def verify_cache_max(self) -> int:
+        return self._verify_cache.max_entries
+
+    @verify_cache_max.setter
+    def verify_cache_max(self, n: int) -> None:
+        self._verify_cache.max_entries = int(n)
+
+    @property
+    def chunk_cache_max(self) -> int:
+        return self._chunk_cache.max_entries
+
+    @chunk_cache_max.setter
+    def chunk_cache_max(self, n: int) -> None:
+        self._chunk_cache.max_entries = int(n)
+
+    def _verify_fn(self, w: int):
+        """The jitted verify program for draft width `w` (LRU-managed
+        by the shared _JitCache)."""
+        return self._verify_cache.get(w)
 
     def _chunk_fn(self, key):
         """The jitted chunked-prefill program for compact batch shape
-        `key` = (B, w) — same LRU discipline as `_verify_fn` over its
-        own cache."""
-        import jax
-
-        fn = self._chunk_cache.get(key)
-        if fn is None:
-            fn = jax.jit(
-                self._chunk_impl_paged if self.paged else self._chunk_impl
-            )
-            self._chunk_cache[key] = fn
-            while len(self._chunk_cache) > max(1, self.chunk_cache_max):
-                self._chunk_cache.popitem(last=False)
-        else:
-            self._chunk_cache.move_to_end(key)
-        return fn
+        `key` = (B, w) — same keyed-LRU discipline as `_verify_fn`."""
+        return self._chunk_cache.get(key)
 
     # -- kernel-failure fallback ---------------------------------------------
 
@@ -345,6 +418,7 @@ class GenerationEngine:
         )
         self._verify_cache.clear()
         self._chunk_cache.clear()
+        self._multistep_cache.clear()
 
     # -- shared forward ------------------------------------------------------
 
@@ -696,10 +770,13 @@ class GenerationEngine:
 
     # -- decode --------------------------------------------------------------
 
-    def _decode_impl(self, params, tokens, lengths, active, ck, cv):
-        """tokens [max_seqs, 1]; lengths [max_seqs] = cache position the
-        incoming token is written at; active [max_seqs] bool masks cache
-        writes for free slots."""
+    def _decode_core(self, params, tokens, lengths, active, ck, cv):
+        """One decode forward over the slot-contiguous cache: write the
+        new K/V row per active slot at `lengths`, run masked one-query
+        attention, return (ck', cv', logits [max_seqs, V]). The
+        single-step jit and the multi-step scan body both trace THIS
+        function, so their HLO op sequence — and therefore their
+        logits — match exactly (the token/logit-identity contract)."""
         import jax
         import jax.numpy as jnp
 
@@ -736,19 +813,30 @@ class GenerationEngine:
             ]
 
         logits = self._forward_logits(params, tokens, hook)[:, -1, :]
+        return new_k, new_v, logits
+
+    def _decode_impl(self, params, tokens, lengths, active, ck, cv):
+        """tokens [max_seqs, 1]; lengths [max_seqs] = cache position the
+        incoming token is written at; active [max_seqs] bool masks cache
+        writes for free slots."""
+        import jax.numpy as jnp
+
+        new_k, new_v, logits = self._decode_core(
+            params, tokens, lengths, active, ck, cv
+        )
         slots = jnp.arange(lengths.shape[0])
         # the sampled token will be written at cache position lengths + 1
         return new_k, new_v, self._pick(logits, slots, lengths + 1), logits
 
-    def _decode_impl_paged(
+    def _decode_core_paged(
         self, params, tokens, lengths, active, tables, ck, cv, cks, cvs
     ):
-        """Paged twin of _decode_impl. tables [max_seqs,
+        """Paged twin of _decode_core. tables [max_seqs,
         max_pages_per_seq] int32 block tables. The new K/V row scatters
         into `tables[slot, lengths // page_size] * page_size + lengths %
         page_size` of the flattened pool; inactive slots are routed to an
         out-of-bounds destination (dropped), replacing the contiguous
-        path's where-mask."""
+        path's where-mask. Returns (ck', cv', cks', cvs', logits)."""
         import jax.numpy as jnp
 
         from flexflow_tpu.ops.attention import (
@@ -803,6 +891,18 @@ class GenerationEngine:
             ]
 
         logits = self._forward_logits(params, tokens, hook)[:, -1, :]
+        return new_k, new_v, new_ks, new_vs, logits
+
+    def _decode_impl_paged(
+        self, params, tokens, lengths, active, tables, ck, cv, cks, cvs
+    ):
+        """Paged twin of _decode_impl (the single-step jit target):
+        one _decode_core_paged forward plus the per-slot sample."""
+        import jax.numpy as jnp
+
+        new_k, new_v, new_ks, new_vs, logits = self._decode_core_paged(
+            params, tokens, lengths, active, tables, ck, cv, cks, cvs
+        )
         slots = jnp.arange(lengths.shape[0])
         return (
             new_k,
@@ -812,6 +912,112 @@ class GenerationEngine:
             self._pick(logits, slots, lengths + 1),
             logits,
         )
+
+    # -- device-resident multi-step decode -----------------------------------
+
+    def _decode_multi_impl(
+        self, k_bucket, params, tokens, lengths, active, limits, eos, ck, cv
+    ):
+        """K fused decode iterations as ONE jitted `lax.scan` — the
+        device-resident inner loop. tokens [max_seqs] int32 (the last
+        emitted token per slot); lengths [max_seqs] pre-window cache
+        lengths; active [max_seqs] bool; limits [max_seqs] int32
+        PER-SLOT fused-step caps (a budget- or boundary-capped slot
+        stops contributing at its own limit while deeper slots keep
+        fusing); eos [max_seqs] int32 EOS token id per slot (-1 =
+        none). Each scan step traces the SAME `_decode_core` the
+        single-step jit traces, then samples with the identical
+        position-derived `_pick` key — fold_in(fold_in(seed, slot),
+        position) depends only on the running length, never the step
+        counter, so the fused stream is identical-by-construction to
+        step-at-a-time. EOS detection, length bumps, and
+        retire-the-slot masking all live in the scan carry; `k_bucket`
+        is the trace-time scan length (the pow-2 bucket the dispatch
+        rounds K up to — steps past a slot's limit are masked out).
+
+        Returns (ck', cv', final_lengths, final_tokens,
+        tokens_ks [K, max_seqs], logits_ks [K, max_seqs, V],
+        mask_ks [K, max_seqs]) — the per-step stacks the window
+        reconcile slices to the true K."""
+        import jax
+        import jax.numpy as jnp
+
+        slots = jnp.arange(lengths.shape[0])
+
+        def body(carry, i):
+            ck_c, cv_c, lens, toks, alive = carry
+            act = alive & (i < limits)
+            nk, nv, logits = self._decode_core(
+                params, toks[:, None], lens, act, ck_c, cv_c
+            )
+            nxt = self._pick(logits, slots, lens + 1)
+            hit = act & (eos >= 0) & (nxt == eos)
+            new_lens = jnp.where(act, lens + 1, lens)
+            new_toks = jnp.where(act, nxt, toks)
+            return (nk, nv, new_lens, new_toks, alive & ~hit), (
+                nxt,
+                logits,
+                act,
+            )
+
+        carry0 = (ck, cv, lengths, tokens, active)
+        (nk, nv, lens, toks, _), (toks_ks, logits_ks, mask_ks) = jax.lax.scan(
+            body, carry0, jnp.arange(k_bucket)
+        )
+        return nk, nv, lens, toks, toks_ks, logits_ks, mask_ks
+
+    def _decode_multi_impl_paged(
+        self,
+        k_bucket,
+        params,
+        tokens,
+        lengths,
+        active,
+        limits,
+        eos,
+        tables,
+        ck,
+        cv,
+        cks,
+        cvs,
+    ):
+        """Paged twin of _decode_multi_impl. The block tables ride in
+        as ONE trace-time snapshot: the dispatch pre-claims every page
+        the window can touch (the scheduler's per-slot limits never
+        cross more than one fresh page — the page-boundary K cap), so
+        the scan body recomputes each step's scatter destination from
+        the carried lengths against STATIC tables. int8 scale pools
+        ride the carry through `_quant_scatter` exactly like the
+        single-step path."""
+        import jax
+        import jax.numpy as jnp
+
+        slots = jnp.arange(lengths.shape[0])
+
+        def body(carry, i):
+            ck_c, cv_c, cks_c, cvs_c, lens, toks, alive = carry
+            act = alive & (i < limits)
+            nk, nv, nks, nvs, logits = self._decode_core_paged(
+                params, toks[:, None], lens, act, tables, ck_c, cv_c,
+                cks_c, cvs_c,
+            )
+            nxt = self._pick(logits, slots, lens + 1)
+            hit = act & (eos >= 0) & (nxt == eos)
+            new_lens = jnp.where(act, lens + 1, lens)
+            new_toks = jnp.where(act, nxt, toks)
+            return (nk, nv, nks, nvs, new_lens, new_toks, alive & ~hit), (
+                nxt,
+                logits,
+                act,
+            )
+
+        carry0 = (ck, cv, cks, cvs, lengths, tokens, active)
+        (nk, nv, nks, nvs, lens, toks, _), (
+            toks_ks,
+            logits_ks,
+            mask_ks,
+        ) = jax.lax.scan(body, carry0, jnp.arange(k_bucket))
+        return nk, nv, nks, nvs, lens, toks, toks_ks, logits_ks, mask_ks
 
     def decode_dispatch(
         self,
@@ -937,6 +1143,196 @@ class GenerationEngine:
         lengths, returns (next_tokens [max_seqs], logits [max_seqs, V])."""
         return self.decode_reconcile(
             self.decode_dispatch(params, tokens, active_mask)
+        )
+
+    def decode_multi_dispatch(
+        self,
+        params,
+        tokens: np.ndarray,
+        active_mask: np.ndarray,
+        step_limits: np.ndarray,
+        eos_tokens: Optional[np.ndarray] = None,
+        chain: Optional[InflightStep] = None,
+        chain_mask: Optional[np.ndarray] = None,
+    ) -> InflightStep:
+        """Enqueue ONE fused K-step decode window WITHOUT blocking.
+
+        tokens [max_seqs] (last emitted token per slot), active_mask
+        [max_seqs] bool, step_limits [max_seqs] int32 — how many fused
+        steps each slot runs (K = max over active slots; the scan
+        traces at the pow-2 bucket of K and masks steps past a slot's
+        own limit). eos_tokens [max_seqs] int32 per-slot EOS ids (-1 =
+        none): EOS retires the slot INSIDE the scan — it emits its
+        final token, then contributes nothing past it.
+
+        The host's view is reserved-K-steps-ahead: active lengths bump
+        by their full limits at dispatch, and the paged allocator
+        pre-claims every page the window can touch before the tables
+        snapshot (the existing begin_inflight/end_inflight reserve
+        window pins them for the window's whole life). The window
+        reconcile rolls back what the device did not take
+        (cache.truncate — EOS inside the window returns the surplus).
+        `chain`/`chain_mask` pipeline a window onto an in-flight step's
+        device_next exactly like decode_dispatch."""
+        import jax.numpy as jnp
+
+        spec = self.cache.spec
+        limits = np.where(
+            np.asarray(active_mask, dtype=bool),
+            np.asarray(step_limits, dtype=np.int32),
+            0,
+        ).astype(np.int32)
+        k = int(limits.max()) if limits.size else 0
+        if k < 1:
+            raise ValueError(
+                "multi-step window needs at least one fused step"
+            )
+        lengths_snap = np.array(self.cache.lengths)
+        for slot in np.nonzero(limits)[0]:
+            if int(lengths_snap[slot]) + int(limits[slot]) > spec.max_len:
+                raise ValueError(
+                    f"slot {int(slot)}: {int(limits[slot])} fused steps "
+                    f"overrun max_len {spec.max_len}"
+                )
+        # pow-2 K bucket: the scan length is a trace-time constant, so
+        # bucketing keeps the compile population log-bounded; the
+        # per-slot limits mask the bucket's surplus steps out
+        k_bucket = 1 << (k - 1).bit_length()
+        args = []
+        if self.paged:
+            # pre-claim every page the window can touch BEFORE the
+            # jitted scan: the block tables ride in as one trace-time
+            # snapshot, so all K steps' destinations must already map
+            # (the admission reserve guarantees these claims; the
+            # scheduler's page-boundary K cap keeps them to at most one
+            # fresh page per slot)
+            for slot in np.nonzero(limits)[0]:
+                start = int(lengths_snap[slot])
+                for p in range(start, start + int(limits[slot])):
+                    self.cache.ensure_position(int(slot), p)
+            args = [snapshot(self.cache.block_tables)]
+        host_tokens = np.asarray(tokens, dtype=np.int32)
+        eos = (
+            np.asarray(eos_tokens, dtype=np.int32)
+            if eos_tokens is not None
+            else np.full(spec.max_seqs, -1, dtype=np.int32)
+        )
+        mask = (
+            np.asarray(chain_mask, dtype=bool)
+            if chain is not None and chain_mask is not None
+            else None
+        )
+        if mask is None or not mask.any():
+            dev_tokens = jnp.asarray(host_tokens)
+        elif mask.all() or np.array_equal(
+            mask, np.asarray(active_mask, dtype=bool)
+        ):
+            dev_tokens = chain.device_next
+        else:
+            dev_tokens = jnp.where(
+                jnp.asarray(mask), chain.device_next, jnp.asarray(host_tokens)
+            )
+        # snapshot() every mutable host array (lengths += limits below,
+        # allocator table edits between iterations mutate behind the
+        # async dispatch queue); see decode_dispatch()
+        scale_args = (
+            [self.cache.k_scale, self.cache.v_scale] if self.paged else []
+        )
+        step_args = (
+            params,
+            dev_tokens,
+            snapshot(self.cache.lengths),
+            jnp.asarray(np.asarray(active_mask, dtype=bool)),
+            jnp.asarray(limits),
+            jnp.asarray(eos),
+            *args,
+            self.cache.k,
+            self.cache.v,
+            *scale_args,
+        )
+        key = (spec.max_seqs, k_bucket, "paged" if self.paged else "slot")
+
+        def call():
+            # resolved inside the dispatch so a kernel fallback's
+            # cleared cache re-traces with the dense attention core
+            return self._multistep_cache.get(key)(*step_args)
+
+        if self.paged:
+            (
+                new_k,
+                new_v,
+                new_ks,
+                new_vs,
+                d_lens,
+                d_toks,
+                toks_ks,
+                logits_ks,
+                mask_ks,
+            ) = self._dispatch("multistep", call)
+            self.cache.commit(new_k, new_v, new_ks, new_vs)
+        else:
+            new_k, new_v, d_lens, d_toks, toks_ks, logits_ks, mask_ks = (
+                self._dispatch("multistep", call)
+            )
+            self.cache.commit(new_k, new_v)
+        act = np.asarray(active_mask, dtype=bool)
+        self.cache.lengths[act] += limits[act]
+        # the in-flight window pins pages this window's snapshot tables
+        # reference for all K steps; decode_multi_reconcile closes it
+        self.cache.begin_inflight()
+        return InflightStep(
+            kind="multistep",
+            dispatch_t=time.perf_counter(),
+            active=np.array(active_mask, dtype=bool),
+            lengths=lengths_snap,
+            host_tokens=host_tokens,
+            device_next=d_toks,
+            device_logits=logits_ks,
+            device_tokens=toks_ks,
+            device_mask=mask_ks,
+            device_lengths=d_lens,
+            k_steps=k,
+            step_limits=limits,
+        )
+
+    def decode_multi_reconcile(
+        self, step: InflightStep
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Block on a fused window's device outputs and close its
+        in-flight window. Returns (tokens_ks [K, max_seqs], logits_ks
+        [K, max_seqs, V], mask_ks [K, max_seqs]) sliced to the
+        window's true K (the scan ran the pow-2 bucket; rows past K
+        are all-masked padding). Commit decisions — which tokens to
+        emit, how far to roll lengths back — belong to the caller,
+        made against the step record's snapshots ONLY: by the time
+        this runs, live cache/scheduler state is a whole window
+        ahead (fxlint FX109)."""
+        try:
+            toks_ks = np.asarray(step.device_tokens)
+            logits_ks = np.asarray(step.device_logits)
+            mask_ks = np.asarray(step.device_mask)
+        finally:
+            self.cache.end_inflight()
+        k = int(step.k_steps)
+        return toks_ks[:k], logits_ks[:k], mask_ks[:k]
+
+    def decode_multi(
+        self,
+        params,
+        tokens: np.ndarray,
+        active_mask: np.ndarray,
+        step_limits: np.ndarray,
+        eos_tokens: Optional[np.ndarray] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Synchronous fused window (dispatch + immediate reconcile).
+        NOTE: the host lengths stay advanced by the FULL per-slot
+        limits; callers roll back early-retired slots with
+        cache.truncate(slot, lengths + taken) like the scheduler's
+        window commit does."""
+        return self.decode_multi_reconcile(
+            self.decode_multi_dispatch(
+                params, tokens, active_mask, step_limits, eos_tokens
+            )
         )
 
     # -- verify (speculative decoding) ---------------------------------------

@@ -242,6 +242,11 @@ _STAT_FIELDS: Dict[str, object] = dict(
     chunk_tokens=0,  # Σ prompt tokens streamed in via chunks
     budget_deferrals=0,  # prefill-pending slots granted no tokens
     budget_used=0,  # tokens the LAST iteration charged to its budget
+    # device-resident multi-step decode (decode_multistep=True)
+    multistep_windows=0,  # fused K-step scan windows dispatched
+    multistep_steps=0,  # Σ decode steps executed inside fused windows
+    host_syncs=0,  # step reconciles (host round-trips), all kinds
+    multistep_cache_entries=0,  # live jitted scan programs (LRU gauge)
 
     # request lifecycle (filled at terminal transitions)
     submitted_requests=0,
@@ -307,6 +312,7 @@ _STAT_DERIVED = (
     "overlap_fraction",
     "mean_ttft_s",
     "mean_decode_s_per_token",
+    "host_syncs_per_token",
 )
 
 
@@ -456,6 +462,16 @@ class SchedulerStats:
             return 0.0
         return self.decode_latency_sum_s / self.finished_requests
 
+    @property
+    def host_syncs_per_token(self) -> float:
+        """Host round-trips (step reconciles) per committed token — the
+        cost the device-resident multi-step loop exists to amortize:
+        the step-at-a-time loop sits at ~1.0, a fused K-step window
+        pushes it toward 1/K."""
+        if not self.tokens_generated:
+            return 0.0
+        return self.host_syncs / self.tokens_generated
+
 
 for _name in _STAT_FIELDS:
     setattr(SchedulerStats, _name, _StatField(_name))
@@ -487,6 +503,8 @@ class _SchedulerBase:
         chunk_size: int = 16,
         kv_swap: bool = False,
         swap_decider=None,
+        decode_multistep: bool = False,
+        max_fused_steps: int = 8,
     ):
         self.engine = engine
         self.cache = engine.cache
@@ -549,6 +567,18 @@ class _SchedulerBase:
         if self.kv_swap and not getattr(engine.cache, "paged", False):
             raise ValueError("kv_swap requires the paged KV layout")
         self.swap_decider = swap_decider
+        # device-resident multi-step decode: when on, runs of decode
+        # iterations with no host-visible event pending fuse into ONE
+        # jitted lax.scan window of up to max_fused_steps steps
+        # (engine.decode_multi_dispatch) reconciled in a single host
+        # sync — see _fusable_steps for the event list that holds
+        # fusing to one step
+        self.decode_multistep = bool(decode_multistep)
+        self.max_fused_steps = int(max_fused_steps)
+        if self.decode_multistep and self.max_fused_steps < 1:
+            raise ValueError(
+                f"max_fused_steps must be >= 1, got {max_fused_steps}"
+            )
         # ServeConfig.debug_invariants / --check-invariants: re-derive
         # the cache/allocator accounting after EVERY iteration (what the
         # chaos harness does), so an invariant violation surfaces at the
@@ -1342,6 +1372,10 @@ class _SchedulerBase:
                 nxt, logits = self.engine.decode_reconcile(step)
             elif step.kind == "chunk":
                 nxt, logits = self.engine.prefill_chunk_reconcile(step)
+            elif step.kind == "multistep":
+                toks_ks, logits_ks, mask_ks = self.engine.decode_multi_reconcile(
+                    step
+                )
             else:
                 logits = self.engine.verify_reconcile(step)
         except Exception as e:
@@ -1349,10 +1383,15 @@ class _SchedulerBase:
             return
         t1 = time.perf_counter()
         self.stats.commit_wait_s += t1 - t0
+        # every reconcile is exactly one host round-trip, whatever the
+        # step's width — the denominator of host_syncs_per_token
+        self.stats.host_syncs += 1
         if step.kind == "decode":
             self._commit_decode(step, nxt, logits)
         elif step.kind == "chunk":
             self._commit_chunk(step, nxt, logits)
+        elif step.kind == "multistep":
+            self._commit_multistep(step, toks_ks, logits_ks, mask_ks)
         else:
             self._commit_verify(step, logits)
         if self._tele is not None:
@@ -1363,7 +1402,9 @@ class _SchedulerBase:
             # state (fxlint FX103)
             tr = self._tele.tracer
             tr.device_window(
-                step.kind,
+                f"multistep[{int(step.k_steps)}]"
+                if step.kind == "multistep"
+                else step.kind,
                 step.seq,
                 step.dispatch_t,
                 t1,
@@ -1410,6 +1451,175 @@ class _SchedulerBase:
         step = self._decode_dispatch_step()
         if step is not None:
             self._reconcile_step(step)
+
+    # -- device-resident multi-step decode (decode_multistep=True) -----------
+
+    def _fusable_steps(self) -> int:
+        """How many decode steps the NEXT dispatch may fuse into one
+        device-resident scan window: `max_fused_steps` when no
+        host-visible event can need the host mid-window, else 1. The
+        events that hold fusing to a single step: speculative mode (a
+        verify's acceptance is host logic every iteration), a non-empty
+        queue (admission next iteration changes the batch), optimistic
+        admission (preemption must never coexist with an open window),
+        any chunk streaming in progress or a final chunk that just
+        committed (phase changes), and deferred cancels waiting on a
+        reconcile. Deadlines deliberately do NOT hold fusing: a
+        deadline expiring mid-window reaps at the window's reconcile —
+        at most K-1 steps of wasted (discarded) device work, the same
+        one-step-stale contract the async loop already carries.
+        Per-slot EOS and page-boundary caps are handled inside the
+        window itself (`_decode_multi_dispatch_step`), not here."""
+        if not self.decode_multistep or self.max_fused_steps <= 1:
+            return 1
+        if self.proposer is not None:
+            return 1
+        if self.queue:
+            return 1
+        if self.admission == "optimistic":
+            return 1
+        if self._chunk_unlocked:
+            return 1
+        if any(self._prefill_pending(r) for r in self.running.values()):
+            return 1
+        if getattr(self, "_pending_cancels", None):
+            return 1
+        return int(self.max_fused_steps)
+
+    def _decode_multi_dispatch_step(self, k: int):
+        """Dispatch phase of one fused K-step decode window: per slot,
+        cap the window depth at the request's remaining token budget,
+        the cache horizon, and (paged layout) the distance to the next
+        page boundary — so the window claims AT MOST one fresh page per
+        slot, which `_secure_pages` handles exactly like a plain decode
+        step's claim. Every cache read on this side goes through
+        `int()`/`np` snapshots (fxlint FX109a): the scan then runs K
+        steps device-side against this dispatch's snapshot, carrying
+        sampling, EOS detection, and length bumps in the scan state.
+        Returns the InflightStep, or None when there is nothing to
+        step."""
+        stepped: Dict[int, Request] = {}
+        limits: Dict[int, int] = {}
+        ps = int(getattr(self.cache.spec, "page_size", 0) or 0)
+        max_len = self.cache.spec.max_len
+        for slot, req in self.running.items():
+            if self._prefill_pending(req) or slot in self._chunk_unlocked:
+                continue
+            cur_len = int(self.cache.lengths[slot])
+            cap = min(
+                k,
+                req.max_new_tokens - len(req.generated),
+                max_len - cur_len,
+            )
+            if ps:
+                # page-boundary truncation: the window ends where the
+                # slot's next fresh page would begin
+                cap = min(cap, ps - (cur_len % ps))
+            if cap >= 1:
+                stepped[slot] = req
+                limits[slot] = cap
+        self._secure_pages({slot: 1 for slot in stepped})
+        stepped = {s: r for s, r in stepped.items() if self.running.get(s) is r}
+        if not stepped:
+            return None
+        spec = self.cache.spec
+        tokens = np.zeros(spec.max_seqs, dtype=np.int32)
+        active = np.zeros(spec.max_seqs, dtype=bool)
+        step_limits = np.zeros(spec.max_seqs, dtype=np.int32)
+        eos = np.full(spec.max_seqs, -1, dtype=np.int32)
+        for slot, req in stepped.items():
+            tokens[slot] = req.generated[-1]
+            active[slot] = True
+            step_limits[slot] = limits[slot]
+            if req.eos_token is not None:
+                eos[slot] = int(req.eos_token)
+        t0 = time.perf_counter()
+        try:
+            step = self.engine.decode_multi_dispatch(
+                self.params, tokens, active, step_limits, eos_tokens=eos
+            )
+        except Exception as e:
+            self._fail_all_running(f"multistep decode failed: {e!r}")
+            return None
+        kmax = int(step.k_steps)
+        if self._tele is not None:
+            tele = self._tele
+            tele.tracer.complete(
+                "dispatch:multistep",
+                "host",
+                t0,
+                time.perf_counter(),
+                args={
+                    "iter": self._iter,
+                    "active": int(active.sum()),
+                    "k": kmax,
+                },
+            )
+            reg = tele.registry
+            reg.counter(
+                "serve_multistep_windows_total",
+                help="fused K-step decode windows dispatched",
+            ).inc()
+            reg.counter(
+                "serve_multistep_steps_total",
+                help="decode steps executed inside fused windows",
+            ).inc(kmax)
+            reg.histogram(
+                "serve_multistep_window_size",
+                bounds=(1, 2, 4, 8, 16, 32, 64),
+                help="fused-window depth K per dispatched window",
+            ).observe(float(kmax))
+        step.iteration = self._iter
+        step.participants = stepped
+        self._note_dispatch(step)
+        stats = self.stats
+        stats.multistep_windows += 1
+        stats.multistep_steps += kmax
+        stats.decode_steps += kmax
+        stats.slot_steps += spec.max_seqs * kmax
+        stats.busy_slot_steps += int(step_limits.sum())
+        self._budget_used_iter += int(step_limits.sum())
+        return step
+
+    def _commit_multistep(self, step, toks_ks, logits_ks, mask_ks) -> None:
+        """Commit a reconciled K-step window: per slot, roll the cache
+        back from the dispatch-time pre-advance to the length the scan
+        actually took (an in-scan EOS hit clears the per-step mask for
+        every later step, so `taken` lands exactly at the EOS
+        position), then emit the taken tokens in order. Rollback runs
+        BEFORE emitting: _emit may retire the request, which frees the
+        slot (truncating a freed slot would be an error). Reads ONLY
+        the step record — pre-step lengths, per-slot limits, and the
+        per-step token/logit/mask stacks all ride the InflightStep
+        (fxlint FX103/FX109b); live cache state is a full window
+        ahead."""
+        active_slots = [s for s, a in enumerate(step.active) if a]
+        if self.injector is not None:
+            logits_ks = np.array(logits_ks)  # writable copy
+            self.injector.corrupt_logits(
+                logits_ks[0], active_slots, iteration=step.iteration
+            )
+        for slot in active_slots:
+            req = step.participants.get(slot)
+            if req is None or self.running.get(slot) is not req:
+                continue
+            taken = int(mask_ks[:, slot].sum())
+            if taken < int(step.step_limits[slot]):
+                # EOS retired the slot mid-window: return the unused
+                # pre-advanced rows (paged slots give surplus pages
+                # back to the reserve) before any emit can free it
+                self.cache.truncate(slot, int(step.lengths[slot]) + taken)
+            for i in range(taken):
+                if not np.isfinite(logits_ks[i, slot]).all():
+                    self._fail(
+                        req,
+                        f"non-finite logits at iteration "
+                        f"{step.iteration} (window step {i})",
+                    )
+                    break
+                self._emit(req, int(toks_ks[i, slot]))
+                if self.running.get(slot) is not req:
+                    break  # retired (EOS/budget) — nothing past it
 
     def _propose(self, k: int) -> Dict[int, List[int]]:
         """Draft tokens for the running slots; a proposer fault (real or
@@ -1823,6 +2033,12 @@ class _SchedulerBase:
     def _generate_once(self) -> None:
         if self.proposer is not None:
             self._verify_once()
+            return
+        k = self._fusable_steps()
+        if k > 1:
+            step = self._decode_multi_dispatch_step(k)
+            if step is not None:
+                self._reconcile_step(step)
         else:
             self._decode_once()
 
@@ -1846,6 +2062,9 @@ class _SchedulerBase:
         )
         self.stats.kernel_fallbacks = getattr(
             self.engine, "kernel_fallbacks", 0
+        )
+        self.stats.multistep_cache_entries = getattr(
+            self.engine, "multistep_cache_entries", 0
         )
         self.stats.prefix_hits = getattr(self.cache, "prefix_hits", 0)
         self.stats.prefix_pages_shared = int(
@@ -2128,7 +2347,19 @@ class AsyncContinuousBatchingScheduler(ContinuousBatchingScheduler):
         prompt tokens are accepted by construction, the engine advances
         lengths at dispatch), so chunks pipeline exactly like chained
         decodes and both steps of iteration N ride the device while the
-        host reconciles N-1."""
+        host reconciles N-1.
+
+        A fused multi-step window (decode_multistep=True) rides the
+        same deque but cannot be token-chained — its last token is K
+        steps deep in the scan — so any in-flight step drains before a
+        window dispatches (the window reads committed generated[-1]
+        tokens), and an open window drains at the NEXT iteration's top
+        before anything else dispatches, which is also where deferred
+        cancels and running-deadline reaping land. The host work that
+        overlaps an open window is the next iteration's admission and
+        bookkeeping, exactly as for a plain in-flight step."""
+        if any(s.kind == "multistep" for s in self._inflight):
+            self._drain_inflight()
         keep = 0
         if self.token_budget and self.running:
             step = self._chunk_dispatch_step(
@@ -2138,16 +2369,34 @@ class AsyncContinuousBatchingScheduler(ContinuousBatchingScheduler):
                 self._inflight.append(step)
                 keep += 1
         if self.running:
-            # chain on the newest in-flight DECODE step — an interleaved
-            # chunk step never carries the decoding slots' next tokens
-            chain = next(
-                (s for s in reversed(self._inflight) if s.kind == "decode"),
-                None,
-            )
-            step = self._decode_dispatch_step(chain=chain)
-            if step is not None:
-                self._inflight.append(step)
-                keep += 1
+            k = self._fusable_steps()
+            if k > 1:
+                # k > 1 implies no chunk streaming in progress, so
+                # nothing was appended above (keep == 0); drain any
+                # plain decode step still in flight from the previous
+                # iteration — the window's input tokens must be
+                # committed before the scan captures them
+                self._drain_inflight()
+                step = self._decode_multi_dispatch_step(k)
+                if step is not None:
+                    self._inflight.append(step)
+                    keep += 1
+            else:
+                # chain on the newest in-flight DECODE step — an
+                # interleaved chunk step never carries the decoding
+                # slots' next tokens
+                chain = next(
+                    (
+                        s
+                        for s in reversed(self._inflight)
+                        if s.kind == "decode"
+                    ),
+                    None,
+                )
+                step = self._decode_dispatch_step(chain=chain)
+                if step is not None:
+                    self._inflight.append(step)
+                    keep += 1
         while len(self._inflight) > keep:
             self._reconcile_front()
         if not keep:

@@ -1,0 +1,31 @@
+"""FX109 positives — device-resident multi-step decode violations.
+
+A multi-step dispatch captures live allocator state into the fused
+K-step scan window (part a), and a window reconcile reads the window's
+geometry from a scheduler-side mirror instead of the step record
+(part b).
+"""
+
+
+class BadEngine:
+    def advance(self, slot):
+        # makes `lengths` a mutated attribute for the scanned file set
+        self.cache.lengths[slot] += 1
+
+    def alloc(self, slot, page):
+        # blessed FX106 name — only here to make `block_tables` mutated
+        self.cache.block_tables[slot] = page
+
+    def decode_multi_dispatch(self, params, tokens, limits):
+        # FX109a: the live length table rides into the K-step window —
+        # the scan reads it behind the dispatch queue, K steps stale
+        step_args = (params, tokens, self.cache.lengths, limits)
+        # FX109a: live block tables bound raw for the window's pages
+        tables = self.cache.block_tables
+        return self._window_fn(*step_args), tables
+
+    def decode_multi_reconcile(self, step):
+        # FX109b: window depth read from a scheduler-side mirror — one
+        # whole window stale under async double-buffering
+        k = self._last_window.k_steps
+        return step.device_tokens[:k]

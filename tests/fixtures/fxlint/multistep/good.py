@@ -1,0 +1,37 @@
+"""FX109 negatives — the blessed multi-step idioms stay silent.
+
+Snapshots (snapshot()/np.array/.copy()) carry host state into the
+window, scalar builtins materialize synchronous reads, the pre-advance
+is a store target, and the reconcile reads window state only through
+the step record.
+"""
+
+import numpy as np
+
+
+def snapshot(x):
+    return np.asarray(np.array(x))
+
+
+class GoodEngine:
+    def advance(self, slot):
+        # same mutations as bad.py: `lengths`/`block_tables` are tainted
+        self.cache.lengths[slot] += 1
+
+    def alloc(self, slot, page):
+        self.cache.block_tables[slot] = page
+
+    def decode_multi_dispatch(self, params, tokens, limits):
+        # snapshot()/np.array are the blessed carriers into the window
+        step_args = (params, tokens, snapshot(self.cache.lengths), limits)
+        tables = np.array(self.cache.block_tables)
+        # int() materializes a host scalar at call time: synchronous
+        cur = int(self.cache.lengths[0])
+        # the pre-advance is a store TARGET — the dispatch-side commit
+        self.cache.lengths[0] += cur
+        return self._window_fn(*step_args), tables
+
+    def decode_multi_reconcile(self, step):
+        # window geometry through the step record only
+        k = int(step.k_steps)
+        return step.device_tokens[:k], step.step_limits
