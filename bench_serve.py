@@ -99,6 +99,16 @@ prefill work it no longer does). Gates — EXIT NONZERO on miss:
 disaggregated p99 decode ITL >= 1.3x better than monolithic, goodput
 >= 0.95x monolithic, zero lost requests in the chaos leg.
 
+--tenancy mode (writes BENCH_TENANCY.json): multi-tenant serving —
+mixed-priority (gold:4 / bronze:1), mixed-LoRA-adapter open-loop
+Poisson traffic at >= 2x overload, weighted-fair deficit round-robin
+vs the unweighted FIFO planner on the identical arrival schedule.
+Gates — EXIT NONZERO on miss: gold p95 SLO attainment under
+weighted-fair >= FIFO's, bronze starvation bounded (all finish, p95
+TTFT within 10x FIFO), zero lost requests, and every stream
+token-identical to an uncontended isolated reference (including the
+per-slot adapter deltas).
+
 The default workload is the flagship Transformer geometry (12 layers,
 hidden 1024, 16 heads — transformer.cc:79-85) recast as a decoder LM;
 `--smoke` shrinks it for CPU CI.
@@ -2005,6 +2015,221 @@ def run_frontdoor(
     }
 
 
+def run_tenancy(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    seed: int = 0,
+):
+    """Multi-tenant gate (writes BENCH_TENANCY.json): mixed-priority,
+    mixed-adapter OPEN-LOOP Poisson traffic at >= 2x overload (the
+    whole stream arrives in a burst against a slot pool half its size),
+    weighted-fair deficit-round-robin scheduling (gold:4, bronze:1)
+    vs the unweighted FIFO planner on the SAME arrival schedule.
+    Requests rotate across LoRA adapters 0 / 1 / none, so the fairness
+    legs also exercise the per-slot adapter gather under preemption
+    pressure. Gates — EXIT NONZERO on miss: (a) gold-class p95 TTFT
+    SLO attainment under weighted-fair >= the FIFO leg's (the
+    threshold is the pooled median TTFT of both legs, so it always
+    discriminates), (b) bronze is starvation-bounded — every bronze
+    request finishes and its weighted-leg p95 TTFT stays within 10x
+    the FIFO leg's, (c) zero lost requests on every leg, and (d)
+    every stream is token-identical to an uncontended isolated
+    reference run (fairness reorders WHEN work is granted, never WHAT
+    is computed — including the adapter deltas)."""
+    import numpy as np
+
+    from flexflow_tpu.serving import Request, ServeConfig, build_scheduler
+    from flexflow_tpu.serving.tenancy import make_lora_weights
+    from flexflow_tpu.serving.tenancy.slo import class_slo_snapshot
+    import time as _time
+
+    rng = np.random.default_rng(seed)
+    chunk = 8
+    budget = max_seqs + chunk
+    max_new = max(6, max_len // 8)
+    classes = "gold:4,bronze:1"
+    n = num_requests
+    # whole stream inside a tight burst: with the slot pool at half the
+    # request count the queue is >= 2x oversubscribed from the start
+    arrivals = _poisson_arrivals(n, rate=n * 16.0, rng=rng)
+    prompt_lens = [4 + int(rng.integers(0, max_len // 4)) for _ in range(n)]
+
+    def requests(with_class):
+        out = []
+        for i in range(n):
+            out.append(
+                Request(
+                    rid=i,
+                    prompt=[(i * 7 + j) % vocab
+                            for j in range(prompt_lens[i])],
+                    max_new_tokens=max_new,
+                    priority_class=(
+                        ("gold" if i % 2 == 0 else "bronze")
+                        if with_class else ""
+                    ),
+                    tenant="acme" if i % 2 == 0 else "initech",
+                    adapter_id=(0, 1, -1)[i % 3],
+                )
+            )
+        return out
+
+    def _serve(**kw):
+        return ServeConfig(
+            max_seqs=max_seqs,
+            max_seq_len=max_len,
+            kv_layout="paged",
+            token_budget=budget,
+            chunk_size=chunk,
+            adapters=2,
+            adapter_rank=4,
+            **kw,
+        )
+
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+
+    def _build(serve):
+        sched, engine, _ = build_scheduler(model, serve)
+        for aid in (0, 1):
+            engine.adapters.load(
+                aid, make_lora_weights(engine.adapters.spec, 4, seed=aid)
+            )
+        return sched
+
+    def _drive(sched, reqs):
+        """Open-loop: submit each request at its arrival offset, step
+        while work is pending, read TTFT off the request records."""
+        pending = list(range(len(reqs)))
+        t0 = _time.perf_counter()
+        while pending or sched._work_pending():
+            now = _time.perf_counter() - t0
+            while pending and arrivals[pending[0]] <= now:
+                sched.submit(reqs[pending.pop(0)])
+            if not sched._work_pending():
+                if pending:
+                    _time.sleep(max(0.0, arrivals[pending[0]] - now))
+                continue
+            sched.step()
+        elapsed = _time.perf_counter() - t0
+        lost = [r.rid for r in reqs if r.status != "finished"]
+        return {
+            "streams": {r.rid: tuple(r.generated) for r in reqs},
+            "lost": lost,
+            "ttft": {r.rid: r.ttft_s for r in reqs if r.ok},
+            "elapsed_s": elapsed,
+        }
+
+    # uncontended isolated reference: every request gets a slot at t0 —
+    # the token streams both timed legs must reproduce exactly
+    ref_sched = _build(
+        ServeConfig(max_seqs=n, max_seq_len=max_len, kv_layout="paged",
+                    adapters=2, adapter_rank=4, classes=classes)
+    )
+    ref_reqs = requests(with_class=True)
+    ref_sched.run(ref_reqs)
+    ref = {r.rid: tuple(r.generated) for r in ref_reqs}
+    if len(ref) != n or any(r.status != "finished" for r in ref_reqs):
+        raise SystemExit("tenancy reference leg lost requests")
+
+    # untimed warm-up of the contended geometry (jit off the clock)
+    _build(_serve(classes=classes, telemetry=True)).run(
+        requests(with_class=True)
+    )
+
+    legs = {}
+    for tag, kw, with_class in (
+        ("weighted", dict(classes=classes, telemetry=True), True),
+        ("fifo", dict(), False),
+    ):
+        sched = _build(_serve(**kw))
+        res = _drive(sched, requests(with_class))
+        if res["lost"]:
+            raise SystemExit(f"tenancy {tag} leg LOST requests: "
+                             f"{res['lost']}")
+        moved = [rid for rid, t in res["streams"].items()
+                 if t != ref[rid]]
+        if moved:
+            raise SystemExit(
+                f"tenancy {tag} leg moved greedy streams for rids "
+                f"{moved} — fairness must not change WHAT is computed"
+            )
+        res["sched"] = sched
+        legs[tag] = res
+
+    gold = [i for i in range(n) if i % 2 == 0]
+    bronze = [i for i in range(n) if i % 2 == 1]
+
+    def _p(ttfts, q):
+        xs = sorted(ttfts)
+        return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
+
+    # load-derived SLO threshold: the pooled median TTFT of both legs
+    # always splits the distribution, so attainment discriminates on
+    # any machine speed
+    pooled = [t for leg in legs.values() for t in leg["ttft"].values()]
+    slo_s = _p(pooled, 0.5)
+
+    def _attain(leg, rids):
+        ts = [legs[leg]["ttft"][r] for r in rids]
+        return sum(t <= slo_s for t in ts) / len(ts)
+
+    att = {
+        "threshold_ms": round(slo_s * 1e3, 2),
+        "gold_weighted": round(_attain("weighted", gold), 3),
+        "gold_fifo": round(_attain("fifo", gold), 3),
+        "bronze_weighted": round(_attain("weighted", bronze), 3),
+        "bronze_fifo": round(_attain("fifo", bronze), 3),
+    }
+    if att["gold_weighted"] < att["gold_fifo"]:
+        raise SystemExit(
+            f"tenancy gate: gold SLO attainment under weighted-fair "
+            f"({att['gold_weighted']}) fell below FIFO "
+            f"({att['gold_fifo']}) at threshold {att['threshold_ms']}ms"
+        )
+    bz_w = _p([legs["weighted"]["ttft"][r] for r in bronze], 0.95)
+    bz_f = _p([legs["fifo"]["ttft"][r] for r in bronze], 0.95)
+    if bz_f > 0 and bz_w > 10.0 * bz_f:
+        raise SystemExit(
+            f"tenancy gate: bronze p95 TTFT {bz_w * 1e3:.1f}ms exceeds "
+            f"10x the FIFO leg's {bz_f * 1e3:.1f}ms — starvation is "
+            "unbounded"
+        )
+
+    wsched = legs["weighted"]["sched"]
+    gold_w = _p([legs["weighted"]["ttft"][r] for r in gold], 0.95)
+    gold_f = _p([legs["fifo"]["ttft"][r] for r in gold], 0.95)
+    return {
+        "metric": f"serve_tenancy_{layers}L_{hidden}h_gold_p95_ttft",
+        "value": round(gold_w * 1e3, 2),
+        "unit": "ms",
+        # FIFO gold p95 TTFT over weighted-fair's (>1 = priority win)
+        "vs_baseline": round(gold_f / gold_w, 3) if gold_w else 0.0,
+        "classes": classes,
+        "overload": f"{n} requests / {max_seqs} slots",
+        "ttft_ms": {
+            leg: {
+                "gold_p50": round(_p([legs[leg]["ttft"][r]
+                                      for r in gold], 0.5) * 1e3, 2),
+                "gold_p95": round(_p([legs[leg]["ttft"][r]
+                                      for r in gold], 0.95) * 1e3, 2),
+                "bronze_p95": round(_p([legs[leg]["ttft"][r]
+                                        for r in bronze], 0.95) * 1e3, 2),
+            }
+            for leg in legs
+        },
+        "slo_attainment": att,
+        "lost_requests": 0,
+        "streams_match": f"{n}/{n}",
+        "adapter_pool": wsched.adapters.telemetry_gauges(),
+        "adapter_traffic": wsched.adapters.telemetry_counters(),
+        "per_class_slo": class_slo_snapshot(wsched._class_slo),
+    }
+
+
 _PRESETS = {
     # flagship geometry (transformer.cc:79-85) as a decoder LM — the TPU
     # target; CPU CI uses --smoke
@@ -2059,6 +2284,8 @@ def main():
             mode = "telemetry"
         elif a == "--multistep":
             mode = "multistep"
+        elif a == "--tenancy":
+            mode = "tenancy"
         elif a == "--serve-async":
             # alone: the sync-vs-async comparison (BENCH_ASYNC.json);
             # with --chaos: the chaos gate runs the async loop
@@ -2190,6 +2417,13 @@ def main():
                 f"disaggregation regressed goodput: "
                 f"{result['goodput_ratio']}x monolithic (floor 0.95x)"
             )
+    elif mode == "tenancy":
+        result = run_tenancy(seed=seed, **args)
+        with open(os.path.join(here, "BENCH_TENANCY.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        # the hard gates (attainment, starvation bound, zero lost,
+        # stream identity) already raised inside run_tenancy on miss
     elif mode == "multistep":
         result = run_multistep(**args)
         with open(os.path.join(here, "BENCH_MULTISTEP.json"), "w") as f:

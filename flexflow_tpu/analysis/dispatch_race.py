@@ -118,6 +118,21 @@ findings go to the baseline):
   stale under async double-buffering, so commit/rollback decisions
   made against it truncate to the wrong length or emit phantom
   steps.
+* **FX110** — adapter-pool ledger discipline for the multi-tenant
+  LoRA pool (``serving/tenancy/adapters.AdapterPool``), FX106's rule
+  applied to its sibling allocator: a subscript store into an
+  ``adapter_tables`` / ``slot_adapter`` / ``_adapter_refcounts``
+  attribute, or a ``heapq`` push/pop reaching the
+  ``_free_adapter_pages`` heap, outside the blessed pool helpers
+  (``load``/``unload``/``attach``/``detach`` and the page-install/
+  free seams — see ``_ADAPTER_BLESSED``). The pool's refcounts are
+  1 (loaded) + 1 per attached slot and ``check_invariants``
+  re-derives them from the tables, so a raw write frees an
+  adapter's pages under a slot mid-decode (the gather then reads a
+  recycled page: silent weight corruption, the tenant-isolation
+  bug) or leaks them forever. The ledger names are disjoint from
+  FX106's on purpose — the two allocators can be linted in one pass
+  without cross-talk.
 """
 
 from __future__ import annotations
@@ -148,6 +163,8 @@ RULES = {
     "reading live source-engine pool state",
     "FX109": "multi-step dispatch captures live host state into the "
     "fused window, or reconcile reads window state off the step record",
+    "FX110": "adapter-pool table/refcount write or free-heap mutation "
+    "outside the blessed AdapterPool helpers",
 }
 
 #: the only functions allowed to write `block_tables` entries or touch
@@ -201,6 +218,30 @@ _SWAP_BLESSED = {
 }
 
 _SWAP_LEDGER_ATTRS = {"_swapped", "_pub_only", "_hosts_down"}
+
+#: the only functions allowed to write the multi-LoRA pool's ledgers
+#: (FX110): the load/unload/attach/detach surface the scheduler calls
+#: plus the page-install/free seams they delegate to. `__init__` is
+#: construction, not mutation (same rationale as FX106).
+_ADAPTER_BLESSED = {
+    "__init__",
+    "load",
+    "unload",
+    "attach",
+    "detach",
+    "_install_adapter_page",
+    "_free_adapter_page",
+    "_pop_free_adapter_page",
+}
+
+#: AdapterPool's refcount-bearing ledgers — deliberately disjoint from
+#: FX106's block_tables/_free_pages names so both allocators lint in
+#: one pass without cross-talk
+_ADAPTER_LEDGER_ATTRS = {
+    "adapter_tables",
+    "slot_adapter",
+    "_adapter_refcounts",
+}
 
 #: method calls that mutate a dict/set ledger in place
 _SWAP_MUTATING_METHODS = {
@@ -598,6 +639,69 @@ def _refcount_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
     return found
 
 
+def _adapter_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
+    """(description, line, offender) for adapter-pool ledger mutations
+    outside the blessed AdapterPool helpers (FX110): a subscript store
+    (or AugAssign) into an ``adapter_tables`` / ``slot_adapter`` /
+    ``_adapter_refcounts`` attribute, or a ``heapq.heappush``/
+    ``heappop`` whose argument reaches the ``_free_adapter_pages``
+    heap. Reads never match — ``slot_tables``/``row_tables`` gather
+    from the ledgers freely, and ``check_invariants`` audits them.
+    Module-level code reports under the pseudo-name '<module>'."""
+    found: List[Tuple[str, int, str]] = []
+
+    def ledger_store_attr(node: ast.AST) -> Optional[str]:
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(t.elts)
+            elif isinstance(t, ast.Subscript) and isinstance(
+                t.value, ast.Attribute
+            ) and t.value.attr in _ADAPTER_LEDGER_ATTRS:
+                return t.value.attr
+        return None
+
+    def heap_reached(node: ast.AST) -> bool:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("heappush", "heappop")
+        ):
+            return False
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Attribute) and (
+                    sub.attr == "_free_adapter_pages"
+                ):
+                    return True
+        return False
+
+    def visit(node: ast.AST, owner: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            owner = node.name
+            if owner in _ADAPTER_BLESSED:
+                return
+        attr = ledger_store_attr(node)
+        if attr is not None:
+            found.append(
+                (f"writes the '{attr}' ledger", node.lineno, owner)
+            )
+        elif heap_reached(node):
+            found.append(
+                ("mutates the '_free_adapter_pages' heap", node.lineno,
+                 owner)
+            )
+        for child in ast.iter_child_nodes(node):
+            visit(child, owner)
+
+    visit(tree, "<module>")
+    return found
+
+
 def _swap_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
     """(description, line, offender) for swap/eviction ledger mutations
     outside the blessed allocator helpers (FX107): subscript stores,
@@ -900,6 +1004,23 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                     "pages; route through swap_out/swap_in/"
                     "discard_swap, the _incref/_decref_page seams, or "
                     "mark_host_down/mark_host_up",
+                )
+            )
+    for path, tree in trees.items():
+        for what, line, owner in _adapter_violations(tree):
+            diags.append(
+                Diagnostic(
+                    "FX110",
+                    path,
+                    line,
+                    f"'{owner}' {what} outside the blessed AdapterPool "
+                    "helpers — adapter-page refcounts are 1 (loaded) "
+                    "plus 1 per attached slot, so a raw write frees an "
+                    "adapter's pages under a slot mid-decode (the "
+                    "gather reads a recycled page: another tenant's "
+                    "weights) or leaks them forever; route through "
+                    "load/unload/attach/detach or the "
+                    "_install_adapter_page/_free_adapter_page seams",
                 )
             )
     for path, tree in trees.items():

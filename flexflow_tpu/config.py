@@ -199,6 +199,14 @@ class FFConfig:
     # --max-fused-steps steps, reconciled in a single host sync
     serve_decode_multistep: bool = False
     serve_max_fused_steps: int = 8
+    # multi-tenant serving (serving/tenancy/): --adapters provisions a
+    # paged pool of that many LoRA adapter ids (--adapter-rank rows
+    # each); --classes "gold:4:200:20,bronze:1" declares priority
+    # classes as name:weight[:ttft_ms[:itl_ms]] and turns the token
+    # planner/admission into weighted-fair deficit round-robin
+    serve_adapters: int = 0
+    serve_adapter_rank: int = 8
+    serve_classes: str = ""
 
     @property
     def num_devices(self) -> int:
@@ -378,6 +386,12 @@ class FFConfig:
                 cfg.serve_decode_multistep = True
             elif a == "--max-fused-steps":
                 cfg.serve_max_fused_steps = int(take())
+            elif a == "--adapters":
+                cfg.serve_adapters = int(take())
+            elif a == "--adapter-rank":
+                cfg.serve_adapter_rank = int(take())
+            elif a == "--classes":
+                cfg.serve_classes = take()
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

@@ -133,6 +133,44 @@ def mha_project_out(attn, ws, ctx, out_dtype, use_bias=True):
     return y
 
 
+def lora_delta_qkv(x, tbl, a_q, b_q, a_k, b_k, a_v, b_v):
+    """Batched paged LoRA deltas for the Q/K/V projections (S-LoRA /
+    Punica posture): per batch row, gather that row's adapter pages out
+    of the pooled A/B factors and compute `(x @ A) @ B` summed over the
+    row's pages — exact, because a rank-r LoRA product is a sum over
+    rank slices and paging splits exactly along rank.
+
+    x: [b, s, e]; tbl: [b, P] int32 page table (sentinel rows of the
+    pool are all-zero, so an unused/base-model row contributes exactly
+    0.0). a_*: [NP+1, e, pr]; b_*: [NP+1, pr, h, d]. Returns three
+    [b, s, h, d] float32 deltas. Every contraction is per-batch-row
+    independent — a mixed-adapter batch computes bit-identically to
+    each row running alone, which the identity gates rely on."""
+    mm = dict(preferred_element_type=jnp.float32)
+    x32 = x.astype(jnp.float32)
+
+    def delta(a_pool, b_pool):
+        # u: [b, s, P, pr] rank activations per page, then contract the
+        # (page, rank-slice) pair back out through B
+        u = jnp.einsum("bse,bper->bspr", x32, a_pool[tbl], **mm)
+        return jnp.einsum("bspr,bprhd->bshd", u, b_pool[tbl], **mm)
+
+    return delta(a_q, b_q), delta(a_k, b_k), delta(a_v, b_v)
+
+
+def lora_delta_out(attn, tbl, a_o, b_o):
+    """Paged LoRA delta for the output projection — the post-kernel
+    epilogue: the attention core (dense or Pallas) runs unmodified and
+    the delta applies to its [b, s, h, d] output. a_o: [NP+1, h, d, pr];
+    b_o: [NP+1, pr, e]. Returns a [b, s, e] float32 delta with the same
+    per-row independence as lora_delta_qkv."""
+    mm = dict(preferred_element_type=jnp.float32)
+    u = jnp.einsum(
+        "bshd,bphdr->bspr", attn.astype(jnp.float32), a_o[tbl], **mm
+    )
+    return jnp.einsum("bspr,bpre->bse", u, b_o[tbl], **mm)
+
+
 def _decode_pallas_hook(q, k_cache, v_cache, lengths, kernel="auto"):
     """Seam for the hand-tiled TPU decode kernel (single-query flash
     against the cache — pallas/decode_kernel.py, the serving analog of

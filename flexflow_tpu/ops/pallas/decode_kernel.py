@@ -52,6 +52,17 @@ there.
 Shapes at the API boundary match ops/attention.py: q [b, w, h, d],
 contiguous cache [b, max_len, h, d], paged pools
 [num_pages, page_size, h, d] with block_tables [b, max_pages_per_seq].
+
+Multi-LoRA posture (serving/tenancy/adapters.py): the kernels are
+adapter-oblivious by design. Per-slot LoRA deltas land OUTSIDE the
+kernel seam — the QKV delta is applied before the cache row write (so
+the pool already holds adapted K/V by the time a kernel reads it) and
+the output delta is a post-kernel epilogue on the attention result.
+Fusing the rank-r gather into the kernel body would add a second
+scalar-prefetch table and a per-slot DMA for a few-percent bandwidth
+term (see CostModel.adapter_delta_cost); not worth forking the kernel
+family. This is why the adapter identity tests can assert bit-identical
+kernel-path tokens with a pool attached but no adapters in use.
 """
 
 from __future__ import annotations

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import math
 import random
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.core.machine import MachineSpec
 from flexflow_tpu.core.pcg import PCGGraph, TensorRef
@@ -1453,6 +1453,62 @@ def optimize_token_budget(
             best, best_score = cand, score
     assert best is not None  # m = 1 always produced a candidate
     return best
+
+
+def optimize_token_budget_per_class(
+    graph: PCGGraph,
+    spec: MachineSpec,
+    prompt_len: int,
+    classes,
+    batch: int = 1,
+    kv_len: int = 1024,
+    chunk_size: int = 16,
+    dp: int = 1,
+    tp: int = 1,
+    page_size: int = 0,
+    machine_model=None,
+    mixed_precision: bool = False,
+    decode_kernel: str = "dense",
+    measured_decode_step_s: float = 0.0,
+):
+    """Per-priority-class `optimize_token_budget`: size ONE shared
+    iteration budget against the tightest SLO of every configured class.
+
+    `classes` is the ``{name: PriorityClass}`` mapping from
+    ``serving.tenancy.parse_classes`` (duck-typed here — any object with
+    ``slo_ttft_ms``/``slo_itl_ms`` works, so search stays import-free of
+    serving). Each class is solved independently with its own
+    thresholds; the scheduler runs a single planner loop, so the
+    returned budget is the max over per-class answers (the class that
+    needs the most chunk throughput to hit its TTFT wins) and
+    ``meets_slo`` only if every class's own solve met its thresholds at
+    that shared operating point. Returns ``(budget, meets_slo,
+    {name: TokenBudgetResult})``; classes with no thresholds set are
+    observe-only and never constrain."""
+    per_class: Dict[str, TokenBudgetResult] = {}
+    for name, cls in classes.items():
+        per_class[name] = optimize_token_budget(
+            graph,
+            spec,
+            prompt_len,
+            batch=batch,
+            kv_len=kv_len,
+            chunk_size=chunk_size,
+            slo_ttft_ms=float(getattr(cls, "slo_ttft_ms", 0.0)),
+            slo_itl_ms=float(getattr(cls, "slo_itl_ms", 0.0)),
+            dp=dp,
+            tp=tp,
+            page_size=page_size,
+            machine_model=machine_model,
+            mixed_precision=mixed_precision,
+            decode_kernel=decode_kernel,
+            measured_decode_step_s=measured_decode_step_s,
+        )
+    if not per_class:
+        raise ValueError("classes must be a non-empty mapping")
+    budget = max(r.token_budget for r in per_class.values())
+    meets = all(r.meets_slo for r in per_class.values())
+    return budget, meets, per_class
 
 
 def optimize_serving(

@@ -650,6 +650,45 @@ class CostModel:
             memory=int(mem),
         )
 
+    def adapter_delta_cost(
+        self,
+        batch: int,
+        hidden: int,
+        rank: int,
+        positions: int = 1,
+        tp: int = 1,
+    ) -> OpCost:
+        """Forward cost of the per-step multi-LoRA epilogue on one chip
+        (serving/tenancy/adapters.apply_adapter_qkv/_out): per in-flight
+        sequence, gather that slot's rank-`rank` A/B pages from the
+        paged adapter pool and add (x @ A) @ B to each of the four
+        attention projections (q, k, v, out).
+
+        The regime matches decode: the gathers are the cost. Each of
+        the 4 projections reads rank rows of A ([hidden, rank]) and B
+        ([rank, hidden]) per sequence — adapter pages are slot-gathered,
+        not broadcast, so the bytes scale with batch, unlike the base
+        weight stream decode_op_cost prices once. FLOPs are the two
+        skinny matmuls, 2·b·w·hidden·rank each side. At typical ranks
+        (8-64) this is single-digit percent of the base weight read,
+        which is why the identity path (`adapter_id = -1`) costs only
+        the predicated add it skips. memory is the live pool pages'
+        steady-state footprint share attributable to these sequences."""
+        tp = max(1, tp)
+        b = max(0, int(batch))
+        w = max(1, int(positions))
+        h = max(1, int(hidden)) // tp
+        r = max(1, int(rank))
+        # A + B rows for q, k, v, out — gathered per sequence, fp32
+        gather_bytes = 4.0 * b * (h * r + r * h) * 4.0
+        act_bytes = 4.0 * b * w * (r + h) * 4.0
+        flops = 4.0 * (2.0 * b * w * h * r + 2.0 * b * w * r * h)
+        return OpCost(
+            forward_time=self._roofline(flops, gather_bytes + act_bytes),
+            backward_time=0.0,
+            memory=int(gather_bytes),
+        )
+
     def prefill_op_cost(
         self,
         node,

@@ -65,6 +65,14 @@ from flexflow_tpu.serving.spec import (
     NGramDraftProposer,
     accept_drafts,
 )
+from flexflow_tpu.serving.tenancy import (
+    AdapterPool,
+    AdapterPoolExhausted,
+    DeficitRoundRobin,
+    PriorityClass,
+    make_lora_weights,
+    parse_classes,
+)
 from flexflow_tpu.serving.frontend import (
     DisaggregatedPipeline,
     EngineReplica,
@@ -108,6 +116,12 @@ __all__ = [
     "ModelDraftProposer",
     "NGramDraftProposer",
     "accept_drafts",
+    "AdapterPool",
+    "AdapterPoolExhausted",
+    "DeficitRoundRobin",
+    "PriorityClass",
+    "make_lora_weights",
+    "parse_classes",
     "DisaggregatedPipeline",
     "EngineReplica",
     "FrontDoor",

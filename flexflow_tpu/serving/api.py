@@ -149,6 +149,18 @@ class ServeConfig:
     # round-trips per committed token on quiet stretches.
     decode_multistep: bool = False
     max_fused_steps: int = 8
+    # multi-tenant serving (serving/tenancy/). adapters (--adapters):
+    # > 0 attaches a paged multi-LoRA AdapterPool sized for that many
+    # resident adapters of rank <= adapter_rank (--adapter-rank);
+    # requests pick one via Request.adapter_id (-1 = base model,
+    # bit-identical to serving without a pool). classes (--classes):
+    # "name:weight[:ttft_ms[:itl_ms]]" entries, comma-separated — more
+    # than one class switches admission + chunk grants to weighted-fair
+    # deficit round-robin, preemption victims to class-priced cost, and
+    # attaches per-class SLO monitors under {"class": name} labels.
+    adapters: int = 0
+    adapter_rank: int = 8
+    classes: str = ""
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -297,6 +309,18 @@ class ServeConfig:
                 "(the static baseline is the reference the fused loop "
                 "is proved identical against)"
             )
+        if self.adapters < 0:
+            raise ValueError(
+                f"adapters must be >= 0 (0 = no pool), got {self.adapters}"
+            )
+        if self.adapters and self.adapter_rank < 1:
+            raise ValueError(
+                f"adapter_rank must be >= 1, got {self.adapter_rank}"
+            )
+        if self.classes:
+            from flexflow_tpu.serving.tenancy.fairness import parse_classes
+
+            parse_classes(self.classes)  # raises on malformed text
 
     @property
     def telemetry_requested(self) -> bool:
@@ -348,6 +372,9 @@ class ServeConfig:
             prefix_evict=cfg.serve_prefix_evict,
             decode_multistep=cfg.serve_decode_multistep,
             max_fused_steps=cfg.serve_max_fused_steps,
+            adapters=cfg.serve_adapters,
+            adapter_rank=cfg.serve_adapter_rank,
+            classes=cfg.serve_classes,
         )
 
 
@@ -458,6 +485,16 @@ def build_scheduler(
         )
     if telemetry is None:
         telemetry = build_telemetry(serve)
+    adapters = None
+    if serve.adapters:
+        from flexflow_tpu.serving.tenancy.adapters import AdapterPool
+
+        adapters = AdapterPool.from_model(
+            model,
+            max_seqs=serve.max_seqs,
+            max_adapters=serve.adapters,
+            max_rank=serve.adapter_rank,
+        )
     engine = GenerationEngine(
         model,
         cache,
@@ -466,7 +503,13 @@ def build_scheduler(
         decode_kernel=serve.decode_kernel,
         injector=injector,
         telemetry=telemetry,
+        adapters=adapters,
     )
+    classes = None
+    if serve.classes:
+        from flexflow_tpu.serving.tenancy.fairness import parse_classes
+
+        classes = parse_classes(serve.classes)
     cls = _SCHEDULERS[serve.scheduler]
     if serve.serve_async:
         # __post_init__ already pinned serve_async to the continuous
@@ -491,8 +534,64 @@ def build_scheduler(
         ),
         decode_multistep=serve.decode_multistep,
         max_fused_steps=serve.max_fused_steps,
+        classes=classes,
+        victim_pricer=(
+            build_victim_pricer(model)
+            if classes and len(classes) > 1
+            else None
+        ),
     )
     return sched, engine, cache
+
+
+def build_victim_pricer(model):
+    """A `(cache, request) -> float` callable pricing one preemption
+    victim's recompute bill (seconds) for the class-priced victim rule:
+    estimate_recompute_step over the victim's resident history, the
+    same modeled step time build_swap_decider prices swap against. The
+    scheduler multiplies the result by the victim's class weight. Falls
+    back to None — resident-token-count pricing — when the model
+    carries no compiled graph/cost-model context; a pricing failure at
+    pick time falls back the same way (the scheduler catches it)."""
+    try:
+        from flexflow_tpu.core.machine import MachineSpec
+        from flexflow_tpu.search.auto import estimate_recompute_step
+        from flexflow_tpu.search.cost_model import CostModel
+        from flexflow_tpu.search.machine_model import build_machine_model
+
+        graph = getattr(model, "graph", None)
+        cfg = getattr(model, "config", None)
+        if graph is None or cfg is None or not graph.nodes:
+            return None
+        spec = MachineSpec(
+            num_nodes=max(1, cfg.num_nodes),
+            chips_per_node=1,
+            chip=cfg.chip,
+        )
+        cm = CostModel(spec, machine_model=build_machine_model(cfg, spec))
+        placement = getattr(model, "serving_placement", None)
+        dp = max(1, int(getattr(placement, "dp", 1)))
+        tp = max(1, int(getattr(placement, "tp", 1)))
+    except Exception:
+        return None
+
+    def price(cache, req) -> float:
+        resume_len = len(req.prompt) + len(req.generated)
+        cost = estimate_recompute_step(
+            graph,
+            cm,
+            dp,
+            tp,
+            resume_len,
+            page_size=getattr(cache.spec, "page_size", 0),
+            decode_kernel="dense",
+        )
+        if cost is None:
+            # nothing to price against: fall back to the token count
+            return float(resume_len)
+        return float(cost.step_time)
+
+    return price
 
 
 def build_swap_decider(model):

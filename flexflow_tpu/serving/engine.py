@@ -224,6 +224,7 @@ class GenerationEngine:
         decode_kernel: str = "auto",
         injector=None,
         telemetry=None,
+        adapters=None,
     ):
         import jax
 
@@ -260,6 +261,15 @@ class GenerationEngine:
         # trace-time constant: each engine owns its jitted steps, so two
         # engines with different modes coexist in one process.
         self.decode_kernel = decode_kernel
+        # multi-tenant LoRA (serving.tenancy.adapters.AdapterPool):
+        # None keeps every traced step byte-for-byte the base engine —
+        # the adapter argument is simply never passed, so no select or
+        # gather enters the HLO. With a pool, every step carries a
+        # (tables, has, pools) pytree snapshotted at dispatch; rows
+        # whose slot serves the base model (adapter_id -1) ride a
+        # jnp.where select that returns the unmodified projection
+        # elements, which is what the bit-identity gates pin down.
+        self.adapters = adapters
         graph = model.graph
         inputs = [
             graph.nodes[g]
@@ -369,6 +379,33 @@ class GenerationEngine:
         """The jitted chunked-prefill program for compact batch shape
         `key` = (B, w) — same keyed-LRU discipline as `_verify_fn`."""
         return self._chunk_cache.get(key)
+
+    # -- adapter gather args (multi-LoRA) ------------------------------------
+
+    def _adapter_slot_args(self):
+        """() without a pool, else a 1-tuple holding the slot-indexed
+        (tables, has, pools) adapter gather for the decode/verify/
+        multistep/chunk steps. The host tables snapshot at dispatch
+        (FX103: the step rides its own copy — scheduler attach/detach
+        between iterations never mutates an in-flight step's view); the
+        device pools are immutable arrays, rebound wholesale by loads,
+        so the step keeps whatever pool generation it captured."""
+        if self.adapters is None:
+            return ()
+        tbl, has = self.adapters.slot_tables()
+        return (
+            (snapshot(tbl), snapshot(has), self.adapters.device_pools),
+        )
+
+    def _adapter_row_args(self, slots):
+        """Prefill twin of `_adapter_slot_args`: batch row i serves slot
+        `slots[i]`, pad rows gather the zero sentinel."""
+        if self.adapters is None:
+            return ()
+        tbl, has = self.adapters.row_tables(slots, self.cache.spec.max_seqs)
+        return (
+            (snapshot(tbl), snapshot(has), self.adapters.device_pools),
+        )
 
     # -- kernel-failure fallback ---------------------------------------------
 
@@ -512,17 +549,26 @@ class GenerationEngine:
 
     # -- prefill -------------------------------------------------------------
 
-    def _prefill_impl(self, params, tokens, slot_ids, prompt_lens, ck, cv):
+    def _prefill_impl(
+        self, params, tokens, slot_ids, prompt_lens, ck, cv, ad=None
+    ):
         """tokens [max_seqs, bucket] int32; slot_ids [max_seqs] (max_seqs
         = out-of-bounds sentinel for padding rows — JAX drops OOB scatter
         rows, so pad rows never touch live cache); prompt_lens [max_seqs]
-        (>=1; pad rows use 1). Returns (ck', cv', next_tokens, last_logits)."""
+        (>=1; pad rows use 1). `ad` is the optional batch-row-aligned
+        adapter gather (tables, has, pools) — None leaves the traced HLO
+        exactly the base engine's. Returns (ck', cv', next_tokens,
+        last_logits)."""
         import jax.numpy as jnp
 
         from flexflow_tpu.ops.attention import (
             mha_project_qkv,
             mha_project_out,
             scaled_dot_product_attention,
+        )
+        from flexflow_tpu.serving.tenancy.adapters import (
+            apply_adapter_out,
+            apply_adapter_qkv,
         )
 
         captured_k: Dict[int, object] = {}
@@ -531,12 +577,14 @@ class GenerationEngine:
         def hook(node, ins, ws, ctx):
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, node.guid)
             captured_k[node.guid] = k
             captured_v[node.guid] = v
             attn = scaled_dot_product_attention(q, k, v, causal=True)
-            return [
-                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
-            ]
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, node.guid)]
 
         logits = self._forward_logits(params, tokens, hook)
         bucket = tokens.shape[1]
@@ -556,7 +604,7 @@ class GenerationEngine:
 
     def _prefill_impl_paged(
         self, params, tokens, slot_ids, row_tables, prompt_lens, ck, cv,
-        cks, cvs,
+        cks, cvs, ad=None,
     ):
         """Paged twin of _prefill_impl. row_tables [max_seqs,
         ceil(bucket/page_size)] int32: the admitted slots' block-table
@@ -574,6 +622,10 @@ class GenerationEngine:
             mha_project_out,
             scaled_dot_product_attention,
         )
+        from flexflow_tpu.serving.tenancy.adapters import (
+            apply_adapter_out,
+            apply_adapter_qkv,
+        )
 
         spec = self.cache.spec
         ps = spec.page_size
@@ -589,6 +641,7 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, g)
             if quant:
                 # scatter inside the hook and attend over the int8
                 # ROUND TRIP: a prefix-shared admission later reads
@@ -617,9 +670,10 @@ class GenerationEngine:
                     cv[g].shape
                 )
             attn = scaled_dot_product_attention(q, k, v, causal=True)
-            return [
-                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
-            ]
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, g)]
 
         logits = self._forward_logits(params, tokens, hook)
         last = jnp.take_along_axis(
@@ -690,6 +744,7 @@ class GenerationEngine:
                 self.cache.v,
                 self.cache.k_scale,
                 self.cache.v_scale,
+                *self._adapter_row_args(slots),
             )
             self.cache.commit(new_k, new_v, new_ks, new_vs)
         else:
@@ -700,6 +755,7 @@ class GenerationEngine:
                 jnp.asarray(plens),
                 self.cache.k,
                 self.cache.v,
+                *self._adapter_row_args(slots),
             )
             self.cache.commit(new_k, new_v)
         for p, s in zip(prompts, slots):
@@ -770,13 +826,15 @@ class GenerationEngine:
 
     # -- decode --------------------------------------------------------------
 
-    def _decode_core(self, params, tokens, lengths, active, ck, cv):
+    def _decode_core(self, params, tokens, lengths, active, ck, cv, ad=None):
         """One decode forward over the slot-contiguous cache: write the
         new K/V row per active slot at `lengths`, run masked one-query
         attention, return (ck', cv', logits [max_seqs, V]). The
         single-step jit and the multi-step scan body both trace THIS
         function, so their HLO op sequence — and therefore their
-        logits — match exactly (the token/logit-identity contract)."""
+        logits — match exactly (the token/logit-identity contract).
+        `ad=None` (no adapter pool) leaves the traced HLO byte-for-byte
+        what it was before multi-LoRA existed."""
         import jax
         import jax.numpy as jnp
 
@@ -784,6 +842,10 @@ class GenerationEngine:
             decode_attention,
             mha_project_qkv,
             mha_project_out,
+        )
+        from flexflow_tpu.serving.tenancy.adapters import (
+            apply_adapter_out,
+            apply_adapter_qkv,
         )
 
         new_k = dict(ck)
@@ -801,6 +863,12 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            # LoRA deltas land BEFORE the cache write: the K/V rows the
+            # pool stores are the adapted values, so the attention
+            # kernel (dense or Pallas) never needs to know adapters
+            # exist — the out-projection delta below is the only
+            # post-kernel epilogue
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, g)
             kc = row_update(ck[g], k)
             vc = row_update(cv[g], v)
             new_k[g] = kc
@@ -808,28 +876,30 @@ class GenerationEngine:
             attn = decode_attention(
                 q, kc, vc, lengths, kernel=self.decode_kernel
             )
-            return [
-                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
-            ]
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, g)]
 
         logits = self._forward_logits(params, tokens, hook)[:, -1, :]
         return new_k, new_v, logits
 
-    def _decode_impl(self, params, tokens, lengths, active, ck, cv):
+    def _decode_impl(self, params, tokens, lengths, active, ck, cv, ad=None):
         """tokens [max_seqs, 1]; lengths [max_seqs] = cache position the
         incoming token is written at; active [max_seqs] bool masks cache
         writes for free slots."""
         import jax.numpy as jnp
 
         new_k, new_v, logits = self._decode_core(
-            params, tokens, lengths, active, ck, cv
+            params, tokens, lengths, active, ck, cv, ad
         )
         slots = jnp.arange(lengths.shape[0])
         # the sampled token will be written at cache position lengths + 1
         return new_k, new_v, self._pick(logits, slots, lengths + 1), logits
 
     def _decode_core_paged(
-        self, params, tokens, lengths, active, tables, ck, cv, cks, cvs
+        self, params, tokens, lengths, active, tables, ck, cv, cks, cvs,
+        ad=None,
     ):
         """Paged twin of _decode_core. tables [max_seqs,
         max_pages_per_seq] int32 block tables. The new K/V row scatters
@@ -843,6 +913,10 @@ class GenerationEngine:
             mha_project_qkv,
             mha_project_out,
             paged_decode_attention,
+        )
+        from flexflow_tpu.serving.tenancy.adapters import (
+            apply_adapter_out,
+            apply_adapter_qkv,
         )
 
         spec = self.cache.spec
@@ -867,6 +941,9 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            # adapted K/V go INTO the pool (delta precedes the scatter),
+            # so the Pallas kernel reads adapter-aware pages unchanged
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, g)
             if quant:
                 kc, new_ks[g], _ = self._quant_scatter(
                     ck[g], cks[g], k[:, 0], dest
@@ -886,22 +963,24 @@ class GenerationEngine:
                 )
             new_k[g] = kc
             new_v[g] = vc
-            return [
-                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
-            ]
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, g)]
 
         logits = self._forward_logits(params, tokens, hook)[:, -1, :]
         return new_k, new_v, new_ks, new_vs, logits
 
     def _decode_impl_paged(
-        self, params, tokens, lengths, active, tables, ck, cv, cks, cvs
+        self, params, tokens, lengths, active, tables, ck, cv, cks, cvs,
+        ad=None,
     ):
         """Paged twin of _decode_impl (the single-step jit target):
         one _decode_core_paged forward plus the per-slot sample."""
         import jax.numpy as jnp
 
         new_k, new_v, new_ks, new_vs, logits = self._decode_core_paged(
-            params, tokens, lengths, active, tables, ck, cv, cks, cvs
+            params, tokens, lengths, active, tables, ck, cv, cks, cvs, ad
         )
         slots = jnp.arange(lengths.shape[0])
         return (
@@ -916,7 +995,8 @@ class GenerationEngine:
     # -- device-resident multi-step decode -----------------------------------
 
     def _decode_multi_impl(
-        self, k_bucket, params, tokens, lengths, active, limits, eos, ck, cv
+        self, k_bucket, params, tokens, lengths, active, limits, eos, ck, cv,
+        ad=None,
     ):
         """K fused decode iterations as ONE jitted `lax.scan` — the
         device-resident inner loop. tokens [max_seqs] int32 (the last
@@ -948,7 +1028,7 @@ class GenerationEngine:
             ck_c, cv_c, lens, toks, alive = carry
             act = alive & (i < limits)
             nk, nv, logits = self._decode_core(
-                params, toks[:, None], lens, act, ck_c, cv_c
+                params, toks[:, None], lens, act, ck_c, cv_c, ad
             )
             nxt = self._pick(logits, slots, lens + 1)
             hit = act & (eos >= 0) & (nxt == eos)
@@ -980,6 +1060,7 @@ class GenerationEngine:
         cv,
         cks,
         cvs,
+        ad=None,
     ):
         """Paged twin of _decode_multi_impl. The block tables ride in
         as ONE trace-time snapshot: the dispatch pre-claims every page
@@ -999,7 +1080,7 @@ class GenerationEngine:
             act = alive & (i < limits)
             nk, nv, nks, nvs, logits = self._decode_core_paged(
                 params, toks[:, None], lens, act, tables, ck_c, cv_c,
-                cks_c, cvs_c,
+                cks_c, cvs_c, ad,
             )
             nxt = self._pick(logits, slots, lens + 1)
             hit = act & (eos >= 0) & (nxt == eos)
@@ -1091,6 +1172,7 @@ class GenerationEngine:
             self.cache.k,
             self.cache.v,
             *scale_args,
+            *self._adapter_slot_args(),
         )
         if self.paged:
             new_k, new_v, new_ks, new_vs, nxt, logits = self._dispatch(
@@ -1249,6 +1331,7 @@ class GenerationEngine:
             self.cache.k,
             self.cache.v,
             *scale_args,
+            *self._adapter_slot_args(),
         )
         key = (spec.max_seqs, k_bucket, "paged" if self.paged else "slot")
 
@@ -1370,7 +1453,9 @@ class GenerationEngine:
             oob = spec.max_seqs * spec.max_len
         return jnp.where(valid, flat, oob).reshape(-1)
 
-    def _verify_impl(self, params, tokens, lengths, draft_lens, ck, cv):
+    def _verify_impl(
+        self, params, tokens, lengths, draft_lens, ck, cv, ad=None
+    ):
         """tokens [max_seqs, w] int32 — column 0 is each slot's last
         emitted (not yet cached) token, columns 1..draft_lens-1 the
         drafted continuation; lengths [max_seqs] = cache length BEFORE
@@ -1387,6 +1472,10 @@ class GenerationEngine:
             mha_project_qkv,
             mha_project_out,
             verify_attention,
+        )
+        from flexflow_tpu.serving.tenancy.adapters import (
+            apply_adapter_out,
+            apply_adapter_qkv,
         )
 
         spec = self.cache.spec
@@ -1407,6 +1496,7 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, g)
             kc = row_update(ck[g], k)
             vc = row_update(cv[g], v)
             new_k[g] = kc
@@ -1414,15 +1504,17 @@ class GenerationEngine:
             attn = verify_attention(
                 q, kc, vc, lengths, kernel=self.decode_kernel
             )
-            return [
-                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
-            ]
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, g)]
 
         logits = self._forward_logits(params, tokens, hook)
         return new_k, new_v, logits
 
     def _verify_impl_paged(
-        self, params, tokens, lengths, draft_lens, tables, ck, cv, cks, cvs
+        self, params, tokens, lengths, draft_lens, tables, ck, cv, cks, cvs,
+        ad=None,
     ):
         """Paged twin of _verify_impl: rows route through the block
         tables into the flattened pools, attention gathers pages via
@@ -1435,6 +1527,10 @@ class GenerationEngine:
             mha_project_qkv,
             mha_project_out,
             paged_verify_attention,
+        )
+        from flexflow_tpu.serving.tenancy.adapters import (
+            apply_adapter_out,
+            apply_adapter_qkv,
         )
 
         spec = self.cache.spec
@@ -1458,6 +1554,7 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, g)
             if quant:
                 kc, new_ks[g], _ = self._quant_scatter(
                     ck[g],
@@ -1491,9 +1588,10 @@ class GenerationEngine:
                 attn = paged_verify_attention(
                     q, kc, vc, tables, lengths, kernel=self.decode_kernel
                 )
-            return [
-                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
-            ]
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, g)]
 
         logits = self._forward_logits(params, tokens, hook)
         return new_k, new_v, new_ks, new_vs, logits
@@ -1564,6 +1662,7 @@ class GenerationEngine:
             self.cache.k,
             self.cache.v,
             *scale_args,
+            *self._adapter_slot_args(),
         )
 
         def call():
@@ -1613,7 +1712,8 @@ class GenerationEngine:
     # -- chunked prefill -----------------------------------------------------
 
     def _chunk_impl(
-        self, params, tokens, slot_ids, all_lengths, chunk_lens, ck, cv
+        self, params, tokens, slot_ids, all_lengths, chunk_lens, ck, cv,
+        ad=None,
     ):
         """tokens [B, w] int32 — the next chunk_lens[b] PROMPT tokens
         of each ACTIVE prefilling slot slot_ids[b] (0-padded);
@@ -1643,9 +1743,17 @@ class GenerationEngine:
             verify_attention,
         )
 
+        from flexflow_tpu.serving.tenancy.adapters import (
+            adapter_rows,
+            apply_adapter_out,
+            apply_adapter_qkv,
+        )
+
         spec = self.cache.spec
         w = tokens.shape[1]
         lengths = all_lengths[slot_ids]  # [B] cursor per active slot
+        # compact the slot-indexed adapter gather to the B batch rows
+        ad = adapter_rows(ad, slot_ids)
         dest = self._verify_scatter_dest(
             w, lengths, chunk_lens, None, jnp, slot_ids=slot_ids
         )
@@ -1663,6 +1771,7 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, g)
             kc = row_update(ck[g], k)
             vc = row_update(cv[g], v)
             new_k[g] = kc
@@ -1673,9 +1782,10 @@ class GenerationEngine:
                 q, kc[slot_ids], vc[slot_ids], lengths,
                 kernel=self.decode_kernel,
             )
-            return [
-                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
-            ]
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, g)]
 
         logits = self._forward_logits(params, tokens, hook)
         last = jnp.take_along_axis(
@@ -1692,7 +1802,7 @@ class GenerationEngine:
 
     def _chunk_impl_paged(
         self, params, tokens, slot_ids, all_lengths, chunk_lens, tables,
-        ck, cv, cks, cvs,
+        ck, cv, cks, cvs, ad=None,
     ):
         """Paged twin of _chunk_impl: rows route through the block
         tables into the flattened pools, attention gathers pages via
@@ -1707,10 +1817,16 @@ class GenerationEngine:
             mha_project_out,
             paged_verify_attention,
         )
+        from flexflow_tpu.serving.tenancy.adapters import (
+            adapter_rows,
+            apply_adapter_out,
+            apply_adapter_qkv,
+        )
 
         spec = self.cache.spec
         w = tokens.shape[1]
         lengths = all_lengths[slot_ids]  # [B] cursor per active slot
+        ad = adapter_rows(ad, slot_ids)
         tables_g = tables[slot_ids]  # [B, pages] batch-aligned
         dest = self._verify_scatter_dest(
             w, lengths, chunk_lens, tables_g, jnp
@@ -1732,6 +1848,7 @@ class GenerationEngine:
             g = node.guid
             use_bias = node.params.get("bias", True)
             q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, g)
             if quant:
                 kc, new_ks[g], _ = self._quant_scatter(
                     ck[g],
@@ -1765,9 +1882,10 @@ class GenerationEngine:
                 attn = paged_verify_attention(
                     q, kc, vc, tables_g, lengths, kernel=self.decode_kernel
                 )
-            return [
-                mha_project_out(attn, ws, ctx, ins[0].dtype, use_bias=use_bias)
-            ]
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, g)]
 
         logits = self._forward_logits(params, tokens, hook)
         last = jnp.take_along_axis(
@@ -1854,6 +1972,7 @@ class GenerationEngine:
             self.cache.k,
             self.cache.v,
             *scale_args,
+            *self._adapter_slot_args(),
         )
 
         def call():

@@ -133,6 +133,12 @@ class Request:
     eos_token: Optional[int] = None
     deadline_s: Optional[float] = None
     events_max: int = 64
+    # multi-tenant serving: free-form tenant tag (telemetry only),
+    # priority class name ("" = the first configured class), and the
+    # LoRA adapter serving this request (-1 = the base model)
+    tenant: str = ""
+    priority_class: str = ""
+    adapter_id: int = -1
 
     generated: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
@@ -505,6 +511,8 @@ class _SchedulerBase:
         swap_decider=None,
         decode_multistep: bool = False,
         max_fused_steps: int = 8,
+        classes=None,
+        victim_pricer=None,
     ):
         self.engine = engine
         self.cache = engine.cache
@@ -611,6 +619,38 @@ class _SchedulerBase:
         # decode/verify waits for the next one, so the chunk planner's
         # grants alone bound the iteration's token work
         self._chunk_unlocked: set = set()
+        # -- multi-tenancy ---------------------------------------------------
+        # `classes` ({name: PriorityClass}, config order = scheduling
+        # order) switches admission and token grants to weighted-fair
+        # DRR and preemption victims to class-priced cost. One class or
+        # None keeps every decision EXACTLY what it was before classes
+        # existed (FIFO admission, youngest-first victims), so single-
+        # tenant schedules — including chaos replays — are untouched.
+        self.classes = dict(classes) if classes else None
+        self._multiclass = bool(self.classes) and len(self.classes) > 1
+        self._default_class = next(iter(self.classes)) if self.classes else ""
+        self._admit_drr = None
+        self._grant_drr: Dict[int, object] = {}  # host -> DRR (token grants)
+        self._class_slo: Dict[str, object] = {}
+        if self._multiclass:
+            from flexflow_tpu.serving.tenancy.fairness import (
+                DeficitRoundRobin,
+            )
+
+            weights = {n: c.weight for n, c in self.classes.items()}
+            self._admit_drr = DeficitRoundRobin(weights, unit=1.0)
+        if self.classes and self._tele is not None:
+            from flexflow_tpu.serving.tenancy.slo import build_class_monitors
+
+            self._class_slo = build_class_monitors(
+                self._tele.registry, self.classes
+            )
+        # class-priced preemption: weight x resident tokens by default,
+        # or the api.py-built CostModel pricer when provided
+        self._victim_pricer = victim_pricer
+        # paged multi-LoRA adapter pool riding the engine (None = no
+        # adapters anywhere; the scheduler owns attach/detach lifecycle)
+        self.adapters = getattr(engine, "adapters", None)
 
     # -- submission / cancellation -------------------------------------------
 
@@ -664,6 +704,31 @@ class _SchedulerBase:
                 f"request {request.rid}: prompt+max_new_tokens {need} "
                 f"exceeds cache max_len {self.cache.spec.max_len}"
             )
+        if request.priority_class and (
+            self.classes is None or request.priority_class not in self.classes
+        ):
+            raise ValueError(
+                f"request {request.rid}: unknown priority class "
+                f"{request.priority_class!r} (configured: "
+                f"{sorted(self.classes) if self.classes else []})"
+            )
+        if request.adapter_id != -1:
+            if self.adapters is None:
+                raise ValueError(
+                    f"request {request.rid}: adapter_id "
+                    f"{request.adapter_id} but the engine has no adapter "
+                    "pool (--adapters)"
+                )
+            if request.adapter_id not in self.adapters.loaded:
+                raise ValueError(
+                    f"request {request.rid}: adapter {request.adapter_id} "
+                    "is not loaded"
+                )
+
+    def _class_of(self, req: Request) -> str:
+        """The request's effective priority class — the FIRST configured
+        class when it names none (config order is scheduling order)."""
+        return req.priority_class or self._default_class
 
     def cancel(self, rid: int) -> bool:
         """Cancel a queued or running request; its slot and pages free
@@ -699,6 +764,8 @@ class _SchedulerBase:
             if self.proposer is not None:
                 self.proposer.retire(req)
             del self.running[req.slot]
+            if self.adapters is not None:
+                self.adapters.detach(req.slot)
             self.cache.free(req.slot)
             req.slot = None
         else:
@@ -740,6 +807,21 @@ class _SchedulerBase:
                 help="terminal request transitions by status",
                 labels={"status": status},
             ).inc()
+            if self.classes:
+                reg.counter(
+                    "serve_requests_total",
+                    help="terminal request transitions by status",
+                    labels={
+                        "status": status,
+                        "class": self._class_of(req),
+                    },
+                ).inc()
+            if req.tenant:
+                reg.counter(
+                    "serve_requests_total",
+                    help="terminal request transitions by status",
+                    labels={"status": status, "tenant": req.tenant},
+                ).inc()
             if (
                 getattr(self.cache, "num_hosts", 1) > 1
                 and slot_host is not None
@@ -762,6 +844,13 @@ class _SchedulerBase:
                 tele.slo.observe_finished(
                     req.finish_time, len(req.generated)
                 )
+                mon = self._class_slo.get(self._class_of(req))
+                if mon is not None:
+                    if req.generated:
+                        mon.observe_ttft(req.ttft_s)
+                    mon.observe_finished(
+                        req.finish_time, len(req.generated)
+                    )
             tele.tracer.request_lifecycle(req)
 
     def _fail(self, req: Request, error: str) -> None:
@@ -778,13 +867,41 @@ class _SchedulerBase:
 
     # -- preemption (optimistic admission) -----------------------------------
 
+    def _victim_cost(self, req: Request) -> float:
+        """Class-priced eviction cost (multiclass only): what preempting
+        this request throws away, weighted by its class — resident
+        tokens (prompt + generated so far, the recompute bill) times the
+        class weight, so a gold:4 request prices 4x the identical
+        bronze one. `victim_pricer` (api.py builds it from the
+        CostModel) replaces the token count with a modeled recompute
+        cost; the class weight still multiplies it."""
+        base = float(len(req.prompt) + len(req.generated))
+        if self._victim_pricer is not None:
+            try:
+                base = float(self._victim_pricer(self.cache, req))
+            except Exception:
+                pass  # a broken pricer must not break preemption
+        return base * self.classes[self._class_of(req)].weight
+
     def _pick_victim(self) -> Optional[Request]:
         """Youngest-by-admission running request — the vLLM victim rule:
         the newest sequence has the least recompute to lose and, under
         FIFO, the weakest fairness claim. (admit_iter, rid) makes the
-        choice deterministic within an admission batch."""
+        choice deterministic within an admission batch.
+
+        Multiclass flips the rule to cheapest-by-class-priced-cost
+        (`_victim_cost`): evict what costs least to redo, priced by
+        class weight. Equal cost falls back to the SAME youngest-first
+        key — (-admit_iter, -rid) under min() — so ties are
+        deterministic by admission order and chaos schedules replay
+        exactly."""
         if not self.running:
             return None
+        if self._multiclass:
+            return min(
+                self.running.values(),
+                key=lambda r: (self._victim_cost(r), -r.admit_iter, -r.rid),
+            )
         return max(
             self.running.values(), key=lambda r: (r.admit_iter, r.rid)
         )
@@ -816,6 +933,8 @@ class _SchedulerBase:
         if self.proposer is not None:
             self.proposer.retire(req)
         del self.running[req.slot]
+        if self.adapters is not None:
+            self.adapters.detach(req.slot)
         action = "recompute"
         if allow_swap and self._swap_eligible(req):
             handle = self.cache.swap_out(req.slot)
@@ -945,11 +1064,13 @@ class _SchedulerBase:
         )
         if slot is None:
             return False  # handle stays valid for a later iteration
-        self.queue.popleft()
+        self._dequeue(req)
         req.swap_handle = None
         req.slot = slot
         req.admit_iter = self._iter
         req.status = RequestStatus.RUNNING
+        if self.adapters is not None:
+            self.adapters.attach(slot, req.adapter_id)
         # any stale chunk cursors die with the swap restore: the full
         # committed history is already resident, nothing left to stream
         req.prefill_seq = []
@@ -1049,6 +1170,8 @@ class _SchedulerBase:
         if self.proposer is not None:
             self.proposer.retire(req)
         del self.running[req.slot]
+        if self.adapters is not None:
+            self.adapters.detach(req.slot)
         del self._by_rid[rid]
         req.slot = None
         req.status = RequestStatus.QUEUED
@@ -1078,6 +1201,8 @@ class _SchedulerBase:
         ):
             if self.proposer is not None:
                 self.proposer.retire(req)
+            if self.adapters is not None:
+                self.adapters.detach(req.slot)
             self.cache.free(req.slot)
             req.slot = None
             req.status = RequestStatus.QUEUED
@@ -1100,6 +1225,38 @@ class _SchedulerBase:
 
     # -- shared pieces -------------------------------------------------------
 
+    def _dequeue(self, req: Request) -> None:
+        """Identity-based queue removal (the multiclass head need not be
+        the GLOBAL front; dataclass __eq__ could drop a twin)."""
+        for i, queued in enumerate(self.queue):
+            if queued is req:
+                del self.queue[i]
+                return
+
+    def _admission_head(self):
+        """The next request admission should try: the global queue front
+        (FIFO), or under multiclass the DRR-selected class's front —
+        per-class FIFO is the global queue filtered by class, so a
+        preempted request's appendleft keeps it at its class front.
+        Returns (request, drr_commit) where drr_commit is the closure
+        that charges the serve IF the admit lands (select is pure:
+        a blocked head charges nothing)."""
+        if not self._multiclass:
+            return self.queue[0], None
+        heads: Dict[str, Request] = {}
+        for r in self.queue:
+            c = self._class_of(r)
+            if c not in heads:
+                heads[c] = r
+        backlogged = list(heads)
+        self._admit_drr.settle(backlogged)
+        name, rounds = self._admit_drr.select({c: 1.0 for c in backlogged})
+
+        def commit(drr=self._admit_drr):
+            drr.charge(name, rounds, backlogged, cost=1.0)
+
+        return heads[name], commit
+
     def _admit(self, limit: Optional[int] = None) -> List[Request]:
         """FIFO admission into free slots (never reorders the queue —
         starvation-free: the head either admits or blocks everyone
@@ -1110,7 +1267,15 @@ class _SchedulerBase:
         policy, only its immediate need under the optimistic one. A
         preempted request re-admits with its recompute sequence
         (prompt + tokens already generated): the prefill rebuilds the
-        KV it lost and its next token comes out of that same call."""
+        KV it lost and its next token comes out of that same call.
+
+        Multiclass (`classes` with >1 entry) replaces WHICH head is
+        tried — deficit round-robin across per-class FIFO queues, so a
+        gold:4 class admits ~4x bronze under contention while every
+        backlogged class still serves within bounded rounds — but not
+        the blocking rule: a selected head that cannot take a slot NOW
+        stops admission for everyone (no bypass), exactly the single-
+        class no-reorder guarantee, just applied to the DRR order."""
         optimistic = self.admission == "optimistic"
         prefix = bool(getattr(self.cache, "prefix_cache", False))
         admitted: List[Request] = []
@@ -1119,7 +1284,7 @@ class _SchedulerBase:
         while self.queue:
             if limit is not None and len(admitted) >= limit:
                 break
-            req = self.queue[0]
+            req, drr_commit = self._admission_head()
             if req.swap_handle is not None:
                 # host-swapped victim: restore its pages instead of
                 # recomputing them — it joins running directly (its
@@ -1127,6 +1292,13 @@ class _SchedulerBase:
                 # batch below
                 if not self._admit_swapped(req):
                     break  # no host can take it NOW — FIFO holds
+                if drr_commit is not None and (
+                    req.status == RequestStatus.RUNNING
+                ):
+                    # charge only a LANDED restore (an injected
+                    # swap_in failure re-routes through the normal
+                    # path without consuming the class's turn)
+                    drr_commit()
                 continue
             seq = list(req.prompt) + list(req.generated)
             # chunked admission claims pages chunk by chunk (the step's
@@ -1152,10 +1324,14 @@ class _SchedulerBase:
                 cursor = 0
             if slot is None:
                 break
-            self.queue.popleft()
+            self._dequeue(req)
             req.slot = slot
             req.admit_iter = self._iter
             req.status = RequestStatus.RUNNING
+            if self.adapters is not None:
+                self.adapters.attach(slot, req.adapter_id)
+            if drr_commit is not None:
+                drr_commit()
             req.log(
                 "admit",
                 f"slot {slot}" + (f" shared {cursor}" if cursor else ""),
@@ -1259,6 +1435,9 @@ class _SchedulerBase:
             now = time.perf_counter()
             if req.last_token_time:
                 self._tele.slo.observe_itl(now - req.last_token_time)
+                mon = self._class_slo.get(self._class_of(req))
+                if mon is not None:
+                    mon.observe_itl(now - req.last_token_time)
             req.last_token_time = now
         self.stats.tokens_generated += 1
         if req._done_after(token):
@@ -1877,6 +2056,11 @@ class _SchedulerBase:
             else:
                 pending = pending_all
                 budget = self.token_budget - int(reserved)
+            if self._multiclass:
+                budget = self._plan_chunks_drr(
+                    h, pending, plan, budget, max_grant
+                )
+                continue
             progress = True
             while progress and budget > 0:
                 progress = False
@@ -1906,6 +2090,75 @@ class _SchedulerBase:
                     "by an iteration's budget",
                 ).inc(deferred)
         return {s: c for s, c in plan.items() if c > 0}
+
+    def _plan_chunks_drr(
+        self,
+        host: int,
+        pending: List[Request],
+        plan: Dict[int, int],
+        budget: int,
+        max_grant: int,
+    ) -> int:
+        """Weighted-fair grant loop for one host partition: each DRR
+        serve grants one chunk unit (up to chunk_size tokens) to the
+        selected class's next pending request, so prefill bandwidth
+        under the token budget divides by class weight instead of
+        admission order. Within a class, requests rotate in admission
+        order (the round-robin fairness the single-class loop has).
+        The DRR instance persists per host across iterations — carried
+        deficits are what make the weighted shares hold over time —
+        and idle classes settle to zero so a silent class cannot bank
+        credit. Mutates `plan` in place; returns the leftover budget."""
+        drr = self._grant_drr.get(host)
+        if drr is None:
+            from flexflow_tpu.serving.tenancy.fairness import (
+                DeficitRoundRobin,
+            )
+
+            weights = {n: c.weight for n, c in self.classes.items()}
+            drr = DeficitRoundRobin(
+                weights, unit=float(max(1, self.chunk_size))
+            )
+            self._grant_drr[host] = drr
+        by_class: Dict[str, List[Request]] = {}
+        for r in pending:
+            by_class.setdefault(self._class_of(r), []).append(r)
+        drr.settle(list(by_class))
+        rr: Dict[str, int] = {c: 0 for c in by_class}
+        while budget > 0:
+            costs: Dict[str, float] = {}
+            heads: Dict[str, Tuple[Request, int, int]] = {}
+            for c, reqs in by_class.items():
+                n = len(reqs)
+                for j in range(n):
+                    pos = (rr[c] + j) % n
+                    req = reqs[pos]
+                    rem = (
+                        len(req.prefill_seq)
+                        - req.prefill_dispatched
+                        - plan[req.slot]
+                    )
+                    if rem <= 0 or plan[req.slot] >= max_grant:
+                        continue
+                    unit = min(
+                        self.chunk_size, rem, max_grant - plan[req.slot]
+                    )
+                    if unit > budget:
+                        continue
+                    costs[c] = float(unit)
+                    heads[c] = (req, unit, pos)
+                    break
+            if not costs:
+                break
+            name, rounds = drr.select(costs)
+            req, unit, pos = heads[name]
+            plan[req.slot] += unit
+            budget -= unit
+            drr.charge(name, rounds, list(costs), cost=float(unit))
+            rr[name] = (pos + 1) % len(by_class[name])
+        if self.debug_invariants:
+            drr.check_invariants(max_cost=float(max(1, self.chunk_size)))
+        return budget
 
     def _chunk_dispatch_step(self, plan: Dict[int, int]):
         """Dispatch phase of one chunked-prefill step: claim the pages
@@ -2088,6 +2341,10 @@ class _SchedulerBase:
                     else 0
                 )
             )
+            if self.adapters is not None:
+                self.adapters.check_invariants()
+            if self._admit_drr is not None:
+                self._admit_drr.check_invariants(max_cost=1.0)
         if self._tele is not None:
             self._sample_telemetry()
 
@@ -2139,6 +2396,33 @@ class _SchedulerBase:
                     for r in self.running.values()
                     if self.cache.host_of_slot(r.slot) == h
                 )
+        if self.classes:
+            # per-class scheduler gauges + the rolling per-class SLO
+            # views: the unlabelled series stay fleet-wide aggregates,
+            # same layering as the per-host block above
+            reg = tele.registry
+            for name in self.classes:
+                labels = {"class": name}
+                reg.gauge(
+                    "serve_queue_depth", labels=labels
+                ).value = sum(
+                    1 for r in self.queue if self._class_of(r) == name
+                )
+                reg.gauge(
+                    "serve_running_requests", labels=labels
+                ).value = sum(
+                    1
+                    for r in self.running.values()
+                    if self._class_of(r) == name
+                )
+            for mon in self._class_slo.values():
+                mon.publish()
+        if self.adapters is not None:
+            reg = tele.registry
+            for name, value in self.adapters.telemetry_gauges().items():
+                reg.gauge(name).value = value
+            for name, value in self.adapters.telemetry_counters().items():
+                reg.counter(name).set_monotonic(value)
         if self.injector is not None:
             self.injector.publish_metrics(tele.registry)
         if self.proposer is not None:

@@ -1,0 +1,32 @@
+"""Multi-tenant serving: paged multi-LoRA adapters, weighted-fair
+priority scheduling, and per-class SLO telemetry.
+
+Three layers, one subsystem:
+
+- :mod:`adapters` — a paged ``AdapterPool`` (the PagedKVCache's sibling
+  allocator) holding rank-r LoRA deltas for the attention projections,
+  gathered per batch row into the engine's dense projections.
+- :mod:`fairness` — ``PriorityClass`` config and the deficit round-robin
+  machinery that makes admission and the PR 10 token-budget planner's
+  chunk grants weighted-fair across classes.
+- :mod:`slo` — per-class rolling TTFT/ITL windows and SLO-violation
+  counters (PR 8's monitors generalized with labels).
+"""
+
+from flexflow_tpu.serving.tenancy.adapters import (  # noqa: F401
+    AdapterPool,
+    AdapterPoolExhausted,
+    AdapterPoolSpec,
+    adapter_rows,
+    apply_adapter_out,
+    apply_adapter_qkv,
+    make_lora_weights,
+)
+from flexflow_tpu.serving.tenancy.fairness import (  # noqa: F401
+    DeficitRoundRobin,
+    PriorityClass,
+    parse_classes,
+)
+from flexflow_tpu.serving.tenancy.slo import (  # noqa: F401
+    build_class_monitors,
+)

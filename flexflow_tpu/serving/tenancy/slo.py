@@ -1,0 +1,39 @@
+"""Per-class SLO monitoring — PR 8's rolling monitors, generalized.
+
+One :class:`~flexflow_tpu.telemetry.slo.SLOMonitor` per priority class,
+labelled ``{"class": name}``: the unlabelled monitor the Telemetry
+object already owns stays the fleet-wide aggregate, and each class gets
+its own rolling TTFT/ITL windows, violation counters
+(``serve_slo_violations_total{class="gold",slo="ttft"}``) and
+percentile gauges riding the same registry and the same JSONL rows.
+Thresholds come from the class config (``PriorityClass.slo_ttft_ms`` /
+``slo_itl_ms``; 0 = observe-only)."""
+
+from typing import Dict, Mapping
+
+from flexflow_tpu.serving.tenancy.fairness import PriorityClass
+from flexflow_tpu.telemetry.slo import SLOMonitor
+
+
+def build_class_monitors(
+    registry,
+    classes: Mapping[str, PriorityClass],
+    window: int = 1024,
+) -> Dict[str, SLOMonitor]:
+    """{class name: labelled SLOMonitor} for every configured class."""
+    return {
+        name: SLOMonitor(
+            registry,
+            ttft_ms=cls.slo_ttft_ms,
+            itl_ms=cls.slo_itl_ms,
+            window=window,
+            labels={"class": name},
+        )
+        for name, cls in classes.items()
+    }
+
+
+def class_slo_snapshot(monitors: Mapping[str, SLOMonitor]) -> Dict[str, dict]:
+    """{class: monitor snapshot} — bench artifacts embed this so the
+    per-class attainment gates read straight off the export."""
+    return {name: mon.snapshot() for name, mon in monitors.items()}

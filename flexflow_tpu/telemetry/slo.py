@@ -94,7 +94,13 @@ class SLOMonitor:
     `serve_slo_violations_total{slo="ttft"|"itl"}`, and gauges
     `serve_slo_{ttft,itl}_p{50,95,99}_ms` + `serve_goodput_tokens_per_s`
     refreshed by `publish()` (the per-iteration sampler calls it, so
-    the JSONL time series carries the rolling view)."""
+    the JSONL time series carries the rolling view).
+
+    `labels` scopes every series the monitor owns — the per-class SLO
+    monitors (serving.tenancy.slo) are instances of THIS class with
+    `labels={"class": name}`, so the unlabelled series stay the
+    fleet-wide aggregate and per-class views ride the same JSONL rows
+    as `name{class="gold"}` columns."""
 
     def __init__(
         self,
@@ -102,10 +108,12 @@ class SLOMonitor:
         ttft_ms: float = 0.0,
         itl_ms: float = 0.0,
         window: int = 1024,
+        labels: Optional[Dict[str, str]] = None,
     ):
         if ttft_ms < 0 or itl_ms < 0:
             raise ValueError("SLO thresholds must be >= 0 (0 = disabled)")
         self.registry = registry
+        self.labels = dict(labels) if labels else None
         self.ttft_ms = float(ttft_ms)
         self.itl_ms = float(itl_ms)
         self.ttft_window = RollingWindow(window)
@@ -118,30 +126,36 @@ class SLOMonitor:
             "serve_ttft_ms",
             DEFAULT_LATENCY_BUCKETS_MS,
             help="submit-to-first-token latency (finished requests)",
+            labels=self.labels,
         )
         self._hist_itl = registry.histogram(
             "serve_itl_ms",
             DEFAULT_LATENCY_BUCKETS_MS,
             help="inter-token latency (gap between consecutive emits)",
+            labels=self.labels,
         )
         self._violations = {
             "ttft": registry.counter(
                 "serve_slo_violations_total",
                 help="observations past the configured SLO threshold",
-                labels={"slo": "ttft"},
+                labels={**(self.labels or {}), "slo": "ttft"},
             ),
             "itl": registry.counter(
-                "serve_slo_violations_total", labels={"slo": "itl"}
+                "serve_slo_violations_total",
+                labels={**(self.labels or {}), "slo": "itl"},
             ),
         }
         self._gauges = {
-            (kind, p): registry.gauge(f"serve_slo_{kind}_p{p}_ms")
+            (kind, p): registry.gauge(
+                f"serve_slo_{kind}_p{p}_ms", labels=self.labels
+            )
             for kind in ("ttft", "itl")
             for p in _PCTS
         }
         self._goodput_gauge = registry.gauge(
             "serve_goodput_tokens_per_s",
             help="rolling goodput: finished-request tokens per second",
+            labels=self.labels,
         )
 
     # -- observation (hot path: O(1), no allocation) -------------------------

@@ -174,11 +174,25 @@ def validate_trace_file(path: str, errors: str = "raise") -> List[str]:
 # -- metrics JSONL validation -------------------------------------------------
 
 
+#: the labelled-series key grammar (registry.series_name's output):
+#: `name{k="v",...}` — pairs sorted, values quoted. Multi-tenant
+#: serving keys all the per-class/per-tenant series this way
+#: (serve_requests_total{class="gold"}, serve_ttft_ms_p95{tenant=...}).
+_LABELLED_KEY_RE = re.compile(
+    r'^[A-Za-z_:][A-Za-z0-9_:]*'
+    r'\{[A-Za-z_][A-Za-z0-9_]*="[^"{}]*"'
+    r'(,[A-Za-z_][A-Za-z0-9_]*="[^"{}]*")*\}$'
+)
+
+
 def validate_metrics_jsonl(
     lines: Sequence[str], errors: str = "raise"
 ) -> List[str]:
     """Every row parses and matches the row schema; `iteration` is
-    non-decreasing (it is a time series, not a bag)."""
+    non-decreasing (it is a time series, not a bag); every braced
+    series key matches the labelled grammar `name{k="v",...}` the
+    registry emits (the tenant/class-labelled serving series are the
+    main producer)."""
     schema = load_schema("metrics_jsonl.schema.json")
     errs: List[str] = []
     last_iter: Optional[int] = None
@@ -194,6 +208,12 @@ def validate_metrics_jsonl(
         errs.extend(
             f"line {n + 1}: {e}" for e in check_schema(row, schema)
         )
+        for k in row:
+            if "{" in k and not _LABELLED_KEY_RE.match(k):
+                errs.append(
+                    f"line {n + 1}: series key {k!r} does not match "
+                    'the labelled grammar name{k="v",...}'
+                )
         it = row.get("iteration")
         if isinstance(it, int):
             if last_iter is not None and it < last_iter:

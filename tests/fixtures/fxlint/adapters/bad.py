@@ -1,0 +1,35 @@
+"""Seeded FX110 violations: the multi-LoRA adapter pool's ledgers
+mutated outside the blessed AdapterPool helpers. Adapter-page
+refcounts are 1 (loaded) + 1 per attached slot and are re-derived
+from adapter_tables/slot_adapter by check_invariants, so a raw write
+frees pages under a slot mid-decode (the gather then reads another
+tenant's weights) or leaks them forever."""
+
+import heapq
+
+
+class RogueTenancy:
+    def hijack_slot(self, pool, slot, aid):
+        # raw slot binding outside attach: no refcounts taken, detach
+        # later underflows them
+        pool.slot_adapter[slot] = aid  # FX110
+
+    def forge_page(self, pool, aid, pi):
+        # raw table write: the page it displaces still counts this
+        # adapter as an owner
+        pool.adapter_tables[aid, pi] = 7  # FX110
+
+    def cook_refcount(self, pool, page):
+        # the audit re-derives refcounts from the tables; a raw bump
+        # desynchronizes them silently
+        pool._adapter_refcounts[page] += 1  # FX110
+
+    def drop_pages(self, pool, aid, upto):
+        for pi in range(upto):
+            page = int(pool.adapter_tables[aid, pi])
+            # returning a possibly-attached page to the heap frees it
+            # under a live slot's gather
+            heapq.heappush(pool._free_adapter_pages, page)  # FX110
+
+    def grab_free(self, pool):
+        return heapq.heappop(pool._free_adapter_pages)  # FX110

@@ -253,6 +253,57 @@ def test_seeded_swap_bypass_is_caught(tmp_path):
     ]
 
 
+def test_adapter_ledger_discipline_fixtures():
+    """FX110: multi-LoRA adapter-pool ledger mutations (adapter_tables,
+    slot_adapter bindings, _adapter_refcounts, the _free_adapter_pages
+    heap) outside the blessed AdapterPool helpers — the discipline that
+    keeps per-tenant adapter pages from being freed under a live slot's
+    gather."""
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "adapters")], ["dispatch-race"])
+    )
+    # hijack_slot (slot binding), forge_page (table write),
+    # cook_refcount (refcount bump), drop_pages (heap push),
+    # grab_free (heap pop)
+    assert diags.get("bad.py", []).count("FX110") == 5, diags
+    # blessed helpers, __init__ population, gather reads, local heaps
+    # all silent
+    assert "good.py" not in diags
+
+
+def test_seeded_adapter_bypass_is_caught(tmp_path):
+    """Re-introduce the bug FX110 exists for: demote the page-free
+    helper to an unblessed name so its table write, refcount zero, and
+    heap push become raw mutations — fxlint must flag all three ledger
+    families; the unmodified pool stays clean (re-proved over the real
+    package by test_dispatch_race_clean_on_head)."""
+    src_path = os.path.join(PACKAGE, "serving", "tenancy", "adapters.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "def _free_adapter_page(", "def rogue_free_page(", 1
+    )
+    assert seeded != src, (
+        "adapters.py no longer defines _free_adapter_page — update "
+        "this test AND the FX110 blessed set together"
+    )
+    (tmp_path / "adapters.py").write_text(seeded)
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    hits = [d for d in diags if d.rule_id == "FX110"]
+    assert any("adapter_tables" in d.message for d in hits), [
+        d.format() for d in diags
+    ]
+    assert any("_free_adapter_pages" in d.message for d in hits), [
+        d.format() for d in diags
+    ]
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "adapters.py")
+    assert run_rules([str(clean)], ["dispatch-race"]) == [], [
+        d.format() for d in run_rules([str(clean)], ["dispatch-race"])
+    ]
+
+
 def test_handoff_lifetime_fixtures():
     """FX108: cross-engine swap handles/records consumed more than once
     (the staged copy is a MOVE token — export pops the source ledger,
