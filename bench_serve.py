@@ -30,6 +30,19 @@ so several tokens ride each verify step's single weight read. Greedy
 outputs are token-identical between the two engines; only the wall
 clock differs. Acceptance floor: 1.3x.
 
+--spec-tree mode (writes BENCH_SPEC_TREE.json): token-TREE speculation
+(spec_branch > 1; one verify scores a deduped draft tree and accepts
+the longest surviving root-to-leaf branch) vs the linear chain at an
+EQUAL verify token budget — the tree's depth x branch node count
+equals the chain's k, so both arms pay for the same number of scored
+rows per verify step. On the bench stream the n-gram draft's per-level
+acceptance is mediocre (the cycle's trailing n-gram has competing
+continuations), which is exactly the regime branching exists for: a
+rejected first candidate no longer kills the whole draft. Gates —
+EXIT NONZERO on miss: accepted draft tokens per verify step >= 1.2x
+the equal-budget linear arm, and every greedy stream token-identical
+to plain decode in BOTH arms.
+
 --decode-kernel {auto,pallas,dense} mode (writes
 BENCH_DECODE_KERNEL.json): the flash-decode kernel engine vs the dense
 engine on both kv layouts over the standard mixed stream — off-TPU the
@@ -725,6 +738,113 @@ def run_spec(
         "spec_p50_decode_ms_per_token": round(
             decode_lat["spec"][50] * 1e3, 3
         ),
+    }
+
+
+def run_spec_tree(
+    layers: int,
+    hidden: int,
+    heads: int,
+    vocab: int,
+    max_seqs: int,
+    max_len: int,
+    num_requests: int,
+    reps: int = 2,
+    spec_k: int = 4,
+    spec_branch: int = 3,
+):
+    """Token-tree speculation (depth spec_k x branch spec_branch) vs the
+    linear chain at EQUAL verify token budget: the linear arm drafts
+    k = spec_k * spec_branch tokens per verify, the tree arm the same
+    number of NODES — both pay for 1 + k scored rows per slot per step.
+    At the stream's mediocre per-level n-gram acceptance (distinct
+    historical continuations of the trailing bigram compete), the chain
+    wastes every row past its first rejection while the tree's sibling
+    branches keep levels alive — the accepted-tokens-per-verify ratio
+    this bench gates on. Greedy streams must stay token-identical to
+    plain decode in all three legs."""
+    from flexflow_tpu.serving import (
+        ContinuousBatchingScheduler,
+        ServeConfig,
+        build_scheduler,
+    )
+
+    model = _build_lm(layers, hidden, heads, vocab, max_seqs, max_len)
+    nodes = spec_k * spec_branch
+
+    def requests():
+        return _long_requests(vocab, max_len, num_requests)
+
+    results = {}
+    stats = {}
+    streams = {}
+    for name, serve in (
+        ("plain", ServeConfig(max_seqs=max_seqs, max_seq_len=max_len)),
+        ("linear", ServeConfig(max_seqs=max_seqs, max_seq_len=max_len,
+                               spec_draft="ngram", spec_k=nodes)),
+        ("tree", ServeConfig(max_seqs=max_seqs, max_seq_len=max_len,
+                             spec_draft="ngram", spec_k=spec_k,
+                             spec_branch=spec_branch)),
+    ):
+        warm, engine, _ = build_scheduler(model, serve)
+        warm.run(requests()[: max_seqs + 1])
+        best = 0.0
+        for _ in range(reps):
+            sched = ContinuousBatchingScheduler(
+                engine, proposer=warm.proposer, spec_k=serve.spec_k,
+                spec_branch=serve.spec_branch,
+            )
+            done = sched.run(requests())
+            if sched.stats.tokens_per_s >= best:
+                best = sched.stats.tokens_per_s
+                stats[name] = sched.stats
+                streams[name] = {
+                    r.rid: tuple(r.generated) for r in done
+                }
+        results[name] = best
+
+    def accepted_per_verify(s):
+        return (
+            s.draft_tokens_accepted / s.verify_steps
+            if s.verify_steps else 0.0
+        )
+
+    apv = {n: accepted_per_verify(stats[n]) for n in ("linear", "tree")}
+    matched = {
+        n: sum(
+            1 for rid in streams["plain"]
+            if streams[n].get(rid) == streams["plain"][rid]
+        )
+        for n in ("linear", "tree")
+    }
+    st = stats["tree"]
+    return {
+        "metric": f"serve_spec_tree_{layers}L_{hidden}h",
+        "value": round(apv["tree"], 3),
+        "unit": "accepted tokens/verify",
+        # tree over equal-budget linear accepted-per-verify (floor 1.2x)
+        "vs_baseline": round(
+            apv["tree"] / apv["linear"] if apv["linear"] else 0.0, 3
+        ),
+        "verify_token_budget": 1 + nodes,
+        "tree_depth": spec_k,
+        "tree_branch": spec_branch,
+        "linear_k": nodes,
+        "draft": "ngram",
+        "linear_accepted_per_verify": round(apv["linear"], 3),
+        "linear_acceptance_rate": round(
+            stats["linear"].acceptance_rate, 3
+        ),
+        "tree_acceptance_rate": round(st.acceptance_rate, 3),
+        "tree_verify_steps": st.tree_verify_steps,
+        "tree_nodes_proposed": st.tree_nodes_proposed,
+        "plain_tokens_per_s": round(results["plain"], 2),
+        "linear_tokens_per_s": round(results["linear"], 2),
+        "tree_tokens_per_s": round(results["tree"], 2),
+        "greedy_streams_match": {
+            n: f"{matched[n]}/{len(streams['plain'])}"
+            for n in ("linear", "tree")
+        },
     }
 
 
@@ -2255,6 +2375,7 @@ def main():
     args = dict(_PRESETS["flagship"])
     mode = "default"
     spec_k = 4
+    spec_branch = 3
     seed = 0
     decode_kernel = "pallas"
     serve_async = False
@@ -2268,6 +2389,8 @@ def main():
             mode = "paged"
         elif a == "--spec":
             mode = "spec"
+        elif a == "--spec-tree":
+            mode = "spec_tree"
         elif a == "--chaos":
             mode = "chaos"
         elif a == "--pressure":
@@ -2300,6 +2423,9 @@ def main():
         elif a == "--spec-k":
             i += 1
             spec_k = int(argv[i])
+        elif a == "--spec-branch":
+            i += 1
+            spec_branch = int(argv[i])
         elif a == "--preset":
             i += 1
             args = dict(_PRESETS[argv[i]])
@@ -2320,6 +2446,26 @@ def main():
         with open(os.path.join(here, "BENCH_SPEC.json"), "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
+    elif mode == "spec_tree":
+        result = run_spec_tree(
+            spec_k=spec_k, spec_branch=spec_branch, **args
+        )
+        with open(os.path.join(here, "BENCH_SPEC_TREE.json"), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        for arm, frac in result["greedy_streams_match"].items():
+            n_match, n_all = frac.split("/")
+            if n_match != n_all:
+                raise SystemExit(
+                    f"tree speculation moved greedy streams: {arm} arm "
+                    f"matched {frac}"
+                )
+        if result["vs_baseline"] < 1.2:
+            raise SystemExit(
+                f"tree speculation missed the accepted-per-verify gate: "
+                f"{result['vs_baseline']}x the equal-budget linear chain "
+                f"(floor 1.2x)"
+            )
     elif mode == "decode_kernel":
         result = run_decode_kernel(decode_kernel=decode_kernel, **args)
         with open(os.path.join(here, "BENCH_DECODE_KERNEL.json"), "w") as f:

@@ -34,7 +34,12 @@ findings go to the baseline):
   so acceptance/rollback/emit decisions made against them are wrong
   exactly when the pipeline is full — the reconcile must read the
   ``InflightStep`` snapshot (``step.lengths``, ``step.active``,
-  ``step.participants``) and nothing else.
+  ``step.participants``) and nothing else. The same rule covers the
+  tree-verify plan (``tree_parents`` / ``tree_plan``): the parent
+  table and per-slot ``DraftTree`` travel WITH the step, so a
+  scheduler-side mirror describes the NEXT iteration's trees and the
+  accept walk would score this step's logits against a different
+  topology.
 * **FX104** — a search-trace recording call (a ``candidate``/
   ``header``/``event``/``result``/``phase`` method on an object whose
   access path names ``trace``) whose argument loads a mutated
@@ -117,7 +122,11 @@ findings go to the baseline):
   its ``InflightStep``; any scheduler-side mirror is a whole window
   stale under async double-buffering, so commit/rollback decisions
   made against it truncate to the wrong length or emit phantom
-  steps.
+  steps. Part (a) also applies to tree-verify dispatch functions
+  (``tree`` + ``dispatch`` in the name): the parent table and page
+  claims ride the same async queue, so live allocator state handed
+  to the jitted tree step (or stored on the ``InflightStep``) must
+  cross as a snapshot.
 * **FX110** — adapter-pool ledger discipline for the multi-tenant
   LoRA pool (``serving/tenancy/adapters.AdapterPool``), FX106's rule
   applied to its sibling allocator: a subscript store into an
@@ -161,8 +170,8 @@ RULES = {
     "allocator helpers",
     "FX108": "cross-engine swap handle consumed twice, or handoff code "
     "reading live source-engine pool state",
-    "FX109": "multi-step dispatch captures live host state into the "
-    "fused window, or reconcile reads window state off the step record",
+    "FX109": "multi-step or tree-verify dispatch captures live host "
+    "state, or reconcile reads window state off the step record",
     "FX110": "adapter-pool table/refcount write or free-heap mutation "
     "outside the blessed AdapterPool helpers",
 }
@@ -323,6 +332,12 @@ _WINDOW_STATE_ATTRS = {
     "device_mask",
     "device_lengths",
 }
+
+#: tree-verify plan state on InflightStep — the dispatched parent table
+#: and the per-slot DraftTree plan; the reconcile's accept walk must
+#: read these through the step record, never a scheduler-side mirror
+#: (FX103's tree extension)
+_TREE_PLAN_ATTRS = {"tree_parents", "tree_plan"}
 
 _ASARRAY_CHAINS = {("jnp", "asarray"), ("jax", "numpy", "asarray")}
 _SNAPSHOT_NAMES = {"snapshot"}
@@ -507,6 +522,17 @@ def _is_multistep_dispatch(fn) -> bool:
     return "multi" in fn.name and "dispatch" in fn.name
 
 
+def _is_tree_dispatch(fn) -> bool:
+    """Tree-verify dispatch code, by the same naming convention as
+    _is_multistep_dispatch ('tree' + 'dispatch'). Exempt from
+    FX103/FX105 like every dispatch function — it takes the snapshots
+    — but what it hands the jitted tree step or stores on the
+    InflightStep must be snapshotted (FX109): the parent table is read
+    behind the async dispatch queue and walked again at reconcile, an
+    iteration after the live tables have moved on."""
+    return "tree" in fn.name and "dispatch" in fn.name
+
+
 def _multistep_capture_violations(
     fn, mutated: Set[str]
 ) -> List[Tuple[str, int]]:
@@ -568,6 +594,32 @@ def _window_state_violations(
             isinstance(node, ast.Attribute)
             and isinstance(node.ctx, ast.Load)
             and node.attr in _WINDOW_STATE_ATTRS
+        ):
+            continue
+        chain = name_chain(node)
+        if chain is not None and chain[0] in step_params:
+            continue
+        found.append((node.attr, node.lineno))
+    return found
+
+
+def _tree_plan_violations(
+    fn, step_params: Set[str]
+) -> List[Tuple[str, int]]:
+    """(attr, line) for loads of tree-verify plan state
+    (``tree_parents`` / ``tree_plan``) inside a reconcile-phase
+    function that do not come through the step parameter. The parent
+    table and the per-slot DraftTree plan travel WITH the
+    InflightStep; under async double-buffering a scheduler-side mirror
+    describes the NEXT iteration's trees, so an accept walk against it
+    scores this step's logits on a different topology — wrong branch
+    accepted, wrong rows compacted."""
+    found: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and node.attr in _TREE_PLAN_ATTRS
         ):
             continue
         chain = name_chain(node)
@@ -931,6 +983,25 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                             "materialize a scalar (int())",
                         )
                     )
+            elif _is_tree_dispatch(node):
+                for attr, line in _multistep_capture_violations(
+                    node, mutated
+                ):
+                    diags.append(
+                        Diagnostic(
+                            "FX109",
+                            path,
+                            line,
+                            f"tree-verify dispatch '{node.name}' "
+                            f"captures live host attribute '{attr}' "
+                            "into the jitted tree step without a "
+                            "snapshot — the parent table and page "
+                            "claims ride the async dispatch queue and "
+                            "the reconcile walks them an iteration "
+                            "later; wrap it in snapshot()/np.array or "
+                            "materialize a scalar (int())",
+                        )
+                    )
             steps = _step_params(node)
             if not steps:
                 continue
@@ -946,6 +1017,21 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                         "WITH its InflightStep; a scheduler-side "
                         "mirror is a whole window stale under async "
                         "double-buffering",
+                    )
+                )
+            for attr, line in _tree_plan_violations(node, steps):
+                diags.append(
+                    Diagnostic(
+                        "FX103",
+                        path,
+                        line,
+                        f"reconcile-phase function '{node.name}' reads "
+                        f"tree-verify plan state '{attr}' off the step "
+                        "record — the parent table and DraftTree plan "
+                        "travel WITH their InflightStep; a scheduler-"
+                        "side mirror describes the NEXT iteration's "
+                        "trees under async double-buffering, so the "
+                        "accept walk scores the wrong topology",
                     )
                 )
             for attr, line in _reconcile_violations(node, mutated):

@@ -134,6 +134,11 @@ class FFConfig:
     # decoder LM passed to build_scheduler) and draft length per verify
     serve_spec_draft: str = ""
     serve_spec_k: int = 4
+    # --spec-branch: token-TREE speculation (SpecInfer tree verify) —
+    # branching factor per draft level; 1 keeps the linear chain path,
+    # > 1 verifies a deduped tree of up to spec_k * spec_branch nodes
+    # in one call and accepts the longest surviving root-to-leaf path
+    serve_spec_branch: int = 1
     # chunked prefill (Sarathi-style; serving/scheduler.py):
     # --token-budget > 0 caps each iteration's token work and streams
     # prompts in via --chunk-size-aligned chunks interleaved with
@@ -344,6 +349,8 @@ class FFConfig:
                 cfg.serve_spec_draft = take()
             elif a == "--spec-k":
                 cfg.serve_spec_k = int(take())
+            elif a == "--spec-branch":
+                cfg.serve_spec_branch = int(take())
             elif a == "--token-budget":
                 cfg.serve_token_budget = int(take())
             elif a == "--chunk-size":

@@ -214,20 +214,82 @@ def decode_attention(q, k_cache, v_cache, lengths, kernel="auto"):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
 
 
-def _verify_pallas_hook(q, k_cache, v_cache, lengths, kernel="auto"):
+def tree_ancestor_matrix(parents):
+    """Ancestor-or-self closure of a draft tree, threaded AS DATA.
+
+    parents: [b, w] int32 — parents[i, j] is the verify-row index of row
+    j's parent within the same w-row window, -1 for the root (row 0, the
+    last emitted token; padding rows may use j - 1, which degenerates to
+    the linear chain). Parent indices must be < their child's index
+    (topological order) — both proposers emit trees that way.
+
+    Returns [b, w, w] bool with anc[i, j, a] = True iff row a is an
+    ancestor of row j or j itself. Pointer doubling over the parent
+    table: ceil(log2(w)) rounds cover any chain inside a w-row window,
+    and the whole computation is data-dependent — one compiled verify
+    program serves EVERY tree shape of width w (the mask is an operand,
+    not a trace-time constant), which is what lets a future fused
+    draft+verify device round rewrite the tree between iterations
+    without recompiling."""
+    b, w = parents.shape
+    anc = jnp.broadcast_to(jnp.eye(w, dtype=bool), (b, w, w))
+    if w == 1:
+        return anc
+    ptr = parents.astype(jnp.int32)
+    for _ in range(max(1, math.ceil(math.log2(w)))):
+        valid = ptr >= 0
+        safe = jnp.clip(ptr, 0, w - 1)
+        idx = jnp.broadcast_to(safe[:, :, None], (b, w, w))
+        rows = jnp.take_along_axis(anc, idx, axis=1)
+        anc = anc | (rows & valid[:, :, None])
+        ptr = jnp.where(valid, jnp.take_along_axis(ptr, safe, axis=1), ptr)
+    return anc
+
+
+def tree_allowed_mask(tree_parents, lengths, w, klen):
+    """[b, w, klen] bool verify visibility for a draft TREE: query row j
+    of sequence i sees cache position p iff p < lengths[i] (the
+    committed prefix) or p falls inside the w-row verify window at the
+    offset of one of row j's ancestors (or j itself). With chain parents
+    (parents[j] = j - 1) this reproduces the staircase
+    `p <= lengths[i] + j` exactly, so the tree mask is a strict
+    generalization of the linear verify mask."""
+    b = tree_parents.shape[0]
+    anc = tree_ancestor_matrix(tree_parents)  # [b, w, w]
+    kpos = jnp.arange(klen)[None, None, :]
+    base = lengths[:, None, None]
+    rel = kpos - base  # window offset of each key position
+    window = (rel >= 0) & (rel < w)
+    idx = jnp.broadcast_to(jnp.clip(rel, 0, w - 1), (b, w, klen))
+    in_tree = jnp.take_along_axis(anc, idx, axis=2)
+    return (kpos < base) | (window & in_tree)
+
+
+def _verify_pallas_hook(q, k_cache, v_cache, lengths, kernel="auto",
+                        allowed=None):
     """Seam for the hand-tiled TPU verify kernel (w-query flash against
     the cache — the speculative-decoding scoring pass; decode is its
     w == 1 case, so pallas/decode_kernel.py serves both with one body).
     None routes verify_attention to the dense jnp path; mode semantics
-    as in _decode_pallas_hook."""
+    as in _decode_pallas_hook. `allowed` is the precomputed [b, w, klen]
+    tree visibility mask (tree-verify); the tree kernel variant carries
+    it as a data operand, gated separately by supports_tree() with the
+    same dense fallback contract."""
     from flexflow_tpu.ops.pallas import decode_kernel as dk
 
     if not dk.use_kernel(kernel, q.shape[1], k_cache.shape[1], q.shape[-1]):
         return None
+    if allowed is not None:
+        if not dk.supports_tree(q.shape[1]):
+            return None
+        return dk.flash_verify_tree(
+            q, k_cache, v_cache, lengths, allowed.astype(jnp.float32)
+        )
     return dk.flash_verify(q, k_cache, v_cache, lengths)
 
 
-def verify_attention(q, k_cache, v_cache, lengths, kernel="auto"):
+def verify_attention(q, k_cache, v_cache, lengths, kernel="auto",
+                     tree_parents=None):
     """Speculative-decoding verify regime: w query positions per sequence
     (the last emitted token plus the drafted continuation) attend
     against the cache in ONE call. q: [b, w, h, d]; k_cache/v_cache:
@@ -240,8 +302,22 @@ def verify_attention(q, k_cache, v_cache, lengths, kernel="auto"):
     while still reading the whole prefix. decode_attention is exactly
     the w == 1 special case, and the same fp32 accumulation / -1e30
     fill keeps verify softmax numerics aligned with prefill and decode
-    (greedy spec decode must be token-identical to plain decode)."""
-    out = _verify_pallas_hook(q, k_cache, v_cache, lengths, kernel)
+    (greedy spec decode must be token-identical to plain decode).
+
+    tree_parents [b, w] int32 (optional) switches the staircase to the
+    SpecInfer token-tree mask: row j then sees the prefix plus only its
+    ancestor rows' window positions (tree_allowed_mask), so several
+    draft branches share one verify call. The tree shape rides as data —
+    no recompile per tree — and chain parents reproduce the staircase
+    bit-for-bit."""
+    allowed_tree = None
+    if tree_parents is not None:
+        allowed_tree = tree_allowed_mask(
+            tree_parents, lengths, q.shape[1], k_cache.shape[1]
+        )
+    out = _verify_pallas_hook(
+        q, k_cache, v_cache, lengths, kernel, allowed=allowed_tree
+    )
     if out is not None:
         return out
     d = q.shape[-1]
@@ -250,11 +326,14 @@ def verify_attention(q, k_cache, v_cache, lengths, kernel="auto"):
     ) / math.sqrt(d)
     w = q.shape[1]
     klen = k_cache.shape[1]
-    # [b, w, klen]: key position <= lengths + query offset
-    allowed = (
-        jnp.arange(klen)[None, None, :]
-        <= lengths[:, None, None] + jnp.arange(w)[None, :, None]
-    )
+    if allowed_tree is not None:
+        allowed = allowed_tree
+    else:
+        # [b, w, klen]: key position <= lengths + query offset
+        allowed = (
+            jnp.arange(klen)[None, None, :]
+            <= lengths[:, None, None] + jnp.arange(w)[None, :, None]
+        )
     logits = jnp.where(allowed[:, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
@@ -272,14 +351,19 @@ def _dequant_pages(pool, tbl, scale, b, heads, d):
 
 
 def _paged_verify_pallas_hook(q, k_pool, v_pool, block_tables, lengths,
-                              kernel="auto", k_scale=None, v_scale=None):
+                              kernel="auto", k_scale=None, v_scale=None,
+                              allowed=None):
     """Seam for the hand-tiled TPU paged-verify kernel (w-query flash
     walking the block table page by page — the fourth member of the
     pallas/decode_kernel.py family, completing the seam symmetry:
     every cache-attention path now has one). None routes
     paged_verify_attention to the dense gather path; mode semantics as
     in _decode_pallas_hook. int8 pools (scales given) route to the
-    quantized kernel variant, gated separately by supports()."""
+    quantized kernel variant, gated separately by supports().
+    `allowed` is the precomputed [b, w, np_seq * page_size] tree
+    visibility mask over LOGICAL positions (the mask tile's index map
+    needs no block-table lookup), routing to the tree kernel variants
+    under the supports_tree() width gate."""
     from flexflow_tpu.ops.pallas import decode_kernel as dk
 
     quant = k_scale is not None
@@ -288,6 +372,18 @@ def _paged_verify_pallas_hook(q, k_pool, v_pool, block_tables, lengths,
         kv_dtype="int8" if quant else "fp32",
     ):
         return None
+    if allowed is not None:
+        if not dk.supports_tree(q.shape[1]):
+            return None
+        mask = allowed.astype(jnp.float32)
+        if quant:
+            return dk.paged_flash_verify_tree_quant(
+                q, k_pool, v_pool, k_scale, v_scale, block_tables,
+                lengths, mask,
+            )
+        return dk.paged_flash_verify_tree(
+            q, k_pool, v_pool, block_tables, lengths, mask
+        )
     if quant:
         return dk.paged_flash_verify_quant(
             q, k_pool, v_pool, k_scale, v_scale, block_tables, lengths
@@ -296,7 +392,8 @@ def _paged_verify_pallas_hook(q, k_pool, v_pool, block_tables, lengths,
 
 
 def paged_verify_attention(q, k_pool, v_pool, block_tables, lengths,
-                           kernel="auto", k_scale=None, v_scale=None):
+                           kernel="auto", k_scale=None, v_scale=None,
+                           tree_parents=None):
     """Verify attention against the block-paged cache. The dense path
     gathers each sequence's pages into a contiguous view (same
     dense-gather strategy as paged_decode_attention, same sentinel
@@ -304,10 +401,19 @@ def paged_verify_attention(q, k_pool, v_pool, block_tables, lengths,
     is token-identical to the slot layout; the kernel path walks the
     table with no gather. With int8 pools, k_scale/v_scale
     [num_pages, heads] fp32 dequantize the gathered pages in place —
-    the fused-dequant chunk loop of the ISSUE."""
+    the fused-dequant chunk loop of the ISSUE. tree_parents [b, w]
+    int32 switches the staircase to the token-tree ancestor mask
+    exactly as in verify_attention (the mask is computed over logical
+    positions, so it threads unchanged through the page gather)."""
+    allowed_tree = None
+    if tree_parents is not None:
+        allowed_tree = tree_allowed_mask(
+            tree_parents, lengths, q.shape[1],
+            block_tables.shape[1] * k_pool.shape[1],
+        )
     out = _paged_verify_pallas_hook(
         q, k_pool, v_pool, block_tables, lengths, kernel,
-        k_scale=k_scale, v_scale=v_scale,
+        k_scale=k_scale, v_scale=v_scale, allowed=allowed_tree,
     )
     if out is not None:
         return out
@@ -322,7 +428,7 @@ def paged_verify_attention(q, k_pool, v_pool, block_tables, lengths,
     else:
         k = k_pool[tbl].reshape(b, -1, heads, d)
         v = v_pool[tbl].reshape(b, -1, heads, d)
-    return verify_attention(q, k, v, lengths)
+    return verify_attention(q, k, v, lengths, tree_parents=tree_parents)
 
 
 def _paged_decode_pallas_hook(q, k_pool, v_pool, block_tables, lengths,

@@ -92,6 +92,11 @@ MODES = ("auto", "pallas", "dense")
 # step that wide is prefill-shaped; the training kernel serves it)
 _MAX_W = 64
 
+# tree-verify widths past this fall back to the dense path: the
+# ancestor mask rides as a [b, w, kv] data operand, so its DMA traffic
+# grows with w where the staircase was computed from two iotas in-core
+_MAX_TREE_W = 32
+
 # process-wide tuned KV-chunk rows for the contiguous kernel, overridden
 # from a measured calibration table ("decode_blocks" entry, installed by
 # runtime/model.py compile() like flash_kernel's flash_blocks). The
@@ -170,6 +175,16 @@ def use_kernel(
     ):
         return False
     return mode == "pallas" or jax.default_backend() == "tpu"
+
+
+def supports_tree(w: int) -> bool:
+    """Width gate for the tree-verify kernel variants, ON TOP of the
+    use_kernel()/supports() geometry gate the caller already passed:
+    the tree mask is a per-(query, key) data operand, so wide trees pay
+    w x the staircase's mask bandwidth — past _MAX_TREE_W the caller
+    falls back to the dense tree path (ops/attention.tree_allowed_mask
+    under jnp.where), the explicit fallback contract of the family."""
+    return 1 <= w <= _MAX_TREE_W
 
 
 class _Cfg(NamedTuple):
@@ -593,3 +608,368 @@ def paged_flash_decode_quant(
     return paged_flash_verify_quant(
         q, k_pool, v_pool, k_scale, v_scale, block_tables, lengths, **kw
     )
+
+
+# -- token-tree verify (SpecInfer ancestor mask as a data operand) ------------
+#
+# The tree variants replace the iota-computed staircase with a
+# precomputed [b, w, kv] visibility mask (ops/attention.tree_allowed_mask)
+# DMA'd chunk by chunk alongside K — the tree SHAPE is data, so one
+# compiled program serves every tree of width w and a future fused
+# draft+verify device round can rewrite the tree without recompiling.
+# Everything else (online softmax, chunk-skip gate, sentinel clamping)
+# is the staircase kernel verbatim: the chunk gate
+# `ik * bk <= length + (w - 1)` still holds because every tree row lives
+# inside the w-row window at positions lengths..lengths + w - 1.
+
+
+def _tree_kernel(
+    len_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, cfg, nk,
+):
+    ib = pl.program_id(0)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _MASK)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[ib]
+
+    @pl.when(ik * cfg.block_k <= length + (cfg.w - 1))
+    def _body():
+        q = q_ref[0, 0]  # (w, d)
+        k = k_ref[0, 0]  # (bk, d)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.sm_scale  # (w, bk) f32
+        s = jnp.where(mask_ref[0] > 0.0, s, _MASK)
+        _online_softmax_step(s, v_ref[0, 0], m_scr, l_scr, acc_scr)
+
+    @pl.when(ik == nk - 1)
+    def _done():
+        _finish(o_ref, l_scr, acc_scr)
+
+
+def flash_verify_tree(
+    q,
+    k_cache,
+    v_cache,
+    lengths,
+    allowed,
+    sm_scale: Optional[float] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """w-query flash attention against the contiguous cache under an
+    arbitrary tree-ancestor mask — ops/attention.verify_attention's
+    tree_parents semantics on the split-KV kernel. allowed:
+    [b, w, max_len] float32, 1.0 where query row j may see the key
+    position (tree_allowed_mask over the dispatch's parent table).
+    Other shapes as flash_verify. Gate with supports() AND
+    supports_tree() before calling."""
+    b, w, h, d = q.shape
+    kv_len = k_cache.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    bk = block_k or _pick_chunk(kv_len)
+    if bk is None or kv_len % bk:
+        raise ValueError(
+            f"flash decode: cache length {kv_len} not tileable "
+            f"(chunk {bk}); use supports() and fall back to dense"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cfg = _Cfg(w, sm_scale, bk, interpret)
+    nk = kv_len // bk
+    qt = q.transpose(0, 2, 1, 3)  # [b, h, w, d]
+    kt = k_cache.transpose(0, 2, 1, 3)
+    vt = v_cache.transpose(0, 2, 1, 3)
+
+    def q_map(ib, ih, ik, lens):
+        return (ib, ih, 0, 0)
+
+    def kv_map(ib, ih, ik, lens):
+        ik = lax.select(ik * bk <= lens[ib] + (w - 1), ik, 0)
+        return (ib, ih, ik, 0)
+
+    def mask_map(ib, ih, ik, lens):
+        # the mask tile follows K's chunk redirect so a skipped chunk's
+        # DMA still lands on resident rows
+        ik = lax.select(ik * bk <= lens[ib] + (w - 1), ik, 0)
+        return (ib, 0, ik)
+
+    out = pl.pallas_call(
+        functools.partial(_tree_kernel, cfg=cfg, nk=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, w, d), q_map),
+                pl.BlockSpec((1, 1, bk, d), kv_map),
+                pl.BlockSpec((1, 1, bk, d), kv_map),
+                pl.BlockSpec((1, w, bk), mask_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, w, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, d), q.dtype),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qt, kt, vt, allowed.astype(jnp.float32))
+    return out.transpose(0, 2, 1, 3)
+
+
+def _paged_tree_kernel(
+    len_ref, tbl_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
+    m_scr, l_scr, acc_scr, *, cfg, num_pages, page_size, np_seq,
+):
+    ib = pl.program_id(0)
+    ip = pl.program_id(2)
+
+    @pl.when(ip == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _MASK)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[ib]
+
+    @pl.when(
+        (ip * page_size <= length + (cfg.w - 1))
+        & (tbl_ref[ib, ip] < num_pages)
+    )
+    def _body():
+        q = q_ref[0, 0]  # (w, d)
+        k = k_ref[0, :, 0, :]  # (page_size, d)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.sm_scale  # (w, page_size)
+        s = jnp.where(mask_ref[0] > 0.0, s, _MASK)
+        _online_softmax_step(s, v_ref[0, :, 0, :], m_scr, l_scr, acc_scr)
+
+    @pl.when(ip == np_seq - 1)
+    def _done():
+        _finish(o_ref, l_scr, acc_scr)
+
+
+def paged_flash_verify_tree(
+    q,
+    k_pool,
+    v_pool,
+    block_tables,
+    lengths,
+    allowed,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+):
+    """Tree-masked w-query flash attention walking the block table —
+    ops/attention.paged_verify_attention's tree_parents semantics with
+    no contiguous gather. allowed: [b, w, max_pages_per_seq * page_size]
+    float32 over LOGICAL positions, so its index map is just the page
+    index — no table lookup, no redirect needed (every logical tile is
+    resident). Other shapes as paged_flash_verify."""
+    b, w, h, d = q.shape
+    num_pages, page_size = k_pool.shape[0], k_pool.shape[1]
+    np_seq = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if page_size % SUBLANES:
+        raise ValueError(
+            f"paged flash decode: page_size {page_size} is not "
+            f"sublane-aligned ({SUBLANES}); use supports() and fall "
+            "back to dense"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cfg = _Cfg(w, sm_scale, page_size, interpret)
+    qt = q.transpose(0, 2, 1, 3)  # [b, h, w, d]
+
+    def q_map(ib, ih, ip, lens, tbl):
+        return (ib, ih, 0, 0)
+
+    def kv_map(ib, ih, ip, lens, tbl):
+        ip = lax.select(ip * page_size <= lens[ib] + (w - 1), ip, 0)
+        page = jnp.minimum(tbl[ib, ip], num_pages - 1)
+        return (page, 0, ih, 0)
+
+    def mask_map(ib, ih, ip, lens, tbl):
+        return (ib, 0, ip)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_tree_kernel,
+            cfg=cfg,
+            num_pages=num_pages,
+            page_size=page_size,
+            np_seq=np_seq,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, np_seq),
+            in_specs=[
+                pl.BlockSpec((1, 1, w, d), q_map),
+                pl.BlockSpec((1, page_size, 1, d), kv_map),
+                pl.BlockSpec((1, page_size, 1, d), kv_map),
+                pl.BlockSpec((1, w, page_size), mask_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, w, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, d), q.dtype),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        qt,
+        k_pool,
+        v_pool,
+        allowed.astype(jnp.float32),
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def _paged_tree_kernel_quant(
+    len_ref, tbl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref,
+    o_ref, m_scr, l_scr, acc_scr, *, cfg, num_pages, page_size, np_seq,
+):
+    """_paged_tree_kernel with the fused per-page dequant of
+    _paged_kernel_quant — the int8 member of the tree family."""
+    ib = pl.program_id(0)
+    ip = pl.program_id(2)
+
+    @pl.when(ip == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _MASK)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[ib]
+
+    @pl.when(
+        (ip * page_size <= length + (cfg.w - 1))
+        & (tbl_ref[ib, ip] < num_pages)
+    )
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # (w, d)
+        k = k_ref[0, :, 0, :].astype(jnp.float32) * ks_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.sm_scale  # (w, page_size)
+        s = jnp.where(mask_ref[0] > 0.0, s, _MASK)
+        v = v_ref[0, :, 0, :].astype(jnp.float32) * vs_ref[0, 0]
+        _online_softmax_step(s, v, m_scr, l_scr, acc_scr)
+
+    @pl.when(ip == np_seq - 1)
+    def _done():
+        _finish(o_ref, l_scr, acc_scr)
+
+
+def paged_flash_verify_tree_quant(
+    q,
+    k_pool,
+    v_pool,
+    k_scale,
+    v_scale,
+    block_tables,
+    lengths,
+    allowed,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+):
+    """paged_flash_verify_tree over int8 pools with fp32 per-(page,
+    head) scale side pools — dequant fuses into the page walk exactly
+    as in paged_flash_verify_quant, the tree mask rides as in
+    paged_flash_verify_tree."""
+    b, w, h, d = q.shape
+    num_pages, page_size = k_pool.shape[0], k_pool.shape[1]
+    np_seq = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if page_size % _INT8_SUBLANES:
+        raise ValueError(
+            f"paged flash decode (int8): page_size {page_size} is not "
+            f"int8-sublane-aligned ({_INT8_SUBLANES}); use supports() "
+            "and fall back to dense"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cfg = _Cfg(w, sm_scale, page_size, interpret)
+    qt = q.transpose(0, 2, 1, 3)  # [b, h, w, d]
+
+    def q_map(ib, ih, ip, lens, tbl):
+        return (ib, ih, 0, 0)
+
+    def kv_map(ib, ih, ip, lens, tbl):
+        ip = lax.select(ip * page_size <= lens[ib] + (w - 1), ip, 0)
+        page = jnp.minimum(tbl[ib, ip], num_pages - 1)
+        return (page, 0, ih, 0)
+
+    def scale_map(ib, ih, ip, lens, tbl):
+        ip = lax.select(ip * page_size <= lens[ib] + (w - 1), ip, 0)
+        page = jnp.minimum(tbl[ib, ip], num_pages - 1)
+        return (page, ih)
+
+    def mask_map(ib, ih, ip, lens, tbl):
+        return (ib, 0, ip)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_tree_kernel_quant,
+            cfg=cfg,
+            num_pages=num_pages,
+            page_size=page_size,
+            np_seq=np_seq,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, np_seq),
+            in_specs=[
+                pl.BlockSpec((1, 1, w, d), q_map),
+                pl.BlockSpec((1, page_size, 1, d), kv_map),
+                pl.BlockSpec((1, page_size, 1, d), kv_map),
+                pl.BlockSpec((1, 1), scale_map),
+                pl.BlockSpec((1, 1), scale_map),
+                pl.BlockSpec((1, w, page_size), mask_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, w, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, LANES), jnp.float32),
+                pltpu.VMEM((w, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, d), q.dtype),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        qt,
+        k_pool,
+        v_pool,
+        k_scale.astype(jnp.float32),
+        v_scale.astype(jnp.float32),
+        allowed.astype(jnp.float32),
+    )
+    return out.transpose(0, 2, 1, 3)

@@ -1034,12 +1034,15 @@ def estimate_verify_step(
     page_size: int = 0,
     decode_kernel: str = "dense",
     kv_dtype: str = "fp32",
+    tree_nodes: int = 0,
 ) -> Optional[GraphCost]:
     """Cost one speculative-decoding VERIFY iteration (k+1 scored token
     positions per sequence, serving/engine.verify) of the whole PCG
     under a (dp, tp) mesh — the spec-decode twin of estimate_decode_step
     (same feasibility rules, same conservative one-all-reduce-per-node
-    TP sync charge; the synced activation is (k+1)x wider)."""
+    TP sync charge; the synced activation is (k+1)x wider).
+    tree_nodes > 0 prices the token-tree verify's 1 + tree_nodes rows
+    instead (CostModel.verify_op_cost's tree_nodes)."""
     if batch % dp != 0:
         return None
     b_chip = batch // dp
@@ -1058,15 +1061,14 @@ def estimate_verify_step(
             node_tp = 1
         c = cm.verify_op_cost(
             node, b_chip, kv_len, k, tp=node_tp, page_size=page_size,
-            kernel=decode_kernel, kv_dtype=kv_dtype,
+            kernel=decode_kernel, kv_dtype=kv_dtype, tree_nodes=tree_nodes,
         )
         compute += c.forward_time
         mem += c.memory
         if node_tp > 1 and node.output_shapes:
             out = node.output_shapes[0]
-            act = (
-                b_chip * (k + 1) * out.logical_sizes[-1] * cm.elem_bytes(out)
-            )
+            w = (1 + tree_nodes) if tree_nodes > 0 else (k + 1)
+            act = b_chip * w * out.logical_sizes[-1] * cm.elem_bytes(out)
             sync += cm.all_reduce(float(act), node_tp)
     return GraphCost(
         step_time=compute + sync,
@@ -1243,6 +1245,150 @@ def optimize_spec_k(
             best = SpecKResult(
                 k, acceptance_rate, rate, decode_rate, step_time, tokens
             )
+    return best
+
+
+def expected_accepted_tree_tokens(
+    acceptance_rate: float, depth: int, branch: int
+) -> float:
+    """E[accepted root-to-leaf path length] of a (depth, branch) token
+    tree under a per-token acceptance rate α. A level survives when ANY
+    of its `branch` alternatives matches — α_b = 1 - (1-α)^branch under
+    the independence approximation — and the accepted path is a
+    geometric prefix of levels, so E = Σ_{i=1..depth} α_b^i. branch = 1
+    reduces exactly to expected_accepted_tokens."""
+    a = min(max(float(acceptance_rate), 0.0), 1.0)
+    ab = 1.0 - (1.0 - a) ** max(1, int(branch))
+    if ab >= 1.0:
+        return float(depth)
+    return ab * (1.0 - ab ** int(depth)) / (1.0 - ab)
+
+
+class SpecTreeResult:
+    """The (depth, branch) draft-tree shape optimize_spec_tree picked.
+    branch == 1 means a tree does not pay at this acceptance profile
+    (the extra verified nodes cost more than the per-level retry is
+    worth) — run the linear chain; depth == 0 means speculation itself
+    does not pay."""
+
+    def __init__(
+        self,
+        depth: int,
+        branch: int,
+        acceptance_rate: float,
+        tokens_per_s: float,
+        decode_tokens_per_s: float,
+        step_time: float,
+        tokens_per_step: float,
+    ):
+        self.depth = depth
+        self.branch = branch
+        self.acceptance_rate = acceptance_rate
+        self.tokens_per_s = tokens_per_s
+        self.decode_tokens_per_s = decode_tokens_per_s
+        self.step_time = step_time
+        self.tokens_per_step = tokens_per_step
+
+    @property
+    def nodes(self) -> int:
+        """Verify node budget (tree width minus the root row)."""
+        return self.depth * self.branch
+
+    @property
+    def speedup(self) -> float:
+        if not self.decode_tokens_per_s:
+            return 1.0
+        return self.tokens_per_s / self.decode_tokens_per_s
+
+    def describe(self) -> str:
+        return (
+            f"spec-tree depth {self.depth} x branch {self.branch} "
+            f"({self.nodes} nodes) at acceptance "
+            f"{self.acceptance_rate:.2f}: {self.tokens_per_step:.2f} "
+            f"tokens/step, expected {self.speedup:.2f}x over plain decode"
+        )
+
+
+def optimize_spec_tree(
+    graph: PCGGraph,
+    spec: MachineSpec,
+    acceptance_rate: float,
+    batch: int = 1,
+    kv_len: int = 1024,
+    depth_max: int = 8,
+    branch_max: int = 4,
+    draft_graph: Optional[PCGGraph] = None,
+    dp: int = 1,
+    tp: int = 1,
+    page_size: int = 0,
+    machine_model=None,
+    mixed_precision: bool = False,
+    decode_kernel: str = "dense",
+) -> SpecTreeResult:
+    """Pick the draft-tree shape (depth, branching factor) that
+    maximizes expected decode throughput at a MEASURED per-token
+    acceptance rate — the tree twin of optimize_spec_k.
+
+    Prices each (d, b) candidate as: one tree verify of 1 + d*b rows
+    (estimate_verify_step with tree_nodes — every node is a scored row
+    and a fresh cache row, whatever the topology) plus the draft cost
+    (d draft decode steps for a model draft: the spine is decoded once
+    and the sibling alternates come from the SAME logits, so branching
+    is draft-free; zero for the n-gram draft), buying
+    1 + E[path](α, d, b) tokens. (d, 1) candidates subsume the linear
+    chain and (0, 1) plain decode, so a profile where trees don't pay
+    degrades to optimize_spec_k's answer rather than a forced tree."""
+    cm = CostModel(
+        spec,
+        measure=False,
+        machine_model=machine_model,
+        mixed_precision=mixed_precision,
+    )
+    base = estimate_decode_step(
+        graph, cm, dp, tp, batch, kv_len, page_size=page_size,
+        decode_kernel=decode_kernel,
+    )
+    if base is None:
+        raise ValueError(f"(dp={dp}, tp={tp}) is infeasible for this graph")
+    draft_step = 0.0
+    if draft_graph is not None:
+        d = estimate_decode_step(
+            draft_graph, cm, dp, tp, batch, kv_len,
+            decode_kernel=decode_kernel,
+        )
+        if d is None:
+            raise ValueError(
+                f"(dp={dp}, tp={tp}) is infeasible for the draft graph"
+            )
+        draft_step = d.step_time
+    decode_rate = batch / base.step_time if base.step_time else 0.0
+    best = SpecTreeResult(
+        0, 1, acceptance_rate, decode_rate, decode_rate, base.step_time, 1.0
+    )
+    for depth in range(1, depth_max + 1):
+        for branch in range(1, branch_max + 1):
+            vcost = estimate_verify_step(
+                graph, cm, dp, tp, batch, kv_len, depth,
+                page_size=page_size, decode_kernel=decode_kernel,
+                tree_nodes=depth * branch,
+            )
+            if vcost is None:
+                continue
+            step_time = vcost.step_time + depth * draft_step
+            tokens = 1.0 + expected_accepted_tree_tokens(
+                acceptance_rate, depth, branch
+            )
+            rate = batch * tokens / step_time if step_time else 0.0
+            if rate > best.tokens_per_s:
+                best = SpecTreeResult(
+                    depth,
+                    branch,
+                    acceptance_rate,
+                    rate,
+                    decode_rate,
+                    step_time,
+                    tokens,
+                )
     return best
 
 

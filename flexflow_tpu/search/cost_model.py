@@ -584,11 +584,17 @@ class CostModel:
         page_size: int = 0,
         kernel: str = "dense",
         kv_dtype: str = "fp32",
+        tree_nodes: int = 0,
     ) -> OpCost:
         """Forward cost of ONE speculative-decoding verify step of this
         op on one chip: k+1 token positions per sequence (the last
         emitted token plus k drafted tokens) scored in a single call
-        (serving/engine.GenerationEngine.verify).
+        (serving/engine.GenerationEngine.verify). tree_nodes > 0 prices
+        the token-TREE verify instead (engine.verify_tree): the row
+        width becomes 1 + tree_nodes whatever k says — a tree node
+        costs exactly what a chain draft position costs (one scored
+        row, one fresh cache row); only the acceptance model differs,
+        and that lives in optimize_spec_tree.
 
         The term structure is WHY speculative decoding wins: the weight
         bytes — the decode regime's dominant cost — stream ONCE for all
@@ -605,7 +611,7 @@ class CostModel:
         kv_dtype "int8" as in decode_op_cost: 1-byte cache rows plus
         per-(page, head) fp32 scale reads."""
         tp = max(1, tp)
-        w = int(k) + 1
+        w = (1 + int(tree_nodes)) if tree_nodes > 0 else (int(k) + 1)
         elem = lambda s: self.elem_bytes(s)  # noqa: E731
         weight_bytes = sum(
             s.volume() * elem(s) for s in node.weight_shapes

@@ -61,9 +61,11 @@ from flexflow_tpu.serving.scheduler import (
 )
 from flexflow_tpu.serving.spec import (
     DraftProposer,
+    DraftTree,
     ModelDraftProposer,
     NGramDraftProposer,
     accept_drafts,
+    accept_tree,
 )
 from flexflow_tpu.serving.tenancy import (
     AdapterPool,
@@ -113,9 +115,11 @@ __all__ = [
     "DraftFault",
     "PagePoolExhausted",
     "DraftProposer",
+    "DraftTree",
     "ModelDraftProposer",
     "NGramDraftProposer",
     "accept_drafts",
+    "accept_tree",
     "AdapterPool",
     "AdapterPoolExhausted",
     "DeficitRoundRobin",

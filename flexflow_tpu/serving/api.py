@@ -64,8 +64,14 @@ class ServeConfig:
     # second compiled decoder LM (pass it as build_scheduler/generate's
     # draft_model). spec_k is the draft length per verify step;
     # spec_ngram the lookup n-gram size.
+    # spec_branch > 1 switches to token-TREE speculation: each verify
+    # scores a deduped tree of up to spec_k * spec_branch draft nodes
+    # (depth spec_k, spec_branch alternatives per level) and accepts
+    # the longest surviving root-to-leaf path; 1 keeps the linear
+    # chain path bit-for-bit.
     spec_draft: str = ""
     spec_k: int = 4
+    spec_branch: int = 1
     spec_ngram: int = 2
     # chunked prefill (Sarathi-Serve; serving/scheduler.py):
     # token_budget > 0 caps each iteration's token work — prompts
@@ -218,6 +224,10 @@ class ServeConfig:
             )
         if self.spec_draft and self.spec_k < 1:
             raise ValueError("spec_k must be >= 1 when spec_draft is set")
+        if self.spec_branch < 1:
+            raise ValueError(
+                f"spec_branch must be >= 1, got {self.spec_branch}"
+            )
         if self.spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
         if self.token_budget < 0 or self.chunk_size < 1:
@@ -352,6 +362,7 @@ class ServeConfig:
             prefix_cache=cfg.serve_prefix_cache,
             spec_draft=cfg.serve_spec_draft,
             spec_k=cfg.serve_spec_k,
+            spec_branch=cfg.serve_spec_branch,
             token_budget=cfg.serve_token_budget,
             chunk_size=cfg.serve_chunk_size,
             decode_kernel=cfg.serve_decode_kernel,
@@ -521,6 +532,7 @@ def build_scheduler(
         engine,
         proposer=build_proposer(serve, draft_model),
         spec_k=serve.spec_k,
+        spec_branch=serve.spec_branch,
         admission=serve.admission,
         max_preemptions=serve.max_preemptions,
         injector=injector,

@@ -205,6 +205,15 @@ class InflightStep:
     participants: Dict[int, object] = dataclasses.field(default_factory=dict)
     plan: Optional[Dict[int, list]] = None
     iteration: int = -1
+    # tree verify (kind "verify_tree"): the per-row parent table the
+    # step was dispatched with (host copy of the device operand) and
+    # slot -> DraftTree plan. Both are SNAPSHOTS taken at dispatch —
+    # the reconcile walks the tree and compacts the cache against
+    # THESE, never a live proposer/scheduler tree the host has since
+    # rebuilt (fxlint FX103/FX109 hold tree-reconcile code to the step
+    # record exactly like the multistep window state).
+    tree_parents: Optional[np.ndarray] = None  # int32 [max_seqs, w]
+    tree_plan: Optional[Dict[int, object]] = None  # slot -> DraftTree
     # dispatch sequence number (scheduler._note_dispatch): the trace
     # layer's step index — device in-flight windows alternate lanes by
     # its parity so overlapping async windows still render
@@ -340,6 +349,17 @@ class GenerationEngine:
                 )
             )
         )
+        # tree-verify programs, one per row width w = 1 + tree nodes.
+        # Kept apart from `_verify_cache` because the tree impl carries
+        # an extra parent-table operand; the scheduler pins a single
+        # node budget, so the steady-state population is one entry
+        self._tree_cache = _JitCache(
+            lambda w: jax.jit(
+                self._verify_tree_impl_paged
+                if self.paged
+                else self._verify_tree_impl
+            )
+        )
 
     @property
     def verify_cache_entries(self) -> int:
@@ -347,6 +367,12 @@ class GenerationEngine:
         `verify_cache_max`) — surfaced as a SchedulerStats field so a
         width-churning workload's compile footprint is observable."""
         return len(self._verify_cache)
+
+    @property
+    def tree_cache_entries(self) -> int:
+        """Live jitted tree-verify programs — the `verify_cache_entries`
+        twin for the tree-width family."""
+        return len(self._tree_cache)
 
     @property
     def multistep_cache_entries(self) -> int:
@@ -374,6 +400,11 @@ class GenerationEngine:
         """The jitted verify program for draft width `w` (LRU-managed
         by the shared _JitCache)."""
         return self._verify_cache.get(w)
+
+    def _tree_fn(self, w: int):
+        """The jitted tree-verify program for row width `w` (root + tree
+        nodes) — same keyed-LRU discipline as `_verify_fn`."""
+        return self._tree_cache.get(w)
 
     def _chunk_fn(self, key):
         """The jitted chunked-prefill program for compact batch shape
@@ -456,6 +487,7 @@ class GenerationEngine:
         self._verify_cache.clear()
         self._chunk_cache.clear()
         self._multistep_cache.clear()
+        self._tree_cache.clear()
 
     # -- shared forward ------------------------------------------------------
 
@@ -1596,6 +1628,158 @@ class GenerationEngine:
         logits = self._forward_logits(params, tokens, hook)
         return new_k, new_v, new_ks, new_vs, logits
 
+    def _verify_tree_impl(
+        self, params, tokens, lengths, draft_lens, parents, ck, cv, ad=None
+    ):
+        """Tree twin of _verify_impl: tokens [max_seqs, w] where column
+        0 is the slot's last emitted token (the tree ROOT's input) and
+        columns 1..w-1 are draft-tree nodes in topological order;
+        parents [max_seqs, w] int32 gives each row's parent ROW index
+        (-1 for row 0). The per-token ancestor mask replaces the
+        staircase: row j attends the committed prefix plus its own
+        root-to-j chain only, so every branch scores exactly as if it
+        were the lone continuation. K/V rows still land at positions
+        lengths + j — branch tokens occupy scattered rows that
+        cache.truncate(slot, new_len, src_rows) later compacts."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            mha_project_qkv,
+            mha_project_out,
+            verify_attention,
+        )
+        from flexflow_tpu.serving.tenancy.adapters import (
+            apply_adapter_out,
+            apply_adapter_qkv,
+        )
+
+        spec = self.cache.spec
+        dest = self._verify_scatter_dest(
+            tokens.shape[1], lengths, draft_lens, None, jnp
+        )
+        new_k = dict(ck)
+        new_v = dict(cv)
+
+        def row_update(cache, new):
+            flat = cache.reshape(-1, spec.num_heads, spec.head_dim)
+            rows = new.astype(cache.dtype).reshape(
+                -1, spec.num_heads, spec.head_dim
+            )
+            return flat.at[dest].set(rows).reshape(cache.shape)
+
+        def hook(node, ins, ws, ctx):
+            g = node.guid
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, g)
+            kc = row_update(ck[g], k)
+            vc = row_update(cv[g], v)
+            new_k[g] = kc
+            new_v[g] = vc
+            attn = verify_attention(
+                q,
+                kc,
+                vc,
+                lengths,
+                kernel=self.decode_kernel,
+                tree_parents=parents,
+            )
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, g)]
+
+        logits = self._forward_logits(params, tokens, hook)
+        return new_k, new_v, logits
+
+    def _verify_tree_impl_paged(
+        self, params, tokens, lengths, draft_lens, parents, tables, ck, cv,
+        cks, cvs, ad=None,
+    ):
+        """Paged twin of _verify_tree_impl — _verify_impl_paged with the
+        parent table threaded into paged_verify_attention."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.attention import (
+            mha_project_qkv,
+            mha_project_out,
+            paged_verify_attention,
+        )
+        from flexflow_tpu.serving.tenancy.adapters import (
+            apply_adapter_out,
+            apply_adapter_qkv,
+        )
+
+        spec = self.cache.spec
+        quant = getattr(self.cache, "quantized", False)
+        dest = self._verify_scatter_dest(
+            tokens.shape[1], lengths, draft_lens, tables, jnp
+        )
+        new_k = dict(ck)
+        new_v = dict(cv)
+        new_ks = dict(cks)
+        new_vs = dict(cvs)
+
+        def row_update(pool, new):
+            flat = pool.reshape(-1, spec.num_heads, spec.head_dim)
+            rows = new.astype(pool.dtype).reshape(
+                -1, spec.num_heads, spec.head_dim
+            )
+            return flat.at[dest].set(rows).reshape(pool.shape)
+
+        def hook(node, ins, ws, ctx):
+            g = node.guid
+            use_bias = node.params.get("bias", True)
+            q, k, v = mha_project_qkv(ins, ws, ctx, use_bias=use_bias)
+            q, k, v = apply_adapter_qkv(ins[0], q, k, v, ad, g)
+            if quant:
+                kc, new_ks[g], _ = self._quant_scatter(
+                    ck[g],
+                    cks[g],
+                    k.reshape(-1, spec.num_heads, spec.head_dim),
+                    dest,
+                )
+                vc, new_vs[g], _ = self._quant_scatter(
+                    cv[g],
+                    cvs[g],
+                    v.reshape(-1, spec.num_heads, spec.head_dim),
+                    dest,
+                )
+                new_k[g] = kc
+                new_v[g] = vc
+                attn = paged_verify_attention(
+                    q,
+                    kc,
+                    vc,
+                    tables,
+                    lengths,
+                    kernel=self.decode_kernel,
+                    k_scale=new_ks[g],
+                    v_scale=new_vs[g],
+                    tree_parents=parents,
+                )
+            else:
+                kc = row_update(ck[g], k)
+                vc = row_update(cv[g], v)
+                new_k[g] = kc
+                new_v[g] = vc
+                attn = paged_verify_attention(
+                    q,
+                    kc,
+                    vc,
+                    tables,
+                    lengths,
+                    kernel=self.decode_kernel,
+                    tree_parents=parents,
+                )
+            out = mha_project_out(
+                attn, ws, ctx, ins[0].dtype, use_bias=use_bias
+            )
+            return [apply_adapter_out(attn, out, ad, g)]
+
+        logits = self._forward_logits(params, tokens, hook)
+        return new_k, new_v, new_ks, new_vs, logits
+
     def verify_dispatch(
         self,
         params,
@@ -1707,6 +1891,118 @@ class GenerationEngine:
         the logits [max_seqs, w, V] as a host array."""
         return self.verify_reconcile(
             self.verify_dispatch(params, tokens, draft_lens)
+        )
+
+    def verify_tree_dispatch(
+        self,
+        params,
+        tokens: np.ndarray,
+        draft_lens: np.ndarray,
+        parents: np.ndarray,
+    ) -> InflightStep:
+        """Enqueue one tree-verify step (SpecInfer's tree-scoring call)
+        WITHOUT blocking. tokens [max_seqs, w]: column 0 the slot's last
+        emitted token, columns 1..draft_lens[s]-1 its draft-TREE nodes
+        in topological order; parents [max_seqs, w] int32 maps each row
+        to its parent row (-1 for the root, identity-chain padding past
+        draft_lens). The ancestor mask is built from `parents` INSIDE
+        the jitted step, so one compiled program serves every tree
+        topology of width w. Page claims, cache commit, and the
+        no-length-advance contract match verify_dispatch exactly; the
+        returned step carries `tree_parents` (a host snapshot of the
+        dispatched table) for the reconcile's tree walk."""
+        import jax.numpy as jnp
+
+        spec = self.cache.spec
+        tokens = np.asarray(tokens, dtype=np.int32)
+        draft_lens = np.asarray(draft_lens, dtype=np.int32)
+        parents = np.asarray(parents, dtype=np.int32)
+        if tokens.ndim != 2 or tokens.shape[0] != spec.max_seqs:
+            raise ValueError(
+                f"tokens must be [max_seqs={spec.max_seqs}, w], "
+                f"got {tokens.shape}"
+            )
+        w = tokens.shape[1]
+        if w < 1:
+            raise ValueError("verify needs at least one token column")
+        if draft_lens.shape != (spec.max_seqs,):
+            raise ValueError("draft_lens must be [max_seqs]")
+        if parents.shape != tokens.shape:
+            raise ValueError(
+                f"parents must match tokens shape {tokens.shape}, "
+                f"got {parents.shape}"
+            )
+        if np.any(parents >= np.arange(w)[None, :]):
+            raise ValueError(
+                "parents must be topological: parents[:, j] < j"
+            )
+        for slot in np.nonzero(draft_lens)[0]:
+            need = int(self.cache.lengths[slot]) + int(draft_lens[slot])
+            if draft_lens[slot] > w or need > spec.max_len:
+                raise ValueError(
+                    f"slot {int(slot)}: draft_lens {int(draft_lens[slot])} "
+                    f"overruns width {w} or max_len {spec.max_len}"
+                )
+        args = []
+        if self.paged:
+            for slot in np.nonzero(draft_lens)[0]:
+                start = int(self.cache.lengths[slot])
+                for p in range(start, start + int(draft_lens[slot])):
+                    self.cache.ensure_position(int(slot), p)
+            args = [snapshot(self.cache.block_tables)]
+        lengths_snap = np.array(self.cache.lengths)
+        scale_args = (
+            [self.cache.k_scale, self.cache.v_scale] if self.paged else []
+        )
+        step_args = (
+            params,
+            jnp.asarray(tokens),
+            snapshot(self.cache.lengths),
+            jnp.asarray(draft_lens),
+            jnp.asarray(parents),
+            *args,
+            self.cache.k,
+            self.cache.v,
+            *scale_args,
+            *self._adapter_slot_args(),
+        )
+
+        def call():
+            # resolved inside the dispatch so a kernel fallback's
+            # cleared cache re-traces with the dense attention core
+            return self._tree_fn(w)(*step_args)
+
+        if self.paged:
+            new_k, new_v, new_ks, new_vs, logits = self._dispatch(
+                "verify", call
+            )
+            self.cache.commit(new_k, new_v, new_ks, new_vs)
+        else:
+            new_k, new_v, logits = self._dispatch("verify", call)
+            self.cache.commit(new_k, new_v)
+        self.cache.begin_inflight()
+        return InflightStep(
+            kind="verify_tree",
+            dispatch_t=time.perf_counter(),
+            active=np.asarray(draft_lens) > 0,
+            lengths=lengths_snap,
+            draft_lens=np.array(draft_lens),
+            device_logits=logits,
+            tree_parents=np.array(parents),
+        )
+
+    def verify_tree(
+        self,
+        params,
+        tokens: np.ndarray,
+        draft_lens: np.ndarray,
+        parents: np.ndarray,
+    ) -> np.ndarray:
+        """Synchronous tree verify: returns logits [max_seqs, w, V] as a
+        host array (reconcile shares verify_reconcile — the tree walk is
+        the caller's, made against the step's snapshots)."""
+        return self.verify_reconcile(
+            self.verify_tree_dispatch(params, tokens, draft_lens, parents)
         )
 
     # -- chunked prefill -----------------------------------------------------

@@ -356,21 +356,70 @@ class KVCache:
         self.lengths[slot] = 0
         heapq.heappush(self._free, slot)
 
-    def truncate(self, slot: int, new_len: int) -> None:
+    def truncate(
+        self, slot: int, new_len: int, src_rows: Optional[Sequence[int]] = None
+    ) -> None:
         """Roll the slot's visible length to `new_len` (speculative-decode
         rollback: verify writes k+1 rows, acceptance keeps a prefix).
         Rows past new_len stay in HBM as stale data — the lengths mask in
         decode/verify attention hides them and later writes overwrite
         them, so no device work is needed. new_len may also EXCEED the
         current length: verify commits its accepted rows through this
-        same call."""
+        same call.
+
+        src_rows (tree-verify commit): absolute cache positions, in
+        path order, holding the ACCEPTED root-to-leaf rows of a token
+        tree — scattered across the verify window because dead branches
+        sit between them. They are compacted into the contiguous tail
+        positions [new_len - len(src_rows), new_len) before the length
+        moves, so the committed cache is indistinguishable from a
+        linear decode of the accepted path (K/V rows carry no positional
+        encoding — attention context is the mask's job — so the row
+        copy is value-exact). Positions must be non-decreasing and each
+        source must sit at-or-after its destination (topological node
+        order guarantees both); src_rows == destinations is a no-op, so
+        chain trees never touch the device."""
         if slot not in self._active:
             raise ValueError(f"slot {slot} is not active")
         if not 0 <= new_len <= self.spec.max_len:
             raise ValueError(
                 f"new_len {new_len} outside [0, {self.spec.max_len}]"
             )
+        if src_rows is not None and len(src_rows):
+            self._compact_rows(slot, new_len, src_rows)
         self.lengths[slot] = new_len
+
+    def _compact_rows(
+        self, slot: int, new_len: int, src_rows: Sequence[int]
+    ) -> None:
+        """Move the accepted tree rows into the contiguous tail of the
+        committed prefix. Functional rebind (fresh dicts, gather before
+        scatter), not in-place mutation: already-queued steps read the
+        OLD arrays, and the new arrays chain behind the verify step's
+        committed outputs on the device queue — the commit() discipline."""
+        import jax.numpy as jnp
+
+        srcs = [int(p) for p in src_rows]
+        dests = list(range(new_len - len(srcs), new_len))
+        if dests[0] < 0:
+            raise ValueError(
+                f"{len(srcs)} compacted rows do not fit under new_len "
+                f"{new_len}"
+            )
+        for s, d in zip(srcs, dests):
+            if not d <= s < self.spec.max_len:
+                raise ValueError(
+                    f"source row {s} outside [{d}, {self.spec.max_len})"
+                )
+        if srcs == dests:
+            return
+        si = jnp.asarray(np.asarray(srcs, dtype=np.int32))
+        di = jnp.asarray(np.asarray(dests, dtype=np.int32))
+        nk, nv = dict(self.k), dict(self.v)
+        for g in self.spec.layer_guids:
+            nk[g] = nk[g].at[slot, di].set(nk[g][slot, si])
+            nv[g] = nv[g].at[slot, di].set(nv[g][slot, si])
+        self.k, self.v = nk, nv
 
     def commit(self, new_k: Dict[int, object], new_v: Dict[int, object]):
         """Swap in the arrays a jitted step returned."""
@@ -1287,7 +1336,9 @@ class PagedKVCache:
         if self._owned(slot) <= self._max_pages[slot]:
             self._reserved_h[h] -= 1
 
-    def truncate(self, slot: int, new_len: int) -> None:
+    def truncate(
+        self, slot: int, new_len: int, src_rows: Optional[Sequence[int]] = None
+    ) -> None:
         """Roll the slot's visible length to `new_len` and return every
         page past ceil(new_len / page_size) to the free list — the
         speculative-decode rollback (verify claims pages for all k+1
@@ -1298,7 +1349,18 @@ class PagedKVCache:
         re-growth of this slot re-claims from a pool that still covers
         every in-flight worst case. new_len may exceed the current
         length (verify commits accepted rows through this call) but
-        never the pages the slot actually holds."""
+        never the pages the slot actually holds.
+
+        src_rows (tree-verify commit): the accepted root-to-leaf rows'
+        absolute positions, compacted into [new_len - len(src_rows),
+        new_len) through the block table BEFORE the dead branches' pages
+        are released — see KVCache.truncate for the contract. On int8
+        pools the moved rows dequantize with their source page's scale
+        and requantize under the destination page's; a destination page
+        whose FIRST row is among the moves re-derives its scale from
+        that row (the _quant_scatter claim rule), so the committed pool
+        bytes match what a sequential decode of the accepted path would
+        have produced up to the int8 round trip."""
         if slot not in self._active:
             raise ValueError(f"slot {slot} is not active")
         if not 0 <= new_len <= self.spec.max_len:
@@ -1311,6 +1373,8 @@ class PagedKVCache:
                 f"new_len {new_len} needs {keep} pages but slot {slot} "
                 f"holds {int(self._held[slot])}"
             )
+        if src_rows is not None and len(src_rows):
+            self._compact_rows(slot, new_len, src_rows)
         old_resv = max(0, int(self._max_pages[slot]) - self._owned(slot))
         for pi in range(keep, self.spec.max_pages_per_seq):
             self._decref_entry(slot, pi)
@@ -1323,6 +1387,94 @@ class PagedKVCache:
                 - old_resv
             )
         self.lengths[slot] = new_len
+
+    def _compact_rows(
+        self, slot: int, new_len: int, src_rows: Sequence[int]
+    ) -> None:
+        """Move the accepted tree rows into the contiguous tail of the
+        committed prefix, resolving positions through the block table.
+        Every touched page is exclusively owned: the verify claimed (and
+        COW-forked where needed) each window page via ensure_position
+        before writing it, so the row copies never leak into a shared
+        prefix page. Functional rebind with gather-before-scatter, as in
+        _cow_page/commit — queued steps keep reading the old pools."""
+        import jax.numpy as jnp
+
+        spec = self.spec
+        ps = spec.page_size
+        srcs = [int(p) for p in src_rows]
+        dests = list(range(new_len - len(srcs), new_len))
+        if dests[0] < 0:
+            raise ValueError(
+                f"{len(srcs)} compacted rows do not fit under new_len "
+                f"{new_len}"
+            )
+        sentinel = spec.num_pages
+
+        def flat(pos: int) -> int:
+            page = int(self.block_tables[slot, pos // ps])
+            if page >= sentinel:
+                raise ValueError(
+                    f"slot {slot} position {pos} has no mapped page"
+                )
+            return page * ps + pos % ps
+
+        for s, d in zip(srcs, dests):
+            if not d <= s < spec.max_len:
+                raise ValueError(
+                    f"source row {s} outside [{d}, {spec.max_len})"
+                )
+        if srcs == dests:
+            return
+        sf = np.asarray([flat(p) for p in srcs], dtype=np.int32)
+        df = np.asarray([flat(p) for p in dests], dtype=np.int32)
+        src_page = sf // ps
+        dst_page = df // ps
+        si = jnp.asarray(sf)
+        di = jnp.asarray(df)
+        nk, nv = dict(self.k), dict(self.v)
+        if not self.quantized:
+            for g in spec.layer_guids:
+                kf = nk[g].reshape(-1, spec.num_heads, spec.head_dim)
+                vf = nv[g].reshape(-1, spec.num_heads, spec.head_dim)
+                nk[g] = kf.at[di].set(kf[si]).reshape(nk[g].shape)
+                nv[g] = vf.at[di].set(vf[si]).reshape(nv[g].shape)
+            self.k, self.v = nk, nv
+            return
+        # int8 pools: dequant with the source page's scale, requantize
+        # under the destination page's. A destination page whose first
+        # row moves re-derives its scale from that row — the same claim
+        # rule _quant_scatter applies on sequential writes, so scales
+        # (and bytes) come out as a linear decode of the path would
+        first = (df % ps == 0)[:, None]  # [a, 1] page-initial dests
+        spi = jnp.asarray(src_page)
+        dpi = jnp.asarray(dst_page)
+        firstj = jnp.asarray(first)
+        nks, nvs = dict(self.k_scale), dict(self.v_scale)
+
+        def requant(pool, scale):
+            f = pool.reshape(-1, spec.num_heads, spec.head_dim)
+            deq = f[si].astype(jnp.float32) * scale[spi][:, :, None]
+            amax = jnp.max(jnp.abs(deq), axis=-1)  # [a, heads]
+            cand = jnp.zeros_like(scale).at[dpi].max(
+                jnp.where(firstj, amax / 127.0, 0.0)
+            )
+            claimed = jnp.zeros_like(scale).at[dpi].max(
+                jnp.where(firstj, 1.0, 0.0)
+            )
+            new_scale = jnp.where(claimed > 0.0, cand, scale)
+            s = new_scale[dpi]  # [a, heads]
+            safe = jnp.where(s > 0.0, s, 1.0)
+            q = jnp.clip(
+                jnp.round(deq / safe[:, :, None]), -127, 127
+            ).astype(pool.dtype)
+            return f.at[di].set(q).reshape(pool.shape), new_scale
+
+        for g in spec.layer_guids:
+            nk[g], nks[g] = requant(nk[g], nks[g])
+            nv[g], nvs[g] = requant(nv[g], nvs[g])
+        self.k, self.v = nk, nv
+        self.k_scale, self.v_scale = nks, nvs
 
     def free(self, slot: int) -> None:
         if slot not in self._active:

@@ -243,6 +243,12 @@ _STAT_FIELDS: Dict[str, object] = dict(
     verify_steps=0,
     draft_tokens_proposed=0,
     draft_tokens_accepted=0,
+    # token-tree speculation (spec_branch > 1): under trees,
+    # draft_tokens_proposed counts the tree DEPTH (the most tokens one
+    # verify could accept), so acceptance_rate keeps its meaning — the
+    # full node count lives here instead
+    tree_verify_steps=0,  # verify steps that scored a draft tree
+    tree_nodes_proposed=0,  # Σ tree nodes dispatched for verification
     # chunked prefill (token_budget > 0)
     chunk_steps=0,  # chunk steps dispatched
     chunk_tokens=0,  # Σ prompt tokens streamed in via chunks
@@ -500,6 +506,7 @@ class _SchedulerBase:
         params=None,
         proposer=None,
         spec_k: int = 4,
+        spec_branch: int = 1,
         admission: str = "reserve",
         max_preemptions: int = 3,
         injector=None,
@@ -521,6 +528,22 @@ class _SchedulerBase:
         self.spec_k = int(spec_k)
         if proposer is not None and self.spec_k < 1:
             raise ValueError("speculative decoding needs spec_k >= 1")
+        # token-tree speculation: spec_branch > 1 switches the verify
+        # step from a single draft chain to a deduped token TREE of up
+        # to spec_k * spec_branch nodes (depth spec_k, spec_branch
+        # alternatives per level before prefix sharing). The compiled
+        # verify width is FIXED at 1 + _tree_nodes — the tree's shape
+        # rides in as a parent table (data), so topology changes never
+        # recompile. spec_branch == 1 keeps the linear chain path
+        # bit-for-bit untouched.
+        self.spec_branch = int(spec_branch)
+        if self.spec_branch < 1:
+            raise ValueError(f"spec_branch must be >= 1, got {spec_branch}")
+        self._tree_nodes = self.spec_k * self.spec_branch
+        # iteration-scoped dry-proposal cache: _fusable_steps may draft
+        # to learn whether speculation has work this iteration; the
+        # result is handed to _verify_once so nothing drafts twice
+        self._cached_proposals = None
         if admission not in _ADMISSION_MODES:
             raise ValueError(
                 f"admission must be one of {_ADMISSION_MODES}, "
@@ -1571,6 +1594,8 @@ class _SchedulerBase:
             self._commit_chunk(step, nxt, logits)
         elif step.kind == "multistep":
             self._commit_multistep(step, toks_ks, logits_ks, mask_ks)
+        elif step.kind == "verify_tree":
+            self._commit_verify_tree(step, logits)
         else:
             self._commit_verify(step, logits)
         if self._tele is not None:
@@ -1637,21 +1662,32 @@ class _SchedulerBase:
         """How many decode steps the NEXT dispatch may fuse into one
         device-resident scan window: `max_fused_steps` when no
         host-visible event can need the host mid-window, else 1. The
-        events that hold fusing to a single step: speculative mode (a
-        verify's acceptance is host logic every iteration), a non-empty
-        queue (admission next iteration changes the batch), optimistic
-        admission (preemption must never coexist with an open window),
-        any chunk streaming in progress or a final chunk that just
-        committed (phase changes), and deferred cancels waiting on a
-        reconcile. Deadlines deliberately do NOT hold fusing: a
-        deadline expiring mid-window reaps at the window's reconcile —
-        at most K-1 steps of wasted (discarded) device work, the same
-        one-step-stale contract the async loop already carries.
-        Per-slot EOS and page-boundary caps are handled inside the
-        window itself (`_decode_multi_dispatch_step`), not here."""
+        events that hold fusing to a single step: a speculative
+        iteration with live drafts (a verify's acceptance is host
+        logic), a non-empty queue (admission next iteration changes
+        the batch), optimistic admission (preemption must never
+        coexist with an open window), any chunk streaming in progress
+        or a final chunk that just committed (phase changes), and
+        deferred cancels waiting on a reconcile. Deadlines
+        deliberately do NOT hold fusing: a deadline expiring
+        mid-window reaps at the window's reconcile — at most K-1 steps
+        of wasted (discarded) device work, the same one-step-stale
+        contract the async loop already carries. Per-slot EOS and
+        page-boundary caps are handled inside the window itself
+        (`_decode_multi_dispatch_step`), not here.
+
+        Speculation holds fusing only while it has something to
+        verify: a STATELESS proposer is dry-run here (result cached
+        for `_verify_once`, nothing drafts twice) and an iteration
+        where no slot drafted — cold n-gram table, post-rollback gap —
+        fuses exactly like plain decode. A stateful proposer keeps the
+        unconditional one-step hold: its draft cache must advance with
+        every committed token. A fused draft+verify round (one device
+        window that drafts AND scores) would relax the live-drafts
+        hold too; the tree-verify mask is already threaded as data
+        (`InflightStep.tree_parents`), which is the seam such a fused
+        kernel would dispatch through."""
         if not self.decode_multistep or self.max_fused_steps <= 1:
-            return 1
-        if self.proposer is not None:
             return 1
         if self.queue:
             return 1
@@ -1663,7 +1699,24 @@ class _SchedulerBase:
             return 1
         if getattr(self, "_pending_cancels", None):
             return 1
+        if self.proposer is not None:
+            if not getattr(self.proposer, "stateless", False):
+                return 1
+            if self._dry_propose():
+                return 1
         return int(self.max_fused_steps)
+
+    def _dry_propose(self) -> bool:
+        """Draft for this iteration ahead of the fuse/verify decision
+        and cache the result for `_verify_once`; True when any slot has
+        a live draft (speculation needs the per-iteration host sync)."""
+        if self.spec_branch > 1:
+            trees = self._propose_trees()
+            self._cached_proposals = ("tree", trees)
+            return any(len(t.tokens) > 0 for t in trees.values())
+        proposals = self._propose(self.spec_k)
+        self._cached_proposals = ("linear", proposals)
+        return any(len(d) > 0 for d in proposals.values())
 
     def _decode_multi_dispatch_step(self, k: int):
         """Dispatch phase of one fused K-step decode window: per slot,
@@ -1957,11 +2010,224 @@ class _SchedulerBase:
 
     def _verify_once(self) -> None:
         """Synchronous speculative iteration: draft up to spec_k tokens
-        per slot, dispatch ONE batched verify, and reconcile it
-        immediately."""
-        step = self._verify_dispatch_step(self._propose(self.spec_k))
+        per slot (a spec_branch-way tree under tree speculation),
+        dispatch ONE batched verify, and reconcile it immediately.
+        Consumes `_fusable_steps`' dry-proposal when one was cached
+        this iteration, so the fuse-or-verify probe never drafts
+        twice."""
+        cached = self._cached_proposals
+        self._cached_proposals = None
+        if self.spec_branch > 1:
+            trees = (
+                cached[1]
+                if cached is not None and cached[0] == "tree"
+                else self._propose_trees()
+            )
+            step = self._verify_tree_dispatch_step(trees)
+        else:
+            proposals = (
+                cached[1]
+                if cached is not None and cached[0] == "linear"
+                else self._propose(self.spec_k)
+            )
+            step = self._verify_dispatch_step(proposals)
         if step is not None:
             self._reconcile_step(step)
+
+    # -- token-tree speculation (spec_branch > 1) ----------------------------
+
+    def _propose_trees(self) -> Dict[int, object]:
+        """Tree twin of _propose: draft one deduped token TREE per
+        running slot (up to spec_k deep, spec_branch alternatives per
+        level, shared prefixes merged). A proposer fault (real or
+        injected) degrades THIS iteration to plain decode — empty
+        trees make every verify row a w=1 decode — instead of killing
+        the run."""
+        t0 = time.perf_counter()
+        draftable = {
+            s: r
+            for s, r in self.running.items()
+            if not self._prefill_pending(r) and s not in self._chunk_unlocked
+        }
+        try:
+            if self.injector is not None:
+                self.injector.maybe_draft_fault()
+            trees = self.proposer.propose_trees(
+                draftable, self.spec_k, self.spec_branch
+            )
+        except Exception:
+            self.stats.draft_faults += 1
+            return {}
+        if self._tele is not None:
+            self._tele.tracer.complete(
+                "draft:propose_tree",
+                "host",
+                t0,
+                time.perf_counter(),
+                args={"iter": self._iter, "slots": len(trees)},
+            )
+        return trees
+
+    def _verify_tree_dispatch_step(self, trees):
+        """Dispatch phase of one tree-speculative iteration: prune each
+        slot's draft tree to its budget and horizon caps (live reads —
+        this is the dispatch side), claim every page the verify's
+        1 + nodes rows need, and enqueue ONE batched tree verify. The
+        compiled width is FIXED at 1 + spec_k * spec_branch whatever
+        shape the trees take — the topology rides in as a parent table
+        (data), so per-iteration tree changes never recompile. Returns
+        the InflightStep (carrying the per-slot DraftTree plan + the
+        pre-step lengths snapshot acceptance needs), or None when
+        nothing runs."""
+        from flexflow_tpu.serving.spec import DraftTree
+
+        spec = self.cache.spec
+        w = 1 + self._tree_nodes
+        plan: Dict[int, object] = {}
+        # chunked mode: tree NODES are charged against the iteration's
+        # token budget exactly like linear drafts — every verifying
+        # slot keeps its 1-token floor, then nodes fit in what remains
+        budget_left = self.token_budget if self.token_budget else None
+        for slot, req in sorted(self.running.items()):
+            if self._prefill_pending(req) or slot in self._chunk_unlocked:
+                continue
+            old_len = int(self.cache.lengths[slot])
+            # every node writes a cache row (horizon cap), but accepted
+            # tokens are bounded by the DEPTH — so the request's
+            # remaining token budget prunes depth, the cache horizon
+            # and iteration budget prune node count
+            max_nodes = min(self._tree_nodes, spec.max_len - old_len - 1)
+            max_depth = req.max_new_tokens - len(req.generated) - 1
+            if budget_left is not None:
+                max_nodes = min(max_nodes, max(0, budget_left - 1))
+            tree = trees.get(slot) or DraftTree([], [])
+            tree = tree.prune(max(0, max_nodes), max(0, max_depth))
+            plan[slot] = tree
+            if budget_left is not None:
+                budget_left -= 1 + len(tree.tokens)
+        # claim pages for every row the verify writes; optimistic
+        # preemption may evict plan slots, so the arrays build AFTER
+        self._secure_pages({s: 1 + len(t.tokens) for s, t in plan.items()})
+        plan = {s: t for s, t in plan.items() if s in self.running}
+        if not plan:
+            return None
+        tokens = np.zeros((spec.max_seqs, w), dtype=np.int32)
+        draft_lens = np.zeros(spec.max_seqs, dtype=np.int32)
+        # pad rows/columns keep a valid chain topology (parent = j - 1)
+        parents = np.tile(
+            np.arange(-1, w - 1, dtype=np.int32), (spec.max_seqs, 1)
+        )
+        nodes_total = 0
+        for slot, tree in plan.items():
+            req = self.running[slot]
+            tokens[slot, 0] = req.generated[-1]
+            for j, t in enumerate(tree.tokens):
+                tokens[slot, 1 + j] = int(t)
+            parents[slot] = tree.row_parents(w)
+            draft_lens[slot] = 1 + len(tree.tokens)
+            nodes_total += len(tree.tokens)
+        t0 = time.perf_counter()
+        try:
+            step = self.engine.verify_tree_dispatch(
+                self.params, tokens, draft_lens, parents
+            )
+        except Exception as e:
+            self._fail_all_running(f"tree verify step failed: {e!r}")
+            return None
+        if self._tele is not None:
+            self._tele.tracer.complete(
+                "dispatch:verify_tree",
+                "host",
+                t0,
+                time.perf_counter(),
+                args={
+                    "iter": self._iter,
+                    "slots": len(plan),
+                    "nodes": nodes_total,
+                },
+            )
+            self._tele.registry.counter(
+                "serve_spec_tree_nodes_total",
+                help="draft-tree nodes dispatched for verification",
+            ).inc(nodes_total)
+        step.iteration = self._iter
+        step.plan = {s: list(t.tokens) for s, t in plan.items()}
+        step.tree_plan = plan
+        step.participants = {s: self.running[s] for s in plan}
+        self._note_dispatch(step)
+        self.stats.verify_steps += 1
+        self.stats.tree_verify_steps += 1
+        self.stats.tree_nodes_proposed += nodes_total
+        self.stats.slot_steps += spec.max_seqs
+        self.stats.busy_slot_steps += len(plan)
+        self._budget_used_iter += int(draft_lens.sum())
+        return step
+
+    def _commit_verify_tree(self, step, logits) -> None:
+        """Commit a reconciled tree-verify step: per slot walk the
+        draft tree against the step's SNAPSHOT plan and lengths (fxlint
+        FX103 — under the async loop the live proposer/cache view is an
+        iteration ahead), accept the longest surviving root-to-leaf
+        path, compact that path's scattered rows into contiguous cache
+        positions (truncate + src_rows — dead branches' rows and pages
+        return to the reserve in the same call), and emit
+        len(path) + 1 tokens. Acceptance counters stay comparable to
+        the linear path: proposed counts the tree DEPTH (the most one
+        verify could accept), accepted the surviving path length."""
+        from flexflow_tpu.serving.spec import accept_tree
+
+        if self.injector is not None:
+            logits = np.array(logits)  # writable copy for the injector
+            self.injector.corrupt_logits(
+                logits, sorted(step.tree_plan), iteration=step.iteration
+            )
+        for slot in sorted(step.tree_plan):
+            req = step.participants.get(slot)
+            if req is None or self.running.get(slot) is not req:
+                continue
+            tree = step.tree_plan[slot]
+            n = len(tree.tokens)
+            old_len = int(step.lengths[slot])
+            if not np.isfinite(logits[slot, : 1 + n]).all():
+                # lengths never advanced for this slot; freeing it
+                # returns its pages, stale tree rows and all
+                self._fail(
+                    req,
+                    f"non-finite logits at iteration {step.iteration}",
+                )
+                continue
+            path, emitted = accept_tree(
+                logits[slot],
+                tree,
+                temperature=self.engine.temperature,
+                seed=self.engine.seed,
+                slot=slot,
+                base_len=old_len,
+            )
+            # commit the accepted path / drop every dead branch BEFORE
+            # emitting: _emit may retire the request, which frees the
+            # slot (truncating a freed slot would be an error). Tree
+            # node i's row sits at position old_len + 1 + i; truncate
+            # compacts the accepted rows down to old_len + 1 ...
+            self.cache.truncate(
+                slot,
+                old_len + len(path) + 1,
+                src_rows=[old_len + 1 + node for node in path],
+            )
+            self.proposer.rollback(slot, old_len + len(path) + 1)
+            self.stats.draft_tokens_proposed += tree.depth()
+            self.stats.draft_tokens_accepted += len(path)
+            if self._tele is not None:
+                self._tele.registry.histogram(
+                    "serve_spec_tree_accepted_path_len",
+                    bounds=(0, 1, 2, 4, 8, 16, 32),
+                    help="accepted root-to-leaf path length per slot "
+                    "per tree-verify step",
+                ).observe(float(len(path)))
+            for t in emitted:
+                self._emit(req, int(t))
+                if req.finished:
+                    break  # EOS mid-verify: nothing past it is emitted
 
     # -- chunked prefill (token_budget > 0) ----------------------------------
 
@@ -1996,7 +2262,11 @@ class _SchedulerBase:
         their cadence WHILE a prompt streams in. `host` narrows the
         count to one host partition's slots (the per-host budget of a
         pod placement)."""
-        per = 1 + (self.spec_k if self.proposer is not None else 0)
+        per = 1 + (
+            (self._tree_nodes if self.spec_branch > 1 else self.spec_k)
+            if self.proposer is not None
+            else 0
+        )
         return per * sum(
             1
             for r in self.running.values()
@@ -2284,22 +2554,26 @@ class _SchedulerBase:
             self._reconcile_step(step)
 
     def _generate_once(self) -> None:
-        if self.proposer is not None:
-            self._verify_once()
-            return
+        # the fuse probe runs FIRST even under speculation: an
+        # iteration where no slot drafted (see _fusable_steps) runs a
+        # fused decode window instead of a degenerate w=1 verify
         k = self._fusable_steps()
         if k > 1:
             step = self._decode_multi_dispatch_step(k)
             if step is not None:
                 self._reconcile_step(step)
-        else:
-            self._decode_once()
+            return
+        if self.proposer is not None:
+            self._verify_once()
+            return
+        self._decode_once()
 
     def _begin_iteration(self) -> None:
         self._iter += 1
         self.stats.iterations += 1
         self._budget_used_iter = 0
         self._chunk_unlocked.clear()
+        self._cached_proposals = None
         if self._tele is not None:
             self._iter_t0 = time.perf_counter()
         if self.injector is not None:
@@ -2709,7 +2983,19 @@ class AsyncContinuousBatchingScheduler(ContinuousBatchingScheduler):
         while len(self._inflight) > keep:
             self._reconcile_front()
         if self.running:
-            step = self._verify_dispatch_step(self._merge_proposals(pre))
+            if self.spec_branch > 1:
+                # tree mode: pre-proposals never fire (_pre_propose
+                # gates on kind == "verify" — predicting which PATH a
+                # tree verify accepts would misfire far more often than
+                # a chain's full-acceptance bet), so trees draft fresh
+                # against the reconciled state
+                step = self._verify_tree_dispatch_step(
+                    self._propose_trees()
+                )
+            else:
+                step = self._verify_dispatch_step(
+                    self._merge_proposals(pre)
+                )
             if step is not None:
                 self._inflight.append(step)
 

@@ -39,7 +39,8 @@ from a measured acceptance rate via `CostModel.verify_op_cost`.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -124,6 +125,248 @@ def accept_drafts(
     return k, emitted
 
 
+# -- token trees (SpecInfer tree-verify) --------------------------------------
+
+
+@dataclasses.dataclass
+class DraftTree:
+    """One slot's branching draft: a token tree rooted at the LAST
+    EMITTED token (the root is implicit — it is verify row 0 and never
+    appears in the node lists). tokens[i] is node i's token; parents[i]
+    is its parent NODE index, -1 for children of the root. Nodes are
+    topologically ordered (every parent index < its child's index) —
+    `from_chains` builds them that way, and the verify mask
+    (ops/attention.tree_ancestor_matrix), the acceptance walk, and the
+    truncate compaction all rely on it. Node i occupies verify row
+    1 + i and cache position lengths[slot] + 1 + i during the verify.
+
+    A single chain (parents == [-1, 0, 1, ...]) is the degenerate tree
+    the linear spec path already handles — schedulers route it through
+    the existing staircase program so branch-1 trees stay bit-identical
+    to linear speculative decoding."""
+
+    tokens: List[int]
+    parents: List[int]
+
+    def __post_init__(self):
+        if len(self.tokens) != len(self.parents):
+            raise ValueError("tokens and parents must have equal length")
+        for i, p in enumerate(self.parents):
+            if not -1 <= p < i:
+                raise ValueError(
+                    f"node {i}: parent {p} breaks topological order"
+                )
+
+    @classmethod
+    def from_chains(cls, chains: Sequence[Sequence[int]]) -> "DraftTree":
+        """Trie-merge candidate chains, deduping shared prefixes: two
+        chains agreeing on their first j tokens share j nodes and
+        branch at the divergence — the dedup that makes a tree cheaper
+        to verify than its chains separately. Chain order is
+        deterministic (first chain's nodes come first), so the same
+        chains always produce the same tree."""
+        tokens: List[int] = []
+        parents: List[int] = []
+        kids: Dict[int, Dict[int, int]] = {}
+        for chain in chains:
+            cur = -1
+            for t in chain:
+                t = int(t)
+                node = kids.setdefault(cur, {}).get(t)
+                if node is None:
+                    node = len(tokens)
+                    tokens.append(t)
+                    parents.append(cur)
+                    kids[cur][t] = node
+                cur = node
+        return cls(tokens, parents)
+
+    @property
+    def nodes(self) -> int:
+        return len(self.tokens)
+
+    def depth(self) -> int:
+        """Longest root-to-leaf path in nodes (the linear-k
+        equivalent: a chain of k drafts has depth k)."""
+        best = 0
+        d = [0] * len(self.tokens)
+        for i, p in enumerate(self.parents):
+            d[i] = 1 if p < 0 else d[p] + 1
+            best = max(best, d[i])
+        return best
+
+    def children(self, node: int) -> List[int]:
+        """Child node indices of `node` (-1 = the root), in proposal
+        order — the acceptance walk's candidate order, which is what
+        keeps branch-1 trees draw-for-draw identical to the linear
+        rejection-sampling path."""
+        return [i for i, p in enumerate(self.parents) if p == node]
+
+    def is_chain(self) -> bool:
+        return all(p == i - 1 for i, p in enumerate(self.parents))
+
+    def chains(self) -> List[List[int]]:
+        """Root-to-leaf token paths (testing/debugging view)."""
+        kids_of: Dict[int, List[int]] = {}
+        for i, p in enumerate(self.parents):
+            kids_of.setdefault(p, []).append(i)
+        out: List[List[int]] = []
+
+        def walk(node: int, path: List[int]) -> None:
+            ks = kids_of.get(node, [])
+            if not ks:
+                out.append(path)
+                return
+            for c in ks:
+                walk(c, path + [int(self.tokens[c])])
+
+        walk(-1, [])
+        return [p for p in out if p]
+
+    def row_parents(self, w: Optional[int] = None) -> List[int]:
+        """Per-VERIFY-ROW parent table of width `w` (>= 1 + nodes):
+        row 0 is the root (-1), row 1 + i is node i, padding rows chain
+        (parent j - 1) so their mask degenerates to the staircase. This
+        is the [w] slice the engine stacks into the [max_seqs, w]
+        tree_parents operand."""
+        n = len(self.tokens)
+        w = 1 + n if w is None else int(w)
+        if w < 1 + n:
+            raise ValueError(f"width {w} < 1 + {n} nodes")
+        rows = [-1] + [0 if p < 0 else 1 + p for p in self.parents]
+        rows += list(range(n, w - 1))  # chain padding: row j's parent j-1
+        return rows
+
+    def prune(
+        self,
+        max_nodes: Optional[int] = None,
+        max_depth: Optional[int] = None,
+    ) -> "DraftTree":
+        """Drop nodes past a depth and/or node budget (token-budget and
+        horizon caps at dispatch). Topological order means keeping a
+        prefix of the node list keeps every survivor's parent, and the
+        depth filter keeps ancestors by construction (depth(parent) <
+        depth(child))."""
+        d = [0] * len(self.tokens)
+        for i, p in enumerate(self.parents):
+            d[i] = 1 if p < 0 else d[p] + 1
+        idx_map: Dict[int, int] = {}
+        tokens: List[int] = []
+        parents: List[int] = []
+        for i, p in enumerate(self.parents):
+            if max_nodes is not None and len(tokens) >= max_nodes:
+                break
+            if max_depth is not None and d[i] > max_depth:
+                continue
+            if p >= 0 and p not in idx_map:
+                continue  # orphaned by the node cap
+            idx_map[i] = len(tokens)
+            tokens.append(int(self.tokens[i]))
+            parents.append(-1 if p < 0 else idx_map[p])
+        return DraftTree(tokens, parents)
+
+
+def accept_tree(
+    row_logits: np.ndarray,
+    tree: DraftTree,
+    temperature: float = 0.0,
+    seed: int = 0,
+    slot: int = 0,
+    base_len: int = 0,
+) -> Tuple[List[int], List[int]]:
+    """Tree acceptance for one slot's verify output — the multi-branch
+    generalization of accept_drafts. row_logits [w >= 1 + nodes, vocab]:
+    row 0 is the target's distribution after the last emitted token,
+    row 1 + i its distribution after node i's root-to-node path.
+    Returns (path, emitted): `path` is the surviving root-to-leaf node
+    index prefix (the rows truncate compacts into the cache) and
+    `emitted` is its tokens plus ONE token from the target (the
+    correction where the tree ran out of matching children, or the
+    bonus at a fully-accepted leaf) — every verify emits at least one
+    token, exactly like the linear rule.
+
+    temperature 0: walk greedily — descend to the child whose token
+    equals the argmax; the emitted stream is argmax-after-committed-
+    prefix at every step, so greedy tree spec is token-identical to
+    plain greedy decode. temperature > 0: multi-candidate rejection
+    sampling (SpecInfer / Leviathan-Chen): at each node, candidates are
+    tried in proposal order against the running residual r (initially
+    p) — candidate c accepts with probability r[c]/sum(r), a rejection
+    zeroes r[c] — and if all candidates reject, the correction samples
+    from the final residual. With one candidate this is draw-for-draw
+    the accept_drafts rule (same per-(seed, slot, position) RNG
+    streams: sub 0 for the first candidate, 1 for the correction,
+    2+ordinal for later candidates, and the leaf bonus reuses sub 0 at
+    the one-past-leaf position, exactly like the linear bonus), so
+    branch-1 trees reproduce linear spec decoding bit-for-bit."""
+    if temperature <= 0.0:
+        path: List[int] = []
+        emitted: List[int] = []
+        cur = -1
+        while True:
+            row = 0 if cur < 0 else 1 + cur
+            pred = int(np.argmax(row_logits[row]))
+            emitted.append(pred)
+            nxt = None
+            for c in tree.children(cur):
+                if int(tree.tokens[c]) == pred:
+                    nxt = c
+                    break
+            if nxt is None:
+                return path, emitted
+            path.append(nxt)
+            cur = nxt
+    path = []
+    emitted = []
+    cur = -1
+    depth = 0
+    while True:
+        row = 0 if cur < 0 else 1 + cur
+        # position the decided token will occupy: base_len + 1 + depth
+        pos = base_len + 1 + depth
+        p = _softmax(row_logits[row] / temperature)
+        kids = tree.children(cur)
+        if not kids:  # fully-accepted leaf: bonus from the target
+            t = int(_rng(seed, slot, pos, 0).choice(p.size, p=p))
+            emitted.append(t)
+            return path, emitted
+        residual = p.copy()
+        accepted_node = None
+        for ordinal, c in enumerate(kids):
+            d = int(tree.tokens[c])
+            total = residual.sum()
+            if total <= 0.0:  # p was a delta on rejected candidates
+                accepted_node = c
+                break
+            u = _rng(
+                seed, slot, pos, 0 if ordinal == 0 else 2 + ordinal
+            ).random()
+            # ordinal 0 compares against p[d] itself (total == 1), the
+            # EXACT comparison accept_drafts makes — not p[d]/sum(p),
+            # whose float64 rounding could flip a knife-edge draw
+            thresh = residual[d] if ordinal == 0 else residual[d] / total
+            if u <= thresh:
+                accepted_node = c
+                break
+            residual[d] = 0.0
+        if accepted_node is None:
+            total = residual.sum()
+            if total <= 0.0:  # delta at the last rejected candidate
+                accepted_node = kids[-1]
+            else:
+                t = int(
+                    _rng(seed, slot, pos, 1).choice(
+                        residual.size, p=residual / total
+                    )
+                )
+                emitted.append(t)
+                return path, emitted
+        path.append(accepted_node)
+        emitted.append(int(tree.tokens[accepted_node]))
+        cur = accepted_node
+        depth += 1
+
+
 # -- draft proposers ----------------------------------------------------------
 
 
@@ -165,6 +408,22 @@ class DraftProposer:
 
     def propose(self, running: Dict[int, object], k: int) -> Dict[int, List[int]]:
         raise NotImplementedError
+
+    def propose_trees(
+        self, running: Dict[int, object], k: int, branch: int
+    ) -> Dict[int, DraftTree]:
+        """Branching drafts for tree verification: up to `branch`
+        candidate chains of up to k tokens per slot, deduped on shared
+        prefixes into one DraftTree. The base implementation wraps
+        propose() — a single chain IS the branch == 1 tree — so every
+        proposer supports tree mode; proposers with a real notion of
+        alternates override it to emit wider trees."""
+        out: Dict[int, DraftTree] = {}
+        for slot, drafts in self.propose(running, k).items():
+            tree = DraftTree.from_chains([drafts])
+            if tree.nodes:
+                out[slot] = tree
+        return out
 
     def propose_sequences(
         self, seqs: Dict[int, List[int]], k: int
@@ -219,6 +478,57 @@ class NGramDraftProposer(DraftProposer):
             if seq[i : i + n] == tail:
                 return [int(t) for t in seq[i + n : i + n + k]]
         return []
+
+    def _lookup_chains(
+        self, seq: List[int], k: int, branch: int
+    ) -> List[List[int]]:
+        """Up to `branch` DISTINCT continuations from distinct earlier
+        occurrences of the trailing n-gram, most recent first — the
+        first chain is exactly what _lookup returns, so branch == 1
+        tree proposals match linear proposals chain-for-chain. Distinct
+        matches that disagree early give the tree its branches; matches
+        that agree merge in DraftTree.from_chains."""
+        if len(seq) > self.max_history:
+            seq = seq[-self.max_history :]
+        n = self.n
+        if len(seq) <= n:
+            return []
+        tail = seq[-n:]
+        chains: List[List[int]] = []
+        for i in range(len(seq) - n - 1, -1, -1):
+            if seq[i : i + n] == tail:
+                cont = [int(t) for t in seq[i + n : i + n + k]]
+                if cont and cont not in chains:
+                    chains.append(cont)
+                if len(chains) >= branch:
+                    break
+        return chains
+
+    def propose_trees(
+        self, running, k: int, branch: int
+    ) -> Dict[int, DraftTree]:
+        return self.propose_tree_sequences(
+            {
+                slot: list(req.prompt) + list(req.generated)
+                for slot, req in running.items()
+            },
+            k,
+            branch,
+        )
+
+    def propose_tree_sequences(
+        self, seqs: Dict[int, List[int]], k: int, branch: int
+    ) -> Dict[int, DraftTree]:
+        """Tree analog of propose_sequences (stateless, so usable for
+        pre-proposal the same way)."""
+        out: Dict[int, DraftTree] = {}
+        for slot, seq in seqs.items():
+            self.lookups += 1
+            chains = self._lookup_chains(list(seq), k, branch)
+            if chains:
+                self.lookup_hits += 1
+                out[slot] = DraftTree.from_chains(chains)
+        return out
 
     def propose(self, running, k: int) -> Dict[int, List[int]]:
         return self.propose_sequences(
@@ -370,3 +680,69 @@ class ModelDraftProposer(DraftProposer):
                 self.draft_tokens += 1
                 drafts[slot].append(int(nxt[slot]))
         return {s: d for s, d in drafts.items() if d}
+
+    def propose_trees(
+        self, running, k: int, branch: int
+    ) -> Dict[int, DraftTree]:
+        """Tree drafts from the draft model: the greedy spine propose()
+        would emit, plus up to branch - 1 single-node ALTERNATES at the
+        root — the runners-up of the draft's first fresh distribution.
+        Root alternates are where tree verification pays most (a
+        mispredicted first token kills a whole linear chain), and they
+        cost no extra draft decode steps: the alternate tokens fall out
+        of the same logits row the spine's first token came from, and
+        they never enter the draft cache (only the spine is fed back),
+        so rollback stays the linear protocol."""
+        if not running or k < 1:
+            return {}
+        spec = self.cache.spec
+        pending: Dict[int, List[int]] = {}
+        drafts: Dict[int, List[int]] = {}
+        root_logits: Dict[int, np.ndarray] = {}
+        for slot, req in running.items():
+            hist = list(req.prompt) + list(req.generated)
+            done = int(self.cache.lengths[slot])
+            pending[slot] = [int(t) for t in hist[done:]]
+            drafts[slot] = []
+        while True:
+            feeds: Dict[int, int] = {}
+            for slot in running:
+                if int(self.cache.lengths[slot]) >= spec.max_len:
+                    continue
+                if pending[slot]:
+                    feeds[slot] = pending[slot][0]
+                elif drafts[slot] and len(drafts[slot]) < k:
+                    feeds[slot] = drafts[slot][-1]
+            if not feeds:
+                break
+            tokens = np.zeros(spec.max_seqs, dtype=np.int32)
+            active = np.zeros(spec.max_seqs, dtype=bool)
+            for slot, tok in feeds.items():
+                tokens[slot] = tok
+                active[slot] = True
+            nxt, logits = self.engine.decode(self.params, tokens, active)
+            self.draft_steps += 1
+            for slot in feeds:
+                if pending[slot]:
+                    pending[slot].pop(0)
+                    self.catchup_feeds += 1
+                    if pending[slot]:
+                        continue
+                self.draft_tokens += 1
+                if not drafts[slot]:
+                    root_logits[slot] = np.asarray(logits[slot])
+                drafts[slot].append(int(nxt[slot]))
+        out: Dict[int, DraftTree] = {}
+        for slot, spine in drafts.items():
+            if not spine:
+                continue
+            chains: List[List[int]] = [list(spine)]
+            row = root_logits.get(slot)
+            if row is not None and branch > 1:
+                for t in np.argsort(row)[::-1]:
+                    if len(chains) >= branch:
+                        break
+                    if int(t) != spine[0]:
+                        chains.append([int(t)])
+            out[slot] = DraftTree.from_chains(chains)
+        return out

@@ -1,0 +1,35 @@
+"""FX109/FX103 negatives — the blessed tree-verify idioms stay silent.
+
+Snapshots carry host state into the jitted tree step, scalar builtins
+materialize synchronous reads, and the reconcile's accept walk reads
+the parent table and DraftTree plan only through the step record.
+"""
+
+import numpy as np
+
+
+def snapshot(x):
+    return np.asarray(np.array(x))
+
+
+class GoodScheduler:
+    def advance(self, slot):
+        # same mutations as bad.py: `lengths`/`block_tables` are tainted
+        self.cache.lengths[slot] += 1
+
+    def alloc(self, slot, page):
+        self.cache.block_tables[slot] = page
+
+    def verify_tree_dispatch(self, params, tokens, parents):
+        # snapshot()/np.array are the blessed carriers into the step
+        step_args = (params, tokens, snapshot(self.cache.lengths), parents)
+        tables = np.array(self.cache.block_tables)
+        # int() materializes a host scalar at call time: synchronous
+        base = int(self.cache.lengths[0])
+        return self._tree_fn(*step_args), tables, base
+
+    def commit_tree(self, step, logits):
+        # the plan and parent table through the step record only
+        plan = step.tree_plan
+        parents = step.tree_parents
+        return logits, parents, plan

@@ -531,6 +531,102 @@ def test_seeded_window_mirror_read_is_caught(tmp_path):
     ]
 
 
+def test_tree_fixtures():
+    """Token-tree verify discipline — (a) FX109: a tree-verify
+    dispatch capturing live allocator state into the jitted tree step,
+    (b) FX103: a tree reconcile reading the dispatched parent table /
+    DraftTree plan from a scheduler-side mirror instead of the step
+    record."""
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "tree")], ["dispatch-race"])
+    )
+    # raw lengths + raw block tables into the tree step (2 × FX109),
+    # mirror-read tree_parents + tree_plan (2 × FX103)
+    assert diags.get("bad.py", []).count("FX109") == 2, diags
+    assert diags.get("bad.py", []).count("FX103") == 2, diags
+    # snapshot carriers, int() scalars, and step-record reads silent
+    assert "good.py" not in diags
+
+
+def test_seeded_tree_capture_is_caught(tmp_path):
+    """Re-introduce the bug the tree FX109 extension exists for: hand
+    the jitted tree step the LIVE length table instead of the snapshot
+    — the step reads it behind the async dispatch queue and the
+    reconcile's accept walk runs an iteration later. fxlint must flag
+    it; the unmodified engine stays clean."""
+    src_path = os.path.join(PACKAGE, "serving", "engine.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "            snapshot(self.cache.lengths),\n"
+        "            jnp.asarray(draft_lens),\n"
+        "            jnp.asarray(parents),\n",
+        "            self.cache.lengths,\n"
+        "            jnp.asarray(draft_lens),\n"
+        "            jnp.asarray(parents),\n",
+        1,
+    )
+    assert seeded != src, (
+        "engine.py's verify_tree_dispatch no longer snapshots "
+        "cache.lengths next to the parents operand — update this "
+        "seeding recipe alongside the refactor"
+    )
+    (tmp_path / "engine.py").write_text(seeded)
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    assert any(
+        d.rule_id == "FX109"
+        and "tree-verify dispatch" in d.message
+        and "lengths" in d.message
+        for d in diags
+    ), [d.format() for d in diags]
+    # the unmodified engine stays clean
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "engine.py")
+    assert run_rules([str(clean)], ["dispatch-race"]) == [], [
+        d.format() for d in run_rules([str(clean)], ["dispatch-race"])
+    ]
+
+
+def test_seeded_tree_plan_mirror_read_is_caught(tmp_path):
+    """Re-introduce the bug the tree FX103 extension exists for: make
+    the tree commit walk a scheduler-side plan mirror instead of the
+    plan that traveled with the step."""
+    src_path = os.path.join(PACKAGE, "serving", "scheduler.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace(
+        "for slot in sorted(step.tree_plan):",
+        "for slot in sorted(self._last_tree_plan.tree_plan):",
+        1,
+    )
+    assert seeded != src, (
+        "scheduler.py's _commit_verify_tree no longer iterates "
+        "step.tree_plan — update this seeding recipe alongside the "
+        "refactor"
+    )
+    (tmp_path / "scheduler.py").write_text(seeded)
+    shutil.copy(
+        os.path.join(PACKAGE, "serving", "kv_cache.py"),
+        tmp_path / "kv_cache.py",
+    )
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    assert any(
+        d.rule_id == "FX103" and "tree_plan" in d.message for d in diags
+    ), [d.format() for d in diags]
+    # the unmodified pair stays clean
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "scheduler.py")
+    shutil.copy(
+        os.path.join(PACKAGE, "serving", "kv_cache.py"),
+        clean / "kv_cache.py",
+    )
+    assert run_rules([str(clean)], ["dispatch-race"]) == [], [
+        d.format() for d in run_rules([str(clean)], ["dispatch-race"])
+    ]
+
+
 # -- retrace-storm (FX2xx) ----------------------------------------------------
 
 

@@ -359,15 +359,18 @@ def test_optimistic_admission_never_fuses(lm):
     cache.check_invariants()
 
 
-def test_speculative_mode_never_fuses(lm):
-    """A verify's acceptance is host logic every iteration — spec
-    decode and fused windows are mutually exclusive by construction."""
+def test_speculative_mode_fuses_only_draft_free_iterations(lm):
+    """A verify's acceptance is host logic — an iteration carrying a
+    draft never fuses. But a dry proposer (no n-gram hit anywhere)
+    makes the iteration an ordinary decode step, and those DO fuse:
+    spec + multistep interleave fused windows with verify steps, and
+    the stream still matches plain decode exactly."""
     kw = dict(spec_draft="ngram", spec_k=3)
     _, _, _, plain = _run(lm, False, "slot", **kw)
     fsched, _, _, fused = _run(lm, True, "slot", **kw)
     _assert_parity(plain, fused)
     assert fsched.stats.verify_steps > 0
-    assert fsched.stats.multistep_windows == 0
+    assert fsched.stats.multistep_windows > 0
 
 
 # -- flags / config wiring ----------------------------------------------------
