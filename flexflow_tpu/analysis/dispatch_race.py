@@ -142,6 +142,23 @@ findings go to the baseline):
   bug) or leaks them forever. The ledger names are disjoint from
   FX106's on purpose — the two allocators can be linted in one pass
   without cross-talk.
+
+* **FX111** — journal-before-publish discipline for the durable
+  serving journal (``serving/journal.RequestJournal``): a mutation of
+  a request's ``generated`` token list (``.append``/``.extend``/
+  ``.insert`` call, subscript store/delete, or rebinding the
+  attribute) outside the blessed emit seam (``_emit`` — see
+  ``_EMIT_BLESSED``). ``_emit`` is the single point where a token
+  becomes stream-visible AND journal-noted (``journal.note``) in the
+  same breath; ``_end_iteration`` then flushes the noted run as a
+  commit record before the front door can publish it. A raw
+  ``req.generated.append(...)`` anywhere else produces a token the
+  journal never saw, so a crash-restart replays the journal and
+  resumes one token short — the recovered stream silently diverges
+  from what the client already received, breaking token-identical
+  resume. ``__init__`` is construction, not emission (same rationale
+  as FX106), and recovery code seeds ``generated`` via the Request
+  constructor for exactly that reason.
 """
 
 from __future__ import annotations
@@ -174,6 +191,8 @@ RULES = {
     "state, or reconcile reads window state off the step record",
     "FX110": "adapter-pool table/refcount write or free-heap mutation "
     "outside the blessed AdapterPool helpers",
+    "FX111": "stream-visible token commit (a 'generated' list "
+    "mutation) outside the blessed journal-noting emit seam",
 }
 
 #: the only functions allowed to write `block_tables` entries or touch
@@ -251,6 +270,20 @@ _ADAPTER_LEDGER_ATTRS = {
     "slot_adapter",
     "_adapter_refcounts",
 }
+
+#: the only functions allowed to mutate a request's `generated` token
+#: list (FX111): `_emit` pairs the append with `journal.note` so every
+#: stream-visible token is journal-noted before the front door can
+#: publish it. `__init__` is construction, not emission (same
+#: rationale as FX106) — recovery seeds `generated` through the
+#: Request constructor.
+_EMIT_BLESSED = {
+    "__init__",
+    "_emit",
+}
+
+#: list-method calls that grow or rewrite the `generated` token run
+_GENERATED_MUTATORS = {"append", "extend", "insert"}
 
 #: method calls that mutate a dict/set ledger in place
 _SWAP_MUTATING_METHODS = {
@@ -754,6 +787,61 @@ def _adapter_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
     return found
 
 
+def _journal_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
+    """(description, line, offender) for stream-visible token commits
+    outside the blessed emit seam (FX111): an ``.append``/``.extend``/
+    ``.insert`` call on a ``generated`` attribute, a subscript store or
+    ``del`` into one, or rebinding the attribute itself, anywhere but
+    ``_emit`` (see ``_EMIT_BLESSED``). Reads never match — the
+    scheduler's length checks, the front door's publish cursor, and the
+    journal's submit snapshot all read ``generated`` freely. Module-
+    level code reports under the pseudo-name '<module>'."""
+    found: List[Tuple[str, int, str]] = []
+
+    def is_generated_attr(node: ast.AST) -> bool:
+        return isinstance(node, ast.Attribute) and node.attr == "generated"
+
+    def mutation_of(node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _GENERATED_MUTATORS
+            and is_generated_attr(node.func.value)
+        ):
+            return f"calls .{node.func.attr}() on a 'generated' list"
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(t.elts)
+            elif isinstance(t, ast.Subscript) and is_generated_attr(
+                t.value
+            ):
+                return "stores into a 'generated' list slot"
+            elif is_generated_attr(t):
+                return "rebinds a 'generated' attribute"
+        return None
+
+    def visit(node: ast.AST, owner: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            owner = node.name
+            if owner in _EMIT_BLESSED:
+                return
+        what = mutation_of(node)
+        if what is not None:
+            found.append((what, node.lineno, owner))
+        for child in ast.iter_child_nodes(node):
+            visit(child, owner)
+
+    visit(tree, "<module>")
+    return found
+
+
 def _swap_violations(tree: ast.Module) -> List[Tuple[str, int, str]]:
     """(description, line, offender) for swap/eviction ledger mutations
     outside the blessed allocator helpers (FX107): subscript stores,
@@ -1107,6 +1195,23 @@ def run(trees: Dict[str, ast.Module]) -> List[Diagnostic]:
                     "weights) or leaks them forever; route through "
                     "load/unload/attach/detach or the "
                     "_install_adapter_page/_free_adapter_page seams",
+                )
+            )
+    for path, tree in trees.items():
+        for what, line, owner in _journal_violations(tree):
+            diags.append(
+                Diagnostic(
+                    "FX111",
+                    path,
+                    line,
+                    f"'{owner}' {what} outside the blessed emit seam — "
+                    "_emit pairs the append with journal.note so every "
+                    "stream-visible token is journal-noted before the "
+                    "front door publishes it; a raw mutation produces "
+                    "a token the journal never saw, so crash-restart "
+                    "replay resumes one token short and the recovered "
+                    "stream silently diverges from what the client "
+                    "already received",
                 )
             )
     for path, tree in trees.items():

@@ -212,6 +212,23 @@ class FFConfig:
     serve_adapters: int = 0
     serve_adapter_rank: int = 8
     serve_classes: str = ""
+    # durable serving (serving/journal.py): --journal attaches an
+    # append-only write-ahead request journal at that path (submit/
+    # commit/terminal records at the host-sync grain — a crash-restart
+    # rebuilds token-identical streams from it); --journal-fsync picks
+    # the durability point (commit|batch|off); --journal-snapshot-every
+    # N journals a KV snapshot of every running slot each N iterations
+    # (paged layout), priced at recovery against recompute.
+    # --door-max-pending bounds the front door's admission backlog
+    # (past it, per-class weighted-share shedding refuses with a
+    # retry-after hint); --breaker-threshold / --breaker-cooldown
+    # configure the per-replica circuit breaker.
+    serve_journal: str = ""
+    serve_journal_fsync: str = "batch"
+    serve_journal_snapshot_every: int = 0
+    serve_door_max_pending: int = 0
+    serve_breaker_threshold: int = 0
+    serve_breaker_cooldown: int = 8
 
     @property
     def num_devices(self) -> int:
@@ -399,6 +416,18 @@ class FFConfig:
                 cfg.serve_adapter_rank = int(take())
             elif a == "--classes":
                 cfg.serve_classes = take()
+            elif a == "--journal":
+                cfg.serve_journal = take()
+            elif a == "--journal-fsync":
+                cfg.serve_journal_fsync = take()
+            elif a == "--journal-snapshot-every":
+                cfg.serve_journal_snapshot_every = int(take())
+            elif a == "--door-max-pending":
+                cfg.serve_door_max_pending = int(take())
+            elif a == "--breaker-threshold":
+                cfg.serve_breaker_threshold = int(take())
+            elif a == "--breaker-cooldown":
+                cfg.serve_breaker_cooldown = int(take())
             # silently accept remaining legion-style flags with one value
             elif a.startswith("-ll:") or a.startswith("-lg:"):
                 take()

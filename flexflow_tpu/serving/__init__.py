@@ -23,7 +23,9 @@ SchedulerStats is a façade over the same registry the exporters read.
 
 from flexflow_tpu.serving.api import (
     ServeConfig,
+    build_journal,
     build_proposer,
+    build_restore_decider,
     build_scheduler,
     build_telemetry,
     generate,
@@ -40,6 +42,16 @@ from flexflow_tpu.serving.faults import (
     FaultInjector,
     FaultPlan,
     KernelFault,
+    ProcessCrash,
+)
+from flexflow_tpu.serving.journal import (
+    JournalCorrupt,
+    RecoveredRequest,
+    RecoveryState,
+    RequestJournal,
+    read_journal,
+    readmit,
+    recover_journal,
 )
 from flexflow_tpu.serving.kv_cache import (
     KVCache,
@@ -113,6 +125,16 @@ __all__ = [
     "FaultPlan",
     "KernelFault",
     "DraftFault",
+    "ProcessCrash",
+    "RequestJournal",
+    "JournalCorrupt",
+    "RecoveredRequest",
+    "RecoveryState",
+    "read_journal",
+    "readmit",
+    "recover_journal",
+    "build_journal",
+    "build_restore_decider",
     "PagePoolExhausted",
     "DraftProposer",
     "DraftTree",

@@ -167,6 +167,30 @@ class ServeConfig:
     adapters: int = 0
     adapter_rank: int = 8
     classes: str = ""
+    # durable serving (serving/journal.py). journal (--journal): path
+    # of the append-only write-ahead request journal ("" = off) —
+    # submit/commit/terminal records at the host-sync grain, the state
+    # a crash-restart rebuilds token-identical streams from.
+    # journal_fsync (--journal-fsync): "commit" fsyncs every record,
+    # "batch" once per host sync (default), "off" flushes but never
+    # fsyncs. journal_snapshot_every (--journal-snapshot-every): > 0
+    # journals a KV snapshot of every running slot each N iterations
+    # (paged layout), letting recovery restore KV over import_swap
+    # instead of recomputing when build_restore_decider prices the
+    # copy cheaper. door_max_pending (--door-max-pending): bounds the
+    # front door's admission backlog; past it, per-class weighted-share
+    # shedding refuses new streams with a retry_after hint (0 =
+    # unbounded). breaker_threshold / breaker_cooldown
+    # (--breaker-threshold / --breaker-cooldown): consecutive failed
+    # health probes before a replica's circuit breaker opens, and the
+    # router iterations it stays open before a half-open trial
+    # placement (threshold 0 = breaker off).
+    journal: str = ""
+    journal_fsync: str = "batch"
+    journal_snapshot_every: int = 0
+    door_max_pending: int = 0
+    breaker_threshold: int = 0
+    breaker_cooldown: int = 8
 
     def __post_init__(self):
         if self.scheduler not in _SCHEDULERS:
@@ -331,6 +355,38 @@ class ServeConfig:
             from flexflow_tpu.serving.tenancy.fairness import parse_classes
 
             parse_classes(self.classes)  # raises on malformed text
+        from flexflow_tpu.serving.journal import FSYNC_MODES
+
+        if self.journal_fsync not in FSYNC_MODES:
+            raise ValueError(
+                f"journal_fsync must be one of {FSYNC_MODES}, "
+                f"got {self.journal_fsync!r}"
+            )
+        if self.journal_snapshot_every < 0:
+            raise ValueError(
+                f"journal_snapshot_every must be >= 0 (0 = off), got "
+                f"{self.journal_snapshot_every}"
+            )
+        if self.journal_snapshot_every and self.kv_layout != "paged":
+            raise ValueError(
+                "journal_snapshot_every requires kv_layout='paged' "
+                "(snapshots ride snapshot_swap, which stages whole pages)"
+            )
+        if self.door_max_pending < 0:
+            raise ValueError(
+                f"door_max_pending must be >= 0 (0 = unbounded), got "
+                f"{self.door_max_pending}"
+            )
+        if self.breaker_threshold < 0:
+            raise ValueError(
+                f"breaker_threshold must be >= 0 (0 = breaker off), got "
+                f"{self.breaker_threshold}"
+            )
+        if self.breaker_cooldown < 1:
+            raise ValueError(
+                f"breaker_cooldown must be >= 1, got "
+                f"{self.breaker_cooldown}"
+            )
 
     @property
     def telemetry_requested(self) -> bool:
@@ -386,6 +442,12 @@ class ServeConfig:
             adapters=cfg.serve_adapters,
             adapter_rank=cfg.serve_adapter_rank,
             classes=cfg.serve_classes,
+            journal=cfg.serve_journal,
+            journal_fsync=cfg.serve_journal_fsync,
+            journal_snapshot_every=cfg.serve_journal_snapshot_every,
+            door_max_pending=cfg.serve_door_max_pending,
+            breaker_threshold=cfg.serve_breaker_threshold,
+            breaker_cooldown=cfg.serve_breaker_cooldown,
         )
 
 
@@ -428,6 +490,26 @@ def build_proposer(serve: ServeConfig, draft_model=None):
     )
 
 
+def build_journal(serve: ServeConfig, injector=None, telemetry=None):
+    """The RequestJournal a ServeConfig asks for, or None when
+    durability is off. `injector` threads the chaos harness's
+    journal-write-failure site through every append; `telemetry` keeps
+    the `serve_journal_bytes` gauge current."""
+    if not serve.journal:
+        return None
+    from flexflow_tpu.serving.journal import RequestJournal
+
+    registry = None
+    if telemetry is not None and getattr(telemetry, "enabled", False):
+        registry = telemetry.registry
+    return RequestJournal(
+        serve.journal,
+        fsync=serve.journal_fsync,
+        injector=injector,
+        registry=registry,
+    )
+
+
 def build_scheduler(
     model,
     serve: ServeConfig,
@@ -435,6 +517,7 @@ def build_scheduler(
     injector=None,
     telemetry=None,
     scheduler_cls=None,
+    journal=None,
 ):
     """(scheduler, engine, cache) wired to a compiled model — the pieces
     generate() uses, exposed for callers that drive iterations themselves
@@ -447,7 +530,9 @@ def build_scheduler(
     attached bundle is reachable as `scheduler.telemetry`.
     `scheduler_cls` overrides the scheduler class the config would pick
     (the disaggregated front door's prefill tier swaps in its
-    chunk-only loop this way); it must subclass a serving scheduler."""
+    chunk-only loop this way); it must subclass a serving scheduler.
+    `journal` attaches an already-open RequestJournal (a restart reuses
+    the one it recovered from); None builds one from `serve.journal`."""
     if (
         (serve.serve_mesh or serve.serve_hosts)
         and getattr(model, "serving_placement", None) is None
@@ -552,6 +637,12 @@ def build_scheduler(
             if classes and len(classes) > 1
             else None
         ),
+        journal=(
+            journal
+            if journal is not None
+            else build_journal(serve, injector=injector, telemetry=telemetry)
+        ),
+        journal_snapshot_every=serve.journal_snapshot_every,
     )
     return sched, engine, cache
 
@@ -653,6 +744,58 @@ def build_swap_decider(model):
             return True  # nothing to price against: prefer the copy
         swap_s = cm.swap_cost(2 * cache.swap_bytes_for(req.slot))
         return swap_s < cost.step_time
+
+    return decide
+
+
+def build_restore_decider(model):
+    """A `(cache, record, resume_len) -> bool` callable pricing a
+    crash-recovery KV restore against the recompute: True when adopting
+    the journal's snapshot record over the host link (one
+    CostModel.swap_cost copy of the record's staged bytes — the journal
+    read itself is off the serving path) beats recomputing `resume_len`
+    tokens of committed history (estimate_recompute_step's modeled step
+    time). The recovery twin of build_swap_decider: same cost model,
+    but the copy is 1x the record bytes (journal -> pool) where a
+    preemption swap pays 2x (out AND back in). Falls back to None —
+    journal.readmit then always restores an available snapshot — when
+    the model carries no compiled graph/cost-model context."""
+    try:
+        from flexflow_tpu.core.machine import MachineSpec
+        from flexflow_tpu.search.auto import estimate_recompute_step
+        from flexflow_tpu.search.cost_model import CostModel
+        from flexflow_tpu.search.machine_model import build_machine_model
+
+        graph = getattr(model, "graph", None)
+        cfg = getattr(model, "config", None)
+        if graph is None or cfg is None or not graph.nodes:
+            return None
+        spec = MachineSpec(
+            num_nodes=max(1, cfg.num_nodes),
+            chips_per_node=1,
+            chip=cfg.chip,
+        )
+        cm = CostModel(spec, machine_model=build_machine_model(cfg, spec))
+        placement = getattr(model, "serving_placement", None)
+        dp = max(1, int(getattr(placement, "dp", 1)))
+        tp = max(1, int(getattr(placement, "tp", 1)))
+    except Exception:
+        return None
+
+    def decide(cache, record, resume_len) -> bool:
+        cost = estimate_recompute_step(
+            graph,
+            cm,
+            dp,
+            tp,
+            int(resume_len),
+            page_size=getattr(cache.spec, "page_size", 0),
+            decode_kernel="dense",
+        )
+        if cost is None:
+            return True  # nothing to price against: prefer the copy
+        restore_s = cm.swap_cost(int(record.get("bytes", 0)))
+        return restore_s < cost.step_time
 
     return decide
 

@@ -32,7 +32,14 @@ threaded through the engine/scheduler seams that injects
   and re-join it on recovery);
 * **engine-replica failure** — a front-door engine replica dies
   mid-stream (the router must evacuate its requests to surviving
-  replicas with zero lost streams).
+  replicas with zero lost streams);
+* **process crash** — the whole engine process dies at an iteration
+  boundary, before or after the write-ahead journal's commit flush
+  (a restart must rebuild the live set from the journal and resume
+  every stream token-identically — serving/journal.py);
+* **journal write failure** — an append to the write-ahead journal
+  refuses (the journal must degrade to undurable, never block or
+  kill serving).
 
 Determinism discipline: every decision draws from a fresh
 `np.random.default_rng([seed, iteration, site, key])` stream, so the
@@ -56,6 +63,7 @@ __all__ = [
     "FaultError",
     "KernelFault",
     "DraftFault",
+    "ProcessCrash",
     "PagePoolExhausted",
     "FaultPlan",
     "FaultInjector",
@@ -76,6 +84,13 @@ class DraftFault(FaultError):
     degrading the iteration to plain decode)."""
 
 
+class ProcessCrash(FaultError):
+    """Injected engine-process death. Deliberately NOT absorbed by the
+    scheduler's per-step fault isolation: it propagates out of `step()`
+    to the harness, which abandons the scheduler object entirely and
+    restarts from the journal — the in-process stand-in for kill -9."""
+
+
 # deterministic sub-stream ids per injection site
 _SITE = {
     "spike": 1,
@@ -86,6 +101,8 @@ _SITE = {
     "swap_fail": 6,
     "host_down": 7,
     "replica_down": 8,
+    "crash": 9,
+    "journal_fail": 10,
 }
 
 
@@ -147,6 +164,18 @@ class FaultPlan:
     replica_down_iters: Mapping[int, int] = dataclasses.field(
         default_factory=dict
     )
+    # process crash: {iteration: phase} kills the engine process at that
+    # scheduler iteration. Phase "begin" crashes at the step boundary
+    # BEFORE any work (nothing new to lose); phase "commit" crashes at
+    # the END of the iteration AFTER tokens were emitted but BEFORE the
+    # journal's commit flush — the worst case: a whole fused multi-step
+    # window's or tree-verify round's accepted run is host-visible yet
+    # unjournaled, and the restart must recompute it token-identically.
+    crash_iters: Mapping[int, str] = dataclasses.field(default_factory=dict)
+    # journal write failure: at each listed iteration the NEXT journal
+    # append refuses (OSError stand-in); the journal must degrade, not
+    # raise into the serving path
+    journal_fail_iters: Sequence[int] = ()
 
     def __post_init__(self):
         for name in ("nan_rate", "kernel_rate", "draft_rate", "spike_rate",
@@ -172,6 +201,14 @@ class FaultPlan:
                     "replica_down_iters maps iterations >= 0 to replicas "
                     f">= 0, got {{{it}: {rep}}}"
                 )
+        for it, phase in self.crash_iters.items():
+            if int(it) < 0 or phase not in ("begin", "commit"):
+                raise ValueError(
+                    "crash_iters maps iterations >= 0 to phase "
+                    f"'begin'|'commit', got {{{it}: {phase!r}}}"
+                )
+        if any(int(it) < 0 for it in self.journal_fail_iters):
+            raise ValueError("journal_fail_iters must be iterations >= 0")
 
 
 class FaultInjector:
@@ -364,6 +401,30 @@ class FaultInjector:
             return None
         self.injected["replica_down"] += 1
         return int(rep)
+
+    def maybe_crash(self, phase: str) -> None:
+        """Raise ProcessCrash when the plan schedules this iteration's
+        `phase` boundary. The scheduler consults it at two seams:
+        "begin" right after `on_iteration` (the step dies before doing
+        work) and "commit" at the end of `_end_iteration` BEFORE the
+        journal's commit flush (the step's emitted tokens die
+        unjournaled — a crash mid-fused-window or mid-tree-verify, since
+        those reconcile exactly once per iteration)."""
+        if self.plan.crash_iters.get(self._iter) == phase:
+            self.injected["crash"] += 1
+            raise ProcessCrash(
+                f"injected process crash at iteration {self._iter} "
+                f"({phase} phase)"
+            )
+
+    def maybe_journal_fail(self) -> bool:
+        """Whether the next journal append fails. Consulted by
+        RequestJournal inside every `_append`; the journal answers a
+        True by entering degraded mode (undurable, still serving)."""
+        if self._iter in set(self.plan.journal_fail_iters):
+            self.injected["journal_fail"] += 1
+            return True
+        return False
 
     def maybe_draft_fault(self) -> None:
         plan = self.plan

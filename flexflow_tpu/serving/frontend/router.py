@@ -39,7 +39,11 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Sequence
 
-from flexflow_tpu.serving.scheduler import Request
+from flexflow_tpu.serving.scheduler import (
+    Request,
+    RequestStatus,
+    TERMINAL_STATUSES,
+)
 
 __all__ = ["EngineReplica", "ReplicaRouter"]
 
@@ -47,17 +51,26 @@ __all__ = ["EngineReplica", "ReplicaRouter"]
 class EngineReplica:
     """One in-process engine replica: scheduler + engine + cache built
     from a compiled model, plus the router's view of it (alive flag,
-    priced capacity ceiling)."""
+    priced capacity ceiling, circuit-breaker state)."""
 
-    def __init__(self, idx: int, model, serve, injector=None):
+    def __init__(self, idx: int, model, serve, injector=None, journal=None):
         from flexflow_tpu.serving.api import build_scheduler
 
         self.idx = int(idx)
         self.scheduler, self.engine, self.cache = build_scheduler(
-            model, serve, injector=injector
+            model, serve, injector=injector, journal=journal
         )
         self.alive = True
         self.capacity = self._priced_capacity(model, serve)
+        # circuit breaker (router-owned; see ReplicaRouter._probe):
+        # closed -> open after `breaker_threshold` consecutive failed
+        # health probes; open -> half_open after `breaker_cooldown`
+        # router iterations; half_open -> closed on the first healthy
+        # probe (or straight back to open on a failed one)
+        self.breaker_state = "closed"
+        self.breaker_failures = 0
+        self.breaker_open_until = -1
+        self._probe_faults = 0
 
     def _priced_capacity(self, model, serve) -> int:
         """The replica's in-flight ceiling from the capacity model —
@@ -111,11 +124,28 @@ class ReplicaRouter:
         serve,
         injector=None,
         telemetry=None,
+        journal=None,
+        health_probe=None,
     ):
         if not models:
             raise ValueError("ReplicaRouter needs at least one replica")
+        if telemetry is None:
+            from flexflow_tpu.serving.api import build_telemetry
+
+            telemetry = build_telemetry(serve)
+        self.telemetry = telemetry
+        if journal is None:
+            from flexflow_tpu.serving.api import build_journal
+
+            # ONE shared journal across replicas: the front door's rid
+            # space is router-wide, so one durable record stream is the
+            # recovery source of truth (per-replica journals would
+            # interleave the same rids across files)
+            journal = build_journal(serve, injector=injector,
+                                    telemetry=telemetry)
+        self.journal = journal
         self.replicas = [
-            EngineReplica(i, m, serve, injector=injector)
+            EngineReplica(i, m, serve, injector=injector, journal=journal)
             for i, m in enumerate(models)
         ]
         self.injector = injector
@@ -123,21 +153,46 @@ class ReplicaRouter:
         self.requests: Dict[int, Request] = {}
         self._iter = 0
         self.rerouted = 0
-        if telemetry is None:
-            from flexflow_tpu.serving.api import build_telemetry
+        # evacuation window (kill_replica): rid -> cancelled? while the
+        # dead replica's requests are between schedulers; a cancel
+        # landing here drops the rid from the re-submit batch
+        self._evacuating: Dict[int, bool] = {}
+        # requests finalized BY THE ROUTER (cancelled mid-evacuation —
+        # they belong to no scheduler's `finished` list)
+        self._orphans: List[Request] = []
+        # per-replica circuit breaker: after `breaker_threshold`
+        # consecutive failed health probes a replica stops taking
+        # placements for `breaker_cooldown` router iterations, then
+        # allows a half-open trial. The default probe is "no NEW
+        # scheduler step faults since the last probe"; `health_probe`
+        # overrides it with any `(replica) -> bool` (True = healthy).
+        self.breaker_threshold = int(getattr(serve, "breaker_threshold", 0))
+        self.breaker_cooldown = int(getattr(serve, "breaker_cooldown", 8))
+        self.health_probe = health_probe
+        self.breaker_opens = 0
 
-            telemetry = build_telemetry(serve)
-        self.telemetry = telemetry
+    @property
+    def classes(self):
+        """The priority-class table (replicas are built identically) —
+        the front door's shedding reads weights from it."""
+        return self.replicas[0].scheduler.classes
 
     # -- placement -----------------------------------------------------------
 
     def route(self, request: Request) -> EngineReplica:
         """Pick the placement: max prefix affinity, then max headroom,
         then lowest index (deterministic). Raises RuntimeError with no
-        alive replica — the router's analog of a full outage."""
+        alive replica — the router's analog of a full outage. Replicas
+        whose circuit breaker is OPEN are excluded (half-open ones take
+        the placement as their trial) — unless every alive replica is
+        open, in which case the alive set routes anyway: availability
+        over protection, the breaker must never manufacture an
+        outage."""
         alive = [r for r in self.replicas if r.alive]
         if not alive:
             raise RuntimeError("no alive replica to route to")
+        routable = [r for r in alive if r.breaker_state != "open"]
+        alive = routable or alive
         affinity = {
             r.idx: (
                 len(r.cache.match_prefix(request.prompt))
@@ -169,15 +224,27 @@ class ReplicaRouter:
 
     # -- scheduler-compatible surface ----------------------------------------
 
-    def submit(self, request: Request) -> bool:
+    def submit(self, request: Request, strict: bool = True) -> bool:
         target = self.route(request)
-        if not target.scheduler.submit(request):
+        self.requests[request.rid] = request
+        if not target.scheduler.submit(request, strict=strict):
+            # strict=False validation reject: the request finalized on
+            # `target` — record the owner so cancel/lookup see the
+            # terminal record instead of an unknown rid
+            self._owner[request.rid] = target
             return False
         self._owner[request.rid] = target
-        self.requests[request.rid] = request
         return True
 
     def cancel(self, rid: int) -> bool:
+        if rid in self._evacuating:
+            # the rid is mid-evacuation — owned by no scheduler while
+            # kill_replica re-places its batch. Mark it: the drain loop
+            # drops it from the re-submit batch and finalizes it
+            # CANCELLED at the router, so the cancel lands instead of
+            # silently missing the ownership gap.
+            self._evacuating[rid] = True
+            return True
         owner = self._owner.get(rid)
         return owner is not None and owner.scheduler.cancel(rid)
 
@@ -203,6 +270,51 @@ class ReplicaRouter:
         for rep in self.replicas:
             if rep.alive and rep.scheduler._work_pending():
                 rep.scheduler.step()
+        self._probe_breakers()
+
+    def _probe_breakers(self) -> None:
+        """One health probe per replica per router iteration, driving
+        the breaker state machine. Default probe: a replica is healthy
+        when its scheduler logged NO new step faults since the last
+        probe — a replica failing whole steps (kernel faults, engine
+        exceptions) trips open before it degrades every stream placed
+        on it, while per-request faults (a NaN retiring one rid) don't
+        count against it."""
+        if not self.breaker_threshold:
+            return
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            if self.health_probe is not None:
+                healthy = bool(self.health_probe(rep))
+            else:
+                faults = int(rep.scheduler.stats.step_faults)
+                healthy = faults <= rep._probe_faults
+                rep._probe_faults = faults
+            if rep.breaker_state == "open":
+                if self._iter >= rep.breaker_open_until:
+                    rep.breaker_state = "half_open"
+                continue
+            if healthy:
+                if rep.breaker_state == "half_open":
+                    rep.breaker_state = "closed"
+                rep.breaker_failures = 0
+                continue
+            rep.breaker_failures += 1
+            if (
+                rep.breaker_state == "half_open"
+                or rep.breaker_failures >= self.breaker_threshold
+            ):
+                rep.breaker_state = "open"
+                rep.breaker_open_until = self._iter + self.breaker_cooldown
+                rep.breaker_failures = 0
+                self.breaker_opens += 1
+                if self.telemetry is not None:
+                    self.telemetry.registry.counter(
+                        "serve_breaker_open_total",
+                        help="circuit-breaker open transitions, by replica",
+                        labels={"replica": str(rep.idx)},
+                    ).inc()
 
     def run(self, requests=None) -> List[Request]:
         for r in requests or ():
@@ -216,9 +328,30 @@ class ReplicaRouter:
         done = [
             req for rep in self.replicas for req in rep.scheduler.finished
         ]
+        done.extend(self._orphans)
         return sorted(done, key=lambda r: r.finish_time)
 
     # -- chaos: replica failure ----------------------------------------------
+
+    def _finalize_orphan(self, req: Request, status: str) -> None:
+        """Terminal transition for a request the router owns alone
+        (cancelled mid-evacuation: no scheduler will ever see it
+        again). Mirrors the scheduler's `_finalize` bookkeeping at the
+        router grain — the request lands in `finished` with a terminal
+        record, never silently vanishes."""
+        if req.status in TERMINAL_STATUSES:
+            return
+        req.status = status
+        req.finish_time = time.perf_counter()
+        req.log(status, "cancelled during evacuation")
+        self._owner.pop(req.rid, None)
+        self._orphans.append(req)
+        if self.telemetry is not None:
+            self.telemetry.registry.counter(
+                "serve_requests_total",
+                help="terminal request transitions by status",
+                labels={"status": status},
+            ).inc()
 
     def kill_replica(self, idx: int) -> List[Request]:
         """A replica dies mid-stream: evacuate every live request and
@@ -235,10 +368,25 @@ class ReplicaRouter:
         t0 = time.perf_counter()
         rep.alive = False
         moved = rep.scheduler.evacuate()
+        # evacuation window: between evacuate() and each re-submit the
+        # movers belong to NO scheduler — a cancel arriving now (client
+        # disconnect racing the kill) must not fall into the ownership
+        # gap. cancel() marks the rid here; the loop below drops marked
+        # rids from the re-submit batch and finalizes them CANCELLED at
+        # the router.
+        self._evacuating = {req.rid: False for req in moved}
         for req in moved:
+            if self._evacuating.get(req.rid):
+                self._finalize_orphan(req, RequestStatus.CANCELLED)
+                continue
             submit_time = req.submit_time
             target = self.route(req)
-            if not target.scheduler.submit(req):
+            # strict=False: a validation re-failure must finalize THIS
+            # request on the target (per-request FAILED) — a strict
+            # submit would raise and abort the drain loop, stranding
+            # the rest of the batch ownerless
+            if not target.scheduler.submit(req, strict=False):
+                self._owner[req.rid] = target
                 continue  # validation re-failure finalized it there
             req.submit_time = submit_time
             self._owner[req.rid] = target
@@ -250,6 +398,7 @@ class ReplicaRouter:
                     help="evacuated streams re-placed, by destination",
                     labels={"replica": str(target.idx)},
                 ).inc()
+        self._evacuating = {}
         if self.telemetry is not None:
             tele = self.telemetry
             tele.registry.counter(

@@ -53,13 +53,16 @@ __all__ = ["StreamEvent", "FrontDoor", "serve_tcp"]
 class StreamEvent:
     """One stream element: a committed token (`kind="token"`) or the
     terminal record (`kind="done"`, carrying the request's final
-    status and error)."""
+    status and error). A `done` with status "shed" is the overload
+    refusal — the request never entered the engine (or the journal, so
+    a retry is clean) and `retry_after_s` hints when to try again."""
 
     rid: int
     kind: str  # "token" | "done"
     token: Optional[int] = None
     status: Optional[str] = None
     error: Optional[str] = None
+    retry_after_s: Optional[float] = None
 
     def to_wire(self) -> Dict[str, object]:
         out: Dict[str, object] = {"event": self.kind, "rid": self.rid}
@@ -69,22 +72,167 @@ class StreamEvent:
             out["status"] = self.status
             if self.error:
                 out["error"] = self.error
+            if self.retry_after_s is not None:
+                out["retry_after_s"] = self.retry_after_s
         return out
 
 
 class FrontDoor:
-    """Async submit/stream/cancel over a scheduler-shaped backend."""
+    """Async submit/stream/cancel over a scheduler-shaped backend.
 
-    def __init__(self, backend, next_rid: int = 0):
+    Three durability/overload layers ride on the base adapter:
+
+    * **idempotent resubmission** — a submit carrying a client
+      `request_key` already seen (live, finished, or recovered from the
+      journal) re-attaches to the EXISTING stream instead of opening a
+      second one; a re-attached stream replays from token 0, so a
+      reconnecting client sees the full committed history exactly once;
+    * **crash-restart recovery** — constructed with a
+      `journal.RecoveryState`, the door re-admits the journal's live
+      set into the fresh backend with recompute cursors (or
+      journal-referenced KV snapshots when `restore_decider` prices
+      the copy under the recompute) and registers their streams, so
+      deterministic greedy decode resumes every stream
+      token-identically;
+    * **overload protection** — `max_pending > 0` bounds the
+      admission backlog; past it, a class whose pending count exceeds
+      its weighted share (`backend.classes` weights; equal shares
+      without them) is SHED: an immediate `done(status="shed")` event
+      with a `retry_after_s` hint, never submitted to the engine and
+      never journaled — so the retry is clean."""
+
+    def __init__(
+        self,
+        backend,
+        next_rid: int = 0,
+        max_pending: int = 0,
+        recovery=None,
+        restore_decider=None,
+    ):
         self.backend = backend
+        self.max_pending = int(max_pending)
         self._next_rid = int(next_rid)
         self._requests: Dict[int, Request] = {}
         self._queues: Dict[int, asyncio.Queue] = {}
         self._published: Dict[int, int] = {}
         self._done: set = set()  # rids whose terminal event is queued
         self._pump_task: Optional[asyncio.Task] = None
+        # idempotency: request_key -> rid for every stream this door
+        # (or the journal it recovered from) knows
+        self._keys: Dict[str, int] = {}
+        self.shed_total: Dict[str, int] = {}
+        self.recovered_requests = 0
+        self.replayed_tokens = 0
+        reg = self._registry()
+        if reg is not None:
+            from flexflow_tpu.telemetry.registry import (
+                register_durability_metrics,
+            )
+
+            classes = tuple(getattr(backend, "classes", None) or ())
+            register_durability_metrics(
+                reg,
+                classes=classes or ("default",),
+                replicas=range(len(getattr(backend, "replicas", ()) or ())),
+            )
+        if recovery is not None:
+            self._adopt(recovery, restore_decider)
+
+    def _registry(self):
+        tele = getattr(self.backend, "telemetry", None)
+        if tele is not None and getattr(tele, "enabled", False):
+            return tele.registry
+        return None
+
+    def _adopt(self, recovery, restore_decider=None) -> None:
+        """Rebuild the live set from a journal RecoveryState: re-admit
+        every recovered request into the fresh backend (recompute
+        cursor, or a priced KV-snapshot restore) and register its
+        stream with the published cursor at 0 — the committed run
+        replays to the (re)connecting client, and everything past it
+        comes from the resumed deterministic decode. Requests whose
+        committed run already satisfied their stopping rule come back
+        terminal without touching the engine (re-admitting them would
+        emit a duplicate token)."""
+        from flexflow_tpu.serving.journal import readmit
+
+        resubmitted, completed = readmit(
+            self.backend, recovery, decider=restore_decider
+        )
+        for req in resubmitted + completed:
+            self._requests[req.rid] = req
+            self._queues[req.rid] = asyncio.Queue()
+            self._published[req.rid] = 0
+            if req.request_key:
+                self._keys[req.request_key] = req.rid
+        # terminal verdicts stay dedupable: a retried submit with a
+        # finished request's key replays its recorded stream
+        for rid, term in recovery.terminals.items():
+            key = term.get("key")
+            if key and key not in self._keys:
+                self._keys[key] = rid
+                self._requests[rid] = Request(
+                    rid=rid,
+                    prompt=[0],
+                    generated=list(term.get("tokens", ())),
+                    status=term.get("status") or "failed",
+                    error=term.get("error"),
+                )
+        self._next_rid = max(self._next_rid, recovery.next_rid)
+        self.recovered_requests = len(resubmitted) + len(completed)
+        self.replayed_tokens = recovery.replayed_tokens
+        reg = self._registry()
+        if reg is not None:
+            reg.counter(
+                "serve_recovery_total",
+                help="journal crash-restart recoveries",
+            ).inc()
+            reg.counter(
+                "serve_replayed_tokens_total",
+                help="committed tokens re-adopted from the journal at "
+                "recovery",
+            ).inc(self.replayed_tokens)
+        self._publish()  # recovered-terminal streams publish immediately
 
     # -- client surface ------------------------------------------------------
+
+    def _pending_live(self) -> List[Request]:
+        return [
+            r
+            for rid, r in self._requests.items()
+            if rid not in self._done and r.status not in TERMINAL_STATUSES
+        ]
+
+    def _shed_check(self, priority_class: str) -> Optional[float]:
+        """None = admit; a retry_after_s hint = shed. Sheds only when
+        the TOTAL backlog is at the bound AND the class's own pending
+        count is at its weighted share — so under overload a
+        high-weight class keeps admitting while low-weight neighbors
+        back off (the per-class degradation order, same posture as the
+        scheduler's weighted-fair admission)."""
+        if not self.max_pending:
+            return None
+        pending = self._pending_live()
+        if len(pending) < self.max_pending:
+            return None
+        classes = getattr(self.backend, "classes", None)
+        if classes and priority_class in classes:
+            weights = {
+                name: float(getattr(spec, "weight", 1.0))
+                for name, spec in classes.items()
+            }
+            total = sum(weights.values()) or 1.0
+            share = max(
+                1,
+                int(self.max_pending * weights[priority_class] / total),
+            )
+            mine = sum(
+                1 for r in pending if (r.priority_class or "") == priority_class
+            )
+            if mine < share:
+                return None
+        excess = len(pending) - self.max_pending + 1
+        return round(0.05 * excess, 4)
 
     async def submit(
         self,
@@ -92,11 +240,61 @@ class FrontDoor:
         max_new_tokens: int = 16,
         eos_token: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        request_key: Optional[str] = None,
+        priority_class: str = "",
+        tenant: str = "",
+        adapter_id: int = -1,
     ) -> int:
         """Submit one request; returns its rid (stream with
         `stream(rid)`). A validation rejection surfaces on the stream
         as an immediate failed `done` event, not an exception here —
-        the wire protocol has one error path, not two."""
+        the wire protocol has one error path, not two. A duplicate
+        `request_key` re-attaches to the existing stream (replayed from
+        token 0); an overloaded door sheds with `done(status="shed")`
+        instead of admitting."""
+        if request_key:
+            hit = self._keys.get(request_key)
+            if hit is not None:
+                req = self._requests.get(hit)
+                if req is not None and hit not in self._queues:
+                    # the original consumer detached (reconnect): replay
+                    # the full committed stream on a fresh queue
+                    self._queues[hit] = asyncio.Queue()
+                    self._published[hit] = 0
+                    self._done.discard(hit)
+                    self._publish()
+                return hit
+        cls = priority_class or ""
+        hint = self._shed_check(cls)
+        if hint is not None:
+            rid = self._next_rid
+            self._next_rid += 1
+            queue = asyncio.Queue()
+            self._queues[rid] = queue
+            self._published[rid] = 0
+            queue.put_nowait(
+                StreamEvent(
+                    rid=rid,
+                    kind="done",
+                    status="shed",
+                    error=(
+                        f"admission backlog at bound "
+                        f"({self.max_pending} pending)"
+                    ),
+                    retry_after_s=hint,
+                )
+            )
+            self._done.add(rid)
+            label = cls or "default"
+            self.shed_total[label] = self.shed_total.get(label, 0) + 1
+            reg = self._registry()
+            if reg is not None:
+                reg.counter(
+                    "serve_shed_total",
+                    help="admissions shed at the front door, by class",
+                    labels={"class": label},
+                ).inc()
+            return rid
         rid = self._next_rid
         self._next_rid += 1
         req = Request(
@@ -105,10 +303,16 @@ class FrontDoor:
             max_new_tokens=max_new_tokens,
             eos_token=eos_token,
             deadline_s=deadline_s,
+            request_key=request_key,
+            priority_class=priority_class,
+            tenant=tenant,
+            adapter_id=adapter_id,
         )
         self._requests[rid] = req
         self._queues[rid] = asyncio.Queue()
         self._published[rid] = 0
+        if request_key:
+            self._keys[request_key] = rid
         self.backend.submit(req)
         self._ensure_pump()
         self._publish()  # a rejected submit is terminal already

@@ -1566,6 +1566,48 @@ class PagedKVCache:
         self.free(slot)
         return handle
 
+    def snapshot_swap(self, slot: int) -> Optional[Dict[str, object]]:
+        """Non-destructive sibling of `swap_out` for the write-ahead
+        journal: gather `slot`'s committed pages (K/V and int8 scales,
+        block-table order) into a host record shaped exactly like
+        `export_swap`'s — fingerprint included, so a RESTARTED engine's
+        `import_swap` can adopt it — WITHOUT freeing the slot, touching
+        the `_swapped` ledger, or spending swap budget (the record's
+        bytes live in the journal file, not in this cache's staging
+        buffers — hence no FX106/FX107 ledger discipline applies).
+        Returns None while an in-flight step could still write the
+        slot's pages: a snapshot of half-written rows would restore a
+        torn sequence."""
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        if self._inflight_depth > 0:
+            return None
+        sentinel = self.spec.num_pages
+        pages = [int(p) for p in self.block_tables[slot] if p != sentinel]
+        idx = np.asarray(pages, dtype=np.int32)
+        hk: Dict[int, np.ndarray] = {}
+        hv: Dict[int, np.ndarray] = {}
+        hks: Dict[int, np.ndarray] = {}
+        hvs: Dict[int, np.ndarray] = {}
+        for g in self.spec.layer_guids:
+            kp, vp = self.k[g], self.v[g]
+            hk[g] = np.asarray(kp[idx])
+            hv[g] = np.asarray(vp[idx])
+            if self.quantized:
+                ksp, vsp = self.k_scale[g], self.v_scale[g]
+                hks[g] = np.asarray(ksp[idx])
+                hvs[g] = np.asarray(vsp[idx])
+        return {
+            "k": hk,
+            "v": hv,
+            "k_scale": hks,
+            "v_scale": hvs,
+            "length": int(self.lengths[slot]),
+            "pages": len(pages),
+            "bytes": self.swap_bytes_for(slot),
+            "fingerprint": self._swap_fingerprint(),
+        }
+
     def swap_in(
         self,
         handle: int,

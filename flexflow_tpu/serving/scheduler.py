@@ -139,6 +139,10 @@ class Request:
     tenant: str = ""
     priority_class: str = ""
     adapter_id: int = -1
+    # durable serving: the CLIENT's idempotency key — a retried submit
+    # carrying the same key dedups against the journal/front-door
+    # instead of opening a second stream (None = no dedup)
+    request_key: Optional[str] = None
 
     generated: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
@@ -520,6 +524,8 @@ class _SchedulerBase:
         max_fused_steps: int = 8,
         classes=None,
         victim_pricer=None,
+        journal=None,
+        journal_snapshot_every: int = 0,
     ):
         self.engine = engine
         self.cache = engine.cache
@@ -588,6 +594,22 @@ class _SchedulerBase:
                     f"{engine.decode_kernel!r}"
                 )
         self.injector = injector
+        # durable serving (serving/journal.py): when a RequestJournal is
+        # attached, submit/commit/terminal records flow through it at
+        # the seams below — submit() at queue entry, _emit -> note
+        # (buffered), _end_iteration -> commit_pending (ONE commit
+        # record per request per host sync, so a fused window or
+        # tree-verify round journals its accepted run at its natural
+        # grain), _finalize -> terminal. The commit flush runs INSIDE
+        # step(), before any front door can observe the new tokens:
+        # journal-before-publish (fxlint FX111).
+        self.journal = journal
+        self.journal_snapshot_every = int(journal_snapshot_every)
+        if self.journal_snapshot_every < 0:
+            raise ValueError(
+                "journal_snapshot_every must be >= 0, got "
+                f"{journal_snapshot_every}"
+            )
         # KV swap-to-host: when on (paged layout only), a preemption
         # victim's committed pages ride the host link instead of being
         # recomputed — unless `swap_decider(cache, request)` (built from
@@ -692,6 +714,11 @@ class _SchedulerBase:
             request.submit_time = time.perf_counter()
             self._by_rid[request.rid] = request
             self.stats.submitted_requests += 1
+            if self.journal is not None:
+                # journal the submit BEFORE its terminal record so the
+                # strict=False reject leaves the same submit->terminal
+                # pair a served request would
+                self.journal.submitted(request)
             self._finalize(request, RequestStatus.FAILED, error=str(e))
             return False
         request.status = RequestStatus.QUEUED
@@ -700,6 +727,8 @@ class _SchedulerBase:
         request.log("submit")
         self._by_rid[request.rid] = request
         self.stats.submitted_requests += 1
+        if self.journal is not None:
+            self.journal.submitted(request)
         self.queue.append(request)
         return True
 
@@ -778,6 +807,10 @@ class _SchedulerBase:
         req.finish_iter = self._iter
         req.finish_time = time.perf_counter()
         req.log(status, error or "")
+        if self.journal is not None:
+            # terminal record (preceded inside finalize() by the rid's
+            # still-buffered commit run): no request ends undurably
+            self.journal.finalize(req.rid, status, error, self._iter)
         slot_host = (
             self.cache.host_of_slot(req.slot)
             if req.slot is not None
@@ -1218,6 +1251,11 @@ class _SchedulerBase:
         survivors. Not a preemption — the requests never failed, the
         hardware did — so `preemptions` budgets don't tick."""
         self._reclaim_inflight_pages()
+        if self.journal is not None:
+            # the movers' committed tokens must be durable under THIS
+            # journal before they re-enter another scheduler (which may
+            # journal elsewhere, or not at all)
+            self.journal.commit_pending(self._iter)
         moved: List[Request] = []
         for req in sorted(
             self.running.values(), key=lambda r: (r.admit_iter, r.rid)
@@ -1444,6 +1482,14 @@ class _SchedulerBase:
 
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(token)
+        if self.journal is not None:
+            # journal-before-publish (fxlint FX111): _emit is the ONLY
+            # writer of the stream-visible token list, and it notes
+            # every token into the journal's pending buffer here —
+            # _end_iteration flushes the buffer as commit records before
+            # step() returns, so no front door can publish a token the
+            # journal never saw
+            self.journal.note(req.rid, token)
         if len(req.generated) == 1:
             req.first_token_time = time.perf_counter()
             req.log("first_token")
@@ -2578,6 +2624,12 @@ class _SchedulerBase:
             self._iter_t0 = time.perf_counter()
         if self.injector is not None:
             self.injector.on_iteration(self._iter, self)
+            # chaos: process death at the step boundary, before any
+            # work — everything journaled so far survives, nothing new
+            # is at risk (serving/journal.py proves the restart)
+            crash = getattr(self.injector, "maybe_crash", None)
+            if crash is not None:
+                crash("begin")
         self._reap_deadlines()
 
     def _end_iteration(self) -> None:
@@ -2621,6 +2673,47 @@ class _SchedulerBase:
                 self._admit_drr.check_invariants(max_cost=1.0)
         if self._tele is not None:
             self._sample_telemetry()
+        if self.injector is not None:
+            # chaos: process death AFTER this iteration's tokens were
+            # emitted but BEFORE the journal's commit flush below — the
+            # worst case: a whole fused multi-step window's or
+            # tree-verify round's accepted run is host-visible yet
+            # unjournaled, and the restart must recompute it
+            # token-identically from the last durable cursor
+            crash = getattr(self.injector, "maybe_crash", None)
+            if crash is not None:
+                crash("commit")
+        if self.journal is not None:
+            # per-host-sync commit flush, INSIDE step(): the front
+            # door's publish runs after step() returns, so the journal
+            # always dominates the published cursor (FX111)
+            self.journal.commit_pending(self._iter)
+            if (
+                self.journal_snapshot_every
+                and self._iter % self.journal_snapshot_every == 0
+            ):
+                self._journal_snapshots()
+
+    def _journal_snapshots(self) -> None:
+        """Journal-referenced KV snapshots (paged layout only): every
+        `journal_snapshot_every` iterations, each running slot's
+        committed pages ride `snapshot_swap` into a snapshot record, so
+        a restart can restore KV over `import_swap` instead of
+        recomputing — priced at recovery by `build_restore_decider`.
+        `gen_len` stamps the committed-run length the snapshot is
+        consistent with; recovery honors the snapshot only while that
+        still matches the journal's committed cursor."""
+        snap = getattr(self.cache, "snapshot_swap", None)
+        if snap is None or self.journal.degraded:
+            return
+        for slot in sorted(self.running):
+            req = self.running[slot]
+            if self._prefill_pending(req):
+                continue  # mid-prefill KV is not a resumable cursor
+            rec = snap(slot)
+            if rec is not None:
+                rec["gen_len"] = len(req.generated)
+                self.journal.snapshot(req.rid, rec)
 
     def _sample_telemetry(self) -> None:
         """One iteration's telemetry sample: KV-pool gauges straight

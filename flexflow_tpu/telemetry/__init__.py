@@ -37,11 +37,13 @@ from typing import Optional
 
 from flexflow_tpu.telemetry.registry import (
     DEFAULT_LATENCY_BUCKETS_MS,
+    DURABILITY_METRICS,
     Counter,
     Gauge,
     Histogram,
     JsonlWriter,
     MetricsRegistry,
+    register_durability_metrics,
     series_name,
 )
 from flexflow_tpu.telemetry.search_trace import SearchTrace
@@ -57,6 +59,7 @@ from flexflow_tpu.telemetry.validate import (
     ValidationError,
     check_schema,
     load_schema,
+    validate_durability_metrics,
     validate_metrics_jsonl,
     validate_metrics_jsonl_file,
     validate_metrics_text,
@@ -78,6 +81,9 @@ __all__ = [
     "JsonlWriter",
     "series_name",
     "DEFAULT_LATENCY_BUCKETS_MS",
+    "DURABILITY_METRICS",
+    "register_durability_metrics",
+    "validate_durability_metrics",
     "Tracer",
     "SLOMonitor",
     "RollingWindow",

@@ -57,6 +57,8 @@ __all__ = [
     "MetricsRegistry",
     "JsonlWriter",
     "DEFAULT_LATENCY_BUCKETS_MS",
+    "DURABILITY_METRICS",
+    "register_durability_metrics",
     "series_name",
 ]
 
@@ -70,6 +72,70 @@ DEFAULT_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
     0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
     1000, 2500, 5000, 10000, 30000, 60000,
 )
+
+
+#: the durability-layer metric catalog (journal / recovery / overload
+#: protection — docs/resilience.md): name -> (kind, help, label key or
+#: None). A checked-in contract rather than ad-hoc instrument-point
+#: names, because recovery metrics are exactly the ones read AFTER a
+#: crash, when nobody can ask the dead process what it called them;
+#: `register_durability_metrics` pre-creates them so a freshly
+#: restarted server's exposition shows explicit zeros, and
+#: `validate.validate_durability_metrics` gates any sample row against
+#: this table.
+DURABILITY_METRICS: Dict[str, Tuple[str, str, Optional[str]]] = {
+    "serve_recovery_total": (
+        "counter",
+        "crash-restart recoveries: journals replayed into a fresh engine",
+        None,
+    ),
+    "serve_replayed_tokens_total": (
+        "counter",
+        "journal-committed tokens re-seeded into recovered requests",
+        None,
+    ),
+    "serve_journal_bytes": (
+        "gauge",
+        "bytes appended to the write-ahead request journal",
+        None,
+    ),
+    "serve_shed_total": (
+        "counter",
+        "requests shed at admission by the overload guard",
+        "class",
+    ),
+    "serve_breaker_open_total": (
+        "counter",
+        "per-replica circuit-breaker open transitions",
+        "replica",
+    ),
+}
+
+
+def register_durability_metrics(
+    registry: "MetricsRegistry",
+    classes: Sequence[str] = ("default",),
+    replicas: Sequence[object] = (),
+) -> Dict[str, object]:
+    """Pre-create every durability series in `registry` so a restarted
+    server's first scrape shows explicit zeros (absent-vs-zero is the
+    difference between 'no recovery happened' and 'nobody instrumented
+    it'). Unlabelled metrics register bare; the labelled families get
+    one series per entry of `classes` / `replicas`. Returns the
+    created instances keyed by their flat series name."""
+    out: Dict[str, object] = {}
+    for name, (kind, help, label) in DURABILITY_METRICS.items():
+        make = registry.counter if kind == "counter" else registry.gauge
+        if label is None:
+            out[name] = make(name, help=help)
+        else:
+            values = classes if label == "class" else replicas
+            for v in values:
+                labels = {label: str(v)}
+                out[series_name(name, labels)] = make(
+                    name, help=help, labels=labels
+                )
+    return out
 
 
 def series_name(name: str, labels: Optional[Mapping[str, str]]) -> str:

@@ -49,6 +49,7 @@ __all__ = [
     "validate_metrics_text",
     "validate_search_trace",
     "validate_search_trace_file",
+    "validate_durability_metrics",
 ]
 
 SCHEMA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "schemas")
@@ -227,6 +228,76 @@ def validate_metrics_jsonl(
 def validate_metrics_jsonl_file(path: str, errors: str = "raise") -> List[str]:
     with open(path) as f:
         return validate_metrics_jsonl(f.readlines(), errors=errors)
+
+
+# -- durability metric contract -----------------------------------------------
+
+_SERIES_KEY_RE = re.compile(
+    r'^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?$'
+)
+
+
+def validate_durability_metrics(
+    sample: Mapping, errors: str = "raise", require_all: bool = False
+) -> List[str]:
+    """The durability-layer series in a flat sample row (a
+    `MetricsRegistry.sample()` dict or a parsed `--metrics-jsonl` row)
+    match the checked-in `registry.DURABILITY_METRICS` catalog:
+    unlabelled metrics appear bare, the labelled families
+    (`serve_shed_total{class=...}`, `serve_breaker_open_total
+    {replica=...}`) carry exactly their catalog label key, and every
+    value is a non-negative number (counters and byte gauges both only
+    accumulate). `require_all=True` additionally demands every
+    unlabelled series be present — the post-`register_durability_
+    metrics` contract, where a fresh server exposes explicit zeros so
+    'no recovery happened' is distinguishable from 'nobody
+    instrumented it'."""
+    from flexflow_tpu.telemetry.registry import DURABILITY_METRICS
+
+    errs: List[str] = []
+    seen = set()
+    for key, value in sample.items():
+        m = _SERIES_KEY_RE.match(key)
+        if m is None:
+            continue
+        name = m.group("name")
+        if name not in DURABILITY_METRICS:
+            continue
+        seen.add(name)
+        _kind, _help, label = DURABILITY_METRICS[name]
+        labels = m.group("labels")
+        if label is None and labels is not None:
+            errs.append(
+                f"{key!r}: {name} is unlabelled in the durability "
+                f"catalog but the series carries labels"
+            )
+        elif label is not None:
+            keys = [
+                p.split("=", 1)[0]
+                for p in (labels.split(",") if labels else [])
+            ]
+            if keys != [label]:
+                errs.append(
+                    f"{key!r}: {name} must carry exactly the "
+                    f"{label!r} label, got {keys}"
+                )
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            errs.append(f"{key!r}: non-numeric value {value!r}")
+        elif value < 0:
+            errs.append(
+                f"{key!r}: negative value {value} — durability "
+                "series only accumulate"
+            )
+    if require_all:
+        for name, (_k, _h, label) in DURABILITY_METRICS.items():
+            if label is None and name not in seen:
+                errs.append(
+                    f"missing durability series {name!r} — "
+                    "register_durability_metrics pre-creates it so a "
+                    "fresh server exposes an explicit zero"
+                )
+    return _raise_or_return(errs, errors)
 
 
 # -- search trace JSONL validation --------------------------------------------

@@ -1,0 +1,31 @@
+"""Seeded FX111 violations: a request's `generated` token list
+mutated outside the blessed `_emit` seam. `_emit` pairs the append
+with `journal.note`, and `_end_iteration` flushes the noted run as a
+commit record BEFORE the front door publishes, so a raw mutation
+produces a stream-visible token the write-ahead journal never saw —
+crash-restart replay then resumes one token short and the recovered
+stream silently diverges from what the client already received."""
+
+
+class RogueScheduler:
+    def backdoor_emit(self, req, token):
+        # stream-visible token with no journal.note: lost on crash
+        req.generated.append(token)  # FX111
+
+    def splice_draft(self, req, accepted):
+        # a whole accepted draft run committed past the journal
+        req.generated.extend(accepted)  # FX111
+
+    def stuff_prefix(self, req, bos):
+        req.generated.insert(0, bos)  # FX111
+
+    def rewrite_tail(self, req, token):
+        # rewriting history the journal (and the client) already has
+        req.generated[-1] = token  # FX111
+
+    def truncate(self, req):
+        del req.generated[-1]  # FX111
+
+    def replace_run(self, req, tokens):
+        # rebinding discards the journaled run wholesale
+        req.generated = list(tokens)  # FX111

@@ -1,0 +1,44 @@
+"""Blessed / innocent idioms FX111 must stay silent on: the `_emit`
+seam itself (append + journal.note in the same breath), `__init__`
+construction, constructor seeding during recovery, reads of the
+`generated` run (publish cursors, length checks, submit snapshots),
+and same-named locals that are not a request attribute."""
+
+
+class Request:
+    def __init__(self, prompt):
+        self.prompt = prompt
+        # construction, not emission — the blessed-__init__ rationale
+        self.generated = []
+
+
+class Scheduler:
+    def __init__(self, journal):
+        self.journal = journal
+
+    def _emit(self, req, token):
+        # THE seam: token becomes stream-visible and journal-noted
+        # in the same breath
+        req.generated.append(token)
+        self.journal.note(req.rid, token)
+
+    def publish_cursor(self, req, cursor):
+        # reads never match: the front door slices the fresh suffix
+        return req.generated[cursor:]
+
+    def is_done(self, req, limit):
+        return len(req.generated) >= limit and req.generated[-1] >= 0
+
+    def submit_snapshot(self, req):
+        # the journal's submit record copies the committed run (a read)
+        return {"rid": req.rid, "committed": list(req.generated)}
+
+
+def readmit(scheduler, committed):
+    # recovery seeds the run through the constructor, then appends to
+    # a LOCAL list — no request attribute involved
+    generated = list(committed)
+    generated.append(0)
+    req = Request(prompt=[0])
+    scheduler.submit(req)
+    return generated

@@ -304,6 +304,58 @@ def test_seeded_adapter_bypass_is_caught(tmp_path):
     ]
 
 
+def test_journal_emit_discipline_fixtures():
+    """FX111: `generated` token-list mutations outside the blessed
+    `_emit` seam — the discipline that keeps every stream-visible
+    token journal-noted before the front door publishes it, so a
+    crash-restart replays to exactly the tokens the client saw."""
+    diags = _by_file(
+        run_rules([os.path.join(FIXTURES, "journal")], ["dispatch-race"])
+    )
+    # backdoor append, draft-run extend, prefix insert, tail rewrite,
+    # tail delete, wholesale rebind
+    assert diags.get("bad.py", []).count("FX111") == 6, diags
+    # the _emit seam, __init__ construction, constructor-seeded
+    # recovery, publish-cursor/length reads, same-named locals silent
+    assert "good.py" not in diags
+
+
+def test_seeded_journal_bypass_is_caught(tmp_path):
+    """Re-introduce the bug FX111 exists for: demote the emit seam to
+    an unblessed name so its `generated` append becomes a raw
+    stream-visible commit the journal never notes — fxlint must flag
+    it; the unmodified scheduler stays clean (re-proved over the real
+    package by test_dispatch_race_clean_on_head)."""
+    src_path = os.path.join(PACKAGE, "serving", "scheduler.py")
+    with open(src_path) as f:
+        src = f.read()
+    seeded = src.replace("def _emit(", "def rogue_emit(", 1)
+    assert seeded != src, (
+        "scheduler.py no longer defines _emit — update this test AND "
+        "the FX111 blessed set together"
+    )
+    (tmp_path / "scheduler.py").write_text(seeded)
+    shutil.copy(
+        os.path.join(PACKAGE, "serving", "kv_cache.py"),
+        tmp_path / "kv_cache.py",
+    )
+    diags = run_rules([str(tmp_path)], ["dispatch-race"])
+    assert any(
+        d.rule_id == "FX111" and "generated" in d.message for d in diags
+    ), [d.format() for d in diags]
+    # the unmodified pair stays clean
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    shutil.copy(src_path, clean / "scheduler.py")
+    shutil.copy(
+        os.path.join(PACKAGE, "serving", "kv_cache.py"),
+        clean / "kv_cache.py",
+    )
+    assert run_rules([str(clean)], ["dispatch-race"]) == [], [
+        d.format() for d in run_rules([str(clean)], ["dispatch-race"])
+    ]
+
+
 def test_handoff_lifetime_fixtures():
     """FX108: cross-engine swap handles/records consumed more than once
     (the staged copy is a MOVE token — export pops the source ledger,

@@ -640,6 +640,181 @@ def test_faultplan_validation():
         FaultPlan(spike_s=-0.1)
 
 
+# -- chaos matrix: every injector site inside fused windows / tree rounds -----
+#
+# PR 17 (decode_multistep) and PR 19 (spec_branch tree verify) moved
+# multiple logical decode steps inside one host sync. Every injector
+# site must keep the single-victim contract when its iteration lands
+# inside that regime, and the window/round boundary reconcile must
+# keep unaffected streams token-identical. Two sites CANNOT land
+# inside an open fused window by construction — swap_fail and
+# host_down need preemption (optimistic admission), and
+# `_fusable_steps` holds fusing to 1 whenever admission is optimistic
+# — so those two are driven through the tree-verify matrix (which has
+# no such gate) instead.
+
+
+def _chaos_run(lm, plan, seed=0, n=4, max_new=10, reqs=None, **cfg_kw):
+    inj = FaultInjector(plan, seed=seed)
+    sched, engine, cache = build_scheduler(
+        lm, ServeConfig(max_seqs=4, max_seq_len=32, **cfg_kw),
+        injector=inj,
+    )
+    for r in (reqs if reqs is not None else _requests(n, max_new=max_new)):
+        sched.submit(r, strict=False)
+    while sched.queue or sched.running:
+        sched.step()
+        if getattr(cache, "paged", False):
+            _check_allocator_invariants(cache, injector=inj)
+    return inj, sched, engine, cache, {r.rid: r for r in sched.finished}
+
+
+_MULTISTEP_CFG = dict(kv_layout="paged", kv_page_size=8,
+                      decode_multistep=True, max_fused_steps=4)
+
+
+@pytest.mark.parametrize("site", ["spike", "cancel", "nan", "kernel",
+                                  "steal"])
+def test_chaos_site_inside_multistep_window(lm, site):
+    """Each injectable site fired at an iteration the fused-window
+    regime covers: exactly the planned victim is touched, every other
+    stream is token-identical to the fault-free run, and windows
+    actually fused around the fault."""
+    base = _baseline(lm, layout="paged", max_new=10,
+                     decode_kernel="dense")
+    plan = {
+        "spike": FaultPlan(spike_rate=1.0, spike_s=0.0005),
+        "cancel": FaultPlan(cancel_iters={3: [1]}),
+        "nan": FaultPlan(nan_iters={3: [1]}),
+        "kernel": FaultPlan(kernel_iters=(3,)),
+        "steal": FaultPlan(steal_iters=(3,), steal_pages=64,
+                           steal_hold=50),
+    }[site]
+    inj, sched, engine, cache, st = _chaos_run(
+        lm, plan,
+        decode_kernel="pallas" if site == "kernel" else "dense",
+        **_MULTISTEP_CFG,
+    )
+    # the regime was real: windows fused, and the site actually fired
+    assert sched.stats.multistep_windows > 0
+    assert sum(inj.summary().values()) > 0
+    # nothing lost: every rid terminal exactly once
+    assert set(st) == set(range(4))
+    assert all(r.status in TERMINAL_STATUSES for r in st.values())
+    assert (sched.stats.terminal_requests
+            == sched.stats.submitted_requests == 4)
+    if site == "cancel":
+        assert st[1].status == RequestStatus.CANCELLED
+        # window-boundary reconcile: the cancelled stream is a clean
+        # PREFIX of the fault-free stream — nothing duplicated or
+        # invented inside the open window
+        assert st[1].generated == base[1][: len(st[1].generated)]
+    elif site == "nan":
+        assert st[1].status == RequestStatus.FAILED
+        assert "non-finite" in st[1].error
+    elif site == "kernel":
+        assert engine.kernel_fallbacks == 1
+        assert engine.decode_kernel == "dense"
+    elif site == "steal":
+        failed = [r for r in st.values()
+                  if r.status == RequestStatus.FAILED]
+        assert failed and all("exhaust" in r.error for r in failed)
+        inj.release_stolen_pages(cache)
+    # the single-victim contract: untouched streams token-identical
+    untouched = [r for r in st.values() if r.ok and r.preemptions == 0]
+    assert untouched
+    for r in untouched:
+        assert r.generated == base[r.rid], r.rid
+    _check_allocator_invariants(cache)
+
+
+_TREE_CFG = dict(kv_layout="paged", kv_page_size=8, spec_draft="ngram",
+                 spec_k=3, spec_branch=2)
+
+
+@pytest.mark.parametrize("site", ["spike", "cancel", "nan", "kernel",
+                                  "draft", "steal"])
+def test_chaos_site_inside_tree_verify_round(lm, site):
+    """The same per-site contract with token-tree verification live:
+    a fault landing on a tree-verify iteration touches its one victim,
+    degrades the round to plain decode (draft), or falls back the
+    kernel — and every unaffected stream still equals the fault-free
+    greedy run (tree speculation is exact, so the baseline is the
+    plain stream)."""
+    base = _baseline(lm, layout="paged", max_new=10,
+                     decode_kernel="dense")
+    plan = {
+        "spike": FaultPlan(spike_rate=1.0, spike_s=0.0005),
+        "cancel": FaultPlan(cancel_iters={3: [1]}),
+        "nan": FaultPlan(nan_iters={2: [2]}),
+        "kernel": FaultPlan(kernel_iters=(3,)),
+        "draft": FaultPlan(draft_iters=(2, 3)),
+        "steal": FaultPlan(steal_iters=(3,), steal_pages=64,
+                           steal_hold=50),
+    }[site]
+    inj, sched, engine, cache, st = _chaos_run(
+        lm, plan,
+        decode_kernel="pallas" if site == "kernel" else "dense",
+        **_TREE_CFG,
+    )
+    assert sched.stats.tree_verify_steps > 0
+    assert sum(inj.summary().values()) > 0
+    assert set(st) == set(range(4))
+    assert all(r.status in TERMINAL_STATUSES for r in st.values())
+    if site == "cancel":
+        assert st[1].status == RequestStatus.CANCELLED
+        assert st[1].generated == base[1][: len(st[1].generated)]
+    elif site == "nan":
+        assert st[2].status == RequestStatus.FAILED
+    elif site == "kernel":
+        assert engine.kernel_fallbacks == 1
+    elif site == "draft":
+        assert sched.stats.draft_faults == 2
+    elif site == "steal":
+        failed = [r for r in st.values()
+                  if r.status == RequestStatus.FAILED]
+        assert failed
+        inj.release_stolen_pages(cache)
+    untouched = [r for r in st.values() if r.ok and r.preemptions == 0]
+    assert untouched
+    for r in untouched:
+        assert r.generated == base[r.rid], r.rid
+    _check_allocator_invariants(cache)
+
+
+def test_swap_fail_inside_tree_verify_round(lm):
+    """The two preemption-coupled sites (swap_out failure, and — by
+    the same recompute fallback — a downed swap host) inside the
+    tree-verify regime: optimistic admission over an overcommitted
+    pool forces swap-out preemption mid-speculation; the injected
+    swap failure downgrades victims to recompute, and every request
+    still finishes at full length."""
+    plan = FaultPlan(swap_fail_iters=(3, 4, 5))
+    inj = FaultInjector(plan, seed=0)
+    sched, _, cache = build_scheduler(
+        lm,
+        ServeConfig(max_seqs=4, max_seq_len=32, kv_layout="paged",
+                    kv_page_size=8, kv_pages=8, admission="optimistic",
+                    max_preemptions=8, kv_swap=True,
+                    spec_draft="ngram", spec_k=3, spec_branch=2),
+        injector=inj,
+    )
+    for r in _requests(4, max_new=16):
+        sched.submit(r)
+    while sched.queue or sched.running:
+        sched.step()
+        _check_allocator_invariants(cache, injector=inj)
+    st = {r.rid: r for r in sched.finished}
+    assert sched.stats.tree_verify_steps > 0
+    assert sched.stats.preemptions > 0
+    assert set(st) == set(range(4))
+    for r in st.values():
+        assert r.status == RequestStatus.FINISHED
+        assert len(r.generated) == 16
+    assert cache.pages_in_use == 0
+    _check_allocator_invariants(cache)
+
+
 # -- search-side: reserve vs optimistic capacity + recompute cost -------------
 
 
